@@ -1,0 +1,30 @@
+"""ibamr_tpu — a TPU-native immersed-boundary / incompressible-flow framework.
+
+A from-scratch JAX/XLA/Pallas rebuild of the capabilities of the reference
+C++/Fortran/MPI framework (huahbo/IBAMR): immersed-boundary fluid-structure
+interaction on staggered Cartesian grids, designed TPU-first:
+
+- Static-shape functional state pytrees; one jitted ``step: State -> State``.
+- Staggered (MAC) grid vector calculus as fused XLA stencils (jnp.roll),
+  which the SPMD partitioner lowers to halo exchanges over ICI when sharded.
+- FFT-based Poisson/Helmholtz solves for the periodic acceptance configs;
+  matrix-free Krylov (CG/GMRES) for everything else.
+- Lagrangian markers as fixed-capacity structure-of-arrays; spread/interp
+  with regularized delta kernels as vmapped gather/scatter.
+- Multi-device scaling via ``jax.sharding.Mesh`` + ``NamedSharding``; no MPI.
+
+Reference parity map (SURVEY.md section numbers):
+  utils.input_db      <- SAMRAI tbox::Database input parser        [SURVEY §5.6]
+  utils.gridfunctions <- muParserCartGridFunction (T12)            [SURVEY §2.1]
+  utils.timers        <- TimerManager / IBTK_TIMER macros (§5.1)
+  utils.checkpoint    <- RestartManager (§5.4)
+  grid, ops.stencils  <- SAMRAI patch data + HierarchyMathOps (T4)
+  solvers             <- IBTK solver infra (T6-T8) + StaggeredStokes (P3)
+  ops.delta, ops.interaction <- LEInteractor (T2), LDataManager (T1)
+  ops.forces, io.structures  <- IBStandardForceGen (P11), IBStandardInitializer (P10)
+  integrators         <- HierarchyIntegrator (T13), INSStaggered (P2),
+                         IBExplicit (P8), IBMethod (P9), AdvDiff (P19)
+  parallel            <- SAMRAI load balancer / schedules as shardings (§2.4)
+"""
+
+__version__ = "0.1.0"

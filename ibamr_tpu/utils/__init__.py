@@ -1,0 +1,14 @@
+from ibamr_tpu.utils.input_db import InputDatabase, parse_input_file, parse_input_string
+from ibamr_tpu.utils.gridfunctions import CartGridFunction
+from ibamr_tpu.utils.timers import TimerManager, timer
+from ibamr_tpu.utils.metrics import MetricsLogger
+
+__all__ = [
+    "InputDatabase",
+    "parse_input_file",
+    "parse_input_string",
+    "CartGridFunction",
+    "TimerManager",
+    "timer",
+    "MetricsLogger",
+]

@@ -1,0 +1,132 @@
+"""Checkpoint / restart of simulation state pytrees.
+
+Reference parity: ``SAMRAI::tbox::RestartManager`` + per-object
+``putToDatabase`` serialization to per-rank HDF5 (SURVEY.md §5.4). TPU-first
+redesign: the ENTIRE simulation state is one functional pytree (grid arrays,
+marker arrays, integrator scalars), so checkpointing is a single pytree
+serialization — no object graph walking. Restarting on a different device
+mesh re-shards on load (the analog of the reference's restart-on-different-
+rank-count support).
+
+Format: one ``.npz`` per checkpoint holding every leaf keyed by its pytree
+path, plus a small JSON sidecar for metadata. No pickle anywhere.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+
+def _esc(s: str) -> str:
+    # escape the path separator so dict keys containing '/' cannot collide
+    # with genuine nesting ({"a/b": x} vs {"a": {"b": y}})
+    return s.replace("%", "%25").replace("/", "%2F")
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if isinstance(p, jax.tree_util.DictKey):
+            parts.append(_esc(str(p.key)))
+        elif isinstance(p, jax.tree_util.SequenceKey):
+            parts.append(str(p.idx))
+        elif isinstance(p, jax.tree_util.GetAttrKey):
+            parts.append(_esc(str(p.name)))
+        elif isinstance(p, jax.tree_util.FlattenedIndexKey):
+            parts.append(_esc(str(p.key)))
+        else:
+            parts.append(re.sub(r"[^\w]", "", str(p)))
+    return "/".join(parts) if parts else "_root"
+
+
+def save_checkpoint(directory: str, state: Any, step: int,
+                    metadata: Optional[Dict[str, Any]] = None,
+                    keep: int = 3) -> str:
+    """Serialize a state pytree. Returns the checkpoint file path."""
+    os.makedirs(directory, exist_ok=True)
+    leaves = jax.tree_util.tree_flatten_with_path(state)[0]
+    arrays = {}
+    for path, leaf in leaves:
+        key = _path_str(path)
+        arrays[key] = np.asarray(jax.device_get(leaf))
+    fname = os.path.join(directory, f"restore.{step:08d}.npz")
+    np.savez(fname, **arrays)
+    meta = dict(metadata or {})
+    meta["step"] = step
+    with open(fname.replace(".npz", ".json"), "w") as f:
+        json.dump(meta, f)
+    _prune(directory, keep)
+    return fname
+
+
+def _prune(directory: str, keep: int) -> None:
+    ckpts = sorted(
+        f for f in os.listdir(directory)
+        if f.startswith("restore.") and f.endswith(".npz"))
+    for f in ckpts[:-keep] if keep > 0 else []:
+        os.remove(os.path.join(directory, f))
+        side = os.path.join(directory, f.replace(".npz", ".json"))
+        if os.path.exists(side):
+            os.remove(side)
+
+
+def latest_step(directory: str) -> Optional[int]:
+    if not os.path.isdir(directory):
+        return None
+    steps = []
+    for f in os.listdir(directory):
+        m = re.fullmatch(r"restore\.(\d+)\.npz", f)
+        if m:
+            steps.append(int(m.group(1)))
+    return max(steps) if steps else None
+
+
+def restore_checkpoint(directory: str, template: Any,
+                       step: Optional[int] = None,
+                       sharding_fn=None):
+    """Restore a state pytree.
+
+    ``template`` is a pytree with the same structure (e.g. a freshly
+    initialized state); its leaves supply structure, dtype and (if the
+    stored array disagrees in dtype) the cast target. ``sharding_fn``, if
+    given, maps (path_str, np_array) -> jax.Array for re-sharding onto a
+    possibly different device mesh.
+
+    Returns (state, step, metadata).
+    """
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {directory}")
+    fname = os.path.join(directory, f"restore.{step:08d}.npz")
+    data = np.load(fname)
+    meta_path = fname.replace(".npz", ".json")
+    metadata: Dict[str, Any] = {}
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            metadata = json.load(f)
+
+    paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
+    new_leaves = []
+    for path, leaf in paths_and_leaves:
+        key = _path_str(path)
+        if key not in data:
+            raise KeyError(f"checkpoint {fname} missing leaf {key!r}")
+        arr = data[key]
+        tgt_dtype = getattr(leaf, "dtype", None)
+        if tgt_dtype is not None and arr.dtype != tgt_dtype:
+            arr = arr.astype(tgt_dtype)
+        if sharding_fn is not None:
+            new_leaves.append(sharding_fn(key, arr))
+        elif hasattr(leaf, "sharding"):
+            new_leaves.append(jax.device_put(arr, leaf.sharding))
+        else:
+            new_leaves.append(arr)
+    state = jax.tree_util.tree_unflatten(treedef, new_leaves)
+    return state, step, metadata

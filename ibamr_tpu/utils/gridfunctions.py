@@ -1,0 +1,148 @@
+"""Analytic grid functions: expression strings evaluated on grid coordinates.
+
+Reference parity: ``muParserCartGridFunction`` / ``CartGridFunction`` (T12,
+SURVEY.md §2.1) — runtime-parsed math expressions from input files, with the
+grid coordinates ``X_0, X_1[, X_2]`` and time ``t`` as variables, used for
+initial conditions, boundary data, and body forces.
+
+TPU-first design: the expression is compiled once into a jax-traceable
+callable over ``jnp`` ufuncs, so evaluating it inside a jitted step is free
+of Python overhead and fuses with downstream ops.
+"""
+
+from __future__ import annotations
+
+import ast
+import math
+from typing import Callable, Dict, Sequence
+
+import jax.numpy as jnp
+
+_ALLOWED_FUNCS = {
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan, "asin": jnp.arcsin,
+    "acos": jnp.arccos, "atan": jnp.arctan, "atan2": jnp.arctan2,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "exp": jnp.exp, "log": jnp.log, "log10": jnp.log10, "sqrt": jnp.sqrt,
+    "abs": jnp.abs, "floor": jnp.floor, "ceil": jnp.ceil, "pow": jnp.power,
+    "min": jnp.minimum, "max": jnp.maximum, "sign": jnp.sign,
+    "heaviside": lambda x: jnp.where(x >= 0, 1.0, 0.0),
+}
+_ALLOWED_CONSTS = {"PI": math.pi, "pi": math.pi, "E": math.e}
+
+
+class _Validator(ast.NodeVisitor):
+    """Whitelist validator: names, numeric constants, arithmetic, calls to
+    whitelisted functions, comparisons, conditional expressions."""
+
+    def __init__(self, varnames):
+        self.varnames = set(varnames)
+
+    def visit_Expression(self, node):
+        self.visit(node.body)
+
+    def visit_Constant(self, node):
+        if not isinstance(node.value, (int, float)):
+            raise ValueError(f"bad constant {node.value!r}")
+
+    def visit_Name(self, node):
+        if node.id not in self.varnames and node.id not in _ALLOWED_CONSTS \
+                and node.id not in _ALLOWED_FUNCS:
+            raise ValueError(f"unknown name {node.id!r} in grid function")
+
+    def visit_Call(self, node):
+        if not isinstance(node.func, ast.Name) or node.func.id not in _ALLOWED_FUNCS:
+            raise ValueError("only whitelisted function calls allowed")
+        if node.keywords:
+            raise ValueError("keyword arguments not allowed in grid functions")
+        for a in node.args:
+            if isinstance(a, ast.Starred):
+                raise ValueError("star-args not allowed in grid functions")
+            self.visit(a)
+
+    def visit_BinOp(self, node):
+        if not isinstance(node.op, (ast.Add, ast.Sub, ast.Mult, ast.Div,
+                                    ast.Pow, ast.Mod, ast.FloorDiv)):
+            raise ValueError("disallowed operator")
+        self.visit(node.left)
+        self.visit(node.right)
+
+    def visit_UnaryOp(self, node):
+        if not isinstance(node.op, (ast.UAdd, ast.USub)):
+            raise ValueError("disallowed unary operator")
+        self.visit(node.operand)
+
+    def visit_IfExp(self, node):
+        self.visit(node.test)
+        self.visit(node.body)
+        self.visit(node.orelse)
+
+    def visit_Compare(self, node):
+        self.visit(node.left)
+        for c in node.comparators:
+            self.visit(c)
+
+    def visit_BoolOp(self, node):
+        for v in node.values:
+            self.visit(v)
+
+    def generic_visit(self, node):
+        if isinstance(node, (ast.Expression, ast.Load, ast.cmpop, ast.boolop,
+                             ast.operator, ast.unaryop)):
+            super().generic_visit(node)
+        elif isinstance(node, (ast.Constant, ast.Name, ast.Call, ast.BinOp,
+                               ast.UnaryOp, ast.IfExp, ast.Compare, ast.BoolOp)):
+            super().generic_visit(node)
+        else:
+            raise ValueError(f"disallowed syntax: {type(node).__name__}")
+
+
+def _normalize(expr: str) -> str:
+    # muParser uses ^ for power; python uses **.
+    return expr.replace("^", "**")
+
+
+class CartGridFunction:
+    """A compiled analytic function f(X_0,...,X_{d-1}, t) -> array.
+
+    >>> f = CartGridFunction("sin(2*PI*X_0)*cos(2*PI*X_1)", dim=2)
+    >>> f((x, y), t=0.0)
+    """
+
+    def __init__(self, expr: str, dim: int):
+        self.expr = expr
+        self.dim = dim
+        varnames = [f"X_{i}" for i in range(dim)] + ["t"] + ["X", "Y", "Z"][:dim]
+        src = _normalize(expr)
+        tree = ast.parse(src, mode="eval")
+        _Validator(varnames).visit(tree)
+        code = compile(tree, f"<gridfunction:{expr}>", "eval")
+        env: Dict[str, object] = dict(_ALLOWED_FUNCS)
+        env.update(_ALLOWED_CONSTS)
+        self._code, self._env = code, env
+
+    def __call__(self, coords: Sequence[jnp.ndarray], t: float = 0.0) -> jnp.ndarray:
+        local: Dict[str, object] = {"t": t}
+        for i, c in enumerate(coords):
+            local[f"X_{i}"] = c
+        # convenience aliases
+        alias = ["X", "Y", "Z"]
+        for i, c in enumerate(coords[: len(alias)]):
+            local[alias[i]] = c
+        out = eval(self._code, {"__builtins__": {}, **self._env}, local)
+        return jnp.asarray(out)
+
+
+def function_from_db(db, dim: int, key_prefix: str = "function") -> Callable:
+    """Build a vector-valued grid function from a sub-database with keys
+    ``function_0 .. function_{d-1}`` (the reference's convention) or a single
+    ``function`` key for scalars. Returns f(coords, t) -> list of arrays or array."""
+    if f"{key_prefix}_0" in db:
+        comps = []
+        i = 0
+        while f"{key_prefix}_{i}" in db:
+            comps.append(CartGridFunction(db.get_string(f"{key_prefix}_{i}"), dim))
+            i += 1
+        return lambda coords, t=0.0: [c(coords, t) for c in comps]
+    expr = db.get_string(key_prefix)
+    f = CartGridFunction(expr, dim)
+    return lambda coords, t=0.0: f(coords, t)

@@ -1,0 +1,333 @@
+"""Hierarchical input-database config system.
+
+Preserves the reference's input-file *vocabulary* (SAMRAI ``tbox::Database``
+files: ``Section { key = value }``, ``//`` comments, comma-separated arrays,
+TRUE/FALSE booleans, quoted strings, simple arithmetic in numeric values) so
+that reference input files (``input2d`` / ``input3d``) port mechanically.
+
+Reference parity: SAMRAI's yacc-based input parser + ``tbox::Database`` typed
+accessors (``getDouble``, ``getBool``, ``getDatabase``) — SURVEY.md §5.6.
+This is a clean-room reimplementation of the file format, not a port.
+"""
+
+from __future__ import annotations
+
+import ast
+import math
+import operator
+import re
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Union
+
+Scalar = Union[int, float, bool, str]
+Value = Union[Scalar, List[Scalar]]
+
+# --------------------------------------------------------------------------
+# Safe arithmetic evaluation for numeric config expressions, e.g. "2*PI/64".
+# --------------------------------------------------------------------------
+
+_ALLOWED_BINOPS = {
+    ast.Add: operator.add,
+    ast.Sub: operator.sub,
+    ast.Mult: operator.mul,
+    ast.Div: operator.truediv,
+    ast.FloorDiv: operator.floordiv,
+    ast.Mod: operator.mod,
+    ast.Pow: operator.pow,
+}
+_ALLOWED_UNARY = {ast.UAdd: operator.pos, ast.USub: operator.neg}
+_CONSTS = {"PI": math.pi, "pi": math.pi, "E": math.e}
+_FUNCS = {
+    "sin": math.sin, "cos": math.cos, "tan": math.tan, "exp": math.exp,
+    "log": math.log, "sqrt": math.sqrt, "abs": abs, "floor": math.floor,
+    "ceil": math.ceil, "pow": pow, "min": min, "max": max, "int": int,
+}
+
+
+def _eval_node(node: ast.AST, names: Dict[str, float]) -> float:
+    if isinstance(node, ast.Expression):
+        return _eval_node(node.body, names)
+    if isinstance(node, ast.Constant) and isinstance(node.value, (int, float)):
+        return node.value
+    if isinstance(node, ast.BinOp) and type(node.op) in _ALLOWED_BINOPS:
+        return _ALLOWED_BINOPS[type(node.op)](
+            _eval_node(node.left, names), _eval_node(node.right, names))
+    if isinstance(node, ast.UnaryOp) and type(node.op) in _ALLOWED_UNARY:
+        return _ALLOWED_UNARY[type(node.op)](_eval_node(node.operand, names))
+    if isinstance(node, ast.Name):
+        if node.id in names:
+            return names[node.id]
+        if node.id in _CONSTS:
+            return _CONSTS[node.id]
+        raise KeyError(node.id)
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        fn = _FUNCS.get(node.func.id)
+        if fn is None:
+            raise KeyError(node.func.id)
+        return fn(*[_eval_node(a, names) for a in node.args])
+    raise ValueError(f"disallowed expression node: {ast.dump(node)}")
+
+
+def eval_arith(expr: str, names: Optional[Dict[str, float]] = None) -> float:
+    """Evaluate a restricted arithmetic expression (no attribute access,
+    no subscripts, whitelisted functions/constants only)."""
+    tree = ast.parse(expr, mode="eval")
+    return _eval_node(tree, names or {})
+
+
+# --------------------------------------------------------------------------
+# Database
+# --------------------------------------------------------------------------
+
+class InputDatabase:
+    """Typed hierarchical key/value store mirroring tbox::Database accessors."""
+
+    def __init__(self, name: str = "root"):
+        self.name = name
+        self._entries: Dict[str, Union[Value, "InputDatabase"]] = {}
+
+    # -- structural ---------------------------------------------------------
+    def keys(self) -> List[str]:
+        return list(self._entries.keys())
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._entries
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._entries)
+
+    def put(self, key: str, value: Union[Value, "InputDatabase"]) -> None:
+        self._entries[key] = value
+
+    def is_database(self, key: str) -> bool:
+        return isinstance(self._entries.get(key), InputDatabase)
+
+    def get_database(self, key: str) -> "InputDatabase":
+        v = self._entries.get(key)
+        if not isinstance(v, InputDatabase):
+            raise KeyError(f"{self.name}: no sub-database {key!r}")
+        return v
+
+    def get_database_with_default(self, key: str) -> "InputDatabase":
+        if key in self and self.is_database(key):
+            return self.get_database(key)
+        return InputDatabase(key)
+
+    # -- typed scalar accessors --------------------------------------------
+    def _get(self, key: str) -> Value:
+        if key not in self._entries:
+            raise KeyError(f"{self.name}: missing key {key!r}")
+        v = self._entries[key]
+        if isinstance(v, InputDatabase):
+            raise KeyError(f"{self.name}: {key!r} is a sub-database, not a value")
+        return v
+
+    def _scalar(self, key: str) -> Scalar:
+        v = self._get(key)
+        if isinstance(v, list):
+            if len(v) != 1:
+                raise TypeError(f"{self.name}: {key!r} is an array of length {len(v)}")
+            return v[0]
+        return v
+
+    def get_int(self, key: str, default: Optional[int] = None) -> int:
+        if key not in self and default is not None:
+            return default
+        return int(self._scalar(key))
+
+    def get_float(self, key: str, default: Optional[float] = None) -> float:
+        if key not in self and default is not None:
+            return default
+        return float(self._scalar(key))
+
+    def get_bool(self, key: str, default: Optional[bool] = None) -> bool:
+        if key not in self and default is not None:
+            return default
+        v = self._scalar(key)
+        if isinstance(v, str):
+            return v.upper() in ("TRUE", "YES", "ON", "1")
+        return bool(v)
+
+    def get_string(self, key: str, default: Optional[str] = None) -> str:
+        if key not in self and default is not None:
+            return default
+        return str(self._scalar(key))
+
+    def get_array(self, key: str, default: Optional[Sequence[Scalar]] = None) -> List[Scalar]:
+        if key not in self and default is not None:
+            return list(default)
+        v = self._get(key)
+        return list(v) if isinstance(v, list) else [v]
+
+    def get_int_array(self, key: str, default: Optional[Sequence[int]] = None) -> List[int]:
+        return [int(x) for x in self.get_array(key, default)]
+
+    def get_float_array(self, key: str, default: Optional[Sequence[float]] = None) -> List[float]:
+        return [float(x) for x in self.get_array(key, default)]
+
+    # -- conversion ---------------------------------------------------------
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for k, v in self._entries.items():
+            out[k] = v.to_dict() if isinstance(v, InputDatabase) else v
+        return out
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any], name: str = "root") -> "InputDatabase":
+        db = cls(name)
+        for k, v in d.items():
+            if isinstance(v, dict):
+                db.put(k, cls.from_dict(v, name=k))
+            else:
+                db.put(k, v)
+        return db
+
+    def __repr__(self) -> str:
+        return f"InputDatabase({self.name!r}, keys={self.keys()})"
+
+
+# --------------------------------------------------------------------------
+# Parser
+# --------------------------------------------------------------------------
+
+_SECTION_RE = re.compile(r"^\s*([A-Za-z_][\w\-]*)\s*\{\s*$")
+_ASSIGN_RE = re.compile(r"^\s*([A-Za-z_][\w\-]*)\s*=\s*(.*)$")
+_CLOSE_RE = re.compile(r"^\s*\}\s*$")
+
+
+def _strip_comments(text: str) -> str:
+    # Remove /* */ block comments, then // line comments (outside strings).
+    text = re.sub(r"/\*.*?\*/", " ", text, flags=re.S)
+    out_lines = []
+    for line in text.splitlines():
+        result, in_str = [], False
+        i = 0
+        while i < len(line):
+            c = line[i]
+            if c == '"':
+                in_str = not in_str
+                result.append(c)
+            elif not in_str and c == "/" and i + 1 < len(line) and line[i + 1] == "/":
+                break
+            elif not in_str and c == "#":  # also accept shell-style comments
+                break
+            else:
+                result.append(c)
+            i += 1
+        out_lines.append("".join(result))
+    return "\n".join(out_lines)
+
+
+def _split_commas(s: str) -> List[str]:
+    """Split on commas that are outside quotes and parentheses."""
+    parts, depth, in_str, cur = [], 0, False, []
+    for c in s:
+        if c == '"':
+            in_str = not in_str
+            cur.append(c)
+        elif not in_str and c == "(":
+            depth += 1
+            cur.append(c)
+        elif not in_str and c == ")":
+            depth -= 1
+            cur.append(c)
+        elif not in_str and depth == 0 and c == ",":
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(c)
+    parts.append("".join(cur))
+    return [p.strip() for p in parts if p.strip()]
+
+
+def _parse_scalar(tok: str) -> Scalar:
+    tok = tok.strip()
+    if tok.startswith('"') and tok.endswith('"') and len(tok) >= 2:
+        return tok[1:-1]
+    up = tok.upper()
+    if up in ("TRUE", "YES", "ON"):
+        return True
+    if up in ("FALSE", "NO", "OFF"):
+        return False
+    try:
+        return int(tok)
+    except ValueError:
+        pass
+    try:
+        return float(tok)
+    except ValueError:
+        pass
+    try:
+        v = eval_arith(tok)
+        if isinstance(v, float) and v.is_integer() and ("." not in tok and "e" not in tok.lower() and "/" not in tok):
+            return int(v)
+        return v
+    except Exception:
+        return tok  # bare word -> string
+
+
+def _parse_value(raw: str) -> Value:
+    parts = _split_commas(raw)
+    vals = [_parse_scalar(p) for p in parts]
+    if len(vals) == 1:
+        return vals[0]
+    return vals
+
+
+def _normalize_braces(text: str) -> str:
+    """Split inline sections (``Main { x = 1 }``) onto separate lines so the
+    line-based parser handles them; braces inside quoted strings are kept."""
+    out, in_str = [], False
+    for c in text:
+        if c == '"':
+            in_str = not in_str
+            out.append(c)
+        elif not in_str and c == "{":
+            out.append(" {\n")
+        elif not in_str and c == "}":
+            out.append("\n}\n")
+        else:
+            out.append(c)
+    return "".join(out)
+
+
+def parse_input_string(text: str, name: str = "root") -> InputDatabase:
+    text = _normalize_braces(_strip_comments(text))
+    root = InputDatabase(name)
+    stack: List[InputDatabase] = [root]
+    lines = text.splitlines()
+    i = 0
+    while i < len(lines):
+        line = lines[i].strip()
+        i += 1
+        if not line:
+            continue
+        # allow "Name {" possibly with trailing content handled line-wise
+        m = _SECTION_RE.match(line)
+        if m:
+            child = InputDatabase(m.group(1))
+            stack[-1].put(m.group(1), child)
+            stack.append(child)
+            continue
+        if _CLOSE_RE.match(line):
+            if len(stack) == 1:
+                raise ValueError("unbalanced '}' in input file")
+            stack.pop()
+            continue
+        m = _ASSIGN_RE.match(line)
+        if m:
+            key, raw = m.group(1), m.group(2).strip()
+            # multi-line arrays: keep consuming while line ends with ','
+            while raw.endswith(",") and i < len(lines):
+                raw += " " + lines[i].strip()
+                i += 1
+            stack[-1].put(key, _parse_value(raw))
+            continue
+        raise ValueError(f"cannot parse input line: {line!r}")
+    if len(stack) != 1:
+        raise ValueError("unbalanced '{' in input file")
+    return root
+
+
+def parse_input_file(path: str) -> InputDatabase:
+    with open(path, "r") as f:
+        return parse_input_string(f.read(), name=path)

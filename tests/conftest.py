@@ -1,0 +1,45 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's ``foo.mpirun=4.input`` trick (SURVEY.md §4): the
+reference exercises its MPI paths with oversubscribed local ranks; we
+exercise our sharding paths with ``xla_force_host_platform_device_count``
+virtual CPU devices. Real-TPU execution is covered by bench.py and the
+driver's compile checks, not by this suite.
+
+Must set env vars BEFORE jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    return jax.devices()
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    """A 1-D 8-device mesh for sharding tests."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices()[:8])
+    return Mesh(devs, axis_names=("x",))
+
+
+@pytest.fixture(scope="session")
+def mesh2x4():
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices()[:8]).reshape(2, 4)
+    return Mesh(devs, axis_names=("x", "y"))

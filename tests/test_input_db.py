@@ -1,0 +1,92 @@
+"""Stage-0 acceptance: config round-trip (SURVEY.md §7.2 stage 0)."""
+
+import math
+
+import pytest
+
+from ibamr_tpu.utils.input_db import (
+    InputDatabase, eval_arith, parse_input_string)
+
+SAMPLE = """
+// An input file in the reference's vocabulary (SURVEY.md §5.6)
+L = 1.0
+MAX_LEVELS = 1
+
+Main {
+   solver_type = "STAGGERED"          // trailing comment
+   dt_max = 1.0e-2
+   num_steps = 5
+   enable_logging = TRUE
+   viz_writers = "VisIt", "Silo"
+   lower = 0.0, 0.0
+   upper = 2*PI, 2*PI                 /* arithmetic values */
+
+   VelocityInitialConditions {
+      function_0 = "sin(2*PI*X_0)*cos(2*PI*X_1)"
+      function_1 = "-cos(2*PI*X_0)*sin(2*PI*X_1)"
+   }
+}
+
+CartesianGeometry {
+   domain_boxes = 0, 0, 63, 63
+   periodic_dimension = 1, 1
+}
+"""
+
+
+def test_parse_scalars():
+    db = parse_input_string(SAMPLE)
+    main = db.get_database("Main")
+    assert main.get_string("solver_type") == "STAGGERED"
+    assert main.get_float("dt_max") == pytest.approx(1.0e-2)
+    assert main.get_int("num_steps") == 5
+    assert main.get_bool("enable_logging") is True
+    assert db.get_float("L") == 1.0
+    assert db.get_int("MAX_LEVELS") == 1
+
+
+def test_parse_arrays_and_arith():
+    db = parse_input_string(SAMPLE)
+    main = db.get_database("Main")
+    assert main.get_float_array("lower") == [0.0, 0.0]
+    up = main.get_float_array("upper")
+    assert up == pytest.approx([2 * math.pi, 2 * math.pi])
+    assert main.get_array("viz_writers") == ["VisIt", "Silo"]
+    geom = db.get_database("CartesianGeometry")
+    assert geom.get_int_array("domain_boxes") == [0, 0, 63, 63]
+
+
+def test_nested_and_defaults():
+    db = parse_input_string(SAMPLE)
+    vic = db.get_database("Main").get_database("VelocityInitialConditions")
+    assert "sin" in vic.get_string("function_0")
+    assert db.get_database("Main").get_float("missing", 3.5) == 3.5
+    assert db.get_database("Main").get_bool("missing", False) is False
+    with pytest.raises(KeyError):
+        db.get_database("Main").get_float("missing")
+
+
+def test_round_trip_dict():
+    db = parse_input_string(SAMPLE)
+    d = db.to_dict()
+    db2 = InputDatabase.from_dict(d)
+    assert db2.to_dict() == d
+
+
+def test_eval_arith_safety():
+    assert eval_arith("2*PI") == pytest.approx(2 * math.pi)
+    assert eval_arith("2**3 + 1") == 9
+    with pytest.raises(Exception):
+        eval_arith("__import__('os').system('true')")
+    with pytest.raises(Exception):
+        eval_arith("().__class__")
+
+
+def test_multiline_array():
+    text = """
+    arr = 1.0,
+          2.0,
+          3.0
+    """
+    db = parse_input_string(text)
+    assert db.get_float_array("arr") == [1.0, 2.0, 3.0]
