@@ -1,0 +1,103 @@
+"""Staggered (MAC) Cartesian grid geometry.
+
+Reference parity: SAMRAI ``CartesianGridGeometry`` + cell/side-centered patch
+data (SURVEY.md L1) collapsed into one static-geometry object. TPU-first
+redesign: geometry is *static metadata* (shapes, spacings) hashable for jit;
+field data are plain ``jnp`` arrays carried in the state pytree, so one
+compiled step function serves the whole run (SURVEY.md §7.1 pillar 1).
+
+Conventions (uniform level, periodic unless stated otherwise):
+- ``n = (n_0, ..., n_{d-1})`` cells; ``dx_d = (x_up_d - x_lo_d) / n_d``.
+- Cell-centered field: shape ``n``; cell ``i`` center at
+  ``x_lo + (i + 1/2) * dx``.
+- Face-centered velocity component ``d``: shape ``n`` with ``u_d[i]`` living
+  on the *lower* face of cell ``i`` in direction ``d`` (position
+  ``x_lo_d + i_d * dx_d``). Under periodicity every component has exactly
+  ``prod(n)`` faces, so all MAC components share one static shape — the key
+  simplification that keeps XLA shapes uniform.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Tuple
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class StaggeredGrid:
+    """Static MAC-grid geometry for a single uniform level."""
+
+    n: Tuple[int, ...]
+    x_lo: Tuple[float, ...]
+    x_up: Tuple[float, ...]
+
+    def __post_init__(self):
+        assert len(self.n) == len(self.x_lo) == len(self.x_up)
+        assert all(nd >= 2 for nd in self.n), "need >=2 cells per dim"
+        object.__setattr__(self, "n", tuple(int(v) for v in self.n))
+        object.__setattr__(self, "x_lo", tuple(float(v) for v in self.x_lo))
+        object.__setattr__(self, "x_up", tuple(float(v) for v in self.x_up))
+
+    # -- derived geometry ---------------------------------------------------
+    @property
+    def dim(self) -> int:
+        return len(self.n)
+
+    @property
+    def dx(self) -> Tuple[float, ...]:
+        return tuple((hi - lo) / nd
+                     for lo, hi, nd in zip(self.x_lo, self.x_up, self.n))
+
+    @property
+    def lengths(self) -> Tuple[float, ...]:
+        return tuple(hi - lo for lo, hi in zip(self.x_lo, self.x_up))
+
+    @property
+    def cell_volume(self) -> float:
+        return math.prod(self.dx)
+
+    @property
+    def num_cells(self) -> int:
+        return math.prod(self.n)
+
+    # -- coordinates --------------------------------------------------------
+    def cell_coords_1d(self, axis: int, dtype=jnp.float32) -> jnp.ndarray:
+        """Cell-center coordinates along one axis, shape (n[axis],)."""
+        d = self.dx[axis]
+        return self.x_lo[axis] + (jnp.arange(self.n[axis], dtype=dtype) + 0.5) * d
+
+    def face_coords_1d(self, axis: int, dtype=jnp.float32) -> jnp.ndarray:
+        """Lower-face coordinates along one axis, shape (n[axis],)."""
+        d = self.dx[axis]
+        return self.x_lo[axis] + jnp.arange(self.n[axis], dtype=dtype) * d
+
+    def _bcast(self, coords_1d, axis: int) -> jnp.ndarray:
+        shape = [1] * self.dim
+        shape[axis] = self.n[axis]
+        return coords_1d.reshape(shape)
+
+    def cell_centers(self, dtype=jnp.float32) -> Tuple[jnp.ndarray, ...]:
+        """Broadcastable cell-center coordinate arrays, one per axis."""
+        return tuple(self._bcast(self.cell_coords_1d(a, dtype), a)
+                     for a in range(self.dim))
+
+    def face_centers(self, comp: int, dtype=jnp.float32) -> Tuple[jnp.ndarray, ...]:
+        """Broadcastable coordinates of velocity-component ``comp`` faces:
+        face coordinate along axis ``comp``, cell-center along the others."""
+        out = []
+        for a in range(self.dim):
+            c = (self.face_coords_1d(a, dtype) if a == comp
+                 else self.cell_coords_1d(a, dtype))
+            out.append(self._bcast(c, a))
+        return tuple(out)
+
+    # -- conversions --------------------------------------------------------
+    def position_to_index(self, x: jnp.ndarray) -> jnp.ndarray:
+        """Continuous cell index of physical position(s) x (..., dim):
+        cell i contains [i, i+1) in these units."""
+        lo = jnp.asarray(self.x_lo, dtype=x.dtype)
+        dx = jnp.asarray(self.dx, dtype=x.dtype)
+        return (x - lo) / dx

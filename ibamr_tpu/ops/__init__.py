@@ -1,0 +1,3 @@
+from ibamr_tpu.ops import stencils, norms
+
+__all__ = ["stencils", "norms"]

@@ -1,0 +1,50 @@
+"""Discrete norms over grid fields.
+
+Reference parity: IBTK ``NormOps`` / SAMRAIVectorReal norms (T17).
+Volume-weighted L1/L2/max norms and inner products. These are the global
+reductions of the framework (the analog of the reference's MPI-reduced
+PETSc VecNorm/VecDot, SURVEY.md §2.4); under sharding XLA lowers them to
+``psum`` collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+
+def l1_norm(f: jnp.ndarray, cell_volume: float = 1.0) -> jnp.ndarray:
+    return jnp.sum(jnp.abs(f)) * cell_volume
+
+
+def l2_norm(f: jnp.ndarray, cell_volume: float = 1.0) -> jnp.ndarray:
+    return jnp.sqrt(jnp.sum(jnp.square(f)) * cell_volume)
+
+
+def max_norm(f: jnp.ndarray) -> jnp.ndarray:
+    return jnp.max(jnp.abs(f))
+
+
+def vel_l2_norm(u: Sequence[jnp.ndarray], cell_volume: float = 1.0) -> jnp.ndarray:
+    s = jnp.sum(jnp.square(u[0]))
+    for c in u[1:]:
+        s = s + jnp.sum(jnp.square(c))
+    return jnp.sqrt(s * cell_volume)
+
+
+def vel_max_norm(u: Sequence[jnp.ndarray]) -> jnp.ndarray:
+    m = jnp.max(jnp.abs(u[0]))
+    for c in u[1:]:
+        m = jnp.maximum(m, jnp.max(jnp.abs(c)))
+    return m
+
+
+def dot(a, b, cell_volume: float = 1.0) -> jnp.ndarray:
+    """Volume-weighted inner product of two fields or two velocity tuples."""
+    if isinstance(a, (tuple, list)):
+        s = jnp.sum(a[0] * b[0])
+        for x, y in zip(a[1:], b[1:]):
+            s = s + jnp.sum(x * y)
+        return s * cell_volume
+    return jnp.sum(a * b) * cell_volume

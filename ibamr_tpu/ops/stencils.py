@@ -1,0 +1,136 @@
+"""Staggered-grid vector calculus as fused XLA stencils.
+
+Reference parity: ``HierarchyMathOps`` / ``PatchMathOps`` + their Fortran
+kernels (T4, SURVEY.md §2.1) — discrete div, grad, Laplacian, curl, and
+cell<->face interpolation on the MAC grid.
+
+TPU-first design: every stencil is expressed with ``jnp.roll`` on whole
+arrays. Under jit, XLA fuses these into single HBM-bandwidth-bound passes;
+under a ``NamedSharding`` the SPMD partitioner lowers the rolls into
+neighbor halo exchanges over ICI automatically — this *is* the replacement
+for SAMRAI's RefineSchedule halo machinery on the periodic uniform level
+(SURVEY.md §2.4). Periodic boundaries are therefore the native case; wall
+boundaries are imposed by masking layers on top (see ibamr_tpu.bc).
+
+Index conventions (see ibamr_tpu.grid.StaggeredGrid):
+- cc field p[i]: cell centers.  fc field u_d[i]: lower face of cell i.
+- d/dx of cc at faces: (p[i] - p[i-1])/dx  -> roll(+1)
+- d/dx of fc at centers: (u[i+1] - u[i])/dx -> roll(-1)
+
+With these, div(grad(p)) == laplacian(p) exactly, and gradient is the
+negative adjoint of divergence — discrete integration by parts that the
+projection method and the tests rely on.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+
+Vel = Tuple[jnp.ndarray, ...]
+
+
+def _dxm(f: jnp.ndarray, axis: int, h: float) -> jnp.ndarray:
+    """Backward difference (f[i] - f[i-1]) / h — cc->fc derivative."""
+    return (f - jnp.roll(f, 1, axis)) / h
+
+
+def _dxp(f: jnp.ndarray, axis: int, h: float) -> jnp.ndarray:
+    """Forward difference (f[i+1] - f[i]) / h — fc->cc derivative."""
+    return (jnp.roll(f, -1, axis) - f) / h
+
+
+def divergence(u: Sequence[jnp.ndarray], dx: Sequence[float]) -> jnp.ndarray:
+    """div u at cell centers from face-centered components."""
+    out = _dxp(u[0], 0, dx[0])
+    for d in range(1, len(u)):
+        out = out + _dxp(u[d], d, dx[d])
+    return out
+
+
+def gradient(p: jnp.ndarray, dx: Sequence[float]) -> Vel:
+    """grad p at faces from a cell-centered field."""
+    return tuple(_dxm(p, d, dx[d]) for d in range(len(dx)))
+
+
+def laplacian(f: jnp.ndarray, dx: Sequence[float]) -> jnp.ndarray:
+    """Standard 2d+1-point Laplacian on the field's own grid (cc or fc)."""
+    out = jnp.zeros_like(f)
+    for d in range(f.ndim):
+        out = out + (jnp.roll(f, -1, d) - 2.0 * f + jnp.roll(f, 1, d)) / (dx[d] ** 2)
+    return out
+
+
+def laplacian_vel(u: Sequence[jnp.ndarray], dx: Sequence[float]) -> Vel:
+    return tuple(laplacian(c, dx) for c in u)
+
+
+# --------------------------------------------------------------------------
+# Interpolations between centerings
+# --------------------------------------------------------------------------
+
+def cc_to_fc(p: jnp.ndarray) -> Vel:
+    """Cell-centered scalar to each face centering (2-point average):
+    value at lower face i of axis d = (p[i-1] + p[i]) / 2."""
+    return tuple(0.5 * (p + jnp.roll(p, 1, d)) for d in range(p.ndim))
+
+
+def fc_to_cc(u: Sequence[jnp.ndarray]) -> Vel:
+    """Each face-centered component to cell centers (2-point average)."""
+    return tuple(0.5 * (c + jnp.roll(c, -1, d)) for d, c in enumerate(u))
+
+
+def fc_component_to_fc(u: Sequence[jnp.ndarray], src: int, dst: int) -> jnp.ndarray:
+    """Interpolate component ``src`` onto the faces of component ``dst``
+    (4-point average in the src/dst plane; identity if src == dst).
+    Needed by the MAC convective operator."""
+    c = u[src]
+    if src == dst:
+        return c
+    # to cell centers along src axis (forward avg), then to dst faces
+    # along dst axis (backward avg)
+    c = 0.5 * (c + jnp.roll(c, -1, src))
+    c = 0.5 * (c + jnp.roll(c, 1, dst))
+    return c
+
+
+# --------------------------------------------------------------------------
+# Curl / vorticity
+# --------------------------------------------------------------------------
+
+def curl_2d_node(u: Sequence[jnp.ndarray], dx: Sequence[float]) -> jnp.ndarray:
+    """2D vorticity w = dv/dx - du/dy at grid nodes (the natural centering:
+    node [i,j] at position (i*dx, j*dy) touches u faces above/below and v
+    faces left/right)."""
+    dvdx = _dxm(u[1], 0, dx[0])
+    dudy = _dxm(u[0], 1, dx[1])
+    return dvdx - dudy
+
+
+def curl_2d_cc(u: Sequence[jnp.ndarray], dx: Sequence[float]) -> jnp.ndarray:
+    """2D vorticity averaged to cell centers (for tagging/visualization)."""
+    w = curl_2d_node(u, dx)
+    w = 0.5 * (w + jnp.roll(w, -1, 0))
+    w = 0.5 * (w + jnp.roll(w, -1, 1))
+    return w
+
+
+def curl_3d_cc(u: Sequence[jnp.ndarray], dx: Sequence[float]) -> Vel:
+    """3D vorticity components averaged to cell centers."""
+    ucc = fc_to_cc(u)
+
+    def dcc(f, axis, h):
+        return (jnp.roll(f, -1, axis) - jnp.roll(f, 1, axis)) / (2.0 * h)
+
+    wx = dcc(ucc[2], 1, dx[1]) - dcc(ucc[1], 2, dx[2])
+    wy = dcc(ucc[0], 2, dx[2]) - dcc(ucc[2], 0, dx[0])
+    wz = dcc(ucc[1], 0, dx[0]) - dcc(ucc[0], 1, dx[1])
+    return (wx, wy, wz)
+
+
+def vorticity_magnitude_cc(u: Sequence[jnp.ndarray], dx: Sequence[float]) -> jnp.ndarray:
+    if len(u) == 2:
+        return jnp.abs(curl_2d_cc(u, dx))
+    w = curl_3d_cc(u, dx)
+    return jnp.sqrt(w[0] ** 2 + w[1] ** 2 + w[2] ** 2)

@@ -26,6 +26,8 @@ _ALLOWED_FUNCS = {
     "abs": jnp.abs, "floor": jnp.floor, "ceil": jnp.ceil, "pow": jnp.power,
     "min": jnp.minimum, "max": jnp.maximum, "sign": jnp.sign,
     "heaviside": lambda x: jnp.where(x >= 0, 1.0, 0.0),
+    "where": jnp.where, "logical_and": jnp.logical_and,
+    "logical_or": jnp.logical_or,
 }
 _ALLOWED_CONSTS = {"PI": math.pi, "pi": math.pi, "E": math.e}
 
@@ -101,6 +103,29 @@ def _normalize(expr: str) -> str:
     return expr.replace("^", "**")
 
 
+class _ArraySemantics(ast.NodeTransformer):
+    """Rewrite scalar-style conditionals to array ops so piecewise
+    expressions (the main use of conditionals in reference input files)
+    work on grid arrays: ``a if c else b`` -> ``where(c, a, b)``;
+    ``and``/``or`` -> ``logical_and``/``logical_or``."""
+
+    def visit_IfExp(self, node):
+        self.generic_visit(node)
+        return ast.copy_location(
+            ast.Call(func=ast.Name(id="where", ctx=ast.Load()),
+                     args=[node.test, node.body, node.orelse], keywords=[]),
+            node)
+
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        fname = "logical_and" if isinstance(node.op, ast.And) else "logical_or"
+        out = node.values[0]
+        for v in node.values[1:]:
+            out = ast.Call(func=ast.Name(id=fname, ctx=ast.Load()),
+                           args=[out, v], keywords=[])
+        return ast.copy_location(out, node)
+
+
 class CartGridFunction:
     """A compiled analytic function f(X_0,...,X_{d-1}, t) -> array.
 
@@ -115,6 +140,7 @@ class CartGridFunction:
         src = _normalize(expr)
         tree = ast.parse(src, mode="eval")
         _Validator(varnames).visit(tree)
+        tree = ast.fix_missing_locations(_ArraySemantics().visit(tree))
         code = compile(tree, f"<gridfunction:{expr}>", "eval")
         env: Dict[str, object] = dict(_ALLOWED_FUNCS)
         env.update(_ALLOWED_CONSTS)

@@ -16,7 +16,7 @@ import ast
 import math
 import operator
 import re
-from typing import Any, Dict, Iterator, List, Optional, Sequence, Union
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple, Union
 
 Scalar = Union[int, float, bool, str]
 Value = Union[Scalar, List[Scalar]]
@@ -189,26 +189,34 @@ class InputDatabase:
 # Parser
 # --------------------------------------------------------------------------
 
-_SECTION_RE = re.compile(r"^\s*([A-Za-z_][\w\-]*)\s*\{\s*$")
-_ASSIGN_RE = re.compile(r"^\s*([A-Za-z_][\w\-]*)\s*=\s*(.*)$")
-_CLOSE_RE = re.compile(r"^\s*\}\s*$")
-
-
 def _strip_comments(text: str) -> str:
-    # Remove /* */ block comments, then // line comments (outside strings).
-    text = re.sub(r"/\*.*?\*/", " ", text, flags=re.S)
+    # Remove /* */ block comments, then // and # line comments (outside
+    # strings; escaped quotes inside strings are honored). Replacement
+    # preserves length so token offsets index the original text.
+    def _blank(m):
+        return re.sub(r"[^\n]", " ", m.group(0))
+
+    text = re.sub(r"/\*.*?\*/", _blank, text, flags=re.S)
     out_lines = []
     for line in text.splitlines():
-        result, in_str = [], False
+        result, in_str, esc = [], False, False
         i = 0
         while i < len(line):
             c = line[i]
-            if c == '"':
-                in_str = not in_str
+            if in_str:
                 result.append(c)
-            elif not in_str and c == "/" and i + 1 < len(line) and line[i + 1] == "/":
+                if esc:
+                    esc = False
+                elif c == "\\":
+                    esc = True
+                elif c == '"':
+                    in_str = False
+            elif c == '"':
+                in_str = True
+                result.append(c)
+            elif c == "/" and i + 1 < len(line) and line[i + 1] == "/":
                 break
-            elif not in_str and c == "#":  # also accept shell-style comments
+            elif c == "#":  # also accept shell-style comments
                 break
             else:
                 result.append(c)
@@ -217,32 +225,13 @@ def _strip_comments(text: str) -> str:
     return "\n".join(out_lines)
 
 
-def _split_commas(s: str) -> List[str]:
-    """Split on commas that are outside quotes and parentheses."""
-    parts, depth, in_str, cur = [], 0, False, []
-    for c in s:
-        if c == '"':
-            in_str = not in_str
-            cur.append(c)
-        elif not in_str and c == "(":
-            depth += 1
-            cur.append(c)
-        elif not in_str and c == ")":
-            depth -= 1
-            cur.append(c)
-        elif not in_str and depth == 0 and c == ",":
-            parts.append("".join(cur))
-            cur = []
-        else:
-            cur.append(c)
-    parts.append("".join(cur))
-    return [p.strip() for p in parts if p.strip()]
-
-
-def _parse_scalar(tok: str) -> Scalar:
+def _parse_scalar(tok: str, raw: Optional[str] = None) -> Scalar:
+    """Parse one value element. ``tok`` is the (possibly space-rejoined)
+    token text used for arithmetic; ``raw`` is the verbatim source span used
+    as the fallback string so unquoted values like ``viz2d/data`` survive."""
     tok = tok.strip()
     if tok.startswith('"') and tok.endswith('"') and len(tok) >= 2:
-        return tok[1:-1]
+        return tok[1:-1].replace('\\"', '"').replace("\\\\", "\\")
     up = tok.upper()
     if up in ("TRUE", "YES", "ON"):
         return True
@@ -257,74 +246,147 @@ def _parse_scalar(tok: str) -> Scalar:
     except ValueError:
         pass
     try:
-        v = eval_arith(tok)
-        if isinstance(v, float) and v.is_integer() and ("." not in tok and "e" not in tok.lower() and "/" not in tok):
+        expr = tok.replace("^", "**")  # muParser-style power in config values
+        v = eval_arith(expr)
+        if isinstance(v, float) and v.is_integer() and not any(
+                ch in tok for ch in (".", "e", "E", "/")):
             return int(v)
         return v
     except Exception:
-        return tok  # bare word -> string
+        return (raw if raw is not None else tok).strip()  # bare word -> string
 
 
-def _parse_value(raw: str) -> Value:
-    parts = _split_commas(raw)
-    vals = [_parse_scalar(p) for p in parts]
-    if len(vals) == 1:
-        return vals[0]
-    return vals
+# Tokens: quoted strings; numbers (incl. scientific notation); identifiers;
+# punctuation/operators; catch-all atoms (unquoted path/filename fragments
+# like ``.txt`` or ``a:b``). Newlines are insignificant, matching the
+# reference's yacc-based grammar (`a = 1  b = 2` on one line is valid).
+_TOKEN_RE = re.compile(r"""
+    "(?:[^"\\]|\\.)*"                   # quoted string
+  | \d+\.?\d*(?:[eE][+-]?\d+)?          # number (123, 1.5, 1e-3)
+  | \.\d+(?:[eE][+-]?\d+)?              # .5
+  | [A-Za-z_]\w*                        # identifier / keyword
+  | \*\*                                # power
+  | [{}=,()+\-*/^%]                     # punctuation & operators
+  | [^\s{}=,"()+\-*/^%]+                # catch-all atom (paths, etc.)
+""", re.X)
 
 
-def _normalize_braces(text: str) -> str:
-    """Split inline sections (``Main { x = 1 }``) onto separate lines so the
-    line-based parser handles them; braces inside quoted strings are kept."""
-    out, in_str = [], False
-    for c in text:
-        if c == '"':
-            in_str = not in_str
-            out.append(c)
-        elif not in_str and c == "{":
-            out.append(" {\n")
-        elif not in_str and c == "}":
-            out.append("\n}\n")
-        else:
-            out.append(c)
-    return "".join(out)
+class _Tok(str):
+    """A token carrying its source span, for verbatim-text fallbacks."""
+    start: int
+    end: int
+
+    def __new__(cls, s: str, start: int, end: int):
+        o = super().__new__(cls, s)
+        o.start, o.end = start, end
+        return o
+
+
+def _tokenize(text: str) -> Tuple[List["_Tok"], str]:
+    text = _strip_comments(text)
+    toks, pos = [], 0
+    for m in _TOKEN_RE.finditer(text):
+        gap = text[pos:m.start()]
+        if gap.strip():
+            raise ValueError(f"cannot tokenize input near: {gap.strip()[:40]!r}")
+        toks.append(_Tok(m.group(0), m.start(), m.end()))
+        pos = m.end()
+    if text[pos:].strip():
+        raise ValueError(f"cannot tokenize input near: {text[pos:].strip()[:40]!r}")
+    return toks, text
+
+
+_IDENT_RE = re.compile(r"[A-Za-z_]\w*\Z")
+
+
+class _Parser:
+    def __init__(self, toks: List["_Tok"], source: str):
+        self.toks = toks
+        self.source = source
+        self.i = 0
+
+    def peek(self, k: int = 0) -> Optional[str]:
+        j = self.i + k
+        return self.toks[j] if j < len(self.toks) else None
+
+    def next(self) -> str:
+        t = self.peek()
+        if t is None:
+            raise ValueError("unexpected end of input file")
+        self.i += 1
+        return t
+
+    def parse_body(self, db: InputDatabase, top: bool) -> None:
+        while True:
+            t = self.peek()
+            if t is None:
+                if not top:
+                    raise ValueError("unbalanced '{' in input file")
+                return
+            if t == "}":
+                if top:
+                    raise ValueError("unbalanced '}' in input file")
+                self.next()
+                return
+            if not _IDENT_RE.match(t):
+                raise ValueError(f"expected a key or section name, got {t!r}")
+            name = self.next()
+            nxt = self.peek()
+            if nxt == "{":
+                self.next()
+                child = InputDatabase(name)
+                db.put(name, child)
+                self.parse_body(child, top=False)
+            elif nxt == "=":
+                self.next()
+                db.put(name, self.parse_value_list())
+            else:
+                raise ValueError(f"expected '=' or '{{' after {name!r}")
+
+    def _at_entry_boundary(self) -> bool:
+        t = self.peek()
+        if t is None or t == "}":
+            return True
+        return bool(_IDENT_RE.match(t)) and self.peek(1) in ("=", "{")
+
+    def parse_value_list(self) -> Value:
+        vals = [self.parse_element()]
+        while self.peek() == ",":
+            self.next()
+            if self._at_entry_boundary():  # tolerate trailing comma
+                break
+            vals.append(self.parse_element())
+        return vals[0] if len(vals) == 1 else vals
+
+    def parse_element(self) -> Scalar:
+        parts: List["_Tok"] = []
+        depth = 0
+        while True:
+            t = self.peek()
+            if t is None or (t == "," and depth == 0) or t in ("{", "="):
+                break
+            if t == "}" and depth == 0:
+                break
+            if depth == 0 and _IDENT_RE.match(t) and self.peek(1) in ("=", "{"):
+                break  # next entry starts
+            t = self.next()
+            if t == "(":
+                depth += 1
+            elif t == ")":
+                depth -= 1
+            parts.append(t)
+        if not parts:
+            raise ValueError("empty value in input file")
+        raw = self.source[parts[0].start:parts[-1].end]
+        if len(parts) == 1:
+            return _parse_scalar(parts[0], raw=raw)
+        return _parse_scalar(" ".join(parts), raw=raw)
 
 
 def parse_input_string(text: str, name: str = "root") -> InputDatabase:
-    text = _normalize_braces(_strip_comments(text))
     root = InputDatabase(name)
-    stack: List[InputDatabase] = [root]
-    lines = text.splitlines()
-    i = 0
-    while i < len(lines):
-        line = lines[i].strip()
-        i += 1
-        if not line:
-            continue
-        # allow "Name {" possibly with trailing content handled line-wise
-        m = _SECTION_RE.match(line)
-        if m:
-            child = InputDatabase(m.group(1))
-            stack[-1].put(m.group(1), child)
-            stack.append(child)
-            continue
-        if _CLOSE_RE.match(line):
-            if len(stack) == 1:
-                raise ValueError("unbalanced '}' in input file")
-            stack.pop()
-            continue
-        m = _ASSIGN_RE.match(line)
-        if m:
-            key, raw = m.group(1), m.group(2).strip()
-            # multi-line arrays: keep consuming while line ends with ','
-            while raw.endswith(",") and i < len(lines):
-                raw += " " + lines[i].strip()
-                i += 1
-            stack[-1].put(key, _parse_value(raw))
-            continue
-        raise ValueError(f"cannot parse input line: {line!r}")
-    if len(stack) != 1:
-        raise ValueError("unbalanced '{' in input file")
+    toks, source = _tokenize(text)
+    _Parser(toks, source).parse_body(root, top=True)
     return root
 
 

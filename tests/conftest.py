@@ -11,11 +11,33 @@ Must set env vars BEFORE jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The container pins JAX_PLATFORMS=axon (single real TPU chip behind a
+# loopback relay) and a sitecustomize hook that registers that backend in
+# every interpreter and would force-initialize it on first jax compute —
+# even under JAX_PLATFORMS=cpu. Tests must run on the virtual CPU mesh
+# (eager ops over the tunnel are ~1000x slower and hang forever if the
+# relay is down), so below we drop the axon backend factory before any
+# compute happens.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402  (import before any test module does)
+
+try:  # private jax API; harmless to skip if it moves between releases
+    from jax._src import xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+except Exception:
+    pass
+jax.config.update("jax_platforms", "cpu")
+
+# Allow float64 in tests: production state is f32 (TPU), but convergence
+# tests validate the SAME operators at f64 on CPU so truncation error is
+# measured above the roundoff floor (SURVEY.md §7.3 hard-part #2).
+jax.config.update("jax_enable_x64", True)
 
 import jax  # noqa: E402
 import pytest  # noqa: E402

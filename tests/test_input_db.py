@@ -90,3 +90,27 @@ def test_multiline_array():
     """
     db = parse_input_string(text)
     assert db.get_float_array("arr") == [1.0, 2.0, 3.0]
+
+
+def test_unquoted_paths_and_atoms():
+    db = parse_input_string("""
+    dirname = viz2d/data
+    file = data.txt
+    precond = FAC-precond
+    """)
+    assert db.get_string("dirname") == "viz2d/data"
+    assert db.get_string("file") == "data.txt"
+    assert db.get_string("precond") == "FAC-precond"
+
+
+def test_caret_power_and_inline_multi_assign():
+    db = parse_input_string("Main { L = 2^6  N = 4*4  x = 1.5, 2.5 }")
+    m = db.get_database("Main")
+    assert m.get_int("L") == 64
+    assert m.get_int("N") == 16
+    assert m.get_float_array("x") == [1.5, 2.5]
+
+
+def test_escaped_quotes_in_strings():
+    db = parse_input_string(r'''s = "say \"hi\" // not a comment"''')
+    assert db.get_string("s") == 'say "hi" // not a comment'

@@ -1,0 +1,154 @@
+"""Stage-1 acceptance (SURVEY.md §7.2 stage 1): MMS convergence of the MAC
+vector calculus vs analytic fields, exact discrete identities, adjointness.
+
+The manufactured fields are periodic trigonometric polynomials; the NumPy
+oracle is the analytic derivative evaluated at the correct staggering.
+"""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ibamr_tpu.grid import StaggeredGrid
+from ibamr_tpu.ops import stencils
+from ibamr_tpu.ops.norms import dot, max_norm
+
+TWO_PI = 2.0 * math.pi
+
+
+F64 = jnp.float64
+
+
+def _grid2(n):
+    return StaggeredGrid(n=(n, n), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+
+
+def _grid3(n):
+    return StaggeredGrid(n=(n, n, n), x_lo=(0.0, 0.0, 0.0), x_up=(1.0, 1.0, 1.0))
+
+
+def _err_ratio(errs):
+    """Average observed convergence order from successive halvings."""
+    orders = [math.log2(errs[i] / errs[i + 1]) for i in range(len(errs) - 1)]
+    return sum(orders) / len(orders)
+
+
+def test_divergence_convergence_2d():
+    errs = []
+    for n in (16, 32, 64):
+        g = _grid2(n)
+        xf, yc = g.face_centers(0, F64)
+        xc, yf = g.face_centers(1, F64)
+        u = jnp.sin(TWO_PI * xf) * jnp.cos(TWO_PI * yc) + 0 * yc
+        v = jnp.cos(TWO_PI * xc) * jnp.sin(TWO_PI * yf) + 0 * xc
+        div = stencils.divergence((u, v), g.dx)
+        cx, cy = g.cell_centers(F64)
+        exact = 2 * TWO_PI * jnp.cos(TWO_PI * cx) * jnp.cos(TWO_PI * cy)
+        errs.append(float(max_norm(div - exact)))
+    assert _err_ratio(errs) > 1.9
+
+
+def test_gradient_convergence_2d():
+    errs = []
+    for n in (16, 32, 64):
+        g = _grid2(n)
+        cx, cy = g.cell_centers(F64)
+        p = jnp.sin(TWO_PI * cx) * jnp.sin(TWO_PI * cy)
+        gx, gy = stencils.gradient(p, g.dx)
+        xf, yc = g.face_centers(0, F64)
+        exact_gx = TWO_PI * jnp.cos(TWO_PI * xf) * jnp.sin(TWO_PI * yc)
+        errs.append(float(max_norm(gx - exact_gx)))
+    assert _err_ratio(errs) > 1.9
+
+
+def test_laplacian_convergence_3d():
+    errs = []
+    for n in (16, 32, 64):
+        g = _grid3(n)
+        cx, cy, cz = g.cell_centers(F64)
+        p = jnp.sin(TWO_PI * cx) * jnp.sin(TWO_PI * cy) * jnp.sin(TWO_PI * cz)
+        lap = stencils.laplacian(p, g.dx)
+        exact = -3 * TWO_PI ** 2 * p
+        errs.append(float(max_norm(lap - exact)))
+    assert _err_ratio(errs) > 1.9
+
+
+def test_div_grad_equals_laplacian_exactly():
+    g = _grid2(32)
+    rng = np.random.default_rng(0)
+    p = jnp.asarray(rng.standard_normal(g.n), dtype=jnp.float64)
+    lhs = stencils.divergence(stencils.gradient(p, g.dx), g.dx)
+    rhs = stencils.laplacian(p, g.dx)
+    np.testing.assert_allclose(np.asarray(lhs), np.asarray(rhs),
+                               rtol=0, atol=1e-10)
+
+
+def test_gradient_is_negative_adjoint_of_divergence():
+    """<grad p, u> = -<p, div u> on the periodic MAC grid (exact identity)."""
+    for gridmk in (_grid2, _grid3):
+        g = gridmk(16)
+        rng = np.random.default_rng(1)
+        p = jnp.asarray(rng.standard_normal(g.n), dtype=jnp.float32)
+        u = tuple(jnp.asarray(rng.standard_normal(g.n), dtype=jnp.float32)
+                  for _ in range(g.dim))
+        lhs = float(dot(stencils.gradient(p, g.dx), u, g.cell_volume))
+        rhs = -float(dot(p, stencils.divergence(u, g.dx), g.cell_volume))
+        assert lhs == pytest.approx(rhs, rel=1e-4, abs=1e-5)
+
+
+def test_cc_fc_interp_preserves_constants_and_converges():
+    g = _grid2(32)
+    c = jnp.full(g.n, 3.25, dtype=jnp.float32)
+    for comp in stencils.cc_to_fc(c):
+        np.testing.assert_allclose(np.asarray(comp), 3.25, rtol=1e-6)
+    for comp in stencils.fc_to_cc((c, c)):
+        np.testing.assert_allclose(np.asarray(comp), 3.25, rtol=1e-6)
+
+    errs = []
+    for n in (16, 32, 64):
+        g = _grid2(n)
+        cx, cy = g.cell_centers(F64)
+        p = jnp.sin(TWO_PI * cx) * jnp.cos(TWO_PI * cy)
+        px = stencils.cc_to_fc(p)[0]
+        xf, yc = g.face_centers(0, F64)
+        exact = jnp.sin(TWO_PI * xf) * jnp.cos(TWO_PI * yc)
+        errs.append(float(max_norm(px - exact)))
+    assert _err_ratio(errs) > 1.9
+
+
+def test_curl_2d_convergence():
+    errs = []
+    for n in (16, 32, 64):
+        g = _grid2(n)
+        xf, yc = g.face_centers(0, F64)
+        xc, yf = g.face_centers(1, F64)
+        # streamfunction psi = sin(2pi x) sin(2pi y): u = dpsi/dy, v = -dpsi/dx
+        u = TWO_PI * jnp.sin(TWO_PI * xf) * jnp.cos(TWO_PI * yc)
+        v = -TWO_PI * jnp.cos(TWO_PI * xc) * jnp.sin(TWO_PI * yf)
+        w = stencils.curl_2d_node((u, v), g.dx)
+        xn = g.face_coords_1d(0, F64)[:, None]
+        yn = g.face_coords_1d(1, F64)[None, :]
+        exact = 2 * TWO_PI ** 2 * jnp.sin(TWO_PI * xn) * jnp.sin(TWO_PI * yn)
+        errs.append(float(max_norm(w - exact)))
+    assert _err_ratio(errs) > 1.9
+
+
+def test_fc_component_to_fc_linear_exact():
+    """The 4-point cross average reproduces linear fields exactly up to
+    periodic wrap; test on interior away from the wrap."""
+    g = _grid2(16)
+    xf, yc = g.face_centers(0, F64)
+    u = (2.0 * xf + 3.0 * yc) + 0.0 * yc
+    u_at_v = stencils.fc_component_to_fc((u, u), src=0, dst=1)
+    xc, yf = g.face_centers(1, F64)
+    exact = 2.0 * xc + 3.0 * yf
+    err = np.abs(np.asarray(u_at_v - exact))[2:-2, 2:-2]
+    assert err.max() < 1e-5
+
+
+def test_position_to_index():
+    g = StaggeredGrid(n=(8, 8), x_lo=(0.0, -1.0), x_up=(2.0, 1.0))
+    idx = g.position_to_index(jnp.array([[0.125, -0.875]]))
+    np.testing.assert_allclose(np.asarray(idx), [[0.5, 0.5]], atol=1e-6)

@@ -109,3 +109,13 @@ def test_checkpoint_prune(tmp_path):
     steps = sorted(int(f.split(".")[1]) for f in os.listdir(d)
                    if f.endswith(".npz"))
     assert steps == [3, 4]
+
+
+def test_gridfunction_piecewise_conditionals():
+    f = CartGridFunction("X_0 if X_0 > 0.5 else 0.0", dim=1)
+    x = jnp.array([0.25, 0.75])
+    out = np.asarray(f((x,)))
+    np.testing.assert_allclose(out, [0.0, 0.75])
+    g = CartGridFunction("(X_0 > 0.2 and X_0 < 0.8) * 2.0", dim=1)
+    out = np.asarray(g((x,)))
+    np.testing.assert_allclose(out, [2.0, 2.0])
