@@ -1,0 +1,3 @@
+from ibamr_tpu.integrators.ins import INSState, INSStaggeredIntegrator
+
+__all__ = ["INSState", "INSStaggeredIntegrator"]

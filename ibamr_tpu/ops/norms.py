@@ -9,9 +9,21 @@ PETSc VecNorm/VecDot, SURVEY.md §2.4); under sharding XLA lowers them to
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import Any, Sequence
 
+import jax
 import jax.numpy as jnp
+
+
+def tree_dot(a: Any, b: Any) -> jnp.ndarray:
+    """Unweighted inner product over any matching pytrees (the primitive
+    under every norm and Krylov residual in the framework)."""
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    s = jnp.sum(la[0] * lb[0])
+    for x, y in zip(la[1:], lb[1:]):
+        s = s + jnp.sum(x * y)
+    return s
 
 
 def l1_norm(f: jnp.ndarray, cell_volume: float = 1.0) -> jnp.ndarray:
@@ -42,9 +54,4 @@ def vel_max_norm(u: Sequence[jnp.ndarray]) -> jnp.ndarray:
 
 def dot(a, b, cell_volume: float = 1.0) -> jnp.ndarray:
     """Volume-weighted inner product of two fields or two velocity tuples."""
-    if isinstance(a, (tuple, list)):
-        s = jnp.sum(a[0] * b[0])
-        for x, y in zip(a[1:], b[1:]):
-            s = s + jnp.sum(x * y)
-        return s * cell_volume
-    return jnp.sum(a * b) * cell_volume
+    return tree_dot(a, b) * cell_volume

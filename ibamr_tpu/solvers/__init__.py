@@ -1,0 +1,3 @@
+from ibamr_tpu.solvers import fft, krylov
+
+__all__ = ["fft", "krylov"]

@@ -1,0 +1,94 @@
+"""Spectral (FFT) solvers for the periodic uniform level.
+
+Reference parity: on periodic uniform grids these replace the whole
+FAC-multigrid + hypre stack (T8) and the Poisson/Helmholtz sub-solves of
+the staggered Stokes projection preconditioner (P3) — SURVEY.md §3.3 "for
+uniform-grid periodic acceptance configs the whole saddle solve collapses
+to FFT Poisson projection + FFT Helmholtz".
+
+Key design point: the inverted symbol is that of the **discrete** 2d+1-point
+Laplacian, ``lam_k = (2 cos(2 pi k / n) - 2) / h^2`` per axis — NOT the
+continuous ``-|k|^2``. Using the discrete symbol makes ``div u`` after
+projection zero to machine precision, because FFT-solve(discrete symbol) is
+the exact inverse of the stencil operator. The same circulant symbol applies
+to cell- and face-centered fields (staggering shifts eigenvectors by a
+phase, not eigenvalues), so one solver serves pressure and velocity.
+
+On TPU, jnp.fft lowers to XLA's FFT; under sharding the transform induces
+the all-to-all transposes over ICI that are this method's true long-range
+communication (SURVEY.md §5.7).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+
+Vel = Tuple[jnp.ndarray, ...]
+
+
+def laplacian_symbol(shape: Sequence[int], dx: Sequence[float],
+                     dtype=jnp.float32) -> jnp.ndarray:
+    """Symbol (eigenvalues) of the discrete periodic Laplacian on the
+    rfftn-truncated spectral grid: sum_d (2 cos(2 pi k_d / n_d) - 2)/h_d^2.
+    Shape: rfftn output shape for a real input of ``shape``."""
+    dim = len(shape)
+    sym = None
+    for d in range(dim):
+        n = shape[d]
+        k = (jnp.fft.rfftfreq(n) if d == dim - 1 else jnp.fft.fftfreq(n))
+        lam = (2.0 * jnp.cos(2.0 * math.pi * k) - 2.0) / (dx[d] ** 2)
+        lam = lam.astype(dtype)
+        bshape = [1] * dim
+        bshape[d] = lam.shape[0]
+        lam = lam.reshape(bshape)
+        sym = lam if sym is None else sym + lam
+    return sym
+
+
+def solve_poisson_periodic(rhs: jnp.ndarray, dx: Sequence[float]) -> jnp.ndarray:
+    """Solve lap(p) = rhs on the periodic grid; returns the zero-mean
+    solution (rhs mean is projected out — the periodic compatibility
+    condition)."""
+    sym = laplacian_symbol(rhs.shape, dx, rhs.dtype)
+    rhat = jnp.fft.rfftn(rhs)
+    # zero out the k=0 mode (symbol is 0 there): fixes the nullspace
+    sym_safe = jnp.where(sym == 0, 1.0, sym)
+    phat = jnp.where(sym == 0, 0.0, rhat / sym_safe)
+    p = jnp.fft.irfftn(phat, s=rhs.shape)
+    return p.astype(rhs.dtype)
+
+
+def solve_helmholtz_periodic(rhs: jnp.ndarray, dx: Sequence[float],
+                             alpha: float, beta: float) -> jnp.ndarray:
+    """Solve (alpha + beta * lap) u = rhs on the periodic grid.
+
+    For Crank-Nicolson viscous steps: alpha = rho/dt, beta = -mu/2.
+    Requires alpha + beta*lam != 0 for all modes (true for alpha>0, beta<0).
+    """
+    sym = laplacian_symbol(rhs.shape, dx, rhs.dtype)
+    rhat = jnp.fft.rfftn(rhs)
+    uhat = rhat / (alpha + beta * sym)
+    u = jnp.fft.irfftn(uhat, s=rhs.shape)
+    return u.astype(rhs.dtype)
+
+
+def solve_helmholtz_periodic_vel(rhs: Vel, dx: Sequence[float],
+                                 alpha: float, beta: float) -> Vel:
+    """Component-wise Helmholtz solve for a MAC velocity (same symbol for
+    every staggering)."""
+    return tuple(solve_helmholtz_periodic(c, dx, alpha, beta) for c in rhs)
+
+
+def project_divergence_free(u: Vel, dx: Sequence[float]) -> Tuple[Vel, jnp.ndarray]:
+    """Exact discrete Leray projection: phi = lap^{-1}(div u);
+    u_proj = u - grad(phi). Returns (u_proj, phi). div(u_proj) == 0 to
+    machine precision because the FFT inverse matches the stencils."""
+    from ibamr_tpu.ops import stencils
+
+    div = stencils.divergence(u, dx)
+    phi = solve_poisson_periodic(div, dx)
+    g = stencils.gradient(phi, dx)
+    return tuple(c - gc for c, gc in zip(u, g)), phi

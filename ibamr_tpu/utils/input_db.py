@@ -190,39 +190,42 @@ class InputDatabase:
 # --------------------------------------------------------------------------
 
 def _strip_comments(text: str) -> str:
-    # Remove /* */ block comments, then // and # line comments (outside
-    # strings; escaped quotes inside strings are honored). Replacement
-    # preserves length so token offsets index the original text.
-    def _blank(m):
-        return re.sub(r"[^\n]", " ", m.group(0))
-
-    text = re.sub(r"/\*.*?\*/", _blank, text, flags=re.S)
-    out_lines = []
-    for line in text.splitlines():
-        result, in_str, esc = [], False, False
-        i = 0
-        while i < len(line):
-            c = line[i]
-            if in_str:
-                result.append(c)
-                if esc:
-                    esc = False
-                elif c == "\\":
-                    esc = True
-                elif c == '"':
-                    in_str = False
+    """Remove ``//``, ``#`` line comments and ``/* */`` block comments in a
+    single string-aware pass: comment markers inside quoted strings (with
+    escape support) are left alone. Newlines are preserved."""
+    out: List[str] = []
+    i, n = 0, len(text)
+    in_str = esc = False
+    while i < n:
+        c = text[i]
+        if in_str:
+            out.append(c)
+            if esc:
+                esc = False
+            elif c == "\\":
+                esc = True
             elif c == '"':
-                in_str = True
-                result.append(c)
-            elif c == "/" and i + 1 < len(line) and line[i + 1] == "/":
-                break
-            elif c == "#":  # also accept shell-style comments
-                break
-            else:
-                result.append(c)
+                in_str = False
             i += 1
-        out_lines.append("".join(result))
-    return "\n".join(out_lines)
+        elif c == '"':
+            in_str = True
+            out.append(c)
+            i += 1
+        elif c == "/" and i + 1 < n and text[i + 1] == "/":
+            while i < n and text[i] != "\n":
+                i += 1
+        elif c == "#":  # also accept shell-style comments
+            while i < n and text[i] != "\n":
+                i += 1
+        elif c == "/" and i + 1 < n and text[i + 1] == "*":
+            j = text.find("*/", i + 2)
+            end = n if j == -1 else j + 2
+            out.append("\n" * text.count("\n", i, end))
+            i = end
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
 
 
 def _parse_scalar(tok: str, raw: Optional[str] = None) -> Scalar:
@@ -264,7 +267,8 @@ _TOKEN_RE = re.compile(r"""
     "(?:[^"\\]|\\.)*"                   # quoted string
   | \d+\.?\d*(?:[eE][+-]?\d+)?          # number (123, 1.5, 1e-3)
   | \.\d+(?:[eE][+-]?\d+)?              # .5
-  | [A-Za-z_]\w*                        # identifier / keyword
+  | [A-Za-z_]\w*(?:-[A-Za-z_]\w*)*      # identifier (hyphens allowed when
+                                        # letter-adjacent: max-levels)
   | \*\*                                # power
   | [{}=,()+\-*/^%]                     # punctuation & operators
   | [^\s{}=,"()+\-*/^%]+                # catch-all atom (paths, etc.)
@@ -296,7 +300,7 @@ def _tokenize(text: str) -> Tuple[List["_Tok"], str]:
     return toks, text
 
 
-_IDENT_RE = re.compile(r"[A-Za-z_]\w*\Z")
+_IDENT_RE = re.compile(r"[A-Za-z_]\w*(?:-[A-Za-z_]\w*)*\Z")
 
 
 class _Parser:

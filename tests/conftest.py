@@ -24,7 +24,8 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
-import jax  # noqa: E402  (import before any test module does)
+import jax  # noqa: E402
+import pytest  # noqa: E402
 
 try:  # private jax API; harmless to skip if it moves between releases
     from jax._src import xla_bridge as _xb
@@ -38,9 +39,6 @@ jax.config.update("jax_platforms", "cpu")
 # tests validate the SAME operators at f64 on CPU so truncation error is
 # measured above the roundoff floor (SURVEY.md §7.3 hard-part #2).
 jax.config.update("jax_enable_x64", True)
-
-import jax  # noqa: E402
-import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")

@@ -114,3 +114,12 @@ def test_caret_power_and_inline_multi_assign():
 def test_escaped_quotes_in_strings():
     db = parse_input_string(r'''s = "say \"hi\" // not a comment"''')
     assert db.get_string("s") == 'say "hi" // not a comment'
+
+
+def test_hyphenated_keys_and_block_comment_in_string():
+    db = parse_input_string("""
+    max-levels = 3
+    pattern = "viz/*"   /* a real comment */
+    """)
+    assert db.get_int("max-levels") == 3
+    assert db.get_string("pattern") == "viz/*"
