@@ -1,0 +1,162 @@
+"""Immersed-boundary coupling integrator (explicit schemes).
+
+Reference parity (SURVEY.md §3.2): ``IBExplicitHierarchyIntegrator`` (P8)
+driving the ``IBStrategy`` contract (P7) implemented by ``IBMethod`` (P9)
+with ``LDataManager`` marker data (T1) and ``IBStandardForceGen`` forces
+(P11). One midpoint timestep:
+
+  U^n      = J(X^n) u^n                       (interpolateVelocity)
+  X^{n+1/2} = X^n + dt/2 U^n                  (forwardEulerStep half)
+  F^{n+1/2} = Force(X^{n+1/2}, U^n)           (computeLagrangianForce)
+  f         = S(X^{n+1/2}) F^{n+1/2}          (spreadForce)
+  u^{n+1}   = INS step with body force f      (fluid solve, §3.3)
+  U^{n+1/2} = J(X^{n+1/2}) (u^n + u^{n+1})/2  (interpolateVelocity)
+  X^{n+1}   = X^n + dt U^{n+1/2}              (midpointStep)
+
+TPU-first design: the marker set is a fixed-capacity ``(N, dim)`` array
+plus an active mask (SURVEY.md §7.1); the entire step — force SoA
+evaluation, spread scatter, FFT fluid solve, interp gather — is one pure
+jittable function, so ``lax.scan`` runs whole simulations on-device.
+
+The ``IBMethod`` plugin seam survives as a small Python protocol: anything
+with ``compute_force(X, U, t)`` can replace the standard force generator
+(the analog of registering a custom IBLagrangianForceStrategy).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ibamr_tpu.grid import StaggeredGrid
+from ibamr_tpu.integrators.ins import INSState, INSStaggeredIntegrator
+from ibamr_tpu.ops import forces as force_mod
+from ibamr_tpu.ops import interaction
+from ibamr_tpu.ops.delta import Kernel
+
+Vel = Tuple[jnp.ndarray, ...]
+
+
+class IBState(NamedTuple):
+    """Coupled fluid + structure state pytree."""
+    ins: INSState
+    X: jnp.ndarray       # (N, dim) marker positions
+    U: jnp.ndarray       # (N, dim) marker velocities (diagnostic / damping)
+    mask: jnp.ndarray    # (N,) 0/1 active-slot mask (fixed-capacity pool)
+
+
+class IBMethod:
+    """Classic marker-IB structure container (P9 parity).
+
+    Holds the force specs and the delta kernel choice; provides the
+    spread / interpolate / force operations the coupling integrator calls
+    through the IBStrategy-shaped interface.
+    """
+
+    def __init__(self, specs: force_mod.ForceSpecs,
+                 kernel: Kernel = "IB_4",
+                 force_fn: Optional[Callable] = None):
+        self.specs = specs
+        self.kernel = kernel
+        self.force_fn = force_fn  # optional custom force strategy
+
+    def compute_force(self, X: jnp.ndarray, U: jnp.ndarray,
+                      t) -> jnp.ndarray:
+        if self.force_fn is not None:
+            return self.force_fn(X, U, t)
+        return force_mod.compute_lagrangian_force(X, U, self.specs)
+
+    def interpolate_velocity(self, u: Vel, grid: StaggeredGrid,
+                             X: jnp.ndarray,
+                             mask: jnp.ndarray) -> jnp.ndarray:
+        return interaction.interpolate_vel(u, grid, X, kernel=self.kernel,
+                                           weights=mask)
+
+    def spread_force(self, F: jnp.ndarray, grid: StaggeredGrid,
+                     X: jnp.ndarray, mask: jnp.ndarray) -> Vel:
+        return interaction.spread_vel(F, grid, X, kernel=self.kernel,
+                                      weights=mask)
+
+
+class IBExplicitIntegrator:
+    """Explicit IB coupling of an INS integrator and an IBMethod (P8)."""
+
+    def __init__(self, ins: INSStaggeredIntegrator, ib: IBMethod,
+                 scheme: str = "midpoint"):
+        if scheme not in ("midpoint", "forward_euler"):
+            raise ValueError(f"unknown IB time stepping scheme {scheme!r}")
+        self.ins = ins
+        self.ib = ib
+        self.scheme = scheme
+
+    # -- state ---------------------------------------------------------------
+    def initialize(self, X0, ins_state: Optional[INSState] = None,
+                   mask=None) -> IBState:
+        dtype = self.ins.dtype
+        X = jnp.asarray(X0, dtype=dtype)
+        if ins_state is None:
+            ins_state = self.ins.initialize()
+        if mask is None:
+            mask = jnp.ones(X.shape[0], dtype=dtype)
+        return IBState(ins=ins_state, X=X,
+                       U=jnp.zeros_like(X),
+                       mask=jnp.asarray(mask, dtype=dtype))
+
+    # -- single step (pure, jittable) ----------------------------------------
+    def step(self, state: IBState, dt: float) -> IBState:
+        grid = self.ins.grid
+        ib = self.ib
+        u_n = state.ins.u
+        X_n = state.X
+
+        # structure prediction to the half step
+        U_n = ib.interpolate_velocity(u_n, grid, X_n, state.mask)
+        if self.scheme == "midpoint":
+            X_half = X_n + 0.5 * dt * U_n
+        else:
+            X_half = X_n
+
+        # Lagrangian force at the half step, spread to the grid
+        t_half = state.ins.t + 0.5 * dt
+        F_half = ib.compute_force(X_half, U_n, t_half)
+        f_eul = ib.spread_force(F_half, grid, X_half, state.mask)
+
+        # fluid solve with the IB body force
+        ins_new = self.ins.step(state.ins, dt, f=f_eul)
+
+        # corrector: move markers with the midpoint velocity
+        if self.scheme == "midpoint":
+            u_half = tuple(0.5 * (a + b) for a, b in zip(u_n, ins_new.u))
+            U_half = ib.interpolate_velocity(u_half, grid, X_half, state.mask)
+            X_new = X_n + dt * U_half
+            U_out = U_half
+        else:
+            X_new = X_n + dt * U_n
+            U_out = U_n
+
+        return IBState(ins=ins_new, X=X_new, U=U_out, mask=state.mask)
+
+    # -- diagnostics ---------------------------------------------------------
+    def total_marker_force(self, state: IBState) -> jnp.ndarray:
+        F = self.ib.compute_force(state.X, state.U, state.ins.t)
+        return jnp.sum(F * state.mask[:, None], axis=0)
+
+
+def advance_ib(integrator: IBExplicitIntegrator, state: IBState, dt: float,
+               num_steps: int) -> IBState:
+    """Advance ``num_steps`` under one jitted lax.scan."""
+    def body(s, _):
+        return integrator.step(s, dt), None
+
+    out, _ = jax.lax.scan(body, state, None, length=num_steps)
+    return out
+
+
+def polygon_area(X: jnp.ndarray) -> jnp.ndarray:
+    """Shoelace area of a closed 2D marker loop (volume-conservation
+    diagnostic for the membrane acceptance configs)."""
+    x, y = X[:, 0], X[:, 1]
+    xn, yn = jnp.roll(x, -1), jnp.roll(y, -1)
+    return 0.5 * jnp.abs(jnp.sum(x * yn - xn * y))

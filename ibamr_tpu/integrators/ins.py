@@ -74,6 +74,9 @@ class INSStaggeredIntegrator:
         component), or a single vector-valued callable
         ``u0(coords_tuple, t) -> [array, ...]`` (what ``function_from_db``
         returns); each component is evaluated at its own face centers.
+        (A vector callable is invoked once per component — dim calls —
+        because each MAC component lives at different coordinates; pass
+        per-component callables or arrays to avoid the redundant work.)
         ``u0_arrays`` passes raw MAC arrays directly."""
         g = self.grid
         if u0_arrays is not None:
@@ -132,11 +135,9 @@ class INSStaggeredIntegrator:
         u_star = fft.solve_helmholtz_periodic_vel(
             tuple(rhs), dx, alpha=rho / dt, beta=-0.5 * mu)
 
-        # 3-4. exact projection
-        div_us = stencils.divergence(u_star, dx)
-        phi = fft.solve_poisson_periodic((rho / dt) * div_us, dx)
-        gphi = stencils.gradient(phi, dx)
-        u_new = tuple(us - (dt / rho) * gc for us, gc in zip(u_star, gphi))
+        # 3-4. exact projection (phi0 = lap^{-1} div u*; phi = (rho/dt) phi0)
+        u_new, phi0 = fft.project_divergence_free(u_star, dx)
+        phi = (rho / dt) * phi0
 
         # 5. pressure update (pressure-increment form w/ viscous correction)
         p_new = p + phi - (0.5 * mu * dt / rho) * stencils.laplacian(phi, dx)

@@ -1,0 +1,4 @@
+from ibamr_tpu.io.structures import (
+    StructureData, read_structure, write_structure)
+
+__all__ = ["StructureData", "read_structure", "write_structure"]

@@ -1,0 +1,148 @@
+"""Readers/writers for the reference's structure input file formats.
+
+Reference parity: ``IBStandardInitializer`` (P10) parsing of
+``<name>.vertex/.spring/.beam/.target`` files (formats per SURVEY.md
+Appendix B):
+
+  name.vertex: line 1 = N;  then N lines  "x y [z]"
+  name.spring: line 1 = M;  then M lines  "idx0 idx1 stiffness rest_length
+                                           [force_fcn_idx]"
+  name.beam:   line 1 = M;  then M lines  "prev mid next bend_rigidity
+                                           [curvature components]"
+  name.target: line 1 = M;  then M lines  "idx stiffness [damping]"
+
+Indices are 0-based within the structure, as in the reference. Parsing is
+host-side (NumPy); the result converts to device SoA specs via
+``StructureData.force_specs()``. A writer is provided for tests and
+example generation (the reference ships pre-generated files instead).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from ibamr_tpu.ops import forces
+
+
+def _read_table(path: str, min_cols: int, max_cols: int,
+                what: str) -> np.ndarray:
+    with open(path) as f:
+        tokens = f.read().split("\n")
+    lines = [ln.split("#")[0].strip() for ln in tokens]
+    lines = [ln for ln in lines if ln]
+    if not lines:
+        raise ValueError(f"{path}: empty {what} file")
+    try:
+        count = int(lines[0].split()[0])
+    except ValueError:
+        raise ValueError(f"{path}: first line must be the {what} count")
+    rows = []
+    for ln in lines[1:count + 1]:
+        cols = ln.split()
+        if not (min_cols <= len(cols) <= max_cols):
+            raise ValueError(
+                f"{path}: expected {min_cols}..{max_cols} columns, got "
+                f"{len(cols)}: {ln!r}")
+        rows.append([float(c) for c in cols])
+    if len(rows) != count:
+        raise ValueError(
+            f"{path}: declared {count} {what} entries, found {len(rows)}")
+    width = max(len(r) for r in rows)
+    out = np.zeros((count, width))
+    for i, r in enumerate(rows):
+        out[i, :len(r)] = r
+    return out
+
+
+@dataclass
+class StructureData:
+    """One structure's host-side data (the P10 'initializer' product)."""
+    name: str
+    vertices: np.ndarray                 # (N, dim)
+    springs: Optional[np.ndarray] = None   # (M, >=4): idx0 idx1 k L0 [fcn]
+    beams: Optional[np.ndarray] = None     # (M, >=4): prev mid next c [C0...]
+    targets: Optional[np.ndarray] = None   # (M, >=2): idx kappa [damping]
+    index_offset: int = 0                # global offset when concatenating
+    extra: dict = field(default_factory=dict)
+
+    @property
+    def num_markers(self) -> int:
+        return self.vertices.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.vertices.shape[1]
+
+    def force_specs(self) -> forces.ForceSpecs:
+        """Device SoA force specs with indices shifted by index_offset."""
+        off = self.index_offset
+        springs = beams = targets = None
+        if self.springs is not None and len(self.springs):
+            s = self.springs
+            springs = forces.make_springs(
+                s[:, 0].astype(np.int32) + off,
+                s[:, 1].astype(np.int32) + off,
+                s[:, 2], s[:, 3])
+        if self.beams is not None and len(self.beams):
+            b = self.beams
+            curv = b[:, 4:4 + self.dim] if b.shape[1] >= 4 + self.dim else None
+            beams = forces.make_beams(
+                b[:, 0].astype(np.int32) + off,
+                b[:, 1].astype(np.int32) + off,
+                b[:, 2].astype(np.int32) + off,
+                b[:, 3], curv, dim=self.dim)
+        if self.targets is not None and len(self.targets):
+            t = self.targets
+            idx = t[:, 0].astype(np.int32)
+            damping = t[:, 2] if t.shape[1] > 2 else None
+            targets = forces.make_targets(
+                idx + off, t[:, 1], self.vertices[idx], damping)
+        return forces.ForceSpecs(springs=springs, beams=beams,
+                                 targets=targets)
+
+
+def read_structure(basename: str, dim: Optional[int] = None) -> StructureData:
+    """Read ``basename.vertex`` (+ optional .spring/.beam/.target)."""
+    vpath = basename + ".vertex"
+    if not os.path.exists(vpath):
+        raise FileNotFoundError(vpath)
+    verts = _read_table(vpath, 2, 3, "vertex")
+    if dim is not None:
+        verts = verts[:, :dim]
+    data = StructureData(name=os.path.basename(basename), vertices=verts)
+    d = verts.shape[1]
+    if os.path.exists(basename + ".spring"):
+        data.springs = _read_table(basename + ".spring", 4, 5, "spring")
+    if os.path.exists(basename + ".beam"):
+        data.beams = _read_table(basename + ".beam", 4, 4 + d, "beam")
+    if os.path.exists(basename + ".target"):
+        data.targets = _read_table(basename + ".target", 2, 3, "target")
+    return data
+
+
+def write_structure(basename: str, data: StructureData) -> None:
+    """Write the structure back out in the reference formats."""
+    def _dump(path, arr, fmt):
+        with open(path, "w") as f:
+            f.write(f"{arr.shape[0]}\n")
+            for row in arr:
+                f.write(fmt(row) + "\n")
+
+    _dump(basename + ".vertex", data.vertices,
+          lambda r: " ".join(f"{v:.17g}" for v in r))
+    if data.springs is not None:
+        _dump(basename + ".spring", data.springs,
+              lambda r: f"{int(r[0])} {int(r[1])} " +
+              " ".join(f"{v:.17g}" for v in r[2:]))
+    if data.beams is not None:
+        _dump(basename + ".beam", data.beams,
+              lambda r: f"{int(r[0])} {int(r[1])} {int(r[2])} " +
+              " ".join(f"{v:.17g}" for v in r[3:]))
+    if data.targets is not None:
+        _dump(basename + ".target", data.targets,
+              lambda r: f"{int(r[0])} " +
+              " ".join(f"{v:.17g}" for v in r[1:]))

@@ -1,0 +1,4 @@
+from ibamr_tpu.models.membrane2d import (
+    build_membrane_example, make_circle_membrane)
+
+__all__ = ["build_membrane_example", "make_circle_membrane"]

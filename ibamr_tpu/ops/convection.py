@@ -49,6 +49,8 @@ def _upwind_p(f: jnp.ndarray, vel: jnp.ndarray, axis: int) -> jnp.ndarray:
 
 def convective_rate(u: Vel, dx: Sequence[float], scheme: str = "centered") -> Vel:
     """N(u)_d = sum_e d/dx_e(u_e u_d), each component at its own faces."""
+    if scheme not in ("centered", "upwind"):
+        raise ValueError(f"unknown convective scheme {scheme!r}")
     dim = len(u)
     out = []
     for d in range(dim):

@@ -1,0 +1,108 @@
+"""Regularized discrete delta-function kernels.
+
+Reference parity: the kernel menu of ``LEInteractor`` (T2, SURVEY.md §2.1):
+PIECEWISE_LINEAR, COSINE, IB_3, IB_4, BSPLINE_2..6, USER_DEFINED. The IB_*
+kernels are Peskin's classical immersed-boundary kernels satisfying the
+zeroth/first moment and even-odd sum conditions; the B-splines are cardinal
+B-splines (partition of unity + symmetry).
+
+TPU-first design: each kernel is a branch-free jnp expression on |r|
+(piecewise pieces combined with jnp.where / truncated powers), so the
+weight evaluation for all markers x all stencil offsets is one fused
+elementwise kernel — no per-marker control flow.
+
+All kernels are 1-D; multi-D weights are tensor products (as in the
+reference's Fortran loops).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, Tuple, Union
+
+import jax.numpy as jnp
+
+KernelFn = Callable[[jnp.ndarray], jnp.ndarray]
+KernelSpec = Tuple[int, KernelFn]  # (support in grid points, phi(r))
+
+
+def _phi_piecewise_linear(r: jnp.ndarray) -> jnp.ndarray:
+    a = jnp.abs(r)
+    return jnp.maximum(1.0 - a, 0.0)
+
+
+def _phi_cosine(r: jnp.ndarray) -> jnp.ndarray:
+    a = jnp.abs(r)
+    return jnp.where(a < 2.0, 0.25 * (1.0 + jnp.cos(0.5 * math.pi * a)), 0.0)
+
+
+def _phi_ib3(r: jnp.ndarray) -> jnp.ndarray:
+    a = jnp.abs(r)
+    # guard sqrt args so the unused branch never produces nan
+    inner = (1.0 + jnp.sqrt(jnp.maximum(1.0 - 3.0 * a * a, 0.0))) / 3.0
+    s = jnp.sqrt(jnp.maximum(1.0 - 3.0 * (1.0 - a) ** 2, 0.0))
+    outer = (5.0 - 3.0 * a - s) / 6.0
+    return jnp.where(a < 0.5, inner, jnp.where(a < 1.5, outer, 0.0))
+
+
+def _phi_ib4(r: jnp.ndarray) -> jnp.ndarray:
+    a = jnp.abs(r)
+    s_in = jnp.sqrt(jnp.maximum(1.0 + 4.0 * a - 4.0 * a * a, 0.0))
+    inner = 0.125 * (3.0 - 2.0 * a + s_in)
+    s_out = jnp.sqrt(jnp.maximum(-7.0 + 12.0 * a - 4.0 * a * a, 0.0))
+    outer = 0.125 * (5.0 - 2.0 * a - s_out)
+    return jnp.where(a < 1.0, inner, jnp.where(a < 2.0, outer, 0.0))
+
+
+def _make_bspline(order: int) -> KernelFn:
+    """Cardinal B-spline M_k via the truncated-power formula:
+    M_k(x) = 1/(k-1)! sum_j (-1)^j C(k,j) (x + k/2 - j)_+^{k-1}.
+    Support k grid points; C^{k-2} smooth; partition of unity."""
+    k = order
+    coef = [((-1) ** j) * math.comb(k, j) / math.factorial(k - 1)
+            for j in range(k + 1)]
+
+    def phi(r: jnp.ndarray) -> jnp.ndarray:
+        out = jnp.zeros_like(r)
+        for j in range(k + 1):
+            out = out + coef[j] * jnp.maximum(r + 0.5 * k - j, 0.0) ** (k - 1)
+        return jnp.where(jnp.abs(r) < 0.5 * k, out, 0.0)
+
+    return phi
+
+
+_KERNELS: Dict[str, KernelSpec] = {
+    "PIECEWISE_LINEAR": (2, _phi_piecewise_linear),
+    "COSINE": (4, _phi_cosine),
+    "IB_3": (3, _phi_ib3),
+    "IB_4": (4, _phi_ib4),
+    "BSPLINE_2": (2, _make_bspline(2)),
+    "BSPLINE_3": (3, _make_bspline(3)),
+    "BSPLINE_4": (4, _make_bspline(4)),
+    "BSPLINE_5": (5, _make_bspline(5)),
+    "BSPLINE_6": (6, _make_bspline(6)),
+}
+
+Kernel = Union[str, KernelSpec]
+
+
+def get_kernel(kernel: Kernel) -> KernelSpec:
+    """Resolve a kernel name (or a user-defined ``(support, phi)`` pair —
+    the USER_DEFINED path of the reference)."""
+    if isinstance(kernel, str):
+        try:
+            return _KERNELS[kernel.upper()]
+        except KeyError:
+            raise ValueError(
+                f"unknown delta kernel {kernel!r}; have {sorted(_KERNELS)}")
+    support, fn = kernel
+    return int(support), fn
+
+
+def stencil_size(kernel: Kernel) -> int:
+    """Reference parity: LEInteractor::getStencilSize."""
+    return get_kernel(kernel)[0]
+
+
+def available_kernels() -> Tuple[str, ...]:
+    return tuple(sorted(_KERNELS))

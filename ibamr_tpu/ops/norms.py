@@ -17,12 +17,15 @@ import jax.numpy as jnp
 
 def tree_dot(a: Any, b: Any) -> jnp.ndarray:
     """Unweighted inner product over any matching pytrees (the primitive
-    under every norm and Krylov residual in the framework)."""
-    la = jax.tree_util.tree_leaves(a)
-    lb = jax.tree_util.tree_leaves(b)
-    s = jnp.sum(la[0] * lb[0])
-    for x, y in zip(la[1:], lb[1:]):
-        s = s + jnp.sum(x * y)
+    under every norm and Krylov residual in the framework). Mismatched
+    structures raise (via tree_map); empty trees give 0.0."""
+    sums = jax.tree_util.tree_map(lambda x, y: jnp.sum(x * y), a, b)
+    leaves = jax.tree_util.tree_leaves(sums)
+    if not leaves:
+        return jnp.asarray(0.0)
+    s = leaves[0]
+    for x in leaves[1:]:
+        s = s + x
     return s
 
 

@@ -84,7 +84,8 @@ def fc_to_cc(u: Sequence[jnp.ndarray]) -> Vel:
 def fc_component_to_fc(u: Sequence[jnp.ndarray], src: int, dst: int) -> jnp.ndarray:
     """Interpolate component ``src`` onto the faces of component ``dst``
     (4-point average in the src/dst plane; identity if src == dst).
-    Needed by the MAC convective operator."""
+    Diagnostic/utility interpolation; the convective operator builds its
+    own edge-centered averages instead (ibamr_tpu.ops.convection)."""
     c = u[src]
     if src == dst:
         return c

@@ -1,0 +1,132 @@
+"""Stage-5 acceptance, part 1: force oracle vs NumPy; structure file IO
+round-trips (SURVEY.md §7.2 stage 5)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ibamr_tpu.io.structures import (
+    StructureData, read_structure, write_structure)
+from ibamr_tpu.ops import forces
+
+
+def test_spring_force_oracle():
+    X = jnp.asarray([[0.0, 0.0], [2.0, 0.0], [0.0, 1.0]], dtype=jnp.float64)
+    U = jnp.zeros_like(X)
+    # one spring 0-1: k=3, L0=1 -> stretched by 1, force on 0 = +3 x-hat
+    specs = forces.ForceSpecs(springs=forces.make_springs(
+        [0], [1], [3.0], [1.0]))
+    F = forces.compute_lagrangian_force(X, U, specs)
+    np.testing.assert_allclose(np.asarray(F),
+                               [[3.0, 0.0], [-3.0, 0.0], [0.0, 0.0]],
+                               atol=1e-12)
+
+
+def test_spring_newton_third_law_random():
+    rng = np.random.default_rng(0)
+    N, M = 20, 40
+    X = jnp.asarray(rng.standard_normal((N, 3)), dtype=jnp.float64)
+    specs = forces.ForceSpecs(springs=forces.make_springs(
+        rng.integers(0, N, M), rng.integers(0, N, M),
+        rng.uniform(0.5, 2.0, M), rng.uniform(0.1, 1.0, M)))
+    F = forces.compute_lagrangian_force(X, jnp.zeros_like(X), specs)
+    np.testing.assert_allclose(np.asarray(jnp.sum(F, axis=0)),
+                               np.zeros(3), atol=1e-12)
+
+
+def test_spring_force_is_negative_energy_gradient():
+    rng = np.random.default_rng(1)
+    N, M = 12, 25
+    X = jnp.asarray(rng.standard_normal((N, 2)) * 2, dtype=jnp.float64)
+    i0 = rng.integers(0, N, M)
+    i1 = (i0 + rng.integers(1, N, M)) % N  # no self-loops (energy not
+    # differentiable at zero length)
+    specs = forces.ForceSpecs(springs=forces.make_springs(
+        i0, i1, rng.uniform(0.5, 2.0, M), rng.uniform(0.5, 1.5, M)))
+    import jax
+    gradE = jax.grad(lambda x: forces.spring_energy(x, specs.springs))(X)
+    F = forces.compute_lagrangian_force(X, jnp.zeros_like(X), specs)
+    np.testing.assert_allclose(np.asarray(F), -np.asarray(gradE), atol=1e-10)
+
+
+def test_beam_force_oracle():
+    # three collinear points: no curvature -> no force; bent -> restoring
+    X = jnp.asarray([[0.0, 0.0], [1.0, 0.0], [2.0, 0.0]], dtype=jnp.float64)
+    specs = forces.ForceSpecs(beams=forces.make_beams([0], [1], [2], [2.0]))
+    F = forces.compute_lagrangian_force(X, jnp.zeros_like(X), specs)
+    np.testing.assert_allclose(np.asarray(F), np.zeros((3, 2)), atol=1e-12)
+
+    Xb = jnp.asarray([[0.0, 0.0], [1.0, 0.5], [2.0, 0.0]], dtype=jnp.float64)
+    F = forces.compute_lagrangian_force(Xb, jnp.zeros_like(Xb), specs)
+    # D = X0 - 2X1 + X2 = (0, -1); c=2 -> F1 = 2cD = (0,-4); F0=F2=-cD=(0,2)
+    np.testing.assert_allclose(np.asarray(F),
+                               [[0.0, 2.0], [0.0, -4.0], [0.0, 2.0]],
+                               atol=1e-12)
+    # bending force field sums to zero (internal force)
+    np.testing.assert_allclose(np.asarray(jnp.sum(F, axis=0)), [0.0, 0.0],
+                               atol=1e-12)
+
+
+def test_target_force_oracle():
+    X = jnp.asarray([[1.0, 1.0]], dtype=jnp.float64)
+    U = jnp.asarray([[0.5, 0.0]], dtype=jnp.float64)
+    specs = forces.ForceSpecs(targets=forces.make_targets(
+        [0], [10.0], jnp.asarray([[0.0, 1.0]]), damping=[2.0]))
+    F = forces.compute_lagrangian_force(X, U, specs)
+    # kappa (X0 - X) - eta U = 10*(-1,0) - 2*(0.5,0) = (-11, 0)
+    np.testing.assert_allclose(np.asarray(F), [[-11.0, 0.0]], atol=1e-12)
+
+
+def test_disabled_specs_masked_out():
+    X = jnp.asarray([[0.0, 0.0], [2.0, 0.0]], dtype=jnp.float64)
+    s = forces.make_springs([0], [1], [3.0], [1.0])
+    s = s._replace(enabled=jnp.zeros_like(s.enabled))
+    F = forces.compute_lagrangian_force(
+        X, jnp.zeros_like(X), forces.ForceSpecs(springs=s))
+    np.testing.assert_allclose(np.asarray(F), np.zeros((2, 2)), atol=1e-12)
+
+
+def test_structure_file_round_trip(tmp_path):
+    rng = np.random.default_rng(2)
+    N = 16
+    verts = rng.standard_normal((N, 2))
+    springs = np.stack([np.arange(N), (np.arange(N) + 1) % N,
+                        rng.uniform(1, 2, N), rng.uniform(0.1, 0.2, N)],
+                       axis=1)
+    beams = np.stack([(np.arange(N) - 1) % N, np.arange(N),
+                      (np.arange(N) + 1) % N, rng.uniform(0.1, 1, N)], axis=1)
+    targets = np.stack([np.arange(0, N, 4),
+                        rng.uniform(5, 10, len(range(0, N, 4))),
+                        rng.uniform(0, 1, len(range(0, N, 4)))], axis=1)
+    data = StructureData(name="loop", vertices=verts, springs=springs,
+                         beams=beams, targets=targets)
+    base = str(tmp_path / "loop")
+    write_structure(base, data)
+    back = read_structure(base)
+    np.testing.assert_allclose(back.vertices, verts, rtol=1e-15)
+    np.testing.assert_allclose(back.springs, springs, rtol=1e-15)
+    np.testing.assert_allclose(back.beams, beams, rtol=1e-15)
+    np.testing.assert_allclose(back.targets, targets, rtol=1e-15)
+    specs = back.force_specs()
+    assert specs.springs is not None
+    assert specs.beams is not None
+    assert specs.targets is not None
+
+
+def test_reader_validates(tmp_path):
+    p = tmp_path / "bad.vertex"
+    p.write_text("3\n0 0\n1 1\n")  # declares 3, provides 2
+    with pytest.raises(ValueError):
+        read_structure(str(tmp_path / "bad"))
+    with pytest.raises(FileNotFoundError):
+        read_structure(str(tmp_path / "missing"))
+
+
+def test_index_offset_for_concatenated_structures():
+    verts = np.zeros((4, 2))
+    springs = np.array([[0, 1, 1.0, 0.1]])
+    data = StructureData(name="s", vertices=verts, springs=springs,
+                         index_offset=100)
+    specs = data.force_specs()
+    assert int(specs.springs.idx0[0]) == 100
+    assert int(specs.springs.idx1[0]) == 101

@@ -1,0 +1,179 @@
+"""Stage-4 acceptance (SURVEY.md §7.2 stage 4): delta-kernel moment
+conditions, spread/interp adjointness, interpolation accuracy, conservation.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ibamr_tpu.grid import StaggeredGrid
+from ibamr_tpu.ops import delta, interaction
+
+ALL_KERNELS = delta.available_kernels()
+IB_KERNELS = ("IB_3", "IB_4")
+
+
+@pytest.mark.parametrize("name", ALL_KERNELS)
+def test_partition_of_unity(name):
+    """sum_j phi(r - j) == 1 for any shift r (zeroth moment)."""
+    support, phi = delta.get_kernel(name)
+    for r in np.linspace(-0.5, 0.5, 11):
+        js = np.arange(-support - 1, support + 2)
+        s = float(sum(phi(jnp.asarray(r - j, dtype=jnp.float64)) for j in js))
+        assert s == pytest.approx(1.0, abs=1e-12), (name, r)
+
+
+@pytest.mark.parametrize("name", ("IB_3", "IB_4", "PIECEWISE_LINEAR",
+                                  "BSPLINE_3", "BSPLINE_4", "BSPLINE_6"))
+def test_first_moment(name):
+    """sum_j (r - j) phi(r - j) == 0 (first moment -> force and torque
+    consistency of spread)."""
+    support, phi = delta.get_kernel(name)
+    for r in np.linspace(-0.5, 0.5, 7):
+        js = np.arange(-support - 2, support + 3)
+        m1 = float(sum((r - j) * phi(jnp.asarray(r - j, dtype=jnp.float64))
+                       for j in js))
+        assert m1 == pytest.approx(0.0, abs=1e-10), (name, r)
+
+
+@pytest.mark.parametrize("name", ["IB_4"])
+def test_even_odd_condition(name):
+    """The classic 4-point Peskin kernel satisfies the even-odd sum
+    condition sum_{j even} phi == sum_{j odd} phi == 1/2 (the 3-point Roma
+    kernel trades it for a second-moment condition instead)."""
+    support, phi = delta.get_kernel(name)
+    for r in np.linspace(-0.5, 0.5, 7):
+        js = np.arange(-support - 2, support + 3)
+        even = float(sum(phi(jnp.asarray(r - j, dtype=jnp.float64))
+                         for j in js if j % 2 == 0))
+        assert even == pytest.approx(0.5, abs=1e-10), (name, r)
+
+
+@pytest.mark.parametrize("name,expected", [("IB_3", 0.5), ("IB_4", 0.375)])
+def test_sum_of_squares_condition(name, expected):
+    """Peskin-family kernels: sum_j phi(r-j)^2 is independent of r
+    (= 1/2 for the 3-point Roma kernel, 3/8 for the 4-point Peskin)."""
+    support, phi = delta.get_kernel(name)
+    for r in np.linspace(-0.5, 0.5, 9):
+        js = np.arange(-support - 2, support + 3)
+        s2 = float(sum(phi(jnp.asarray(r - j, dtype=jnp.float64)) ** 2
+                       for j in js))
+        assert s2 == pytest.approx(expected, abs=1e-10), (name, r)
+
+
+def test_support_compact():
+    for name in ALL_KERNELS:
+        support, phi = delta.get_kernel(name)
+        edge = 0.5 * support
+        assert float(phi(jnp.asarray(edge + 1e-3))) == 0.0
+        assert float(phi(jnp.asarray(-edge - 1e-3))) == 0.0
+        assert float(phi(jnp.asarray(0.0))) > 0.0
+
+
+@pytest.mark.parametrize("dim", [2, 3])
+@pytest.mark.parametrize("kernel", ["IB_4", "IB_3", "BSPLINE_4"])
+def test_spread_interp_adjoint(dim, kernel):
+    """<spread(F), u> h^dim == sum_m F_m interp(u)_m, exactly."""
+    n = 16
+    g = StaggeredGrid(n=(n,) * dim, x_lo=(0.0,) * dim, x_up=(1.0,) * dim)
+    rng = np.random.default_rng(0)
+    N = 37
+    X = jnp.asarray(rng.uniform(0, 1, size=(N, dim)), dtype=jnp.float64)
+    F = jnp.asarray(rng.standard_normal(N), dtype=jnp.float64)
+    u = jnp.asarray(rng.standard_normal(g.n), dtype=jnp.float64)
+
+    f_spread = interaction.spread(F, g, X, centering="cell", kernel=kernel)
+    lhs = float(jnp.sum(f_spread * u)) * g.cell_volume
+    Um = interaction.interpolate(u, g, X, centering="cell", kernel=kernel)
+    rhs = float(jnp.sum(F * Um))
+    assert lhs == pytest.approx(rhs, rel=1e-12)
+
+
+def test_spread_conserves_total_force():
+    """integral of spread force == sum of marker forces (zeroth moment +
+    periodic wrap)."""
+    g = StaggeredGrid(n=(24, 24), x_lo=(0.0, 0.0), x_up=(2.0, 2.0))
+    rng = np.random.default_rng(1)
+    N = 50
+    X = jnp.asarray(rng.uniform(0, 2, size=(N, 2)), dtype=jnp.float64)
+    F = jnp.asarray(rng.standard_normal((N, 2)), dtype=jnp.float64)
+    f = interaction.spread_vel(F, g, X, kernel="IB_4")
+    for d in range(2):
+        total = float(jnp.sum(f[d])) * g.cell_volume
+        assert total == pytest.approx(float(jnp.sum(F[:, d])), rel=1e-12)
+
+
+def test_interpolate_smooth_field_accuracy():
+    """Interpolating a smooth field converges (2nd order for IB_4)."""
+    errs = []
+    rng = np.random.default_rng(2)
+    Xn = rng.uniform(0.2, 0.8, size=(200, 2))
+    for n in (16, 32, 64):
+        g = StaggeredGrid(n=(n, n), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+        cx, cy = g.cell_centers(jnp.float64)
+        u = jnp.sin(2 * math.pi * cx) * jnp.cos(2 * math.pi * cy)
+        X = jnp.asarray(Xn, dtype=jnp.float64)
+        Um = interaction.interpolate(u, g, X, centering="cell", kernel="IB_4")
+        exact = np.sin(2 * math.pi * Xn[:, 0]) * np.cos(2 * math.pi * Xn[:, 1])
+        errs.append(float(jnp.max(jnp.abs(Um - exact))))
+    order = math.log2(errs[0] / errs[1]) / 1 if errs[1] else 99
+    order2 = math.log2(errs[1] / errs[2])
+    assert 0.5 * (order + order2) > 1.8, errs
+
+
+def test_constant_field_interpolates_exactly():
+    """Partition of unity -> a constant field interpolates exactly,
+    anywhere (including near the periodic wrap)."""
+    g = StaggeredGrid(n=(8, 8), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    X = jnp.asarray([[0.01, 0.99], [0.5, 0.5], [0.999, 0.001]],
+                    dtype=jnp.float64)
+    for kernel in ALL_KERNELS:
+        u = jnp.full(g.n, 2.5, dtype=jnp.float64)
+        Um = interaction.interpolate(u, g, X, centering="cell", kernel=kernel)
+        np.testing.assert_allclose(np.asarray(Um), 2.5, rtol=1e-12,
+                                   err_msg=kernel)
+
+
+def test_velocity_interp_linear_field_exact():
+    """MAC staggering honored: interpolating u=(x at x-faces, y at y-faces)
+    linear fields reproduces marker coordinates (first moment), away from
+    the periodic wrap."""
+    g = StaggeredGrid(n=(32, 32), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    xf, yc = g.face_centers(0, jnp.float64)
+    xc, yf = g.face_centers(1, jnp.float64)
+    u = jnp.broadcast_to(xf, g.n)
+    v = jnp.broadcast_to(yf, g.n)
+    rng = np.random.default_rng(3)
+    X = jnp.asarray(rng.uniform(0.2, 0.8, size=(40, 2)), dtype=jnp.float64)
+    U = interaction.interpolate_vel((u, v), g, X, kernel="IB_4")
+    np.testing.assert_allclose(np.asarray(U), np.asarray(X), atol=1e-12)
+
+
+def test_masked_markers_contribute_nothing():
+    g = StaggeredGrid(n=(16, 16), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    rng = np.random.default_rng(4)
+    X = jnp.asarray(rng.uniform(0, 1, size=(10, 2)), dtype=jnp.float64)
+    F = jnp.asarray(rng.standard_normal((10, 2)), dtype=jnp.float64)
+    mask = jnp.asarray([1.0] * 5 + [0.0] * 5, dtype=jnp.float64)
+    f_all = interaction.spread_vel(F[:5], g, X[:5], kernel="IB_4")
+    f_masked = interaction.spread_vel(F, g, X, kernel="IB_4", weights=mask)
+    for a, b in zip(f_all, f_masked):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-12)
+
+
+def test_spread_interp_inside_jit():
+    g = StaggeredGrid(n=(16, 16), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    X = jnp.asarray([[0.3, 0.7]], dtype=jnp.float32)
+    F = jnp.asarray([[1.0, -2.0]], dtype=jnp.float32)
+
+    @jax.jit
+    def roundtrip(F, X):
+        f = interaction.spread_vel(F, g, X, kernel="IB_4")
+        return interaction.interpolate_vel(f, g, X, kernel="IB_4")
+
+    out = roundtrip(F, X)
+    assert out.shape == (1, 2)
+    assert np.isfinite(np.asarray(out)).all()
