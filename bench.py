@@ -1,0 +1,62 @@
+"""Headline benchmark: IB/explicit/ex4-equivalent 3D elastic shell.
+
+Measures coupled IB timesteps/sec (interp -> force -> spread -> INS
+projection solve -> correct) on the BASELINE.json north-star config:
+256^3 grid, ~1e5 markers, IB_4 delta. Prints ONE JSON line.
+
+`vs_baseline`: BASELINE.json `published` is empty and the reference mount
+was empty at survey time (SURVEY.md §6) — no measured reference
+denominator exists yet, so vs_baseline is null until one is produced.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=256, help="grid cells/axis")
+    ap.add_argument("--n-lat", type=int, default=316)
+    ap.add_argument("--n-lon", type=int, default=316)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--dt", type=float, default=5e-5)
+    args = ap.parse_args()
+
+    import jax
+    from ibamr_tpu.models.shell3d import build_shell_example
+
+    integ, state = build_shell_example(
+        n_cells=args.n, n_lat=args.n_lat, n_lon=args.n_lon,
+        radius=0.25, aspect=1.2, stiffness=1.0, rest_length_factor=0.75,
+        mu=0.05)
+
+    step = jax.jit(lambda s, dt: integ.step(s, dt))
+
+    # compile + warmup
+    for _ in range(max(args.warmup, 1)):
+        state = step(state, args.dt)
+    jax.block_until_ready(state)
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        state = step(state, args.dt)
+    jax.block_until_ready(state)
+    elapsed = time.perf_counter() - t0
+
+    n_markers = int(state.X.shape[0])
+    steps_per_sec = args.steps / elapsed
+    print(json.dumps({
+        "metric": (f"IB/explicit/ex4 3D shell {args.n}^3, "
+                   f"{n_markers} markers: timesteps/sec"),
+        "value": round(steps_per_sec, 4),
+        "unit": "steps/s",
+        "vs_baseline": None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

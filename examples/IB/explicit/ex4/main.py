@@ -1,7 +1,10 @@
-"""ex0-equivalent driver: 2D periodic elastic membrane in incompressible
-flow (reference: examples/IB/explicit/ex0 main.cpp + input2d).
+"""ex4-equivalent driver: 3D elastic shell in incompressible flow
+(reference: examples/IB/explicit/ex4 main.cpp + input3d).
 
-Run:  python examples/IB/explicit/ex0/main.py [input2d] [restart_dir step]
+Run:  python examples/IB/explicit/ex4/main.py [input3d] [restart_dir step]
+Multi-device: the Eulerian grid shards over all visible devices
+automatically when more than one device is present (spatial domain
+decomposition, SURVEY.md §2.3 S1).
 """
 
 import os
@@ -14,22 +17,32 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), *[".."] * 4))
 
 import numpy as np  # noqa: E402
 
-from ibamr_tpu.integrators.ib import advance_ib, polygon_area  # noqa: E402
-from ibamr_tpu.models.membrane2d import build_membrane_example  # noqa: E402
+from ibamr_tpu.models.shell3d import build_shell_example, shell_volume  # noqa: E402
 from ibamr_tpu.utils import MetricsLogger, TimerManager, parse_input_file  # noqa: E402
 from ibamr_tpu.utils.checkpoint import restore_checkpoint, save_checkpoint  # noqa: E402
 
 
 def main(argv):
     input_path = argv[1] if len(argv) > 1 else \
-        os.path.join(os.path.dirname(__file__), "input2d")
+        os.path.join(os.path.dirname(__file__), "input3d")
     db = parse_input_file(input_path)
     main_db = db.get_database("Main")
     ins_db = db.get_database("INSStaggeredHierarchyIntegrator")
 
-    integ, state = build_membrane_example(input_db=db, dtype=jnp.float32)
+    integ, state = build_shell_example(input_db=db, dtype=jnp.float32)
 
-    # optional restart: main.py input2d <restart_dir> <step>
+    # shard over all devices when more than one is visible
+    if len(jax.devices()) > 1:
+        from ibamr_tpu.parallel import make_mesh, make_sharded_ib_step
+        from ibamr_tpu.parallel.mesh import place_state
+
+        mesh = make_mesh()
+        state = place_state(state, integ.ins.grid, mesh)
+        step_fn = make_sharded_ib_step(integ, mesh)
+        print(f"sharding over mesh {dict(mesh.shape)}")
+    else:
+        step_fn = jax.jit(lambda s, d: integ.step(s, d))
+
     start_step = 0
     if len(argv) > 3:
         state, start_step, _ = restore_checkpoint(argv[2], state,
@@ -40,23 +53,28 @@ def main(argv):
     num_steps = ins_db.get_int("num_steps")
     viz_int = main_db.get_int("viz_dump_interval", 0)
     rst_int = main_db.get_int("restart_interval", 0)
-    viz_dir = main_db.get_string("viz_dirname", "viz_ex0")
-    rst_dir = main_db.get_string("restart_dirname", "restart_ex0")
+    viz_dir = main_db.get_string("viz_dirname", "viz_ex4")
+    rst_dir = main_db.get_string("restart_dirname", "restart_ex4")
     os.makedirs(viz_dir, exist_ok=True)
 
+    geo = db.get_database_with_default("CartesianGeometry")
+    x_lo = geo.get_array("x_lo", [0.0, 0.0, 0.0])
+    x_up = geo.get_array("x_up", [1.0, 1.0, 1.0])
+    center = tuple(0.5 * (lo + hi) for lo, hi in zip(x_lo, x_up))
     tm = TimerManager.instance()
     with MetricsLogger(main_db.get_string("log_file"), echo=True) as metrics:
         step = start_step
         while step < num_steps:
-            chunk = min(viz_int or 50, num_steps - step)
+            chunk = min(viz_int or 20, num_steps - step)
             with tm.scope("IB::advanceHierarchy"):
-                state = advance_ib(integ, state, dt, chunk)
+                for _ in range(chunk):
+                    state = step_fn(state, dt)
                 jax.block_until_ready(state.X)
             step += chunk
             metrics.log({
                 "step": step,
                 "t": state.ins.t,
-                "area": polygon_area(state.X),
+                "volume": shell_volume(state.X, center),
                 "ke": integ.ins.kinetic_energy(state.ins),
                 "max_div": integ.ins.max_divergence(state.ins),
                 "cfl_dt": integ.ins.cfl_dt(state.ins),

@@ -64,6 +64,11 @@ class INSStaggeredIntegrator:
         self.mu = float(mu)
         self.convective_op_type = convective_op_type
         self.dtype = dtype
+        # Overridable solver seams (the StaggeredStokesSolver plugin
+        # interface of the north star): the sharded path swaps these for
+        # pencil-decomposed distributed FFT solves (parallel.fftpar).
+        self.helmholtz_vel_solve = fft.solve_helmholtz_periodic_vel
+        self.project = fft.project_divergence_free
 
     # -- state construction -------------------------------------------------
     def initialize(self, u0=None, u0_arrays: Optional[Vel] = None) -> INSState:
@@ -132,11 +137,11 @@ class INSStaggeredIntegrator:
             if f is not None:
                 r = r + f[d]
             rhs.append(r)
-        u_star = fft.solve_helmholtz_periodic_vel(
+        u_star = self.helmholtz_vel_solve(
             tuple(rhs), dx, alpha=rho / dt, beta=-0.5 * mu)
 
         # 3-4. exact projection (phi0 = lap^{-1} div u*; phi = (rho/dt) phi0)
-        u_new, phi0 = fft.project_divergence_free(u_star, dx)
+        u_new, phi0 = self.project(u_star, dx)
         phi = (rho / dt) * phi0
 
         # 5. pressure update (pressure-increment form w/ viscous correction)

@@ -77,8 +77,13 @@ class StructureData:
     def dim(self) -> int:
         return self.vertices.shape[1]
 
-    def force_specs(self) -> forces.ForceSpecs:
-        """Device SoA force specs with indices shifted by index_offset."""
+    def force_specs(self, dtype=None) -> forces.ForceSpecs:
+        """Device SoA force specs with indices shifted by index_offset.
+        ``dtype`` matches the simulation's state dtype (default f32)."""
+        import jax.numpy as jnp
+
+        if dtype is None:
+            dtype = jnp.float32
         off = self.index_offset
         springs = beams = targets = None
         if self.springs is not None and len(self.springs):
@@ -86,7 +91,7 @@ class StructureData:
             springs = forces.make_springs(
                 s[:, 0].astype(np.int32) + off,
                 s[:, 1].astype(np.int32) + off,
-                s[:, 2], s[:, 3])
+                s[:, 2], s[:, 3], dtype=dtype)
         if self.beams is not None and len(self.beams):
             b = self.beams
             curv = b[:, 4:4 + self.dim] if b.shape[1] >= 4 + self.dim else None
@@ -94,13 +99,14 @@ class StructureData:
                 b[:, 0].astype(np.int32) + off,
                 b[:, 1].astype(np.int32) + off,
                 b[:, 2].astype(np.int32) + off,
-                b[:, 3], curv, dim=self.dim)
+                b[:, 3], curv, dim=self.dim, dtype=dtype)
         if self.targets is not None and len(self.targets):
             t = self.targets
             idx = t[:, 0].astype(np.int32)
             damping = t[:, 2] if t.shape[1] > 2 else None
             targets = forces.make_targets(
-                idx + off, t[:, 1], self.vertices[idx], damping)
+                idx + off, t[:, 1], self.vertices[idx], damping,
+                dtype=dtype)
         return forces.ForceSpecs(springs=springs, beams=beams,
                                  targets=targets)
 

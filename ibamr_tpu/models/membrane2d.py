@@ -75,9 +75,13 @@ def build_membrane_example(
     if dtype is None:
         dtype = jnp.float32
 
+    n = (n_cells, n_cells)
+    x_lo, x_up = (0.0, 0.0), (1.0, 1.0)
     if input_db is not None:
         geo = input_db.get_database_with_default("CartesianGeometry")
-        n_cells = geo.get_int_array("n_cells", [n_cells, n_cells])[0]
+        n = tuple(int(v) for v in geo.get_int_array("n_cells", list(n)))
+        x_lo = tuple(float(v) for v in geo.get_array("x_lo", list(x_lo)))
+        x_up = tuple(float(v) for v in geo.get_array("x_up", list(x_up)))
         ins_db = input_db.get_database_with_default(
             "INSStaggeredHierarchyIntegrator")
         rho = ins_db.get_float("rho", rho)
@@ -94,15 +98,15 @@ def build_membrane_example(
         rest_length_factor = mem.get_float("rest_length_factor",
                                            rest_length_factor)
 
-    grid = StaggeredGrid(n=(n_cells, n_cells), x_lo=(0.0, 0.0),
-                         x_up=(1.0, 1.0))
+    grid = StaggeredGrid(n=n, x_lo=x_lo, x_up=x_up)
     ins = INSStaggeredIntegrator(grid, rho=rho, mu=mu,
                                  convective_op_type=convective_op_type,
                                  dtype=dtype)
+    center = tuple(0.5 * (lo + hi) for lo, hi in zip(x_lo, x_up))
     structure = make_circle_membrane(
-        num_markers, radius, center=(0.5, 0.5), stiffness=stiffness,
+        num_markers, radius, center=center, stiffness=stiffness,
         rest_length_factor=rest_length_factor, aspect=aspect)
-    ib = IBMethod(structure.force_specs(), kernel=kernel)
+    ib = IBMethod(structure.force_specs(dtype=dtype), kernel=kernel)
     integ = IBExplicitIntegrator(ins, ib, scheme="midpoint")
     state = integ.initialize(structure.vertices)
     return integ, state

@@ -62,39 +62,42 @@ class ForceSpecs(NamedTuple):
     targets: Optional[TargetSpecs] = None
 
 
-def make_springs(idx0, idx1, stiffness, rest_length) -> SpringSpecs:
+def make_springs(idx0, idx1, stiffness, rest_length,
+                 dtype=jnp.float32) -> SpringSpecs:
     idx0 = jnp.asarray(idx0, dtype=jnp.int32)
     return SpringSpecs(
         idx0=idx0,
         idx1=jnp.asarray(idx1, dtype=jnp.int32),
-        stiffness=jnp.asarray(stiffness, dtype=jnp.float32),
-        rest_length=jnp.asarray(rest_length, dtype=jnp.float32),
-        enabled=jnp.ones(idx0.shape, dtype=jnp.float32))
+        stiffness=jnp.asarray(stiffness, dtype=dtype),
+        rest_length=jnp.asarray(rest_length, dtype=dtype),
+        enabled=jnp.ones(idx0.shape, dtype=dtype))
 
 
-def make_beams(prev, mid, nxt, rigidity, rest_curvature=None, dim=2) -> BeamSpecs:
+def make_beams(prev, mid, nxt, rigidity, rest_curvature=None, dim=2,
+               dtype=jnp.float32) -> BeamSpecs:
     prev = jnp.asarray(prev, dtype=jnp.int32)
     if rest_curvature is None:
-        rest_curvature = jnp.zeros((prev.shape[0], dim), dtype=jnp.float32)
+        rest_curvature = jnp.zeros((prev.shape[0], dim), dtype=dtype)
     return BeamSpecs(
         prev=prev,
         mid=jnp.asarray(mid, dtype=jnp.int32),
         nxt=jnp.asarray(nxt, dtype=jnp.int32),
-        rigidity=jnp.asarray(rigidity, dtype=jnp.float32),
-        rest_curvature=jnp.asarray(rest_curvature, dtype=jnp.float32),
-        enabled=jnp.ones(prev.shape, dtype=jnp.float32))
+        rigidity=jnp.asarray(rigidity, dtype=dtype),
+        rest_curvature=jnp.asarray(rest_curvature, dtype=dtype),
+        enabled=jnp.ones(prev.shape, dtype=dtype))
 
 
-def make_targets(idx, stiffness, X_target, damping=None) -> TargetSpecs:
+def make_targets(idx, stiffness, X_target, damping=None,
+                 dtype=jnp.float32) -> TargetSpecs:
     idx = jnp.asarray(idx, dtype=jnp.int32)
     if damping is None:
-        damping = jnp.zeros(idx.shape, dtype=jnp.float32)
+        damping = jnp.zeros(idx.shape, dtype=dtype)
     return TargetSpecs(
         idx=idx,
-        stiffness=jnp.asarray(stiffness, dtype=jnp.float32),
-        damping=jnp.asarray(damping, dtype=jnp.float32),
-        X_target=jnp.asarray(X_target, dtype=jnp.float32),
-        enabled=jnp.ones(idx.shape, dtype=jnp.float32))
+        stiffness=jnp.asarray(stiffness, dtype=dtype),
+        damping=jnp.asarray(damping, dtype=dtype),
+        X_target=jnp.asarray(X_target, dtype=dtype),
+        enabled=jnp.ones(idx.shape, dtype=dtype))
 
 
 def spring_energy(X: jnp.ndarray, s: SpringSpecs) -> jnp.ndarray:

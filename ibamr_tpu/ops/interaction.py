@@ -129,14 +129,6 @@ def spread_vel(F: jnp.ndarray, grid: StaggeredGrid, X: jnp.ndarray,
                weights: Optional[jnp.ndarray] = None) -> Vel:
     """Spread marker forces (N, dim) onto the MAC grid, one scatter per
     component at its own centering. Includes the 1/h^dim delta factor."""
-    inv_vol = 1.0 / math.prod(grid.dx)
-    out = []
-    for d in range(grid.dim):
-        lin, wgt = _stencil(grid, X, centering=d, kernel=kernel)
-        vals = F[:, d, None] * wgt
-        if weights is not None:
-            vals = vals * weights[:, None]
-        acc = jnp.zeros(grid.num_cells, dtype=jnp.result_type(F, wgt))
-        acc = acc.at[lin.reshape(-1)].add(vals.reshape(-1))
-        out.append((acc * inv_vol).reshape(grid.n))
-    return tuple(out)
+    return tuple(spread(F[:, d], grid, X, centering=d, kernel=kernel,
+                        weights=weights)
+                 for d in range(grid.dim))

@@ -1,0 +1,24 @@
+"""Multi-device parallelism: spatial domain decomposition over a TPU mesh.
+
+Reference parity (SURVEY.md §2.3/§2.4): SAMRAI's MPI domain decomposition
+(LoadBalancer patch->rank assignment, RefineSchedule halo exchange,
+SAMRAI_MPI/PETSc reductions) becomes a `jax.sharding.Mesh` with
+XLA collectives over ICI. Two execution paths are provided:
+
+- `mesh.py` — GSPMD path: jit the single-device step with
+  `with_sharding_constraint` on all grid arrays; XLA's SPMD partitioner
+  lowers roll-stencils to neighbor collective-permutes and FFTs to
+  all-to-all/all-gather transposes automatically.
+- `halo.py` / `fftpar.py` — explicit `shard_map` path: hand-written
+  ppermute halo exchange and pencil-decomposed distributed FFT, the
+  controlled analog of the reference's precomputed RefineSchedules.
+"""
+
+from ibamr_tpu.parallel.mesh import (  # noqa: F401
+    factor_devices,
+    grid_pspec,
+    make_mesh,
+    make_sharded_ib_step,
+    make_sharded_ins_step,
+    shard_state,
+)

@@ -1,0 +1,212 @@
+"""Pencil-decomposed distributed FFT Poisson/Helmholtz solvers.
+
+Reference parity: the spectral replacement for hypre's distributed
+multigrid bottom solves (T8) under domain decomposition — SURVEY.md §2.4
+row "Reduction"/§5.7: the FFT's transposes are the framework's true
+long-range communication, expressed as `lax.all_to_all` inside
+`shard_map` so they ride ICI as explicit collectives.
+
+Scheme (classic pencil transpose): FFT the locally-complete trailing
+axes, then for each sharded axis all-to-all-transpose it against an
+already-transformed axis and FFT it locally; apply the (sliced) discrete
+Laplacian symbol; mirror the transposes back. Local FFTs act on
+contiguous local blocks (which also sidesteps XLA CPU's layout-restricted
+FFT thunk that breaks the naive GSPMD lowering of `rfftn` on a 2D-sharded
+2D array).
+
+Supported decompositions (grid axes are sharded left-to-right by mesh
+axes): 2D or 3D grid x 1D mesh; 3D grid x 2D mesh (true pencils); 2D
+grid x 2D mesh (both mesh axes flattened into one transpose group).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ibamr_tpu.grid import StaggeredGrid
+from ibamr_tpu.parallel.mesh import grid_pspec
+
+Vel = Tuple[jnp.ndarray, ...]
+
+
+def _axis_symbol(n: int, h: float, dtype) -> jnp.ndarray:
+    """Eigenvalues of the 1D discrete periodic Laplacian, full-spectrum
+    (fft, not rfft) ordering: (2 cos(2 pi k / n) - 2) / h^2."""
+    k = jnp.fft.fftfreq(n)
+    return ((2.0 * jnp.cos(2.0 * math.pi * k) - 2.0) / (h * h)).astype(dtype)
+
+
+def _slice_for_shard(l: jnp.ndarray, idx, count: int) -> jnp.ndarray:
+    size = l.shape[0] // count
+    return lax.dynamic_slice(l, (idx * size,), (size,))
+
+
+class PencilFFT:
+    """Distributed spectral solver bound to one (grid, mesh) pair.
+
+    ``op(sym, rhat, *scalars)`` runs pointwise in the spectral domain on
+    each shard's pencil; scalars (e.g. Helmholtz alpha/beta) pass through
+    shard_map as replicated operands so they may be traced values.
+    """
+
+    def __init__(self, grid: StaggeredGrid, mesh: Mesh):
+        self.grid = grid
+        self.mesh = mesh
+        dim = grid.dim
+        axes = tuple(mesh.axis_names)
+        sizes = tuple(mesh.shape[a] for a in axes)
+        if len(axes) > dim:
+            raise ValueError("mesh has more axes than the grid")
+        n = grid.n
+        for d, (name, p) in enumerate(zip(axes, sizes)):
+            if n[d] % p != 0:
+                raise ValueError(
+                    f"grid axis {d} ({n[d]}) not divisible by mesh axis "
+                    f"{name!r} ({p})")
+        if dim == 2 and len(axes) == 2:
+            ptot = sizes[0] * sizes[1]
+            if n[0] % ptot or n[1] % ptot:
+                raise ValueError("2D grid on 2D mesh needs n % (Px*Py) == 0")
+        elif dim == 3 and len(axes) == 2:
+            if n[2] % sizes[1] or n[1] % sizes[0]:
+                raise ValueError(
+                    "3D pencil needs n[2] % Py == 0 and n[1] % Px == 0")
+        elif len(axes) == 1 and dim >= 2:
+            if n[1] % sizes[0]:
+                raise ValueError("1D pencil needs n[1] % P == 0")
+        self.axes = axes
+        self.sizes = sizes
+        self.spec = grid_pspec(mesh, dim)
+
+    # -- spectral core -------------------------------------------------------
+    def _make_kernel(self, op: Callable, rdt) -> Callable:
+        """Build the per-shard kernel r_local, *scalars -> u_local."""
+        dim = self.grid.dim
+        axes, sizes = self.axes, self.sizes
+        n, dx = self.grid.n, self.grid.dx
+        cdt = jnp.complex128 if rdt == jnp.float64 else jnp.complex64
+        lam = [_axis_symbol(n[d], dx[d], rdt) for d in range(dim)]
+
+        if len(axes) == 1:
+            ax = axes[0]
+
+            def kernel(r, *scalars):
+                c = r.astype(cdt)
+                for d in range(1, dim):
+                    c = jnp.fft.fft(c, axis=d)
+                c = lax.all_to_all(c, ax, split_axis=1, concat_axis=0,
+                                   tiled=True)
+                c = jnp.fft.fft(c, axis=0)
+                i = lax.axis_index(ax)
+                parts = [lam[0].reshape((-1,) + (1,) * (dim - 1)),
+                         _slice_for_shard(lam[1], i, sizes[0]).reshape(
+                             (1, -1) + (1,) * (dim - 2))]
+                for d in range(2, dim):
+                    parts.append(lam[d].reshape(
+                        (1,) * d + (-1,) + (1,) * (dim - 1 - d)))
+                c = op(sum(parts), c, *scalars)
+                c = jnp.fft.ifft(c, axis=0)
+                c = lax.all_to_all(c, ax, split_axis=0, concat_axis=1,
+                                   tiled=True)
+                for d in range(dim - 1, 0, -1):
+                    c = jnp.fft.ifft(c, axis=d)
+                return jnp.real(c).astype(rdt)
+
+        elif dim == 3:
+            ax, ay = axes
+
+            def kernel(r, *scalars):
+                c = r.astype(cdt)
+                c = jnp.fft.fft(c, axis=2)
+                c = lax.all_to_all(c, ay, split_axis=2, concat_axis=1,
+                                   tiled=True)
+                c = jnp.fft.fft(c, axis=1)
+                c = lax.all_to_all(c, ax, split_axis=1, concat_axis=0,
+                                   tiled=True)
+                c = jnp.fft.fft(c, axis=0)
+                ix, iy = lax.axis_index(ax), lax.axis_index(ay)
+                sym = (lam[0][:, None, None]
+                       + _slice_for_shard(lam[1], ix, sizes[0])[None, :, None]
+                       + _slice_for_shard(lam[2], iy, sizes[1])[None, None, :])
+                c = op(sym, c, *scalars)
+                c = jnp.fft.ifft(c, axis=0)
+                c = lax.all_to_all(c, ax, split_axis=0, concat_axis=1,
+                                   tiled=True)
+                c = jnp.fft.ifft(c, axis=1)
+                c = lax.all_to_all(c, ay, split_axis=1, concat_axis=2,
+                                   tiled=True)
+                c = jnp.fft.ifft(c, axis=2)
+                return jnp.real(c).astype(rdt)
+
+        else:  # dim == 2, 2D mesh: flatten both mesh axes into one group
+            ax, ay = axes
+            ptot = sizes[0] * sizes[1]
+
+            def kernel(r, *scalars):
+                c = r.astype(cdt)
+                # unshard axis 1 by splitting axis 0 further over ay
+                c = lax.all_to_all(c, ay, split_axis=0, concat_axis=1,
+                                   tiled=True)
+                c = jnp.fft.fft(c, axis=1)
+                c = lax.all_to_all(c, (ax, ay), split_axis=1, concat_axis=0,
+                                   tiled=True)
+                c = jnp.fft.fft(c, axis=0)
+                i = lax.axis_index((ax, ay))
+                sym = (lam[0][:, None]
+                       + _slice_for_shard(lam[1], i, ptot)[None, :])
+                c = op(sym, c, *scalars)
+                c = jnp.fft.ifft(c, axis=0)
+                c = lax.all_to_all(c, (ax, ay), split_axis=0, concat_axis=1,
+                                   tiled=True)
+                c = jnp.fft.ifft(c, axis=1)
+                c = lax.all_to_all(c, ay, split_axis=1, concat_axis=0,
+                                   tiled=True)
+                return jnp.real(c).astype(rdt)
+
+        return kernel
+
+    def _spectral_apply(self, rhs: jnp.ndarray, op: Callable,
+                        *scalars) -> jnp.ndarray:
+        kernel = self._make_kernel(op, rhs.dtype)
+        scalars = tuple(jnp.asarray(s, dtype=rhs.dtype) for s in scalars)
+        fn = jax.shard_map(
+            kernel, mesh=self.mesh,
+            in_specs=(self.spec,) + tuple(P() for _ in scalars),
+            out_specs=self.spec)
+        return fn(rhs, *scalars)
+
+    # -- public solves -------------------------------------------------------
+    def poisson(self, rhs: jnp.ndarray) -> jnp.ndarray:
+        """Zero-mean solution of lap(p) = rhs (periodic)."""
+        def op(sym, rhat):
+            safe = jnp.where(sym == 0, 1.0, sym)
+            return jnp.where(sym == 0, 0.0, rhat / safe)
+
+        return self._spectral_apply(rhs, op)
+
+    def helmholtz(self, rhs: jnp.ndarray, alpha, beta) -> jnp.ndarray:
+        """Solve (alpha + beta lap) u = rhs; alpha/beta may be traced."""
+        def op(sym, rhat, a, b):
+            return rhat / (a + b * sym)
+
+        return self._spectral_apply(rhs, op, alpha, beta)
+
+    def helmholtz_vel(self, rhs: Vel, dx, alpha, beta) -> Vel:
+        """Drop-in for solvers.fft.solve_helmholtz_periodic_vel (dx is
+        carried by the bound grid; accepted for signature parity)."""
+        return tuple(self.helmholtz(c, alpha, beta) for c in rhs)
+
+    def project_divergence_free(self, u: Vel, dx) -> Tuple[Vel, jnp.ndarray]:
+        """Drop-in for solvers.fft.project_divergence_free."""
+        from ibamr_tpu.ops import stencils
+
+        div = stencils.divergence(u, dx)
+        phi = self.poisson(div)
+        g = stencils.gradient(phi, dx)
+        return tuple(c - gc for c, gc in zip(u, g)), phi

@@ -1,0 +1,142 @@
+"""Device-mesh construction and GSPMD-sharded simulation steps.
+
+Reference parity: SAMRAI `LoadBalancer` patch->rank assignment (S1,
+SURVEY.md §2.3) — here the "patches" are equal blocks of each uniform
+level, laid out over a 1D or 2D `jax.sharding.Mesh` so halo traffic rides
+ICI neighbor links. Marker arrays stay replicated (every device evaluates
+all Lagrangian forces — cheap at O(1e5) markers next to the grid work);
+the spread scatter and interp gather are partitioned by XLA against the
+sharded grid, which is the VecScatter analog (§2.4 "irregular scatter").
+
+The GSPMD contract: the step function is the SAME pure function as the
+single-device path; only `with_sharding_constraint` pins where arrays
+live. XLA then inserts `collective-permute` for the roll-stencil halos and
+all-to-all/all-gather for the FFT transposes — the two communication
+patterns SURVEY.md §5.7 identifies as nearest-neighbor halos + the FFT's
+true long-range exchange.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ibamr_tpu.grid import StaggeredGrid
+
+
+def factor_devices(n: int, max_axes: int = 2) -> Tuple[int, ...]:
+    """Near-square factorization of the device count into mesh axes
+    (the analog of choosing a process grid for domain decomposition)."""
+    if max_axes == 1 or n == 1:
+        return (n,)
+    a = int(math.isqrt(n))
+    while a > 1 and n % a != 0:
+        a -= 1
+    if a == 1:
+        return (n,)
+    return (n // a, a)
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              devices: Optional[Sequence] = None,
+              axis_names: Tuple[str, ...] = ("x", "y"),
+              max_axes: int = 2) -> Mesh:
+    """Build a 1D/2D spatial mesh over the first ``n_devices`` devices."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    shape = factor_devices(len(devices), max_axes)
+    import numpy as np
+    dev_arr = np.array(devices).reshape(shape)
+    return Mesh(dev_arr, axis_names[:len(shape)])
+
+
+def grid_pspec(mesh: Mesh, grid_dim: int) -> P:
+    """PartitionSpec sharding the leading grid axes over the mesh axes."""
+    names = list(mesh.axis_names)[:grid_dim]
+    return P(*names, *([None] * (grid_dim - len(names))))
+
+
+def shard_state(state, grid: StaggeredGrid, mesh: Mesh):
+    """Pin every grid-shaped array in the state pytree to the spatial
+    sharding; everything else (markers, scalars) stays replicated."""
+    spec = grid_pspec(mesh, grid.dim)
+    sharding = NamedSharding(mesh, spec)
+    gshape = tuple(grid.n)
+
+    def constrain(a):
+        if hasattr(a, "shape") and tuple(a.shape) == gshape:
+            return jax.lax.with_sharding_constraint(a, sharding)
+        return a
+
+    return jax.tree_util.tree_map(constrain, state)
+
+
+def _with_pencil_solvers(ins_integ, mesh: Mesh):
+    """Shallow-copy an INS integrator with its spectral solves swapped for
+    the pencil-decomposed distributed FFT (parallel.fftpar) — the solver
+    seam of the north star's StaggeredStokesSolver interface."""
+    import copy
+
+    from ibamr_tpu.parallel.fftpar import PencilFFT
+
+    pencil = PencilFFT(ins_integ.grid, mesh)
+    integ2 = copy.copy(ins_integ)
+    integ2.helmholtz_vel_solve = pencil.helmholtz_vel
+    integ2.project = pencil.project_divergence_free
+    return integ2
+
+
+def make_sharded_ins_step(integ, mesh: Mesh):
+    """Jitted INS step with grid arrays sharded over ``mesh``: GSPMD
+    roll-stencil halos + explicit pencil-FFT solves."""
+    integ = _with_pencil_solvers(integ, mesh)
+    grid = integ.grid
+
+    def step(state, dt, f=None):
+        state = shard_state(state, grid, mesh)
+        if f is not None:
+            f = shard_state(f, grid, mesh)
+        return shard_state(integ.step(state, dt, f=f), grid, mesh)
+
+    return jax.jit(step)
+
+
+def make_sharded_ib_step(integ, mesh: Mesh):
+    """Jitted coupled IB step (interp -> force -> spread -> fluid solve ->
+    correct) with the Eulerian state sharded over ``mesh``. This is the
+    whole-timestep SPMD program of SURVEY.md §3.2's device-boundary note."""
+    import copy
+
+    grid = integ.ins.grid
+    integ = copy.copy(integ)
+    integ.ins = _with_pencil_solvers(integ.ins, mesh)
+
+    def step(state, dt):
+        state = state._replace(ins=shard_state(state.ins, grid, mesh))
+        new = integ.step(state, dt)
+        return new._replace(ins=shard_state(new.ins, grid, mesh))
+
+    return jax.jit(step)
+
+
+def place_state(state, grid: StaggeredGrid, mesh: Mesh):
+    """Device-put the initial state under the spatial sharding (so the
+    first step doesn't start from a single-device layout)."""
+    spec = grid_pspec(mesh, grid.dim)
+    sharding = NamedSharding(mesh, spec)
+    replicated = NamedSharding(mesh, P())
+    gshape = tuple(grid.n)
+
+    def put(a):
+        a = jnp.asarray(a)
+        if tuple(a.shape) == gshape:
+            return jax.device_put(a, sharding)
+        return jax.device_put(a, replicated)
+
+    return jax.tree_util.tree_map(put, state)

@@ -1,0 +1,153 @@
+"""Multi-device sharding tests: 1-device vs 8-device agreement.
+
+The analog of the reference's ``mpirun=1`` vs ``mpirun=4`` baseline
+comparisons (SURVEY.md §4): the same config run replicated and sharded
+must agree to roundoff tolerance.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ibamr_tpu.models.membrane2d import build_membrane_example
+from ibamr_tpu.models.shell3d import build_shell_example, make_spherical_shell
+from ibamr_tpu.parallel import (factor_devices, make_mesh,
+                                make_sharded_ib_step, make_sharded_ins_step)
+from ibamr_tpu.parallel.mesh import place_state
+
+
+def _tree_allclose(a, b, rtol, atol):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=rtol, atol=atol)
+
+
+def test_factor_devices():
+    assert factor_devices(8) == (4, 2)
+    assert factor_devices(4) == (2, 2)
+    assert factor_devices(7) == (7,)
+    assert factor_devices(1) == (1,)
+    assert factor_devices(8, max_axes=1) == (8,)
+
+
+def test_make_mesh_shapes():
+    mesh = make_mesh(8)
+    assert mesh.devices.shape == (4, 2)
+    assert mesh.axis_names == ("x", "y")
+    mesh1 = make_mesh(8, max_axes=1)
+    assert mesh1.devices.shape == (8,)
+
+
+@pytest.mark.parametrize("mesh_axes", [1, 2])
+def test_ins_sharded_matches_single(mesh_axes):
+    """Pure fluid step (Taylor-Green start) sharded vs replicated."""
+    from ibamr_tpu.grid import StaggeredGrid
+    from ibamr_tpu.integrators.ins import INSStaggeredIntegrator
+
+    grid = StaggeredGrid(n=(32, 32), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    integ = INSStaggeredIntegrator(grid, rho=1.0, mu=0.01,
+                                   dtype=jnp.float64)
+    two_pi = 2.0 * np.pi
+
+    def u0(coords, t):
+        x, y = coords
+        return [jnp.sin(two_pi * x) * jnp.cos(two_pi * y) + 0 * y,
+                -jnp.cos(two_pi * x) * jnp.sin(two_pi * y) + 0 * x]
+
+    state0 = integ.initialize(u0=u0)
+    dt = 1e-3
+
+    ref = state0
+    step1 = jax.jit(lambda s, d: integ.step(s, d))
+    for _ in range(5):
+        ref = step1(ref, dt)
+
+    mesh = make_mesh(8, max_axes=mesh_axes)
+    stepN = make_sharded_ins_step(integ, mesh)
+    out = place_state(state0, grid, mesh)
+    for _ in range(5):
+        out = stepN(out, dt)
+
+    _tree_allclose(ref, out, rtol=1e-12, atol=1e-12)
+
+
+def test_ib_membrane_sharded_matches_single():
+    """Full coupled IB step (2D membrane) sharded vs replicated."""
+    integ, state0 = build_membrane_example(
+        n_cells=32, num_markers=64, aspect=1.3, dtype=jnp.float64)
+    dt = 1e-3
+
+    ref = state0
+    step1 = jax.jit(lambda s, d: integ.step(s, d))
+    for _ in range(5):
+        ref = step1(ref, dt)
+
+    mesh = make_mesh(8, max_axes=2)
+    stepN = make_sharded_ib_step(integ, mesh)
+    out = place_state(state0, integ.ins.grid, mesh)
+    for _ in range(5):
+        out = stepN(out, dt)
+
+    _tree_allclose(ref, out, rtol=1e-11, atol=1e-12)
+
+
+def test_ib_shell3d_sharded_matches_single():
+    """Full coupled IB step (3D shell) on a 2D-sharded 3D grid."""
+    integ, state0 = build_shell_example(
+        n_cells=16, n_lat=8, n_lon=8, dtype=jnp.float64)
+    dt = 1e-3
+
+    ref = jax.jit(lambda s, d: integ.step(s, d))(state0, dt)
+
+    mesh = make_mesh(8, max_axes=2)
+    stepN = make_sharded_ib_step(integ, mesh)
+    out = stepN(place_state(state0, integ.ins.grid, mesh), dt)
+
+    _tree_allclose(ref, out, rtol=1e-11, atol=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# 3D shell model structure checks
+# ---------------------------------------------------------------------------
+
+def test_shell_geometry():
+    data = make_spherical_shell(8, 16, radius=0.25, center=(0.5, 0.5, 0.5),
+                                stiffness=1.0)
+    assert data.vertices.shape == (128, 3)
+    r = np.linalg.norm(data.vertices - 0.5, axis=1)
+    np.testing.assert_allclose(r, 0.25, rtol=1e-12)
+    # ring springs (8*16) + meridian springs (7*16)
+    assert data.springs.shape[0] == 8 * 16 + 7 * 16
+    # spring indices valid
+    assert data.springs[:, :2].max() < 128
+    assert data.springs[:, :2].min() >= 0
+
+
+def test_shell_beams_present():
+    data = make_spherical_shell(8, 16, radius=0.25, center=(0.5, 0.5, 0.5),
+                                stiffness=1.0, bend_rigidity=0.01)
+    assert data.beams is not None
+    assert data.beams.shape[0] == 6 * 16  # interior rings only
+
+
+def test_shell_spring_rest_state_is_equilibrium_free():
+    """With rest_length_factor=1 on a perfect sphere, ring springs are at
+    their rest length -> near-zero net ring tension (chord vs arc gives a
+    small systematic; verify it vanishes with resolution)."""
+    from ibamr_tpu.ops import forces as fmod
+
+    coarse = make_spherical_shell(16, 16, 0.25, (0.5, 0.5, 0.5), 1.0)
+    fine = make_spherical_shell(64, 64, 0.25, (0.5, 0.5, 0.5), 1.0)
+
+    def max_force(data):
+        X = jnp.asarray(data.vertices)
+        F = fmod.compute_lagrangian_force(X, jnp.zeros_like(X),
+                                          data.force_specs())
+        return float(jnp.max(jnp.abs(F)))
+
+    # forces scale down as the lattice refines toward the smooth sphere
+    assert max_force(fine) < max_force(coarse)
