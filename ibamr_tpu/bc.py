@@ -1,0 +1,139 @@
+"""Physical boundary conditions on the uniform MAC grid.
+
+Reference parity: the Robin BC machinery of IBTK (T9, SURVEY.md §2.1) —
+``RobinBcCoefStrategy`` / ``muParserRobinBcCoefs`` semantics: each domain
+side prescribes a * Q + b * dQ/dn = g. The common named cases:
+
+- ``periodic``  — both sides of the axis wrap (the default everywhere).
+- ``dirichlet`` — Q = g at the boundary face      (a=1, b=0).
+- ``neumann``   — dQ/dn = g at the boundary face  (a=0, b=1).
+
+TPU-first design: BCs are static metadata (hashable dataclasses) baked
+into jitted step functions; ghost filling is array padding + arithmetic
+(no indirection), so XLA fuses the fills into the stencils that consume
+them — the analog of SAMRAI's physical-boundary RefinePatchStrategy fill
+pass collapsing into the compute kernel.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+PERIODIC = "periodic"
+DIRICHLET = "dirichlet"
+NEUMANN = "neumann"
+_KINDS = (PERIODIC, DIRICHLET, NEUMANN)
+
+
+@dataclasses.dataclass(frozen=True)
+class SideBC:
+    """One side's condition. ``value`` is the (constant) boundary datum g;
+    spatially-varying data enters via the solvers' RHS lifting hooks."""
+    kind: str = PERIODIC
+    value: float = 0.0
+
+    def __post_init__(self):
+        if self.kind not in _KINDS:
+            raise ValueError(f"unknown BC kind {self.kind!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class AxisBC:
+    lo: SideBC = SideBC()
+    hi: SideBC = SideBC()
+
+    def __post_init__(self):
+        if (self.lo.kind == PERIODIC) != (self.hi.kind == PERIODIC):
+            raise ValueError("periodic must be set on both sides of an axis")
+
+    @property
+    def periodic(self) -> bool:
+        return self.lo.kind == PERIODIC
+
+
+def periodic_axis() -> AxisBC:
+    return AxisBC()
+
+
+def dirichlet_axis(lo: float = 0.0, hi: float = 0.0) -> AxisBC:
+    return AxisBC(SideBC(DIRICHLET, lo), SideBC(DIRICHLET, hi))
+
+
+def neumann_axis(lo: float = 0.0, hi: float = 0.0) -> AxisBC:
+    return AxisBC(SideBC(NEUMANN, lo), SideBC(NEUMANN, hi))
+
+
+@dataclasses.dataclass(frozen=True)
+class DomainBC:
+    """Per-axis BCs for one scalar (cell-centered) field, or one velocity
+    component's wall behavior when used by the INS machinery."""
+    axes: Tuple[AxisBC, ...]
+
+    @property
+    def all_periodic(self) -> bool:
+        return all(a.periodic for a in self.axes)
+
+    @classmethod
+    def periodic(cls, dim: int) -> "DomainBC":
+        return cls(axes=(AxisBC(),) * dim)
+
+
+# ---------------------------------------------------------------------------
+# Ghost filling for cell-centered fields
+# ---------------------------------------------------------------------------
+
+def _ghost_values_cc(Q: jnp.ndarray, axis: int, side: SideBC, h: float,
+                     lo_side: bool) -> jnp.ndarray:
+    """One ghost layer for a cell-centered field beyond a wall: linear
+    extrapolation through the boundary-face value (dirichlet) or slope
+    (neumann). Outward normal points lo-ward on the lo side."""
+    idx = [slice(None)] * Q.ndim
+    idx[axis] = slice(0, 1) if lo_side else slice(-1, None)
+    interior = Q[tuple(idx)]
+    if side.kind == DIRICHLET:
+        return 2.0 * side.value - interior
+    if side.kind == NEUMANN:
+        # dQ/dn = g with n the OUTWARD normal: on either side the ghost
+        # lies outward of the interior cell, so (ghost - interior)/h = g.
+        return interior + h * side.value
+    raise ValueError(side.kind)
+
+
+def fill_ghosts_cc(Q: jnp.ndarray, bc: DomainBC,
+                   dx: Sequence[float]) -> jnp.ndarray:
+    """Pad a cell-centered field with ONE ghost layer per side honoring
+    the BCs (periodic wrap or wall extrapolation). Output shape n+2 per
+    axis; stencil consumers slice the interior back out."""
+    out = Q
+    for d, axbc in enumerate(bc.axes):
+        if axbc.periodic:
+            lo_idx = [slice(None)] * out.ndim
+            hi_idx = [slice(None)] * out.ndim
+            lo_idx[d] = slice(-1, None)
+            hi_idx[d] = slice(0, 1)
+            lo_ghost, hi_ghost = out[tuple(lo_idx)], out[tuple(hi_idx)]
+        else:
+            lo_ghost = _ghost_values_cc(out, d, axbc.lo, dx[d], True)
+            hi_ghost = _ghost_values_cc(out, d, axbc.hi, dx[d], False)
+        out = jnp.concatenate([lo_ghost, out, hi_ghost], axis=d)
+    return out
+
+
+def laplacian_cc(Q: jnp.ndarray, bc: DomainBC,
+                 dx: Sequence[float]) -> jnp.ndarray:
+    """BC-aware 2d+1-point Laplacian of a cell-centered field (ghost-fill
+    then difference; XLA fuses the pad into the stencil)."""
+    G = fill_ghosts_cc(Q, bc, dx)
+    dim = Q.ndim
+    center = tuple(slice(1, -1) for _ in range(dim))
+    out = jnp.zeros_like(Q)
+    for d in range(dim):
+        lo = list(center)
+        hi = list(center)
+        lo[d] = slice(0, -2)
+        hi[d] = slice(2, None)
+        out = out + (G[tuple(lo)] - 2.0 * Q + G[tuple(hi)]) / dx[d] ** 2
+    return out

@@ -56,7 +56,8 @@ class INSStaggeredIntegrator:
 
     def __init__(self, grid: StaggeredGrid, rho: float = 1.0,
                  mu: float = 0.01, convective_op_type: str = "centered",
-                 dtype=jnp.float32):
+                 dtype=jnp.float32,
+                 wall_axes: Optional[Tuple[bool, ...]] = None):
         if convective_op_type not in ("centered", "upwind", "none"):
             raise ValueError(f"unknown convective_op_type {convective_op_type!r}")
         self.grid = grid
@@ -64,11 +65,38 @@ class INSStaggeredIntegrator:
         self.mu = float(mu)
         self.convective_op_type = convective_op_type
         self.dtype = dtype
+        self.wall_axes = (tuple(bool(w) for w in wall_axes)
+                          if wall_axes is not None
+                          else (False,) * grid.dim)
+        if len(self.wall_axes) != grid.dim:
+            raise ValueError(
+                f"wall_axes has {len(self.wall_axes)} entries for a "
+                f"{grid.dim}D grid")
         # Overridable solver seams (the StaggeredStokesSolver plugin
         # interface of the north star): the sharded path swaps these for
-        # pencil-decomposed distributed FFT solves (parallel.fftpar).
-        self.helmholtz_vel_solve = fft.solve_helmholtz_periodic_vel
-        self.project = fft.project_divergence_free
+        # pencil-decomposed distributed FFT solves (parallel.fftpar); the
+        # wall-bounded path (no-slip walls on ``wall_axes``) swaps them
+        # for fast-diagonalization solves (solvers.fastdiag).
+        if any(self.wall_axes):
+            from ibamr_tpu.integrators import ins_walls
+
+            if convective_op_type != "none":
+                raise NotImplementedError(
+                    "wall-bounded INS currently supports "
+                    "convective_op_type='none' (Stokes); wall-aware "
+                    "convection is a planned addition")
+            ops = ins_walls.WallOps(grid, self.wall_axes)
+            self.helmholtz_vel_solve = ops.helmholtz_vel
+            self.project = ops.project
+            self.laplacian_vel = ops.laplacian_vel
+            self.pressure_gradient = ops.pressure_gradient
+            self.laplacian_cc = ops.laplacian_cc
+        else:
+            self.helmholtz_vel_solve = fft.solve_helmholtz_periodic_vel
+            self.project = fft.project_divergence_free
+            self.laplacian_vel = stencils.laplacian_vel
+            self.pressure_gradient = stencils.gradient
+            self.laplacian_cc = stencils.laplacian
 
     # -- state construction -------------------------------------------------
     def initialize(self, u0=None, u0_arrays: Optional[Vel] = None) -> INSState:
@@ -128,8 +156,8 @@ class INSStaggeredIntegrator:
                            for a, b in zip(n_curr, state.n_prev))
 
         # 2. semi-implicit viscous solve for u*
-        lap_u = stencils.laplacian_vel(u, dx)
-        gp = stencils.gradient(p, dx)
+        lap_u = self.laplacian_vel(u, dx)
+        gp = self.pressure_gradient(p, dx)
         rhs = []
         for d in range(g.dim):
             r = (rho / dt) * u[d] + 0.5 * mu * lap_u[d] \
@@ -145,7 +173,7 @@ class INSStaggeredIntegrator:
         phi = (rho / dt) * phi0
 
         # 5. pressure update (pressure-increment form w/ viscous correction)
-        p_new = p + phi - (0.5 * mu * dt / rho) * stencils.laplacian(phi, dx)
+        p_new = p + phi - (0.5 * mu * dt / rho) * self.laplacian_cc(phi, dx)
 
         return INSState(u=u_new, p=p_new, n_prev=n_curr,
                         t=state.t + dt, k=state.k + 1)

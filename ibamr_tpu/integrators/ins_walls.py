@@ -1,0 +1,141 @@
+"""Wall-bounded (no-slip) operators for the staggered INS integrator.
+
+Reference parity: the non-periodic half of the staggered Stokes machinery
+(P3: StaggeredStokesPhysicalBoundaryHelper, INSProjectionBcCoef,
+INSIntermediateVelocityBcCoef; T8's non-periodic solvers; T9 wall fills —
+SURVEY.md §2.1/§2.2) for homogeneous no-slip walls, collapsed onto the
+fast-diagonalization solver (solvers.fastdiag).
+
+Storage convention for a wall axis (see fastdiag "fc_pinned"): every MAC
+component keeps shape ``n`` per axis; for the wall-NORMAL component the
+slot at index 0 along that axis is the lo wall face, pinned to 0, and
+the hi wall face is the periodic-wrap image of slot 0 — so for
+HOMOGENEOUS no-slip both wall faces carry 0 and the periodic roll
+stencils for divergence and the normal-axis Laplacian remain EXACT; only
+tangential components need explicit odd-reflection ghosts, and the
+pressure gradient is masked at pinned faces.
+
+Projection note: with u.n = 0 enforced at walls the pressure Poisson
+problem gets homogeneous Neumann BCs; the masked discrete gradient
+composed with the roll divergence reproduces the Neumann matrix rows
+exactly, so the projection is discretely exact (div u = 0 to roundoff).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+
+from ibamr_tpu.bc import AxisBC, DomainBC, SideBC, dirichlet_axis, neumann_axis
+from ibamr_tpu.grid import StaggeredGrid
+from ibamr_tpu.ops import stencils
+from ibamr_tpu.solvers.fastdiag import FastDiagSolver
+
+Vel = Tuple[jnp.ndarray, ...]
+
+
+def _axis_bc(wall: bool, kind_builder) -> AxisBC:
+    return kind_builder() if wall else AxisBC()
+
+
+class WallOps:
+    """Per-grid wall-aware operators + solvers, built once per config."""
+
+    def __init__(self, grid: StaggeredGrid, wall_axes: Sequence[bool]):
+        self.grid = grid
+        self.wall_axes = tuple(bool(w) for w in wall_axes)
+        dim = grid.dim
+
+        # velocity Helmholtz solvers: component d -> per-axis centering
+        self.vel_solvers = []
+        for d in range(dim):
+            axes, cents = [], []
+            for e in range(dim):
+                if not self.wall_axes[e]:
+                    axes.append(AxisBC())
+                    cents.append("cc")
+                elif e == d:
+                    axes.append(dirichlet_axis())
+                    cents.append("fc_pinned")
+                else:
+                    axes.append(dirichlet_axis())
+                    cents.append("cc")
+            self.vel_solvers.append(
+                FastDiagSolver(grid, DomainBC(axes=tuple(axes)),
+                               tuple(cents)))
+
+        # pressure Poisson: cc, Neumann at walls
+        p_axes = tuple(_axis_bc(w, neumann_axis) for w in self.wall_axes)
+        self.p_solver = FastDiagSolver(grid, DomainBC(axes=p_axes),
+                                       ("cc",) * dim)
+
+        # ghost-fill BC descriptors for the explicit stencils (shared
+        # with bc.laplacian_cc so the ghost arithmetic lives in ONE
+        # place). Component d treats its own wall axis as periodic: the
+        # pinned-face storage wraps exactly for homogeneous walls.
+        self._p_lap_bc = DomainBC(axes=p_axes)
+        self._vel_lap_bc = [
+            DomainBC(axes=tuple(
+                dirichlet_axis() if (self.wall_axes[e] and e != d)
+                else AxisBC()
+                for e in range(dim)))
+            for d in range(dim)]
+
+    # -- masks ---------------------------------------------------------------
+    def _pin_normal(self, c: jnp.ndarray, d: int) -> jnp.ndarray:
+        """Zero the pinned wall-face slot of component d (wall axes only)."""
+        if not self.wall_axes[d]:
+            return c
+        idx = [slice(None)] * c.ndim
+        idx[d] = slice(0, 1)
+        return c.at[tuple(idx)].set(0.0)
+
+    # -- operators -----------------------------------------------------------
+    def laplacian_vel(self, u: Sequence[jnp.ndarray],
+                      dx: Sequence[float]) -> Vel:
+        """Component Laplacians with homogeneous no-slip ghosts.
+
+        Per component d, axis e:
+        - e periodic, or e == d on a wall axis (pinned storage): the
+          periodic wrap is exact (wall nodes carry 0).
+        - e != d on a wall axis: tangential no-slip -> homogeneous
+          Dirichlet ghosts (odd reflection).
+        Ghost arithmetic delegates to bc.laplacian_cc.
+        """
+        from ibamr_tpu import bc as bc_mod
+
+        return tuple(
+            self._pin_normal(bc_mod.laplacian_cc(c, self._vel_lap_bc[d], dx),
+                             d)
+            for d, c in enumerate(u))
+
+    def pressure_gradient(self, p: jnp.ndarray,
+                          dx: Sequence[float]) -> Vel:
+        """grad p at faces; zero at pinned wall faces (no normal update —
+        the discrete homogeneous-Neumann condition)."""
+        g = stencils.gradient(p, dx)
+        return tuple(self._pin_normal(c, d) for d, c in enumerate(g))
+
+    def laplacian_cc(self, f: jnp.ndarray, dx: Sequence[float]) -> jnp.ndarray:
+        """Cell-centered Laplacian with homogeneous-Neumann wall ghosts
+        (for the pressure-increment update); delegates to bc.laplacian_cc."""
+        from ibamr_tpu import bc as bc_mod
+
+        return bc_mod.laplacian_cc(f, self._p_lap_bc, dx)
+
+    # -- solver seams (signatures match the periodic fft module) -------------
+    def helmholtz_vel(self, rhs: Vel, dx, alpha, beta) -> Vel:
+        return tuple(self.vel_solvers[d].solve(c, alpha, beta)
+                     for d, c in enumerate(rhs))
+
+    def project(self, u: Vel, dx) -> Tuple[Vel, jnp.ndarray]:
+        """Leray projection with wall BCs: div uses the roll stencil
+        (exact — wall faces carry 0), phi solves the Neumann Poisson
+        problem, and the correction is masked at pinned faces."""
+        div = stencils.divergence(u, dx)
+        phi = self.p_solver.solve(div, 0.0, 1.0, zero_nullspace=True)
+        g = self.pressure_gradient(phi, dx)
+        u_new = tuple(self._pin_normal(c - gc, d)
+                      for d, (c, gc) in enumerate(zip(u, g)))
+        return u_new, phi

@@ -197,6 +197,11 @@ class PencilFFT:
 
         return self._spectral_apply(rhs, op, alpha, beta)
 
+    def helmholtz_cc(self, rhs: jnp.ndarray, dx, alpha, beta) -> jnp.ndarray:
+        """Drop-in for solvers.fft.solve_helmholtz_periodic (dx carried by
+        the bound grid; accepted for signature parity)."""
+        return self.helmholtz(rhs, alpha, beta)
+
     def helmholtz_vel(self, rhs: Vel, dx, alpha, beta) -> Vel:
         """Drop-in for solvers.fft.solve_helmholtz_periodic_vel (dx is
         carried by the bound grid; accepted for signature parity)."""

@@ -85,6 +85,11 @@ def _with_pencil_solvers(ins_integ, mesh: Mesh):
 
     from ibamr_tpu.parallel.fftpar import PencilFFT
 
+    if any(getattr(ins_integ, "wall_axes", ())):
+        raise NotImplementedError(
+            "sharded stepping currently supports fully periodic INS; "
+            "wall-bounded fast-diagonalization solves are not yet "
+            "distributed")
     pencil = PencilFFT(ins_integ.grid, mesh)
     integ2 = copy.copy(ins_integ)
     integ2.helmholtz_vel_solve = pencil.helmholtz_vel
@@ -103,6 +108,30 @@ def make_sharded_ins_step(integ, mesh: Mesh):
         if f is not None:
             f = shard_state(f, grid, mesh)
         return shard_state(integ.step(state, dt, f=f), grid, mesh)
+
+    return jax.jit(step)
+
+
+def make_sharded_adv_diff_step(integ, mesh: Mesh):
+    """Jitted adv-diff step with grid arrays sharded over ``mesh``."""
+    import copy
+
+    from ibamr_tpu.parallel.fftpar import PencilFFT
+
+    pencil = PencilFFT(integ.grid, mesh)
+    integ = copy.copy(integ)
+    integ.helmholtz_solve = pencil.helmholtz_cc
+    grid = integ.grid
+
+    def step(state, dt, u=None, sources=None):
+        state = shard_state(state, grid, mesh)
+        if u is not None:
+            u = shard_state(u, grid, mesh)
+        if sources is not None:
+            sources = [None if s is None else shard_state(s, grid, mesh)
+                       for s in sources]
+        return shard_state(integ.step(state, dt, u=u, sources=sources),
+                           grid, mesh)
 
     return jax.jit(step)
 
