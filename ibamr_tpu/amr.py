@@ -1,0 +1,611 @@
+"""Two-level static mesh refinement: restriction, prolongation, coarse-fine
+interpolation, and a subcycled composite advance with refluxing.
+
+Reference parity: the coarse-fine machinery of T10 (SURVEY.md §2.1 —
+``CartCellDoubleQuadraticCFInterpolation``, ``CartSideDoubleDivPreservingRefine``,
+``CartCellDoubleCubicCoarsen``) and the level-by-level AMR parallel
+structure S4, restricted to the two-level static case of the build plan
+(SURVEY.md §7.2 stage 8; dynamic regridding is stage 11, on top of this).
+
+TPU-first redesign (SURVEY.md §7.1): the fine level is ONE dense array
+over a static index box (``FineBox``) — no patch lists, no schedules. All
+transfer operators are reshapes/gathers with static shapes:
+
+- restriction        = block-mean reshape (cell) / coincident-face mean (MAC);
+- CF ghost fill      = separable quadratic (3-point Lagrange) gather from
+                       the periodic coarse level at fine ghost centers;
+- div-preserving MAC prolongation = transverse/normal linear interpolation
+  (flux-preserving 3/4–1/4 weights) followed by an EXACT per-coarse-cell
+  Neumann correction: the 2^dim-subcell Poisson pseudo-inverse is a single
+  precomputed (2^dim x 2^dim) matrix applied to all cells with one matmul
+  — the reference's recursive Fortran reconstruction becomes an MXU op.
+
+The composite advance is the classic subcycled flux-form scheme: one
+coarse step, ``ratio`` fine substeps with space-time interpolated ghost
+data, restriction of the fine solution onto covered coarse cells, and a
+reflux correction that replaces the coarse flux through the coarse-fine
+interface with the time/space-averaged fine flux — total mass is then
+conserved to roundoff, which the tests enforce.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from ibamr_tpu.grid import StaggeredGrid
+
+Vel = Tuple[jnp.ndarray, ...]
+
+
+# --------------------------------------------------------------------------
+# Geometry
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class FineBox:
+    """A static refined region: coarse cells [lo, lo+shape) at ``ratio``x.
+
+    The box must sit strictly inside the periodic coarse domain (>=2 cells
+    of clearance) so coarse-fine stencils never wrap around the domain —
+    the same restriction the reference enforces via proper nesting.
+    """
+
+    lo: Tuple[int, ...]        # coarse cell index of the box lower corner
+    shape: Tuple[int, ...]     # box extent in coarse cells
+    ratio: int = 2
+
+    def __post_init__(self):
+        object.__setattr__(self, "lo", tuple(int(v) for v in self.lo))
+        object.__setattr__(self, "shape", tuple(int(v) for v in self.shape))
+        assert self.ratio == 2, "only refinement ratio 2 is implemented"
+        assert all(s >= 1 for s in self.shape)
+
+    @property
+    def dim(self) -> int:
+        return len(self.lo)
+
+    @property
+    def hi(self) -> Tuple[int, ...]:
+        return tuple(l + s for l, s in zip(self.lo, self.shape))
+
+    @property
+    def fine_n(self) -> Tuple[int, ...]:
+        return tuple(s * self.ratio for s in self.shape)
+
+    def validate(self, grid: StaggeredGrid, clearance: int = 2) -> None:
+        assert self.dim == grid.dim
+        for d in range(grid.dim):
+            assert clearance <= self.lo[d], \
+                f"fine box too close to domain edge on axis {d}"
+            assert self.hi[d] <= grid.n[d] - clearance, \
+                f"fine box too close to domain edge on axis {d}"
+
+    def fine_grid(self, grid: StaggeredGrid) -> StaggeredGrid:
+        """Geometry of the refined region as its own (non-periodic) grid."""
+        dx = grid.dx
+        x_lo = tuple(grid.x_lo[d] + self.lo[d] * dx[d]
+                     for d in range(grid.dim))
+        x_up = tuple(grid.x_lo[d] + self.hi[d] * dx[d]
+                     for d in range(grid.dim))
+        return StaggeredGrid(n=self.fine_n, x_lo=x_lo, x_up=x_up)
+
+
+# --------------------------------------------------------------------------
+# Restriction (fine -> coarse)
+# --------------------------------------------------------------------------
+
+def restrict_cc(fine: jnp.ndarray, ratio: int = 2) -> jnp.ndarray:
+    """Conservative block-mean coarsening of cell data (the constant-
+    preserving member of the reference's coarsen-op family T10)."""
+    dim = fine.ndim
+    shape = []
+    for d in range(dim):
+        assert fine.shape[d] % ratio == 0
+        shape += [fine.shape[d] // ratio, ratio]
+    arr = fine.reshape(shape)
+    for d in reversed(range(dim)):
+        arr = arr.mean(axis=2 * d + 1)
+    return arr
+
+
+def restrict_mac(u_fine: Sequence[jnp.ndarray], ratio: int = 2) -> Vel:
+    """Coarsen box MAC data (component d has shape fine_n + e_d): coarse
+    face value = mean of the 2^(dim-1) coincident fine faces (even normal
+    index). Preserves fluxes through coarse faces exactly."""
+    out = []
+    for d, uf in enumerate(u_fine):
+        dim = uf.ndim
+        # keep only fine faces lying on coarse face planes
+        sl = [slice(None)] * dim
+        sl[d] = slice(0, None, ratio)
+        arr = uf[tuple(sl)]
+        # mean over transverse fine offsets
+        shape = []
+        for a in range(dim):
+            if a == d:
+                shape.append(arr.shape[a])
+            else:
+                shape += [arr.shape[a] // ratio, ratio]
+        arr = arr.reshape(shape)
+        # mean trailing ratio axes (those after each transverse axis)
+        k = 0
+        axes = []
+        for a in range(dim):
+            if a == d:
+                k += 1
+            else:
+                axes.append(k + 1)
+                k += 2
+        arr = arr.mean(axis=tuple(axes))
+        out.append(arr)
+    return tuple(out)
+
+
+# --------------------------------------------------------------------------
+# Separable Lagrange interpolation from the periodic coarse level
+# --------------------------------------------------------------------------
+
+def interp_periodic(field: jnp.ndarray, pts: jnp.ndarray,
+                    order: int = 2) -> jnp.ndarray:
+    """Interpolate a periodic grid array at continuous index coordinates.
+
+    ``pts`` is (..., dim) in units where grid point ``i`` sits at index
+    coordinate ``i`` (callers fold in the 0.5 cell-center offset).
+    ``order``=1 (2-point linear) or 2 (3-point quadratic — the CF
+    interpolation order of the reference's T10 ops).
+    """
+    dim = field.ndim
+    flat_pts = pts.reshape(-1, dim)
+    npts = flat_pts.shape[0]
+
+    if order == 2:
+        offs = jnp.arange(-1, 2)
+
+        def weights(t):
+            # t in [-0.5, 0.5]: Lagrange through nodes {-1, 0, +1}
+            return jnp.stack([0.5 * t * (t - 1.0),
+                              (1.0 - t) * (1.0 + t),
+                              0.5 * t * (t + 1.0)], axis=-1)
+
+        def base(x):
+            return jnp.round(x).astype(jnp.int32)
+    elif order == 1:
+        offs = jnp.arange(0, 2)
+
+        def weights(t):
+            return jnp.stack([1.0 - t, t], axis=-1)
+
+        def base(x):
+            return jnp.floor(x).astype(jnp.int32)
+    else:
+        raise ValueError(f"unsupported order {order}")
+
+    lin = None
+    wgt = None
+    for d in range(dim):
+        x = flat_pts[:, d]
+        b = base(x)
+        t = x - b.astype(x.dtype)
+        idx = jnp.mod(b[:, None] + offs[None, :], field.shape[d])
+        w = weights(t)
+        if lin is None:
+            lin, wgt = idx, w
+        else:
+            s = offs.shape[0]
+            lin = lin[..., :, None] * field.shape[d] + idx.reshape(
+                (npts,) + (1,) * (lin.ndim - 1) + (s,))
+            wgt = wgt[..., :, None] * w.reshape(
+                (npts,) + (1,) * (wgt.ndim - 1) + (s,))
+    vals = jnp.take(field.reshape(-1), lin.reshape(npts, -1), axis=0)
+    out = jnp.sum(vals * wgt.reshape(npts, -1), axis=-1)
+    return out.reshape(pts.shape[:-1])
+
+
+def _fine_cell_index_coords(box: FineBox, ghost: int,
+                            dtype=jnp.float64) -> jnp.ndarray:
+    """Continuous coarse *cell-center index* coordinates of fine cell
+    centers (including ``ghost`` fine ghost layers), shape (*nf+2g, dim).
+    Coarse cell center i sits at index coordinate i."""
+    r = box.ratio
+    axes = []
+    for d in range(box.dim):
+        i = jnp.arange(-ghost, box.fine_n[d] + ghost, dtype=dtype)
+        # physical position in coarse cell units: lo + (i + 0.5)/r;
+        # coarse center j at j + 0.5  =>  index coord = pos - 0.5
+        axes.append(box.lo[d] + (i + 0.5) / r - 0.5)
+    grids = jnp.meshgrid(*axes, indexing="ij")
+    return jnp.stack(grids, axis=-1)
+
+
+def prolong_cc(coarse: jnp.ndarray, box: FineBox, ghost: int = 0,
+               order: int = 2) -> jnp.ndarray:
+    """Interpolate coarse cell data onto fine cell centers of ``box``
+    (plus ``ghost`` fine ghost layers) — initial fill / CF ghost fill."""
+    pts = _fine_cell_index_coords(box, ghost, dtype=coarse.dtype)
+    return interp_periodic(coarse, pts, order=order)
+
+
+def fill_fine_ghosts(fine: jnp.ndarray, coarse: jnp.ndarray, box: FineBox,
+                     ghost: int) -> jnp.ndarray:
+    """Pad the fine interior with ghost layers interpolated from coarse
+    (quadratic — T10's CF interpolation), keeping interior values exact.
+
+    Only the O(surface) ghost shell is interpolated: one slab pair per
+    axis in onion order (slabs of earlier axes carry the corners)."""
+    dim = box.dim
+    g = ghost
+    nf = box.fine_n
+    r = box.ratio
+    out = jnp.zeros(tuple(n + 2 * g for n in nf), dtype=fine.dtype)
+    inner = tuple(slice(g, g + n) for n in nf)
+    out = out.at[inner].set(fine)
+
+    def axis_coords(a, lo_i, hi_i):
+        i = jnp.arange(lo_i, hi_i, dtype=coarse.dtype) - g  # fine index
+        return box.lo[a] + (i + 0.5) / r - 0.5
+
+    for d in range(dim):
+        for side in (0, 1):
+            rng = []
+            for a in range(dim):
+                if a < d:                       # corners owned by axis < d
+                    rng.append((g, g + nf[a]))
+                elif a == d:
+                    rng.append((0, g) if side == 0
+                               else (nf[a] + g, nf[a] + 2 * g))
+                else:
+                    rng.append((0, nf[a] + 2 * g))
+            axes = [axis_coords(a, lo_i, hi_i)
+                    for a, (lo_i, hi_i) in enumerate(rng)]
+            pts = jnp.stack(jnp.meshgrid(*axes, indexing="ij"), axis=-1)
+            vals = interp_periodic(coarse, pts, order=2)
+            out = out.at[tuple(slice(lo_i, hi_i)
+                               for lo_i, hi_i in rng)].set(vals)
+    return out
+
+
+# --------------------------------------------------------------------------
+# Divergence-preserving MAC prolongation
+# --------------------------------------------------------------------------
+
+def _neumann_block_pinv(dim: int, dx_f: Sequence[float]) -> np.ndarray:
+    """Pseudo-inverse of the 2^dim-subcell Neumann Laplacian of one coarse
+    cell (zero flux through the coarse cell boundary). Host-precomputed."""
+    n = 2 ** dim
+    A = np.zeros((n, n))
+    cells = list(itertools.product(*[range(2)] * dim))
+    index = {c: i for i, c in enumerate(cells)}
+    for c in cells:
+        i = index[c]
+        for d in range(dim):
+            for s in (-1, 1):
+                nb = list(c)
+                nb[d] += s
+                if 0 <= nb[d] < 2:
+                    j = index[tuple(nb)]
+                    w = 1.0 / (dx_f[d] ** 2)
+                    A[i, i] -= w
+                    A[i, j] += w
+    return np.linalg.pinv(A)
+
+
+def _box_mac_divergence(u: Sequence[jnp.ndarray],
+                        dx: Sequence[float]) -> jnp.ndarray:
+    """Divergence on a box MAC layout (component d has +1 extent on d)."""
+    dim = len(u)
+    out = None
+    for d in range(dim):
+        up = [slice(None)] * dim
+        lo = [slice(None)] * dim
+        up[d] = slice(1, None)
+        lo[d] = slice(0, -1)
+        term = (u[d][tuple(up)] - u[d][tuple(lo)]) / dx[d]
+        out = term if out is None else out + term
+    return out
+
+
+def prolong_mac_div_preserving(u_coarse: Sequence[jnp.ndarray],
+                               grid: StaggeredGrid,
+                               box: FineBox) -> Vel:
+    """Prolong a periodic coarse MAC field onto ``box`` so that each fine
+    cell's divergence EQUALS its parent coarse cell's divergence (so
+    discretely div-free stays div-free) — the
+    ``CartSideDoubleDivPreservingRefine`` contract (T10).
+
+    Returns box MAC arrays (component d has shape fine_n + e_d).
+    Scheme: flux-preserving linear interpolation, then an exact local
+    Neumann Poisson correction per coarse cell (one matmul, see module
+    docstring).
+    """
+    dim = grid.dim
+    r = box.ratio
+    dx = grid.dx
+    dx_f = tuple(h / r for h in dx)
+    nb = box.shape
+    dtype = u_coarse[0].dtype
+
+    # --- step A: componentwise interpolation ---------------------------
+    u_fine = []
+    for d in range(dim):
+        uc = u_coarse[d]
+        # transverse: 3/4-1/4 linear interpolation at fine cell centers;
+        # each coarse-face pair averages back to the coarse value (flux
+        # preserving). Work on the coarse array, then slice the box.
+        arr = uc
+        for a in range(dim):
+            if a == d:
+                continue
+            # central-slope linear reconstruction at offsets -/+ 1/4:
+            # the pair averages to the coarse value EXACTLY, so the flux
+            # through every coarse face is preserved (the property the
+            # Neumann correction's solvability relies on)
+            slope = 0.5 * (jnp.roll(arr, -1, a) - jnp.roll(arr, 1, a))
+            lo_v = arr - 0.25 * slope   # fine offset -1/4
+            hi_v = arr + 0.25 * slope   # fine offset +1/4
+            arr = jnp.stack([lo_v, hi_v], axis=arr.ndim)  # append fine-offset axis
+        # arr axes: dim coarse axes then one 2-wide axis per transverse a
+        # (in increasing a order, skipping d). Slice the box — with one
+        # extra plane along d, since face index == cell index puts coarse
+        # face planes lo[d]..hi[d] inclusive at slice(lo, hi+1) (the box
+        # clearance guarantees hi+1 <= n without wrapping).
+        box_sl = tuple(slice(box.lo[a],
+                             box.hi[a] + (1 if a == d else 0))
+                       for a in range(dim))
+        arr = arr[box_sl]
+        # interleave transverse fine axes: move each (coarse_a, fine_a)
+        # pair together then reshape to fine extent
+        perm = []
+        trans_axes = [a for a in range(dim) if a != d]
+        for a in range(dim):
+            perm.append(a)
+            if a != d:
+                perm.append(dim + trans_axes.index(a))
+        arr = arr.transpose(perm)
+        new_shape = tuple(nb[a] * r if a != d else nb[a] + 1
+                          for a in range(dim))
+        planes = arr.reshape(new_shape)   # nb[d]+1 coarse face planes
+        # insert midplanes: average of adjacent coarse planes
+        lo_p = [slice(None)] * dim
+        hi_p = [slice(None)] * dim
+        lo_p[d] = slice(0, -1)
+        hi_p[d] = slice(1, None)
+        mid = 0.5 * (planes[tuple(lo_p)] + planes[tuple(hi_p)])
+        # interleave: coarse-plane 0, mid 0, coarse-plane 1, mid 1, ...
+        nfd = nb[d] * r
+        shape_f = list(planes.shape)
+        shape_f[d] = nfd + 1
+        out = jnp.zeros(shape_f, dtype=dtype)
+        ev = [slice(None)] * dim
+        od = [slice(None)] * dim
+        ev[d] = slice(0, None, 2)
+        od[d] = slice(1, None, 2)
+        out = out.at[tuple(ev)].set(planes)
+        out = out.at[tuple(od)].set(mid)
+        u_fine.append(out)
+
+    # --- step B: exact local Neumann correction ------------------------
+    from ibamr_tpu.ops import stencils
+    div_c = stencils.divergence(u_coarse, dx)
+    box_sl = tuple(slice(box.lo[a], box.hi[a]) for a in range(dim))
+    target = div_c[box_sl]                                # (nb,)
+    d0 = _box_mac_divergence(u_fine, dx_f)                # (nf,)
+    # block-reshape defect to (ncells, 2^dim)
+    blk = d0.reshape([v for a in range(dim) for v in (nb[a], r)])
+    perm = [2 * a for a in range(dim)] + [2 * a + 1 for a in range(dim)]
+    blk = blk.transpose(perm).reshape(int(np.prod(nb)), r ** dim)
+    tgt = target.reshape(-1, 1)
+    defect = tgt - blk                                    # (ncells, 2^dim)
+
+    pinv = jnp.asarray(_neumann_block_pinv(dim, dx_f), dtype=dtype)
+    phi = defect @ pinv.T                                 # (ncells, 2^dim)
+    phi = phi.reshape([nb[a] for a in range(dim)] + [r] * dim)
+    inv_perm = np.argsort(perm)
+    phi = phi.transpose(inv_perm).reshape(box.fine_n)
+
+    # add grad(phi) on block-interior faces (odd face index along d)
+    out = []
+    for d in range(dim):
+        uf = u_fine[d]
+        lo_p = [slice(None)] * dim
+        hi_p = [slice(None)] * dim
+        lo_p[d] = slice(0, None, 2)   # phi at subcell 0 of each block
+        hi_p[d] = slice(1, None, 2)   # phi at subcell 1
+        g = (phi[tuple(hi_p)] - phi[tuple(lo_p)]) / dx_f[d]
+        od = [slice(None)] * dim
+        od[d] = slice(1, None, 2)
+        uf = uf.at[tuple(od)].add(g)
+        out.append(uf)
+    return tuple(out)
+
+
+# --------------------------------------------------------------------------
+# Two-level subcycled advection-diffusion advance with refluxing
+# --------------------------------------------------------------------------
+
+class TwoLevelAdvDiff:
+    """Composite two-level advance of dQ/dt + div(uQ) = kappa lap(Q).
+
+    Reference parity: the level-by-level subcycled advance + flux
+    synchronization of the AMR integrators (SURVEY.md §3.4, S4, T10),
+    specialized to one static fine box over the periodic coarse level.
+    Explicit flux-form update on both levels (Euler in time), ``ratio``
+    fine substeps per coarse step, space-time interpolated CF ghosts,
+    restriction onto covered cells, and reflux at the CF interface.
+    """
+
+    GHOST = 2
+
+    def __init__(self, grid: StaggeredGrid, box: FineBox,
+                 kappa: float = 0.0, scheme: str = "centered",
+                 u_coarse: Optional[Vel] = None,
+                 u_fine: Optional[Vel] = None):
+        box.validate(grid)
+        self.grid = grid
+        self.box = box
+        self.kappa = float(kappa)
+        assert scheme in ("centered", "upwind")
+        self.scheme = scheme
+        self.fine = box.fine_grid(grid)
+        self.dx_f = tuple(h / box.ratio for h in grid.dx)
+        # advection velocities per level (constant in time); None = no
+        # advection. u_fine uses the box MAC layout (fine_n + e_d).
+        self.u_c = u_coarse
+        self.u_f = u_fine
+
+    # -- fluxes --------------------------------------------------------------
+    def _coarse_fluxes(self, Q: jnp.ndarray) -> Vel:
+        """Flux at lower faces, periodic layout (shape n per axis)."""
+        dx = self.grid.dx
+        out = []
+        for d in range(self.grid.dim):
+            Qm = jnp.roll(Q, 1, d)
+            F = jnp.zeros_like(Q)
+            if self.u_c is not None:
+                qf = (0.5 * (Qm + Q) if self.scheme == "centered"
+                      else jnp.where(self.u_c[d] > 0, Qm, Q))
+                F = F + self.u_c[d] * qf
+            if self.kappa != 0.0:
+                F = F - self.kappa * (Q - Qm) / dx[d]
+            out.append(F)
+        return tuple(out)
+
+    def _fine_fluxes(self, Qg: jnp.ndarray) -> Vel:
+        """Flux on the box MAC layout from the ghost-padded fine array."""
+        g = self.GHOST
+        dim = self.grid.dim
+        nf = self.box.fine_n
+        out = []
+        for d in range(dim):
+            # cells i-1 and i for faces i = 0..nf[d] along d, interior
+            # along other axes
+            lo_sl = [slice(g, g + nf[a]) for a in range(dim)]
+            hi_sl = [slice(g, g + nf[a]) for a in range(dim)]
+            lo_sl[d] = slice(g - 1, g + nf[d])
+            hi_sl[d] = slice(g, g + nf[d] + 1)
+            Qm = Qg[tuple(lo_sl)]
+            Qp = Qg[tuple(hi_sl)]
+            F = jnp.zeros_like(Qm)
+            if self.u_f is not None:
+                qf = (0.5 * (Qm + Qp) if self.scheme == "centered"
+                      else jnp.where(self.u_f[d] > 0, Qm, Qp))
+                F = F + self.u_f[d] * qf
+            if self.kappa != 0.0:
+                F = F - self.kappa * (Qp - Qm) / self.dx_f[d]
+            out.append(F)
+        return tuple(out)
+
+    # -- composite step ------------------------------------------------------
+    def step(self, Qc: jnp.ndarray, Qf: jnp.ndarray,
+             dt: float) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        grid, box = self.grid, self.box
+        dim = grid.dim
+        r = box.ratio
+        dx, dx_f = grid.dx, self.dx_f
+        dt_f = dt / r
+
+        # 1. coarse step (flux form, periodic)
+        Fc = self._coarse_fluxes(Qc)
+        div = None
+        for d in range(dim):
+            t = (jnp.roll(Fc[d], -1, d) - Fc[d]) / dx[d]
+            div = t if div is None else div + t
+        Qc_new = Qc - dt * div
+
+        # 2. fine substeps with space-time interpolated ghosts; accumulate
+        #    time-averaged fine fluxes through the CF interface
+        acc_lo = [None] * dim
+        acc_hi = [None] * dim
+        for m in range(r):
+            theta = m / r
+            Qc_theta = (1.0 - theta) * Qc + theta * Qc_new
+            Qg = fill_fine_ghosts(Qf, Qc_theta, box, self.GHOST)
+            Ff = self._fine_fluxes(Qg)
+            divf = None
+            for d in range(dim):
+                lo_sl = [slice(None)] * dim
+                hi_sl = [slice(None)] * dim
+                lo_sl[d] = slice(0, -1)
+                hi_sl[d] = slice(1, None)
+                t = (Ff[d][tuple(hi_sl)] - Ff[d][tuple(lo_sl)]) / dx_f[d]
+                divf = t if divf is None else divf + t
+                # interface flux accumulation (planes 0 and nf[d])
+                pl = [slice(None)] * dim
+                pl[d] = 0
+                f_lo = Ff[d][tuple(pl)]
+                pl[d] = -1
+                f_hi = Ff[d][tuple(pl)]
+                acc_lo[d] = f_lo if acc_lo[d] is None else acc_lo[d] + f_lo
+                acc_hi[d] = f_hi if acc_hi[d] is None else acc_hi[d] + f_hi
+            Qf = Qf - dt_f * divf
+
+        # 3. restriction onto covered coarse cells
+        box_sl = tuple(slice(box.lo[a], box.hi[a]) for a in range(dim))
+        Qc_new = Qc_new.at[box_sl].set(restrict_cc(Qf, r))
+
+        # 4. reflux: replace the coarse flux through each CF interface face
+        #    by the time/space-averaged fine flux in the update of the
+        #    UNcovered neighbor cell
+        for d in range(dim):
+            # transverse-average fine faces onto coarse faces
+            def face_avg(f):
+                tr = [a for a in range(dim) if a != d]
+                # f has the fine transverse shape; block-mean by r
+                new_shape = []
+                for a in tr:
+                    new_shape += [box.shape[a], r]
+                arr = f.reshape(new_shape)
+                mean_axes = tuple(2 * i + 1 for i in range(len(tr)))
+                return arr.mean(axis=mean_axes)
+
+            favg_lo = face_avg(acc_lo[d]) / r
+            favg_hi = face_avg(acc_hi[d]) / r
+            # coarse fluxes at the same faces
+            tr_sl = tuple(slice(box.lo[a], box.hi[a])
+                          for a in range(dim) if a != d)
+
+            def coarse_face(idx):
+                sl = [slice(box.lo[a], box.hi[a]) for a in range(dim)]
+                sl[d] = idx
+                return Fc[d][tuple(sl)]
+
+            fc_lo = coarse_face(box.lo[d])      # face at lower CF boundary
+            fc_hi = coarse_face(box.hi[d])      # face at upper CF boundary
+            # lower neighbor cell (lo[d]-1): flux F[lo] is its UPPER face:
+            #   Q -= dt/dx (F_up - F_low)  =>  delta = -dt/dx (f_fine - f_c)
+            low_cell = [slice(box.lo[a], box.hi[a]) for a in range(dim)]
+            low_cell[d] = box.lo[d] - 1
+            Qc_new = Qc_new.at[tuple(low_cell)].add(
+                -dt / dx[d] * (favg_lo - fc_lo))
+            # upper neighbor cell (hi[d]): flux F[hi] is its LOWER face:
+            #   delta = +dt/dx (f_fine - f_c)
+            hi_cell = [slice(box.lo[a], box.hi[a]) for a in range(dim)]
+            hi_cell[d] = box.hi[d]
+            Qc_new = Qc_new.at[tuple(hi_cell)].add(
+                dt / dx[d] * (favg_hi - fc_hi))
+
+        return Qc_new, Qf
+
+    # -- diagnostics ---------------------------------------------------------
+    def total(self, Qc: jnp.ndarray, Qf: jnp.ndarray) -> jnp.ndarray:
+        """Composite conserved integral: uncovered coarse + fine."""
+        box = self.box
+        vol_c = self.grid.cell_volume
+        vol_f = vol_c / (box.ratio ** self.grid.dim)
+        covered = jnp.zeros_like(Qc, dtype=bool)
+        box_sl = tuple(slice(box.lo[a], box.hi[a])
+                       for a in range(self.grid.dim))
+        covered = covered.at[box_sl].set(True)
+        return (jnp.sum(jnp.where(covered, 0.0, Qc)) * vol_c
+                + jnp.sum(Qf) * vol_f)
+
+    def initialize(self, fn, dtype=jnp.float64):
+        """Evaluate ``fn(coords_tuple) -> array`` on both levels."""
+        Qc = jnp.asarray(fn(self.grid.cell_centers(dtype)), dtype=dtype)
+        Qf = jnp.asarray(fn(self.fine.cell_centers(dtype)), dtype=dtype)
+        return jnp.broadcast_to(Qc, self.grid.n), \
+            jnp.broadcast_to(Qf, self.fine.n)

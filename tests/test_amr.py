@@ -1,0 +1,206 @@
+"""Stage-8 tests: two-level static refinement machinery (SURVEY.md §7.2).
+
+Covers the T10 transfer-operator contracts and the composite subcycled
+advance: restriction conservation, CF interpolation accuracy order,
+divergence-preserving MAC prolongation (exactness), composite mass
+conservation with refluxing, and matched-solution accuracy vs a uniform
+fine run.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ibamr_tpu import amr
+from ibamr_tpu.grid import StaggeredGrid
+from ibamr_tpu.ops import stencils
+from ibamr_tpu.solvers import fft
+
+
+def _grid2d(n=32):
+    return StaggeredGrid(n=(n, n), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+
+
+def _grid3d(n=16):
+    return StaggeredGrid(n=(n, n, n), x_lo=(0.0,) * 3, x_up=(1.0,) * 3)
+
+
+# -- restriction ------------------------------------------------------------
+
+def test_restrict_cc_conservation():
+    rng = np.random.default_rng(0)
+    f = jnp.asarray(rng.standard_normal((8, 8, 8)))
+    c = amr.restrict_cc(f, 2)
+    assert c.shape == (4, 4, 4)
+    # block mean conserves the integral (fine cells are 1/8 volume)
+    assert np.isclose(float(f.sum()) / 8.0, float(c.sum()))
+
+
+def test_restrict_mac_preserves_coarse_fluxes():
+    rng = np.random.default_rng(1)
+    nf = (8, 6)
+    uf = (jnp.asarray(rng.standard_normal((nf[0] + 1, nf[1]))),
+          jnp.asarray(rng.standard_normal((nf[0], nf[1] + 1))))
+    uc = amr.restrict_mac(uf, 2)
+    assert uc[0].shape == (5, 3)
+    assert uc[1].shape == (4, 4)
+    # flux through a coarse x-face = sum of its 2 fine faces
+    want = float(uf[0][2, 0] + uf[0][2, 1]) / 2.0
+    assert np.isclose(float(uc[0][1, 0]), want)
+
+
+# -- CF interpolation -------------------------------------------------------
+
+@pytest.mark.parametrize("dim", [2, 3])
+def test_cf_ghost_interp_order(dim):
+    """Quadratic ghost fill from coarse is O(h^3) on smooth fields."""
+    errs = []
+    for n in (16, 32):
+        g = _grid2d(n) if dim == 2 else StaggeredGrid(
+            n=(n,) * 3, x_lo=(0.0,) * 3, x_up=(1.0,) * 3)
+        box = amr.FineBox(lo=(n // 4,) * dim, shape=(n // 4,) * dim)
+
+        def f(coords):
+            out = 0.0
+            for c in coords:
+                out = out + jnp.sin(2 * jnp.pi * c)
+            return jnp.broadcast_to(out, g.n if len(
+                coords[0].shape) == dim else None)
+
+        Qc = f(g.cell_centers(jnp.float64))
+        fine = box.fine_grid(g)
+        ghost = 2
+        padded = amr.prolong_cc(Qc, box, ghost=ghost, order=2)
+        # exact values at the padded points
+        r = box.ratio
+        axes = []
+        for d in range(dim):
+            i = np.arange(-ghost, box.fine_n[d] + ghost)
+            axes.append(g.x_lo[d] + (box.lo[d] + (i + 0.5) / r) * g.dx[d])
+        mesh = np.meshgrid(*axes, indexing="ij")
+        exact = sum(np.sin(2 * np.pi * m) for m in mesh)
+        errs.append(float(jnp.max(jnp.abs(padded - exact))))
+    order = np.log2(errs[0] / errs[1])
+    assert order > 2.5, f"CF interp order {order}, errs {errs}"
+
+
+# -- div-preserving MAC prolongation ---------------------------------------
+
+@pytest.mark.parametrize("dim", [2, 3])
+def test_prolong_mac_div_preserving(dim):
+    rng = np.random.default_rng(2)
+    n = 16
+    g = _grid2d(n) if dim == 2 else _grid3d(n)
+    # random MAC field, projected discretely divergence-free
+    u = tuple(jnp.asarray(rng.standard_normal(g.n)) for _ in range(dim))
+    u, _ = fft.project_divergence_free(u, g.dx)
+    assert float(jnp.max(jnp.abs(stencils.divergence(u, g.dx)))) < 1e-10
+
+    box = amr.FineBox(lo=(4,) * dim, shape=(4,) * dim)
+    uf = amr.prolong_mac_div_preserving(u, g, box)
+    for d in range(dim):
+        want = list(box.fine_n)
+        want[d] += 1
+        assert uf[d].shape == tuple(want)
+    dx_f = tuple(h / 2 for h in g.dx)
+    df = amr._box_mac_divergence(uf, dx_f)
+    assert float(jnp.max(jnp.abs(df))) < 1e-10, "prolonged field not div-free"
+
+    # fine divergence equals the parent coarse divergence for general
+    # (non-solenoidal) fields too
+    v = tuple(jnp.asarray(rng.standard_normal(g.n)) for _ in range(dim))
+    vf = amr.prolong_mac_div_preserving(v, g, box)
+    df = amr._box_mac_divergence(vf, dx_f)
+    dc = stencils.divergence(v, g.dx)
+    box_sl = tuple(slice(box.lo[a], box.hi[a]) for a in range(dim))
+    parent = np.repeat(np.repeat(np.asarray(dc[box_sl]), 2, 0), 2, 1)
+    if dim == 3:
+        parent = np.repeat(parent, 2, 2)
+    assert float(jnp.max(jnp.abs(df - parent))) < 1e-9
+
+
+def test_prolong_mac_preserves_coarse_face_fluxes():
+    """Restriction o prolongation = identity on the box MAC data."""
+    rng = np.random.default_rng(3)
+    g = _grid2d(16)
+    u = tuple(jnp.asarray(rng.standard_normal(g.n)) for _ in range(2))
+    box = amr.FineBox(lo=(4, 6), shape=(4, 3))
+    uf = amr.prolong_mac_div_preserving(u, g, box)
+    uc = amr.restrict_mac(uf, 2)
+    # compare against the coarse faces of the box (+1 extent on own axis)
+    want_x = u[0][4:9, 6:9]
+    want_y = u[1][4:8, 6:10]
+    np.testing.assert_allclose(np.asarray(uc[0]), np.asarray(want_x),
+                               atol=1e-12)
+    np.testing.assert_allclose(np.asarray(uc[1]), np.asarray(want_y),
+                               atol=1e-12)
+
+
+# -- composite advance ------------------------------------------------------
+
+def _gauss(coords, x0, w):
+    r2 = 0.0
+    for c, x in zip(coords, x0):
+        r2 = r2 + (c - x) ** 2
+    return jnp.exp(-r2 / w ** 2)
+
+
+def test_two_level_conservation():
+    """Refluxed composite advance conserves total mass to roundoff."""
+    n = 32
+    g = _grid2d(n)
+    box = amr.FineBox(lo=(8, 8), shape=(12, 12))
+    fine = box.fine_grid(g)
+    u_c = (0.7 * jnp.ones(g.n), -0.3 * jnp.ones(g.n))
+    u_f = (0.7 * jnp.ones((box.fine_n[0] + 1, box.fine_n[1])),
+           -0.3 * jnp.ones((box.fine_n[0], box.fine_n[1] + 1)))
+    integ = amr.TwoLevelAdvDiff(g, box, kappa=2e-3, scheme="upwind",
+                                u_coarse=u_c, u_fine=u_f)
+    Qc, Qf = integ.initialize(lambda c: _gauss(c, (0.45, 0.45), 0.08))
+    tot0 = float(integ.total(Qc, Qf))
+    dt = 2e-3
+    for _ in range(40):
+        Qc, Qf = integ.step(Qc, Qf, dt)
+    tot1 = float(integ.total(Qc, Qf))
+    assert abs(tot1 - tot0) < 1e-12 * max(1.0, abs(tot0)), \
+        f"mass drift {tot1 - tot0}"
+    assert np.isfinite(float(jnp.max(jnp.abs(Qf))))
+
+
+def test_two_level_matches_uniform_fine():
+    """With the feature inside the fine box, the composite solution tracks
+    a uniform-fine run far better than the coarse-only run (the stage-8
+    acceptance criterion, SURVEY.md §7.2)."""
+    n = 32
+    kappa = 1.5e-3
+    g = _grid2d(n)
+    box = amr.FineBox(lo=(6, 6), shape=(16, 16))
+    integ = amr.TwoLevelAdvDiff(g, box, kappa=kappa, scheme="centered")
+    Qc, Qf = integ.initialize(lambda c: _gauss(c, (0.45, 0.45), 0.07))
+    dt = 1.2e-3
+    nsteps = 60
+    for _ in range(nsteps):
+        Qc, Qf = integ.step(Qc, Qf, dt)
+
+    # uniform fine reference: pure-diffusion explicit Euler at dx/2, dt/2
+    gf = _grid2d(2 * n)
+    Qr = _gauss(gf.cell_centers(jnp.float64), (0.45, 0.45), 0.07)
+    Qr = jnp.broadcast_to(Qr, gf.n)
+    for _ in range(2 * nsteps):
+        Qr = Qr + 0.5 * dt * kappa * stencils.laplacian(Qr, gf.dx)
+
+    # coarse-only run (same scheme on the coarse grid)
+    Qo = _gauss(g.cell_centers(jnp.float64), (0.45, 0.45), 0.07)
+    Qo = jnp.broadcast_to(Qo, g.n)
+    for _ in range(nsteps):
+        Qo = Qo + dt * kappa * stencils.laplacian(Qo, g.dx)
+
+    # compare inside the fine box (fine cells vs reference cells coincide)
+    fsl = tuple(slice(2 * box.lo[a], 2 * box.hi[a]) for a in range(2))
+    err_comp = float(jnp.max(jnp.abs(Qf - Qr[fsl])))
+    # coarse-only error measured against block-averaged reference
+    ref_c = amr.restrict_cc(Qr, 2)
+    box_sl = tuple(slice(box.lo[a], box.hi[a]) for a in range(2))
+    err_coarse = float(jnp.max(jnp.abs(Qo[box_sl] - ref_c[box_sl])))
+    assert err_comp < 0.5 * err_coarse, (err_comp, err_coarse)
+    assert err_comp < 5e-4, err_comp
