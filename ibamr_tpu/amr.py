@@ -31,6 +31,7 @@ conserved to roundoff, which the tests enforce.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import itertools
 from typing import Optional, Sequence, Tuple
 
@@ -206,18 +207,23 @@ def interp_periodic(field: jnp.ndarray, pts: jnp.ndarray,
     return out.reshape(pts.shape[:-1])
 
 
+def _fine_to_coarse_coord(box: FineBox, axis: int,
+                          i: jnp.ndarray) -> jnp.ndarray:
+    """Coarse *cell-center index* coordinate of fine cell ``i`` (may be a
+    ghost index < 0 or >= fine_n). Physical position in coarse cell units
+    is lo + (i + 0.5)/r; coarse center j sits at j + 0.5, so the index
+    coordinate is that minus 0.5. The single registration-formula source
+    for every CF interpolation below."""
+    return box.lo[axis] + (i + 0.5) / box.ratio - 0.5
+
+
 def _fine_cell_index_coords(box: FineBox, ghost: int,
                             dtype=jnp.float64) -> jnp.ndarray:
-    """Continuous coarse *cell-center index* coordinates of fine cell
-    centers (including ``ghost`` fine ghost layers), shape (*nf+2g, dim).
-    Coarse cell center i sits at index coordinate i."""
-    r = box.ratio
-    axes = []
-    for d in range(box.dim):
-        i = jnp.arange(-ghost, box.fine_n[d] + ghost, dtype=dtype)
-        # physical position in coarse cell units: lo + (i + 0.5)/r;
-        # coarse center j at j + 0.5  =>  index coord = pos - 0.5
-        axes.append(box.lo[d] + (i + 0.5) / r - 0.5)
+    """Continuous coarse cell-center index coordinates of fine cell
+    centers (including ``ghost`` fine ghost layers), shape (*nf+2g, dim)."""
+    axes = [_fine_to_coarse_coord(
+        box, d, jnp.arange(-ghost, box.fine_n[d] + ghost, dtype=dtype))
+        for d in range(box.dim)]
     grids = jnp.meshgrid(*axes, indexing="ij")
     return jnp.stack(grids, axis=-1)
 
@@ -230,25 +236,17 @@ def prolong_cc(coarse: jnp.ndarray, box: FineBox, ghost: int = 0,
     return interp_periodic(coarse, pts, order=order)
 
 
-def fill_fine_ghosts(fine: jnp.ndarray, coarse: jnp.ndarray, box: FineBox,
-                     ghost: int) -> jnp.ndarray:
-    """Pad the fine interior with ghost layers interpolated from coarse
-    (quadratic — T10's CF interpolation), keeping interior values exact.
-
-    Only the O(surface) ghost shell is interpolated: one slab pair per
-    axis in onion order (slabs of earlier axes carry the corners)."""
+@functools.lru_cache(maxsize=32)
+def _ghost_slab_geometry(box: FineBox, ghost: int, dtype_name: str):
+    """Static ghost-shell geometry: per slab, the padded-array slice and
+    the coarse index coordinates of its points. One slab pair per axis in
+    onion order (slabs of earlier axes carry the corners); cached because
+    it depends only on (box, ghost)."""
     dim = box.dim
     g = ghost
     nf = box.fine_n
-    r = box.ratio
-    out = jnp.zeros(tuple(n + 2 * g for n in nf), dtype=fine.dtype)
-    inner = tuple(slice(g, g + n) for n in nf)
-    out = out.at[inner].set(fine)
-
-    def axis_coords(a, lo_i, hi_i):
-        i = jnp.arange(lo_i, hi_i, dtype=coarse.dtype) - g  # fine index
-        return box.lo[a] + (i + 0.5) / r - 0.5
-
+    dtype = jnp.dtype(dtype_name)
+    slabs = []
     for d in range(dim):
         for side in (0, 1):
             rng = []
@@ -260,12 +258,28 @@ def fill_fine_ghosts(fine: jnp.ndarray, coarse: jnp.ndarray, box: FineBox,
                                else (nf[a] + g, nf[a] + 2 * g))
                 else:
                     rng.append((0, nf[a] + 2 * g))
-            axes = [axis_coords(a, lo_i, hi_i)
-                    for a, (lo_i, hi_i) in enumerate(rng)]
+            axes = [_fine_to_coarse_coord(
+                box, a, jnp.arange(lo_i - g, hi_i - g, dtype=dtype))
+                for a, (lo_i, hi_i) in enumerate(rng)]
             pts = jnp.stack(jnp.meshgrid(*axes, indexing="ij"), axis=-1)
-            vals = interp_periodic(coarse, pts, order=2)
-            out = out.at[tuple(slice(lo_i, hi_i)
-                               for lo_i, hi_i in rng)].set(vals)
+            sl = tuple(slice(lo_i, hi_i) for lo_i, hi_i in rng)
+            slabs.append((sl, pts))
+    return tuple(slabs)
+
+
+def fill_fine_ghosts(fine: jnp.ndarray, coarse: jnp.ndarray, box: FineBox,
+                     ghost: int) -> jnp.ndarray:
+    """Pad the fine interior with ghost layers interpolated from coarse
+    (quadratic — T10's CF interpolation), keeping interior values exact.
+    Only the O(surface) ghost shell is interpolated, from precomputed
+    static slab geometry."""
+    g = ghost
+    out = jnp.zeros(tuple(n + 2 * g for n in box.fine_n),
+                    dtype=fine.dtype)
+    inner = tuple(slice(g, g + n) for n in box.fine_n)
+    out = out.at[inner].set(fine)
+    for sl, pts in _ghost_slab_geometry(box, ghost, coarse.dtype.name):
+        out = out.at[sl].set(interp_periodic(coarse, pts, order=2))
     return out
 
 
@@ -462,13 +476,14 @@ class TwoLevelAdvDiff:
         """Flux at lower faces, periodic layout (shape n per axis)."""
         dx = self.grid.dx
         out = []
+        from ibamr_tpu.ops.convection import advective_face_value
+
         for d in range(self.grid.dim):
             Qm = jnp.roll(Q, 1, d)
             F = jnp.zeros_like(Q)
             if self.u_c is not None:
-                qf = (0.5 * (Qm + Q) if self.scheme == "centered"
-                      else jnp.where(self.u_c[d] > 0, Qm, Q))
-                F = F + self.u_c[d] * qf
+                F = F + self.u_c[d] * advective_face_value(
+                    Qm, Q, self.u_c[d], self.scheme)
             if self.kappa != 0.0:
                 F = F - self.kappa * (Q - Qm) / dx[d]
             out.append(F)
@@ -476,6 +491,8 @@ class TwoLevelAdvDiff:
 
     def _fine_fluxes(self, Qg: jnp.ndarray) -> Vel:
         """Flux on the box MAC layout from the ghost-padded fine array."""
+        from ibamr_tpu.ops.convection import advective_face_value
+
         g = self.GHOST
         dim = self.grid.dim
         nf = self.box.fine_n
@@ -491,9 +508,8 @@ class TwoLevelAdvDiff:
             Qp = Qg[tuple(hi_sl)]
             F = jnp.zeros_like(Qm)
             if self.u_f is not None:
-                qf = (0.5 * (Qm + Qp) if self.scheme == "centered"
-                      else jnp.where(self.u_f[d] > 0, Qm, Qp))
-                F = F + self.u_f[d] * qf
+                F = F + self.u_f[d] * advective_face_value(
+                    Qm, Qp, self.u_f[d], self.scheme)
             if self.kappa != 0.0:
                 F = F - self.kappa * (Qp - Qm) / self.dx_f[d]
             out.append(F)

@@ -1,3 +1,4 @@
 from ibamr_tpu.integrators.ins import INSState, INSStaggeredIntegrator
+from ibamr_tpu.integrators.cib import CIBMethod, RigidBodies
 
-__all__ = ["INSState", "INSStaggeredIntegrator"]
+__all__ = ["INSState", "INSStaggeredIntegrator", "CIBMethod", "RigidBodies"]

@@ -64,16 +64,13 @@ def convective_flux_divergence(Q: jnp.ndarray, u: Vel,
                                scheme: str) -> jnp.ndarray:
     """div(u Q) at cell centers from face fluxes. ``scheme`` selects the
     face value of Q: centered average or upwind donor cell."""
+    from ibamr_tpu.ops.convection import advective_face_value
+
     dim = Q.ndim
     out = jnp.zeros_like(Q)
     for d in range(dim):
         Qm = jnp.roll(Q, 1, d)            # Q[i-1] at lower face i
-        if scheme == "centered":
-            qf = 0.5 * (Qm + Q)
-        elif scheme == "upwind":
-            qf = jnp.where(u[d] > 0, Qm, Q)
-        else:
-            raise ValueError(f"unknown convective scheme {scheme!r}")
+        qf = advective_face_value(Qm, Q, u[d], scheme)
         flux = u[d] * qf                   # at lower faces of axis d
         out = out + (jnp.roll(flux, -1, d) - flux) / dx[d]
     return out

@@ -47,6 +47,19 @@ def _upwind_p(f: jnp.ndarray, vel: jnp.ndarray, axis: int) -> jnp.ndarray:
     return jnp.where(vel >= 0, f, jnp.roll(f, -1, axis))
 
 
+def advective_face_value(Qm: jnp.ndarray, Qp: jnp.ndarray,
+                         vel: jnp.ndarray, scheme: str) -> jnp.ndarray:
+    """Face value of an advected scalar from its two neighbor cells
+    (Qm below the face, Qp above) and the face-normal velocity — the one
+    shared scheme-selection point for the cell-centered transport paths
+    (adv_diff and the two-level AMR fluxes)."""
+    if scheme == "centered":
+        return 0.5 * (Qm + Qp)
+    if scheme == "upwind":
+        return jnp.where(vel > 0, Qm, Qp)
+    raise ValueError(f"unknown convective scheme {scheme!r}")
+
+
 def convective_rate(u: Vel, dx: Sequence[float], scheme: str = "centered") -> Vel:
     """N(u)_d = sum_e d/dx_e(u_e u_d), each component at its own faces."""
     if scheme not in ("centered", "upwind"):

@@ -118,7 +118,8 @@ def make_sharded_adv_diff_step(integ, mesh: Mesh):
 
     from ibamr_tpu.parallel.fftpar import PencilFFT
 
-    if any(s is not None for s in integ._wall_solvers):
+    if any(s is not None
+           for s in getattr(integ, '_wall_solvers', ())):
         raise NotImplementedError(
             "wall-bounded fast-diagonalization adv-diff solves are not "
             "yet distributed; use periodic quantities under sharding")

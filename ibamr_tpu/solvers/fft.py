@@ -82,6 +82,26 @@ def solve_helmholtz_periodic_vel(rhs: Vel, dx: Sequence[float],
     return tuple(solve_helmholtz_periodic(c, dx, alpha, beta) for c in rhs)
 
 
+def solve_stokes_periodic(f: Vel, dx: Sequence[float],
+                          mu: float) -> Tuple[Vel, jnp.ndarray]:
+    """Solve steady Stokes  -mu lap(u) + grad(p) = f,  div(u) = 0  on the
+    periodic MAC grid; returns (u, p), both zero-mean.
+
+    Reference parity: the CIB formulation's fluid solve (P15) — the
+    reference runs its Krylov staggered-Stokes stack; periodically the
+    solve is exact in two FFT passes: p from lap(p) = div(f), then each
+    velocity component from -mu lap(u_d) = (P f)_d, where P is the
+    discrete Leray projection. All operators share the discrete symbol so
+    div(u) == 0 to machine precision. The zero-mean convention discards
+    any net force (a periodic steady state exists only in the zero-mean
+    frame — the standard traction-free convention).
+    """
+    f_proj, phi = project_divergence_free(f, dx)
+    # lap^{-1} zeroes the k=0 mode, so each u component is zero-mean
+    u = tuple(-solve_poisson_periodic(c, dx) / mu for c in f_proj)
+    return u, phi
+
+
 def project_divergence_free(u: Vel, dx: Sequence[float]) -> Tuple[Vel, jnp.ndarray]:
     """Exact discrete Leray projection: phi = lap^{-1}(div u);
     u_proj = u - grad(phi). Returns (u_proj, phi). div(u_proj) == 0 to

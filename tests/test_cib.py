@@ -1,0 +1,166 @@
+"""Stage-9 tests: CIB rigid-body mobility (SURVEY.md §7.2, examples/CIB/ex0
+equivalent): steady Stokes solver exactness, mobility operator SPD,
+resistance-matrix symmetry/isotropy, prescribed-motion constraint
+residual, and quasi-static free-body motion.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ibamr_tpu.grid import StaggeredGrid
+from ibamr_tpu.integrators import cib
+from ibamr_tpu.ops import stencils
+from ibamr_tpu.solvers import fft
+
+
+def _grid2d(n=64):
+    return StaggeredGrid(n=(n, n), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+
+
+# -- steady Stokes solver ---------------------------------------------------
+
+def test_stokes_periodic_discrete_exactness():
+    """-mu lap(u) + grad(p) = f is satisfied to machine precision and
+    div(u) == 0 (the discrete-symbol FFT contract)."""
+    rng = np.random.default_rng(0)
+    g = _grid2d(32)
+    mu = 0.7
+    f = tuple(jnp.asarray(rng.standard_normal(g.n)) for _ in range(2))
+    u, p = fft.solve_stokes_periodic(f, g.dx, mu)
+    assert float(jnp.max(jnp.abs(stencils.divergence(u, g.dx)))) < 1e-11
+    lap_u = stencils.laplacian_vel(u, g.dx)
+    gp = stencils.gradient(p, g.dx)
+    for d in range(2):
+        resid = -mu * lap_u[d] + gp[d] - f[d]
+        # the solver works in the zero-mean frame: residual = -mean force
+        resid = resid + jnp.mean(f[d])
+        assert float(jnp.max(jnp.abs(resid))) < 1e-10
+
+
+# -- mobility operator ------------------------------------------------------
+
+def _disc_setup(n=64, n_markers=40, radius=0.12):
+    g = _grid2d(n)
+    X = cib.make_disc((0.5, 0.5), radius, n_markers)
+    bodies = cib.RigidBodies(
+        body_id=jnp.zeros(n_markers, dtype=jnp.int32), n_bodies=1)
+    return g, X, bodies
+
+
+def test_mobility_operator_spd():
+    g, X, bodies = _disc_setup()
+    m = cib.CIBMethod(g, bodies, mu=1.0)
+    rng = np.random.default_rng(1)
+    l1 = jnp.asarray(rng.standard_normal(X.shape))
+    l2 = jnp.asarray(rng.standard_normal(X.shape))
+    a = float(jnp.sum(l1 * m.mobility_apply(X, l2)))
+    b = float(jnp.sum(l2 * m.mobility_apply(X, l1)))
+    assert np.isclose(a, b, rtol=1e-10), "mobility not symmetric"
+    q = float(jnp.sum(l1 * m.mobility_apply(X, l1)))
+    assert q > 0, "mobility not positive"
+
+
+def test_resistance_matrix_spd_isotropy():
+    g, X, bodies = _disc_setup()
+    m = cib.CIBMethod(g, bodies, mu=1.0)
+    R, _, info = m.resistance_matrix(X)
+    assert bool(info.converged)
+    R = np.asarray(R)
+    assert R.shape == (3, 3)          # 2 translations + 1 rotation
+    np.testing.assert_allclose(R, R.T, rtol=1e-8)
+    ev = np.linalg.eigvalsh(R)
+    assert ev.min() > 0, f"resistance not SPD: {ev}"
+    # disc isotropy: x and y drag equal; translation-rotation decoupled
+    assert np.isclose(R[0, 0], R[1, 1], rtol=1e-6)
+    assert abs(R[0, 2]) < 1e-6 * R[0, 0]
+    assert abs(R[0, 1]) < 1e-6 * R[0, 0]
+
+
+def test_constraint_rigid_motion_residual():
+    """Prescribed translation: the solved flow moves every marker with
+    the prescribed velocity (the CIB constraint, to CG tolerance)."""
+    g, X, bodies = _disc_setup()
+    m = cib.CIBMethod(g, bodies, mu=1.0, cg_tol=1e-11)
+    U = jnp.asarray([[0.3, -0.1, 0.0]])
+    lam, FT, info = m.solve_constraint(X, U)
+    assert bool(info.converged)
+    # replay: spread lambda, solve Stokes, interp
+    got = m.mobility_apply(X, lam)
+    want = cib.rigid_velocity(X, bodies, U)
+    err = float(jnp.max(jnp.abs(got - want)))
+    assert err < 1e-7, f"constraint residual {err}"
+    # drag opposes motion: net force along +x must be positive (the
+    # constraint force DRIVES the body against fluid drag)
+    assert float(FT[0, 0]) * 0.3 > 0
+    # torque-free for pure translation of a disc
+    assert abs(float(FT[0, 2])) < 1e-6 * abs(float(FT[0, 0]))
+
+
+def test_mobility_solve_roundtrip():
+    """solve_mobility inverts solve_constraint: U -> (lam, FT) -> U."""
+    g, X, bodies = _disc_setup()
+    m = cib.CIBMethod(g, bodies, mu=1.0, cg_tol=1e-11)
+    U = jnp.asarray([[0.2, 0.05, 0.4]])
+    _, FT, _ = m.solve_constraint(X, U)
+    U2, _, _ = m.solve_mobility(X, FT)
+    np.testing.assert_allclose(np.asarray(U2), np.asarray(U),
+                               rtol=1e-5, atol=1e-8)
+
+
+def test_two_body_mobility_symmetry():
+    """Hydrodynamic interactions: the cross-body resistance blocks are
+    transposes (Lorentz reciprocity)."""
+    g = _grid2d(64)
+    X1 = cib.make_disc((0.35, 0.5), 0.08, 24)
+    X2 = cib.make_disc((0.65, 0.5), 0.08, 24)
+    X = jnp.concatenate([X1, X2])
+    bid = jnp.concatenate([jnp.zeros(24, jnp.int32),
+                           jnp.ones(24, jnp.int32)])
+    m = cib.CIBMethod(g, cib.RigidBodies(body_id=bid, n_bodies=2), mu=1.0)
+    R, _, info = m.resistance_matrix(X)
+    assert bool(info.converged)
+    R = np.asarray(R)
+    assert R.shape == (6, 6)
+    np.testing.assert_allclose(R[:3, 3:], R[3:, :3].T, rtol=1e-6,
+                               atol=1e-8 * abs(R).max())
+    # coupling is weaker than self-resistance
+    assert abs(R[0, 3]) < abs(R[0, 0])
+
+
+def test_free_body_sedimentation_step():
+    """A forced body translates along the force; an unforced one stays."""
+    g, X, bodies = _disc_setup()
+    m = cib.CIBMethod(g, bodies, mu=1.0)
+    FT = jnp.asarray([[0.0, -1.0, 0.0]])       # gravity-like
+    X1, U, _ = m.step(X, FT, dt=1e-2)
+    assert float(U[0, 1]) < 0, "body must sediment along the force"
+    assert abs(float(U[0, 0])) < 1e-6 * abs(float(U[0, 1]))
+    drop = np.asarray(X1 - X)
+    np.testing.assert_allclose(drop[:, 1], drop[0, 1], rtol=1e-5)
+
+    FT0 = jnp.zeros((1, 3))
+    X2, U0, _ = m.step(X, FT0, dt=1e-2)
+    assert float(jnp.max(jnp.abs(U0))) < 1e-10
+    np.testing.assert_allclose(np.asarray(X2), np.asarray(X))
+
+
+@pytest.mark.parametrize("dim", [3])
+def test_sphere_mobility_3d(dim):
+    """3D: sphere resistance is isotropic and SPD (6x6)."""
+    n = 32
+    g = StaggeredGrid(n=(n,) * 3, x_lo=(0.0,) * 3, x_up=(1.0,) * 3)
+    X = cib.make_sphere((0.5, 0.5, 0.5), 0.12, 6, 8)
+    bodies = cib.RigidBodies(
+        body_id=jnp.zeros(X.shape[0], dtype=jnp.int32), n_bodies=1)
+    m = cib.CIBMethod(g, bodies, mu=1.0, cg_tol=1e-8, cg_maxiter=300)
+    R, _, info = m.resistance_matrix(X)
+    assert bool(info.converged)
+    R = np.asarray(R)
+    assert R.shape == (6, 6)
+    np.testing.assert_allclose(R, R.T, rtol=1e-6, atol=1e-8 * abs(R).max())
+    ev = np.linalg.eigvalsh(R)
+    assert ev.min() > 0
+    # isotropy of translational drag
+    d = np.diag(R)[:3]
+    assert np.allclose(d, d[0], rtol=2e-2), d
