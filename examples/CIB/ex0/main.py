@@ -52,7 +52,7 @@ def main(argv):
     num_steps = ts.get_int("num_steps")
     viz_dir = main_db.get_string("viz_dirname", "viz_cib")
     os.makedirs(viz_dir, exist_ok=True)
-    metrics = MetricsLogger(main_db.get_string("log_file", None))
+    metrics = MetricsLogger(main_db.get_string("log_file", "") or None)
     timers = TimerManager()
 
     step = jax.jit(lambda x: method.step(x, FT, dt))

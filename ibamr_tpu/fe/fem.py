@@ -1,0 +1,208 @@
+"""FE kinematics, hyperelastic energies, and nodal force assembly.
+
+Reference parity: the mechanics core of ``IBFEMethod`` (P17) +
+``FEDataManager`` (T16): deformation gradient at quadrature points, a
+first-Piola-Kirchhoff (PK1) stress from a strain-energy density, and the
+weak-form nodal force  F_a = -sum_q w_q P(FF_q) dN_a/dX(q).
+
+TPU-first redesign: the reference assembles PK1 element loops in C++ and
+projects through libMesh; here the total elastic energy
+
+    E(x) = sum_elems sum_q  w_q * W(FF(x))
+
+is a pure jitted function of the nodal positions and the nodal force is
+literally ``-jax.grad(E)`` — exactly the weak-form assembly (PK1 = dW/dFF
+falls out of the chain rule), with consistency guaranteed by construction
+and the whole thing fused by XLA into the coupled IB step. An explicit
+PK1-assembly path is kept for parity and as a cross-check oracle.
+
+All reference-configuration tables (shape gradients dN/dX, quadrature
+measures w*dV, lumped mass) are host-precomputed once per mesh
+(SURVEY.md §7.3 hard-part #6); only current-configuration kinematics run
+per step.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ibamr_tpu.fe.mesh import FEMesh
+
+# -- reference elements (linear simplices) ----------------------------------
+
+# shape functions at barycentric-style local coords; rows = quad points
+_TRI3_QP = np.array([[1 / 6, 1 / 6], [2 / 3, 1 / 6], [1 / 6, 2 / 3]])
+_TRI3_QW = np.array([1 / 6, 1 / 6, 1 / 6])          # ref-triangle area 1/2
+_TET4_A, _TET4_B = 0.5854101966249685, 0.1381966011250105
+_TET4_QP = np.array([[_TET4_B, _TET4_B, _TET4_B],
+                     [_TET4_A, _TET4_B, _TET4_B],
+                     [_TET4_B, _TET4_A, _TET4_B],
+                     [_TET4_B, _TET4_B, _TET4_A]])
+_TET4_QW = np.array([1 / 24] * 4)                   # ref-tet volume 1/6
+
+
+def _shape_table(elem_type: str):
+    """(N(q,a), dN/dxi(a,d), qp weights) for the reference element."""
+    if elem_type == "TRI3":
+        qp, qw = _TRI3_QP, _TRI3_QW
+        N = np.stack([1.0 - qp[:, 0] - qp[:, 1], qp[:, 0], qp[:, 1]], axis=1)
+        dN = np.array([[-1.0, -1.0], [1.0, 0.0], [0.0, 1.0]])
+    elif elem_type == "TET4":
+        qp, qw = _TET4_QP, _TET4_QW
+        N = np.stack([1.0 - qp.sum(axis=1), qp[:, 0], qp[:, 1], qp[:, 2]],
+                     axis=1)
+        dN = np.array([[-1.0, -1.0, -1.0], [1.0, 0.0, 0.0],
+                       [0.0, 1.0, 0.0], [0.0, 0.0, 1.0]])
+    else:
+        raise ValueError(f"unknown element type {elem_type!r}")
+    return N, dN, qw
+
+
+class FEAssembly(NamedTuple):
+    """Device-resident reference-configuration tables for one mesh."""
+    elems: jnp.ndarray     # (E, nen) int32 connectivity
+    shape: jnp.ndarray     # (nq, nen) shape values at quad points
+    dNdX: jnp.ndarray      # (E, nen, dim) reference shape gradients
+    wdV: jnp.ndarray       # (E, nq) quadrature weight * |detJ|
+    lumped_mass: jnp.ndarray  # (n_nodes,) sum_q wdV * N_a  (unit density)
+    n_nodes: int
+    dim: int
+
+
+def build_assembly(mesh: FEMesh, dtype=jnp.float32) -> FEAssembly:
+    N, dN, qw = _shape_table(mesh.elem_type)
+    Xe = mesh.nodes[mesh.elems]                      # (E, nen, dim)
+    # J_ij = dX_i/dxi_j  (constant per linear simplex)
+    J = np.einsum("ad,eai->eid", dN, Xe)             # (E, dim, dim)
+    detJ = np.linalg.det(J)
+    Jinv = np.linalg.inv(J)
+    dNdX = np.einsum("ad,edi->eai", dN, Jinv)        # (E, nen, dim)
+    wdV = np.abs(detJ)[:, None] * qw[None, :]        # (E, nq)
+
+    n_nodes = mesh.n_nodes
+    mass = np.zeros(n_nodes)
+    contrib = np.einsum("eq,qa->ea", wdV, N)         # (E, nen)
+    np.add.at(mass, mesh.elems, contrib)
+
+    return FEAssembly(
+        elems=jnp.asarray(mesh.elems, dtype=jnp.int32),
+        shape=jnp.asarray(N, dtype=dtype),
+        dNdX=jnp.asarray(dNdX, dtype=dtype),
+        wdV=jnp.asarray(wdV, dtype=dtype),
+        lumped_mass=jnp.asarray(mass, dtype=dtype),
+        n_nodes=n_nodes, dim=mesh.dim)
+
+
+# -- kinematics --------------------------------------------------------------
+
+def deformation_gradients(asm: FEAssembly, x: jnp.ndarray) -> jnp.ndarray:
+    """FF_e = dx/dX per element (constant for linear simplices) -> (E, dim, dim)."""
+    xe = x[asm.elems]                                # (E, nen, dim)
+    return jnp.einsum("eai,eaj->eij", xe, asm.dNdX)
+
+
+# -- strain-energy densities (W: FF -> scalar) -------------------------------
+
+def _log_ext(J, eps: float = 1e-4):
+    """log(J) with a C1 linear extension below ``eps``: near/through
+    element inversion the volumetric terms keep a large (1/eps-slope)
+    restoring force instead of a clamped-to-zero gradient."""
+    return jnp.where(J > eps, jnp.log(jnp.maximum(J, eps)),
+                     jnp.log(eps) + (J - eps) / eps)
+
+
+def neo_hookean(mu: float, lam: float) -> Callable:
+    """Compressible neo-Hookean, the IBFE-ex0-style material:
+    W = mu/2 (I1 - d) - mu ln J + lam/2 (ln J)^2."""
+    def W(FF):
+        d = FF.shape[-1]
+        J = jnp.linalg.det(FF)
+        logJ = _log_ext(J)
+        I1 = jnp.einsum("...ij,...ij->...", FF, FF)
+        return 0.5 * mu * (I1 - d) - mu * logJ + 0.5 * lam * logJ ** 2
+    return W
+
+
+def stvk(mu: float, lam: float) -> Callable:
+    """St. Venant-Kirchhoff: W = mu tr(EE^2) + lam/2 (tr EE)^2,
+    EE = (FF^T FF - I)/2."""
+    def W(FF):
+        d = FF.shape[-1]
+        C = jnp.einsum("...ki,...kj->...ij", FF, FF)
+        E = 0.5 * (C - jnp.eye(d, dtype=FF.dtype))
+        trE = jnp.trace(E, axis1=-2, axis2=-1)
+        return mu * jnp.einsum("...ij,...ij->...", E, E) + 0.5 * lam * trE ** 2
+    return W
+
+
+def pk1(W: Callable) -> Callable:
+    """PK1 stress P = dW/dFF (vectorized over leading axes)."""
+    return jax.grad(lambda FF: jnp.sum(W(FF)))
+
+
+# -- force assembly ----------------------------------------------------------
+
+def elastic_energy(asm: FEAssembly, W: Callable, x: jnp.ndarray):
+    """E(x) = sum_e sum_q wdV * W(FF_e). Linear simplices: FF constant per
+    element, so per-element energy is W(FF_e) * sum_q wdV."""
+    FF = deformation_gradients(asm, x)
+    return jnp.sum(W(FF) * jnp.sum(asm.wdV, axis=1))
+
+
+def nodal_forces(asm: FEAssembly, W: Callable, x: jnp.ndarray) -> jnp.ndarray:
+    """Weak-form nodal elastic force F = -dE/dx -> (n_nodes, dim)."""
+    return -jax.grad(lambda xx: elastic_energy(asm, W, xx))(x)
+
+
+def nodal_forces_pk1(asm: FEAssembly, W: Callable,
+                     x: jnp.ndarray) -> jnp.ndarray:
+    """Explicit PK1 assembly F_a = -sum_e sum_q wdV P(FF) dN_a/dX — the
+    reference's element-loop form; must equal :func:`nodal_forces`."""
+    FF = deformation_gradients(asm, x)
+    P = pk1(W)(FF)                                   # (E, dim, dim)
+    vol = jnp.sum(asm.wdV, axis=1)                   # (E,)
+    Fe = -jnp.einsum("e,eij,eaj->eai", vol, P, asm.dNdX)  # (E, nen, dim)
+    out = jnp.zeros((asm.n_nodes, asm.dim), dtype=x.dtype)
+    return out.at[asm.elems.reshape(-1)].add(
+        Fe.reshape(-1, asm.dim))
+
+
+# -- quadrature-point utilities (the "unified" coupling scheme) --------------
+
+def quad_positions(asm: FEAssembly, x: jnp.ndarray) -> jnp.ndarray:
+    """Current positions of all quadrature points -> (E*nq, dim)."""
+    xe = x[asm.elems]                                # (E, nen, dim)
+    xq = jnp.einsum("qa,eai->eqi", asm.shape, xe)
+    return xq.reshape(-1, asm.dim)
+
+def project_to_quads(asm: FEAssembly, nodal: jnp.ndarray) -> jnp.ndarray:
+    """Evaluate a nodal field at quadrature points -> (E*nq, ...)."""
+    ne = nodal[asm.elems]                            # (E, nen, ...)
+    nq = jnp.einsum("qa,ea...->eq...", asm.shape, ne)
+    return nq.reshape((-1,) + nodal.shape[1:])
+
+
+def l2_project_from_quads(asm: FEAssembly, vals: jnp.ndarray) -> jnp.ndarray:
+    """Lumped-mass L2 projection of quad-point values to nodes:
+    N_a-weighted quadrature sum divided by the lumped mass — the rebuild's
+    ``FEDataManager::buildL2ProjectionSolver`` (T16) with mass lumping."""
+    E, nq = asm.wdV.shape
+    v = vals.reshape((E, nq) + vals.shape[1:])
+    contrib = jnp.einsum("eq,qa,eq...->ea...", asm.wdV, asm.shape, v)
+    out = jnp.zeros((asm.n_nodes,) + vals.shape[1:], dtype=vals.dtype)
+    out = out.at[asm.elems.reshape(-1)].add(
+        contrib.reshape((-1,) + vals.shape[1:]))
+    shape = (asm.n_nodes,) + (1,) * (vals.ndim - 1)
+    return out / safe_lumped_mass(asm).reshape(shape)
+
+
+def safe_lumped_mass(asm: FEAssembly) -> jnp.ndarray:
+    """Lumped mass with zeros (nodes unreferenced by any element — legal
+    in external Triangle meshes) replaced by 1 so divisions stay finite;
+    such nodes carry no load either way."""
+    return jnp.where(asm.lumped_mass > 0, asm.lumped_mass,
+                     jnp.ones_like(asm.lumped_mass))

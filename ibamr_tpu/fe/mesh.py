@@ -1,0 +1,172 @@
+"""Finite-element meshes for the IBFE structure path.
+
+Reference parity: the *used surface* of libMesh in ``IBFEMethod`` /
+``FEDataManager`` (P17/T16, SURVEY.md §2) — a nodal mesh of linear
+simplex elements carrying the Lagrangian solid. The reference links
+libMesh; the rebuild keeps the mesh as plain arrays (nodes, connectivity)
+built host-side with NumPy, because everything the device ever touches is
+the precomputed quadrature tables in :mod:`ibamr_tpu.fe.fem`
+(SURVEY.md §7.3 hard-part #6: FE reference-configuration quantities are
+host precompute, only per-step kinematics run on TPU).
+
+Element types: TRI3 (2D solids) and TET4 (3D solids), both linear
+simplices — the element family the IBFE acceptance config uses.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+import numpy as np
+
+
+@dataclass
+class FEMesh:
+    """Nodal mesh of one linear-simplex element type.
+
+    nodes: (n_nodes, dim) float reference coordinates
+    elems: (n_elems, nen) int connectivity (nen = dim + 1)
+    elem_type: "TRI3" | "TET4"
+    """
+    nodes: np.ndarray
+    elems: np.ndarray
+    elem_type: str
+
+    @property
+    def dim(self) -> int:
+        return self.nodes.shape[1]
+
+    @property
+    def n_nodes(self) -> int:
+        return self.nodes.shape[0]
+
+    @property
+    def n_elems(self) -> int:
+        return self.elems.shape[0]
+
+    def volume(self) -> float:
+        """Total reference measure (area in 2D, volume in 3D)."""
+        X = self.nodes[self.elems]          # (E, nen, dim)
+        edges = X[:, 1:, :] - X[:, :1, :]   # (E, dim, dim)
+        det = np.linalg.det(edges)
+        fact = 2.0 if self.elem_type == "TRI3" else 6.0
+        return float(np.sum(np.abs(det)) / fact)
+
+
+def disc_mesh(radius: float = 0.25,
+              center: Tuple[float, float] = (0.5, 0.5),
+              n_rings: int = 8) -> FEMesh:
+    """Unstructured TRI3 disc: a center node plus ``n_rings`` concentric
+    rings; ring r holds ``6r`` nodes (hex-like layout keeps triangles
+    well-shaped). The standard IBFE-ex0-style solid body."""
+    nodes = [np.array(center, dtype=np.float64)]
+    ring_start = [0]
+    for r in range(1, n_rings + 1):
+        ring_start.append(len(nodes))
+        m = 6 * r
+        th = 2.0 * np.pi * np.arange(m) / m
+        rr = radius * r / n_rings
+        for t in th:
+            nodes.append(np.array([center[0] + rr * np.cos(t),
+                                   center[1] + rr * np.sin(t)]))
+    nodes = np.stack(nodes, axis=0)
+
+    elems = []
+    # inner fan: center to ring 1 (6 nodes)
+    s1 = ring_start[1]
+    for k in range(6):
+        elems.append([0, s1 + k, s1 + (k + 1) % 6])
+    # strips between ring r (6r nodes) and ring r+1 (6(r+1) nodes)
+    for r in range(1, n_rings):
+        si, mi = ring_start[r], 6 * r
+        so, mo = ring_start[r + 1], 6 * (r + 1)
+        # walk the outer ring; connect each outer edge to the nearest
+        # inner node, and fill the leftover wedges
+        inner_of = [int(np.floor(k * mi / mo + 0.5)) % mi
+                    for k in range(mo)]
+        for k in range(mo):
+            k1 = (k + 1) % mo
+            a, b = inner_of[k], inner_of[k1]
+            elems.append([so + k, so + k1, si + a])
+            if a != b:
+                elems.append([so + k1, si + b, si + a])
+    return FEMesh(nodes=nodes, elems=np.asarray(elems, dtype=np.int32),
+                  elem_type="TRI3")
+
+
+def block_mesh_tri(nx: int, ny: int,
+                   x_lo: Tuple[float, float] = (0.0, 0.0),
+                   x_up: Tuple[float, float] = (1.0, 1.0)) -> FEMesh:
+    """Structured TRI3 rectangle: (nx x ny) quads split into 2 triangles."""
+    xs = np.linspace(x_lo[0], x_up[0], nx + 1)
+    ys = np.linspace(x_lo[1], x_up[1], ny + 1)
+    gx, gy = np.meshgrid(xs, ys, indexing="ij")
+    nodes = np.stack([gx.ravel(), gy.ravel()], axis=1)
+
+    def nid(i, j):
+        return i * (ny + 1) + j
+
+    elems = []
+    for i in range(nx):
+        for j in range(ny):
+            a, b = nid(i, j), nid(i + 1, j)
+            c, d = nid(i + 1, j + 1), nid(i, j + 1)
+            elems.append([a, b, c])
+            elems.append([a, c, d])
+    return FEMesh(nodes=nodes, elems=np.asarray(elems, dtype=np.int32),
+                  elem_type="TRI3")
+
+
+def block_mesh_tet(nx: int, ny: int, nz: int,
+                   x_lo=(0.0, 0.0, 0.0), x_up=(1.0, 1.0, 1.0)) -> FEMesh:
+    """Structured TET4 box: each hex cell split into 6 tetrahedra."""
+    xs = np.linspace(x_lo[0], x_up[0], nx + 1)
+    ys = np.linspace(x_lo[1], x_up[1], ny + 1)
+    zs = np.linspace(x_lo[2], x_up[2], nz + 1)
+    gx, gy, gz = np.meshgrid(xs, ys, zs, indexing="ij")
+    nodes = np.stack([gx.ravel(), gy.ravel(), gz.ravel()], axis=1)
+
+    def nid(i, j, k):
+        return (i * (ny + 1) + j) * (nz + 1) + k
+
+    # 6-tet (Kuhn) subdivision of the unit cube
+    kuhn = [(0, 1, 3, 7), (0, 1, 5, 7), (0, 2, 3, 7),
+            (0, 2, 6, 7), (0, 4, 5, 7), (0, 4, 6, 7)]
+    elems = []
+    for i in range(nx):
+        for j in range(ny):
+            for k in range(nz):
+                corner = [nid(i + a, j + b, k + c)
+                          for a in (0, 1) for b in (0, 1) for c in (0, 1)]
+                # corner index bit order: a*4 + b*2 + c
+                for t in kuhn:
+                    elems.append([corner[v] for v in t])
+    return FEMesh(nodes=nodes, elems=np.asarray(elems, dtype=np.int32),
+                  elem_type="TET4")
+
+
+def read_triangle(node_path: str, ele_path: str) -> FEMesh:
+    """Read a mesh in the public Triangle ``.node``/``.ele`` ASCII format
+    (the rebuild's analog of the reference's libMesh file readers)."""
+    with open(node_path) as f:
+        toks = f.read().split()
+    n_nodes, dim = int(toks[0]), int(toks[1])
+    n_attr, n_bdry = int(toks[2]), int(toks[3])
+    stride = 1 + dim + n_attr + n_bdry
+    body = toks[4:4 + n_nodes * stride]
+    first_idx = int(body[0])
+    nodes = np.array(
+        [[float(body[r * stride + 1 + d]) for d in range(dim)]
+         for r in range(n_nodes)])
+    with open(ele_path) as f:
+        toks = f.read().split()
+    n_elems, nen = int(toks[0]), int(toks[1])
+    n_attr = int(toks[2])
+    stride = 1 + nen + n_attr
+    body = toks[3:3 + n_elems * stride]
+    elems = np.array(
+        [[int(body[r * stride + 1 + a]) - first_idx for a in range(nen)]
+         for r in range(n_elems)], dtype=np.int32)
+    etype = "TRI3" if nen == 3 else "TET4"
+    return FEMesh(nodes=nodes, elems=elems, elem_type=etype)

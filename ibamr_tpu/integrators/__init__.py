@@ -1,4 +1,6 @@
 from ibamr_tpu.integrators.ins import INSState, INSStaggeredIntegrator
 from ibamr_tpu.integrators.cib import CIBMethod, RigidBodies
+from ibamr_tpu.integrators.ibfe import IBFEMethod
 
-__all__ = ["INSState", "INSStaggeredIntegrator", "CIBMethod", "RigidBodies"]
+__all__ = ["INSState", "INSStaggeredIntegrator", "CIBMethod", "RigidBodies",
+           "IBFEMethod"]

@@ -1,0 +1,118 @@
+"""IBFE: immersed finite-element structure method.
+
+Reference parity: ``IBFEMethod`` (P17) + ``FEDataManager`` (T16,
+SURVEY.md §2.2) — the Lagrangian structure is a finite-element solid;
+internal forces come from the hyperelastic weak form (PK1 stress), and
+fluid-structure coupling spreads/interpolates with the same regularized
+delta kernels as the marker IB path.
+
+Coupling schemes, matching the reference's vocabulary:
+
+- ``"nodal"``: spread the weak-form nodal forces from the nodal positions
+  and interpolate velocity at the nodes (the reference's nodal-coupling /
+  mass-lumped option).
+- ``"unified"``: L2-project the nodal force to a force *density*, evaluate
+  it at element quadrature points, and spread each quad point's
+  ``G(X_q) * w_q dV`` (the reference's default quadrature-point coupling,
+  better volume conservation for coarse structural meshes); velocity is
+  interpolated at quad points and L2-projected back to nodes.
+
+Both schemes conserve total force exactly (sum of spread point forces ==
+sum of nodal forces, by partition of unity of the shape functions).
+
+``IBFEMethod`` implements the same strategy surface as
+:class:`ibamr_tpu.integrators.ib.IBMethod` (compute_force /
+spread_force / interpolate_velocity), so
+:class:`~ibamr_tpu.integrators.ib.IBExplicitIntegrator` drives it
+unchanged — the IBStrategy plugin seam (P7) doing its job.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax.numpy as jnp
+
+from ibamr_tpu.fe.fem import (FEAssembly, build_assembly, elastic_energy,
+                              l2_project_from_quads, nodal_forces,
+                              project_to_quads, quad_positions)
+from ibamr_tpu.fe.mesh import FEMesh
+from ibamr_tpu.grid import StaggeredGrid
+from ibamr_tpu.ops import interaction
+from ibamr_tpu.ops.delta import Kernel
+
+Vel = Tuple[jnp.ndarray, ...]
+
+
+class IBFEMethod:
+    """FE-structure strategy for the explicit IB coupling integrator.
+
+    The coupled state's ``X`` is the (n_nodes, dim) array of current
+    nodal positions; reference-configuration tables live in ``self.asm``.
+    """
+
+    def __init__(self, mesh: FEMesh, W: Callable,
+                 kernel: Kernel = "IB_4",
+                 coupling: str = "unified",
+                 damping: float = 0.0,
+                 body_force: Optional[Callable] = None,
+                 dtype=jnp.float32):
+        if coupling not in ("nodal", "unified"):
+            raise ValueError(f"unknown IBFE coupling scheme {coupling!r}")
+        self.mesh = mesh
+        self.asm: FEAssembly = build_assembly(mesh, dtype=dtype)
+        self.W = W
+        self.kernel = kernel
+        self.coupling = coupling
+        self.damping = damping
+        self.body_force = body_force  # optional (x, t) -> nodal force
+
+    # -- IBStrategy surface --------------------------------------------------
+    def compute_force(self, X: jnp.ndarray, U: jnp.ndarray,
+                      t) -> jnp.ndarray:
+        F = nodal_forces(self.asm, self.W, X)
+        if self.damping:
+            F = F - self.damping * U
+        if self.body_force is not None:
+            F = F + self.body_force(X, t)
+        return F
+
+    def interpolate_velocity(self, u: Vel, grid: StaggeredGrid,
+                             X: jnp.ndarray,
+                             mask: jnp.ndarray) -> jnp.ndarray:
+        if self.coupling == "nodal":
+            return interaction.interpolate_vel(u, grid, X,
+                                               kernel=self.kernel,
+                                               weights=mask)
+        xq = quad_positions(self.asm, X)
+        Uq = interaction.interpolate_vel(u, grid, xq, kernel=self.kernel)
+        # nodal mask honored the same way the nodal path does: inactive
+        # slots interpolate to zero (and so do not move)
+        return l2_project_from_quads(self.asm, Uq) * mask[:, None]
+
+    def spread_force(self, F: jnp.ndarray, grid: StaggeredGrid,
+                     X: jnp.ndarray, mask: jnp.ndarray) -> Vel:
+        if self.coupling == "nodal":
+            return interaction.spread_vel(F, grid, X, kernel=self.kernel,
+                                          weights=mask)
+        # force density G = M_lumped^{-1} F at nodes -> quad points,
+        # each quad point spreads G(X_q) * (w_q dV); nodal mask zeroes
+        # inactive slots' contribution, matching the nodal path
+        from ibamr_tpu.fe.fem import safe_lumped_mass
+        G = F * mask[:, None] / safe_lumped_mass(self.asm)[:, None]
+        Gq = project_to_quads(self.asm, G)
+        wq = self.asm.wdV.reshape(-1)
+        xq = quad_positions(self.asm, X)
+        return interaction.spread_vel(Gq * wq[:, None], grid, xq,
+                                      kernel=self.kernel)
+
+    # -- diagnostics ---------------------------------------------------------
+    def energy(self, X: jnp.ndarray):
+        return elastic_energy(self.asm, self.W, X)
+
+    def current_volume(self, X: jnp.ndarray):
+        """Deformed measure: sum_e |det FF_e| * refvol_e."""
+        from ibamr_tpu.fe.fem import deformation_gradients
+        FF = deformation_gradients(self.asm, X)
+        return jnp.sum(jnp.abs(jnp.linalg.det(FF))
+                       * jnp.sum(self.asm.wdV, axis=1))

@@ -1,0 +1,192 @@
+"""IBFE finite-element structure tests (stage 10, P17/T16 parity).
+
+Oracles: mesh/quadrature measure identities, zero residual force at the
+reference configuration, autodiff assembly == explicit PK1 assembly,
+exact force conservation under spreading, and the end-to-end stretched-
+disc relaxation (the IBFE/explicit/ex0 acceptance behavior).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ibamr_tpu.fe import (block_mesh_tet, block_mesh_tri, build_assembly,
+                          deformation_gradients, disc_mesh, elastic_energy,
+                          l2_project_from_quads, neo_hookean, nodal_forces,
+                          nodal_forces_pk1, project_to_quads, quad_positions,
+                          read_triangle, stvk)
+from ibamr_tpu.grid import StaggeredGrid
+from ibamr_tpu.models.fe_disc2d import build_fe_disc_example
+from ibamr_tpu.integrators.ibfe import IBFEMethod
+
+
+F64 = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+
+# -- mesh + assembly ---------------------------------------------------------
+
+def test_block_mesh_measure():
+    m2 = block_mesh_tri(4, 3, (0.0, 0.0), (2.0, 1.5))
+    assert np.isclose(m2.volume(), 3.0)
+    m3 = block_mesh_tet(2, 2, 2, (0, 0, 0), (1, 2, 1))
+    assert np.isclose(m3.volume(), 2.0)
+
+
+def test_disc_mesh_area():
+    m = disc_mesh(radius=0.3, center=(0.5, 0.5), n_rings=16)
+    # polygonal approximation of the circle: area below pi r^2, O(1/n^2)
+    assert abs(m.volume() - np.pi * 0.09) / (np.pi * 0.09) < 5e-3
+
+
+def test_assembly_measures_match_mesh():
+    for m in (block_mesh_tri(3, 3), disc_mesh(n_rings=4),
+              block_mesh_tet(2, 2, 2)):
+        asm = build_assembly(m, dtype=F64)
+        assert np.isclose(float(jnp.sum(asm.wdV)), m.volume(), rtol=1e-5)
+        assert np.isclose(float(jnp.sum(asm.lumped_mass)), m.volume(),
+                          rtol=1e-5)
+
+
+def test_identity_deformation():
+    m = disc_mesh(n_rings=4)
+    asm = build_assembly(m, dtype=F64)
+    FF = deformation_gradients(asm, jnp.asarray(m.nodes, dtype=F64))
+    assert np.allclose(np.asarray(FF),
+                       np.broadcast_to(np.eye(2), FF.shape), atol=1e-5)
+
+
+# -- forces ------------------------------------------------------------------
+
+@pytest.mark.parametrize("W", [neo_hookean(1.0, 4.0), stvk(1.0, 4.0)])
+def test_zero_force_at_reference(W):
+    m = disc_mesh(n_rings=4)
+    asm = build_assembly(m, dtype=F64)
+    F = nodal_forces(asm, W, jnp.asarray(m.nodes, dtype=F64))
+    assert float(jnp.max(jnp.abs(F))) < 1e-5
+
+
+def test_translation_invariance_and_total_force():
+    m = block_mesh_tri(3, 3)
+    asm = build_assembly(m, dtype=F64)
+    W = neo_hookean(1.0, 2.0)
+    x = jnp.asarray(m.nodes, dtype=F64)
+    x_def = x.at[:, 0].mul(1.3)  # uniaxial stretch
+    F1 = nodal_forces(asm, W, x_def)
+    F2 = nodal_forces(asm, W, x_def + jnp.array([0.7, -0.2], dtype=F64))
+    assert np.allclose(np.asarray(F1), np.asarray(F2), atol=1e-5)
+    # partition of unity => internal forces sum to zero
+    assert np.allclose(np.asarray(jnp.sum(F1, axis=0)), 0.0, atol=1e-4)
+
+
+@pytest.mark.parametrize("W", [neo_hookean(1.0, 4.0), stvk(0.5, 1.0)])
+def test_autodiff_matches_pk1_assembly(W):
+    m = disc_mesh(n_rings=3)
+    asm = build_assembly(m, dtype=F64)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(m.nodes + 0.02 * rng.randn(*m.nodes.shape), dtype=F64)
+    Fa = np.asarray(nodal_forces(asm, W, x))
+    Fp = np.asarray(nodal_forces_pk1(asm, W, x))
+    assert np.allclose(Fa, Fp, atol=1e-4 * max(1.0, np.abs(Fa).max()))
+
+
+def test_energy_decreases_along_force():
+    m = disc_mesh(n_rings=3)
+    asm = build_assembly(m, dtype=F64)
+    W = neo_hookean(1.0, 4.0)
+    x = jnp.asarray(m.nodes, dtype=F64)
+    x = x.at[:, 0].mul(1.2)
+    E0 = float(elastic_energy(asm, W, x))
+    F = nodal_forces(asm, W, x)
+    E1 = float(elastic_energy(asm, W, x + 1e-3 * F))
+    assert E1 < E0
+
+
+# -- quadrature-point transfer (unified coupling) ----------------------------
+
+def test_quad_projection_constant_roundtrip():
+    m = disc_mesh(n_rings=4)
+    asm = build_assembly(m, dtype=F64)
+    c = jnp.full((asm.n_nodes, 2), 1.7, dtype=F64)
+    cq = project_to_quads(asm, c)
+    assert np.allclose(np.asarray(cq), 1.7, atol=1e-6)
+    back = l2_project_from_quads(asm, cq)
+    assert np.allclose(np.asarray(back), 1.7, atol=1e-5)
+
+
+def test_quad_positions_inside_hull():
+    m = disc_mesh(radius=0.2, center=(0.5, 0.5), n_rings=4)
+    asm = build_assembly(m, dtype=F64)
+    xq = np.asarray(quad_positions(asm, jnp.asarray(m.nodes, dtype=F64)))
+    r = np.linalg.norm(xq - 0.5, axis=1)
+    assert r.max() < 0.2
+
+
+# -- coupling: spreading conservation + interp consistency -------------------
+
+@pytest.mark.parametrize("coupling", ["nodal", "unified"])
+def test_spread_conserves_total_force(coupling):
+    grid = StaggeredGrid(n=(32, 32), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    m = disc_mesh(radius=0.15, center=(0.5, 0.5), n_rings=4)
+    fe = IBFEMethod(m, neo_hookean(1.0, 4.0), coupling=coupling, dtype=F64)
+    rng = np.random.RandomState(1)
+    X = jnp.asarray(m.nodes * 1.1 - 0.05, dtype=F64)
+    F = jnp.asarray(rng.randn(m.n_nodes, 2), dtype=F64)
+    mask = jnp.ones(m.n_nodes, dtype=F64)
+    f = fe.spread_force(F, grid, X, mask)
+    h2 = float(np.prod(grid.dx))
+    total_grid = np.array([float(jnp.sum(comp)) * h2 for comp in f])
+    total_F = np.asarray(jnp.sum(F, axis=0))
+    assert np.allclose(total_grid, total_F, rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.parametrize("coupling", ["nodal", "unified"])
+def test_interp_constant_velocity(coupling):
+    grid = StaggeredGrid(n=(32, 32), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    m = disc_mesh(radius=0.15, center=(0.5, 0.5), n_rings=4)
+    fe = IBFEMethod(m, neo_hookean(1.0, 4.0), coupling=coupling, dtype=F64)
+    u = (jnp.full(grid.n, 0.8, dtype=F64),
+         jnp.full(grid.n, -0.3, dtype=F64))
+    mask = jnp.ones(m.n_nodes, dtype=F64)
+    U = fe.interpolate_velocity(u, grid, jnp.asarray(m.nodes, dtype=F64),
+                                mask)
+    assert np.allclose(np.asarray(U[:, 0]), 0.8, atol=1e-5)
+    assert np.allclose(np.asarray(U[:, 1]), -0.3, atol=1e-5)
+
+
+# -- end-to-end: stretched disc relaxation (ex0 behavior) --------------------
+
+@pytest.mark.parametrize("coupling", ["unified"])
+def test_stretched_disc_relaxes(coupling):
+    integ, state = build_fe_disc_example(
+        n_cells=32, n_rings=4, radius=0.2, stretch=1.3,
+        mu_s=1.0, lam_s=4.0, mu=0.1, coupling=coupling)
+    fe = integ.ib
+    E0 = float(fe.energy(state.X))
+    A0 = float(fe.current_volume(state.X))
+    dt = 2e-3
+    from ibamr_tpu.integrators.ib import advance_ib
+    state = jax.block_until_ready(advance_ib(integ, state, dt, 300))
+    E1 = float(fe.energy(state.X))
+    A1 = float(fe.current_volume(state.X))
+    assert np.isfinite(E1) and E1 < 0.5 * E0      # elastic energy released
+    assert abs(A1 - A0) / A0 < 0.02               # incompressibility
+    # aspect ratio of the deformed disc has moved toward 1
+    Xc = np.asarray(state.X) - np.asarray(state.X).mean(axis=0)
+    sx, sy = Xc[:, 0].std(), Xc[:, 1].std()
+    assert max(sx, sy) / min(sx, sy) < 1.25
+
+
+# -- io ----------------------------------------------------------------------
+
+def test_read_triangle_roundtrip(tmp_path):
+    node = tmp_path / "m.node"
+    ele = tmp_path / "m.ele"
+    node.write_text(
+        "4 2 0 0\n1 0.0 0.0\n2 1.0 0.0\n3 1.0 1.0\n4 0.0 1.0\n")
+    ele.write_text("2 3 0\n1 1 2 3\n2 1 3 4\n")
+    m = read_triangle(str(node), str(ele))
+    assert m.n_nodes == 4 and m.n_elems == 2 and m.elem_type == "TRI3"
+    assert np.isclose(m.volume(), 1.0)
+    assert m.elems.min() == 0
