@@ -442,182 +442,47 @@ def prolong_mac_div_preserving(u_coarse: Sequence[jnp.ndarray],
 # --------------------------------------------------------------------------
 
 class TwoLevelAdvDiff:
-    """Composite two-level advance of dQ/dt + div(uQ) = kappa lap(Q).
+    """Composite two-level advance of dQ/dt + div(uQ) = kappa lap(Q) on
+    one STATIC fine box.
 
     Reference parity: the level-by-level subcycled advance + flux
-    synchronization of the AMR integrators (SURVEY.md §3.4, S4, T10),
-    specialized to one static fine box over the periodic coarse level.
-    Explicit flux-form update on both levels (Euler in time), ``ratio``
-    fine substeps per coarse step, space-time interpolated CF ghosts,
-    restriction onto covered cells, and reflux at the CF interface.
+    synchronization of the AMR integrators (SURVEY.md §3.4, S4, T10).
+    Thin facade over the dynamic-origin core
+    (:class:`ibamr_tpu.amr_dynamic.DynamicTwoLevelAdvDiff`) with the
+    window origin pinned to ``box.lo`` — one implementation of the
+    subcycled flux/reflux machinery serves both the static and the
+    moving-window case.
     """
-
-    GHOST = 2
 
     def __init__(self, grid: StaggeredGrid, box: FineBox,
                  kappa: float = 0.0, scheme: str = "centered",
                  u_coarse: Optional[Vel] = None,
                  u_fine: Optional[Vel] = None):
+        from ibamr_tpu.amr_dynamic import DynamicTwoLevelAdvDiff
         box.validate(grid)
         self.grid = grid
         self.box = box
         self.kappa = float(kappa)
-        assert scheme in ("centered", "upwind")
         self.scheme = scheme
         self.fine = box.fine_grid(grid)
         self.dx_f = tuple(h / box.ratio for h in grid.dx)
-        # advection velocities per level (constant in time); None = no
-        # advection. u_fine uses the box MAC layout (fine_n + e_d).
-        self.u_c = u_coarse
-        self.u_f = u_fine
-
-    # -- fluxes --------------------------------------------------------------
-    def _coarse_fluxes(self, Q: jnp.ndarray) -> Vel:
-        """Flux at lower faces, periodic layout (shape n per axis)."""
-        dx = self.grid.dx
-        out = []
-        from ibamr_tpu.ops.convection import advective_face_value
-
-        for d in range(self.grid.dim):
-            Qm = jnp.roll(Q, 1, d)
-            F = jnp.zeros_like(Q)
-            if self.u_c is not None:
-                F = F + self.u_c[d] * advective_face_value(
-                    Qm, Q, self.u_c[d], self.scheme)
-            if self.kappa != 0.0:
-                F = F - self.kappa * (Q - Qm) / dx[d]
-            out.append(F)
-        return tuple(out)
-
-    def _fine_fluxes(self, Qg: jnp.ndarray) -> Vel:
-        """Flux on the box MAC layout from the ghost-padded fine array."""
-        from ibamr_tpu.ops.convection import advective_face_value
-
-        g = self.GHOST
-        dim = self.grid.dim
-        nf = self.box.fine_n
-        out = []
-        for d in range(dim):
-            # cells i-1 and i for faces i = 0..nf[d] along d, interior
-            # along other axes
-            lo_sl = [slice(g, g + nf[a]) for a in range(dim)]
-            hi_sl = [slice(g, g + nf[a]) for a in range(dim)]
-            lo_sl[d] = slice(g - 1, g + nf[d])
-            hi_sl[d] = slice(g, g + nf[d] + 1)
-            Qm = Qg[tuple(lo_sl)]
-            Qp = Qg[tuple(hi_sl)]
-            F = jnp.zeros_like(Qm)
-            if self.u_f is not None:
-                F = F + self.u_f[d] * advective_face_value(
-                    Qm, Qp, self.u_f[d], self.scheme)
-            if self.kappa != 0.0:
-                F = F - self.kappa * (Qp - Qm) / self.dx_f[d]
-            out.append(F)
-        return tuple(out)
+        self._core = DynamicTwoLevelAdvDiff(
+            grid, box.shape, kappa=kappa, scheme=scheme,
+            u_c=u_coarse, u_f=u_fine, ratio=box.ratio, clearance=1)
+        self._lo = jnp.asarray(box.lo, dtype=jnp.int32)
 
     # -- composite step ------------------------------------------------------
     def step(self, Qc: jnp.ndarray, Qf: jnp.ndarray,
              dt: float) -> Tuple[jnp.ndarray, jnp.ndarray]:
-        grid, box = self.grid, self.box
-        dim = grid.dim
-        r = box.ratio
-        dx, dx_f = grid.dx, self.dx_f
-        dt_f = dt / r
-
-        # 1. coarse step (flux form, periodic)
-        Fc = self._coarse_fluxes(Qc)
-        div = None
-        for d in range(dim):
-            t = (jnp.roll(Fc[d], -1, d) - Fc[d]) / dx[d]
-            div = t if div is None else div + t
-        Qc_new = Qc - dt * div
-
-        # 2. fine substeps with space-time interpolated ghosts; accumulate
-        #    time-averaged fine fluxes through the CF interface
-        acc_lo = [None] * dim
-        acc_hi = [None] * dim
-        for m in range(r):
-            theta = m / r
-            Qc_theta = (1.0 - theta) * Qc + theta * Qc_new
-            Qg = fill_fine_ghosts(Qf, Qc_theta, box, self.GHOST)
-            Ff = self._fine_fluxes(Qg)
-            divf = None
-            for d in range(dim):
-                lo_sl = [slice(None)] * dim
-                hi_sl = [slice(None)] * dim
-                lo_sl[d] = slice(0, -1)
-                hi_sl[d] = slice(1, None)
-                t = (Ff[d][tuple(hi_sl)] - Ff[d][tuple(lo_sl)]) / dx_f[d]
-                divf = t if divf is None else divf + t
-                # interface flux accumulation (planes 0 and nf[d])
-                pl = [slice(None)] * dim
-                pl[d] = 0
-                f_lo = Ff[d][tuple(pl)]
-                pl[d] = -1
-                f_hi = Ff[d][tuple(pl)]
-                acc_lo[d] = f_lo if acc_lo[d] is None else acc_lo[d] + f_lo
-                acc_hi[d] = f_hi if acc_hi[d] is None else acc_hi[d] + f_hi
-            Qf = Qf - dt_f * divf
-
-        # 3. restriction onto covered coarse cells
-        box_sl = tuple(slice(box.lo[a], box.hi[a]) for a in range(dim))
-        Qc_new = Qc_new.at[box_sl].set(restrict_cc(Qf, r))
-
-        # 4. reflux: replace the coarse flux through each CF interface face
-        #    by the time/space-averaged fine flux in the update of the
-        #    UNcovered neighbor cell
-        for d in range(dim):
-            # transverse-average fine faces onto coarse faces
-            def face_avg(f):
-                tr = [a for a in range(dim) if a != d]
-                # f has the fine transverse shape; block-mean by r
-                new_shape = []
-                for a in tr:
-                    new_shape += [box.shape[a], r]
-                arr = f.reshape(new_shape)
-                mean_axes = tuple(2 * i + 1 for i in range(len(tr)))
-                return arr.mean(axis=mean_axes)
-
-            favg_lo = face_avg(acc_lo[d]) / r
-            favg_hi = face_avg(acc_hi[d]) / r
-            # coarse fluxes at the same faces
-            tr_sl = tuple(slice(box.lo[a], box.hi[a])
-                          for a in range(dim) if a != d)
-
-            def coarse_face(idx):
-                sl = [slice(box.lo[a], box.hi[a]) for a in range(dim)]
-                sl[d] = idx
-                return Fc[d][tuple(sl)]
-
-            fc_lo = coarse_face(box.lo[d])      # face at lower CF boundary
-            fc_hi = coarse_face(box.hi[d])      # face at upper CF boundary
-            # lower neighbor cell (lo[d]-1): flux F[lo] is its UPPER face:
-            #   Q -= dt/dx (F_up - F_low)  =>  delta = -dt/dx (f_fine - f_c)
-            low_cell = [slice(box.lo[a], box.hi[a]) for a in range(dim)]
-            low_cell[d] = box.lo[d] - 1
-            Qc_new = Qc_new.at[tuple(low_cell)].add(
-                -dt / dx[d] * (favg_lo - fc_lo))
-            # upper neighbor cell (hi[d]): flux F[hi] is its LOWER face:
-            #   delta = +dt/dx (f_fine - f_c)
-            hi_cell = [slice(box.lo[a], box.hi[a]) for a in range(dim)]
-            hi_cell[d] = box.hi[d]
-            Qc_new = Qc_new.at[tuple(hi_cell)].add(
-                dt / dx[d] * (favg_hi - fc_hi))
-
-        return Qc_new, Qf
+        from ibamr_tpu.amr_dynamic import AMRState
+        out = self._core.step(AMRState(Qc=Qc, Qf=Qf, lo=self._lo), dt)
+        return out.Qc, out.Qf
 
     # -- diagnostics ---------------------------------------------------------
     def total(self, Qc: jnp.ndarray, Qf: jnp.ndarray) -> jnp.ndarray:
         """Composite conserved integral: uncovered coarse + fine."""
-        box = self.box
-        vol_c = self.grid.cell_volume
-        vol_f = vol_c / (box.ratio ** self.grid.dim)
-        covered = jnp.zeros_like(Qc, dtype=bool)
-        box_sl = tuple(slice(box.lo[a], box.hi[a])
-                       for a in range(self.grid.dim))
-        covered = covered.at[box_sl].set(True)
-        return (jnp.sum(jnp.where(covered, 0.0, Qc)) * vol_c
-                + jnp.sum(Qf) * vol_f)
+        from ibamr_tpu.amr_dynamic import AMRState
+        return self._core.total(AMRState(Qc=Qc, Qf=Qf, lo=self._lo))
 
     def initialize(self, fn, dtype=jnp.float64):
         """Evaluate ``fn(coords_tuple) -> array`` on both levels."""

@@ -146,11 +146,17 @@ def block_mesh_tet(nx: int, ny: int, nz: int,
                   elem_type="TET4")
 
 
+def _read_tokens(path: str):
+    """Whitespace tokens with Triangle-format '#' comments stripped."""
+    with open(path) as f:
+        return [t for line in f
+                for t in line.split("#", 1)[0].split()]
+
+
 def read_triangle(node_path: str, ele_path: str) -> FEMesh:
     """Read a mesh in the public Triangle ``.node``/``.ele`` ASCII format
     (the rebuild's analog of the reference's libMesh file readers)."""
-    with open(node_path) as f:
-        toks = f.read().split()
+    toks = _read_tokens(node_path)
     n_nodes, dim = int(toks[0]), int(toks[1])
     n_attr, n_bdry = int(toks[2]), int(toks[3])
     stride = 1 + dim + n_attr + n_bdry
@@ -159,8 +165,7 @@ def read_triangle(node_path: str, ele_path: str) -> FEMesh:
     nodes = np.array(
         [[float(body[r * stride + 1 + d]) for d in range(dim)]
          for r in range(n_nodes)])
-    with open(ele_path) as f:
-        toks = f.read().split()
+    toks = _read_tokens(ele_path)
     n_elems, nen = int(toks[0]), int(toks[1])
     n_attr = int(toks[2])
     stride = 1 + nen + n_attr

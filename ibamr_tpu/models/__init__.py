@@ -1,4 +1,6 @@
+from ibamr_tpu.models.fe_disc2d import build_fe_disc_example
 from ibamr_tpu.models.membrane2d import (
     build_membrane_example, make_circle_membrane)
 
-__all__ = ["build_membrane_example", "make_circle_membrane"]
+__all__ = ["build_fe_disc_example", "build_membrane_example",
+           "make_circle_membrane"]

@@ -1,0 +1,173 @@
+"""Dynamic AMR tests (stage 11): tagging, single-box fitting, traced-
+origin regrid conservation, overlap preservation, and the moving-window
+integrator tracking an advected pulse (regrid-invariance acceptance,
+SURVEY.md §7.2 stage 11).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ibamr_tpu.amr_dynamic import (AMRState, DynamicTwoLevelAdvDiff,
+                                   copy_overlap, fit_box_origin,
+                                   prolong_cc_conservative, regrid,
+                                   restrict_into_coarse, tag_gradient,
+                                   tag_markers, tag_value)
+from ibamr_tpu.grid import StaggeredGrid
+
+F64 = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+
+def gauss2d(x0, y0, w):
+    def fn(coords):
+        x, y = coords
+        return jnp.exp(-((x - x0) ** 2 + (y - y0) ** 2) / w ** 2)
+    return fn
+
+
+# -- tagging + fitting -------------------------------------------------------
+
+def test_tag_value_and_fit():
+    grid = StaggeredGrid(n=(32, 32), x_lo=(0, 0), x_up=(1, 1))
+    Q = jnp.zeros((32, 32)).at[10:14, 20:22].set(1.0)
+    tags = tag_value(Q, 0.5)
+    lo = np.asarray(fit_box_origin(tags, (8, 8), clearance=2))
+    # window [lo, lo+8) must cover cells [10,14) x [20,22)
+    assert lo[0] <= 10 and lo[0] + 8 >= 14
+    assert lo[1] <= 20 and lo[1] + 8 >= 22
+
+
+def test_fit_clips_to_clearance():
+    tags = jnp.zeros((32, 32), dtype=bool).at[0:3, 29:32].set(True)
+    lo = np.asarray(fit_box_origin(tags, (8, 8), clearance=2))
+    assert lo[0] == 2 and lo[1] == 32 - 8 - 2
+
+
+def test_fit_no_tags_centers():
+    tags = jnp.zeros((32, 32), dtype=bool)
+    lo = np.asarray(fit_box_origin(tags, (8, 8), clearance=2))
+    assert tuple(lo) == (12, 12)
+
+
+def test_tag_markers_buffer():
+    grid = StaggeredGrid(n=(16, 16), x_lo=(0, 0), x_up=(1, 1))
+    X = jnp.array([[0.53, 0.53]])  # cell (8, 8)
+    tags = np.asarray(tag_markers(X, grid, buffer=1))
+    assert tags[8, 8] and tags[7, 8] and tags[8, 9] and tags[9, 8]
+    assert not tags[8, 11]
+
+
+# -- transfer operators ------------------------------------------------------
+
+def test_prolong_conservative_block_means():
+    rng = np.random.RandomState(0)
+    Qc = jnp.asarray(rng.randn(16, 16), dtype=F64)
+    lo = jnp.array([3, 5], dtype=jnp.int32)
+    Qf = prolong_cc_conservative(Qc, lo, (6, 4))
+    # each 2x2 fine block averages exactly to its parent coarse value
+    blk = np.asarray(Qf).reshape(6, 2, 4, 2).mean(axis=(1, 3))
+    assert np.allclose(blk, np.asarray(Qc)[3:9, 5:9], atol=1e-6)
+
+
+def test_prolong_conservative_linear_exact():
+    # linear fields are reproduced exactly by central-slope reconstruction
+    x = np.arange(16)[:, None] + 0.5
+    y = np.arange(16)[None, :] + 0.5
+    Qc = jnp.asarray(2.0 * x + 3.0 * y, dtype=F64)
+    lo = jnp.array([4, 4], dtype=jnp.int32)
+    Qf = np.asarray(prolong_cc_conservative(Qc, lo, (4, 4)))
+    xf = 4 + (np.arange(8)[:, None] + 0.5) / 2
+    yf = 4 + (np.arange(8)[None, :] + 0.5) / 2
+    assert np.allclose(Qf, 2.0 * xf + 3.0 * yf, atol=1e-5)
+
+
+def test_regrid_conserves_total():
+    grid = StaggeredGrid(n=(32, 32), x_lo=(0, 0), x_up=(1, 1))
+    sim = DynamicTwoLevelAdvDiff(grid, (8, 8), dtype=F64)
+    state = sim.initialize(gauss2d(0.4, 0.4, 0.1))
+    T0 = float(sim.total(state))
+    lo_new = jnp.array([14, 16], dtype=jnp.int32)
+    Qc2, Qf2 = regrid(state.Qc, state.Qf, state.lo, lo_new)
+    s2 = AMRState(Qc=Qc2, Qf=Qf2, lo=lo_new)
+    T1 = float(sim.total(s2))
+    assert abs(T1 - T0) <= 1e-10 * max(1.0, abs(T0)) + 1e-12
+
+
+def test_copy_overlap_preserves_fine_data():
+    rng = np.random.RandomState(1)
+    Qf_old = jnp.asarray(rng.randn(8, 8), dtype=F64)
+    lo_old = jnp.array([4, 4], dtype=jnp.int32)
+    lo_new = jnp.array([5, 6], dtype=jnp.int32)   # shift (1,2) coarse cells
+    Qf_new = jnp.zeros((8, 8), dtype=F64)
+    out = np.asarray(copy_overlap(Qf_new, Qf_old, lo_new, lo_old))
+    # overlap in new-window fine coords: rows 0..5, cols 0..3 come from
+    # old rows 2.., cols 4..
+    assert np.allclose(out[0:6, 0:4], np.asarray(Qf_old)[2:8, 4:8])
+    assert np.allclose(out[6:, :], 0.0) and np.allclose(out[:, 4:], 0.0)
+
+
+def test_restrict_into_coarse_roundtrip():
+    Qc = jnp.zeros((16, 16), dtype=F64)
+    Qf = jnp.ones((8, 8), dtype=F64) * 3.0
+    lo = jnp.array([5, 7], dtype=jnp.int32)
+    out = np.asarray(restrict_into_coarse(Qc, Qf, lo))
+    assert np.allclose(out[5:9, 7:11], 3.0)
+    assert out.sum() == pytest.approx(4 * 4 * 3.0)
+
+
+# -- moving-window integrator ------------------------------------------------
+
+def test_jitted_advance_mass_conservation_and_tracking():
+    grid = StaggeredGrid(n=(48, 48), x_lo=(0, 0), x_up=(1, 1))
+
+    def u_fn(coords, d):
+        return jnp.full_like(coords[0], 0.7 if d == 0 else 0.0)
+
+    sim = DynamicTwoLevelAdvDiff(grid, (12, 12), kappa=1e-4,
+                                 scheme="upwind", u_fn=u_fn,
+                                 tag_threshold=0.03, dtype=F64)
+    state = sim.initialize(gauss2d(0.3, 0.5, 0.07))
+    lo0 = np.asarray(state.lo).copy()
+    T0 = float(sim.total(state))
+
+    dt = 0.25 * grid.dx[0] / 0.7 / 2   # fine CFL-safe
+    adv = jax.jit(lambda s: sim.advance(s, dt, 64, regrid_interval=4))
+    state = jax.block_until_ready(adv(state))
+    T1 = float(sim.total(state))
+    # flux-form + reflux + conservative regrid => conservation
+    assert abs(T1 - T0) < 1e-8 * max(1.0, abs(T0)) + 1e-10
+    # the window moved with the pulse (advected right by 0.7*t)
+    lo1 = np.asarray(state.lo)
+    assert lo1[0] > lo0[0]
+    # pulse peak near expected position on the composite solution
+    t_end = 64 * dt
+    x_peak = 0.3 + 0.7 * t_end
+    Qc = np.asarray(restrict_into_coarse(state.Qc, state.Qf, state.lo))
+    i_pk = np.unravel_index(np.argmax(Qc), Qc.shape)
+    x_pk = (i_pk[0] + 0.5) * grid.dx[0]
+    assert abs(x_pk - x_peak) < 0.08
+    assert abs((i_pk[1] + 0.5) * grid.dx[1] - 0.5) < 0.08
+
+
+def test_regrid_invariance_of_smooth_solution():
+    # advancing with frequent regrids vs a static window that already
+    # covers the pulse path must agree closely where both are fine
+    grid = StaggeredGrid(n=(48, 48), x_lo=(0, 0), x_up=(1, 1))
+    sim = DynamicTwoLevelAdvDiff(grid, (16, 16), kappa=2e-3,
+                                 tag_threshold=0.02, dtype=F64)
+    ic = gauss2d(0.5, 0.5, 0.08)
+    s_dyn = sim.initialize(ic)
+    s_static = sim.initialize(ic)
+    dt = 2e-4
+    adv_regrid = jax.jit(lambda s: sim.advance(s, dt, 40,
+                                               regrid_interval=5))
+    adv_static = jax.jit(lambda s: sim.advance(s, dt, 40,
+                                               regrid_interval=10 ** 6))
+    out_d = jax.block_until_ready(adv_regrid(s_dyn))
+    out_s = jax.block_until_ready(adv_static(s_static))
+    # same composite solution on the coarse level
+    Qd = np.asarray(restrict_into_coarse(out_d.Qc, out_d.Qf, out_d.lo))
+    Qs = np.asarray(restrict_into_coarse(out_s.Qc, out_s.Qf, out_s.lo))
+    assert np.max(np.abs(Qd - Qs)) < 5e-4 * np.max(np.abs(Qs))
