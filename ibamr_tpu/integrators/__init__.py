@@ -1,6 +1,8 @@
 from ibamr_tpu.integrators.ins import INSState, INSStaggeredIntegrator
 from ibamr_tpu.integrators.cib import CIBMethod, RigidBodies
 from ibamr_tpu.integrators.ibfe import IBFEMethod
+from ibamr_tpu.integrators.constraint_ib import (ConstraintIBMethod,
+                                                 ConstraintIBState)
 
 __all__ = ["INSState", "INSStaggeredIntegrator", "CIBMethod", "RigidBodies",
-           "IBFEMethod"]
+           "IBFEMethod", "ConstraintIBMethod", "ConstraintIBState"]
