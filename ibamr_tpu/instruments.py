@@ -1,0 +1,128 @@
+"""Instrument panels: flow meters and pressure gauges on fiber loops.
+
+Reference parity: ``IBInstrumentPanel`` + ``IBInstrumentationSpec``
+(P13, SURVEY.md §2.2/§5.5) — meters defined by ordered marker loops
+riding on the structure; each step they report the volumetric flux
+through the surface spanned by the loop and the mean pressure along it,
+appended to the metrics stream.
+
+TPU-first redesign: the reference reduces per-rank partial sums over a
+``ParallelMap`` (T14); here each meter is a static padded index array
+and the readings are pure jitted reductions (interp gathers +
+``segment_sum``), so instrumentation adds no host synchronization.
+
+Geometry: the spanning surface is the centroid fan of the loop (exact
+for planar loops, the reference's assumption as well):
+  3D: flux = sum_tri u(centroid_tri) . n_tri A_tri
+  2D: a "loop" is an open curve; flux = integral of u . n ds across it
+      (n = left-normal of each segment).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, NamedTuple, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ibamr_tpu.grid import StaggeredGrid
+from ibamr_tpu.ops import interaction
+from ibamr_tpu.ops.delta import Kernel
+
+Vel = Tuple[jnp.ndarray, ...]
+
+
+class MeterSpecs(NamedTuple):
+    """B meters, each a padded chain of marker indices.
+
+    idx: (B, L) int32 marker indices (pad slots repeat the first node);
+    valid: (B, L) 0/1 — 1 for real nodes (pressure averaging);
+    seg: (B, L) 0/1 — 1 for real segments k -> k+1 (flux); for closed
+    meters this includes the closing segment back to the first node.
+    """
+    idx: jnp.ndarray
+    valid: jnp.ndarray
+    seg: jnp.ndarray
+
+
+def make_meters(loops: Sequence[Sequence[int]], closed=True,
+                dtype=jnp.float32) -> MeterSpecs:
+    """Build padded meter specs from per-meter marker index lists.
+
+    ``closed``: bool or per-meter list — closed loops (3D spanning
+    surfaces) include the closing segment; open chains (2D cross-section
+    meters) do not.
+    """
+    B = len(loops)
+    if isinstance(closed, bool):
+        closed = [closed] * B
+    L = max(len(l) for l in loops) + 1   # always >= 1 pad slot
+    idx = np.zeros((B, L), dtype=np.int32)
+    valid = np.zeros((B, L), dtype=np.float64)
+    seg = np.zeros((B, L), dtype=np.float64)
+    for b, loop in enumerate(loops):
+        n = len(loop)
+        idx[b, :n] = loop
+        idx[b, n:] = loop[0]     # pad at the first node's position
+        valid[b, :n] = 1.0
+        seg[b, :n - 1] = 1.0
+        if closed[b]:
+            # segment n-1 -> n lands on the first node: the closer
+            seg[b, n - 1] = 1.0
+    return MeterSpecs(idx=jnp.asarray(idx),
+                      valid=jnp.asarray(valid, dtype=dtype),
+                      seg=jnp.asarray(seg, dtype=dtype))
+
+
+class InstrumentPanel:
+    """Flow-meter + pressure-gauge readings for marker loops (P13)."""
+
+    def __init__(self, grid: StaggeredGrid, meters: MeterSpecs,
+                 kernel: Kernel = "IB_4"):
+        self.grid = grid
+        self.meters = meters
+        self.kernel = kernel
+
+    # -- readings (pure, jittable) -------------------------------------------
+    def readings(self, u: Vel, p: jnp.ndarray,
+                 X: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+        """{"flux": (B,), "mean_pressure": (B,)}; one interp gather per
+        quantity, reductions on device."""
+        grid = self.grid
+        idx, valid = self.meters.idx, self.meters.valid
+        seg_valid = self.meters.seg
+        B, L = idx.shape
+        Xl = X[idx]                                     # (B, L, dim)
+
+        if grid.dim == 2:
+            # open-curve meter: segments between consecutive real nodes
+            a = Xl
+            b = jnp.roll(Xl, -1, axis=1)
+            mid = 0.5 * (a + b).reshape(-1, 2)
+            t = (b - a)
+            # left normal (ds-weighted): (t_y, -t_x)
+            nrm = jnp.stack([t[..., 1], -t[..., 0]], axis=-1).reshape(-1, 2)
+            Um = interaction.interpolate_vel(u, grid, mid,
+                                             kernel=self.kernel)
+            flux = jnp.sum((Um * nrm).sum(-1).reshape(B, L)
+                           * seg_valid, axis=1)
+        else:
+            # centroid-fan triangulation of each closed loop
+            cnt = jnp.maximum(jnp.sum(valid, axis=1, keepdims=True), 1.0)
+            cent = jnp.sum(Xl * valid[..., None], axis=1) / cnt   # (B, 3)
+            a = Xl
+            b = jnp.roll(Xl, -1, axis=1)
+            tri_c = (a + b + cent[:, None, :]) / 3.0
+            # area-weighted normal of triangle (cent, a, b)
+            nrm = 0.5 * jnp.cross(a - cent[:, None, :],
+                                  b - cent[:, None, :])
+            Um = interaction.interpolate_vel(u, grid, tri_c.reshape(-1, 3),
+                                             kernel=self.kernel)
+            flux = jnp.sum((Um.reshape(B, L, 3) * nrm).sum(-1)
+                           * seg_valid, axis=1)
+
+        Pm = interaction.interpolate(p, grid, Xl.reshape(-1, grid.dim),
+                                     centering="cell", kernel=self.kernel)
+        cnt = jnp.maximum(jnp.sum(valid, axis=1), 1.0)
+        mean_p = jnp.sum(Pm.reshape(B, L) * valid, axis=1) / cnt
+        return {"flux": flux, "mean_pressure": mean_p}

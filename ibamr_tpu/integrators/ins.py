@@ -136,9 +136,13 @@ class INSStaggeredIntegrator:
 
     # -- single step (pure, jittable) ---------------------------------------
     def step(self, state: INSState, dt: float,
-             f: Optional[Vel] = None) -> INSState:
+             f: Optional[Vel] = None,
+             q: Optional[jnp.ndarray] = None) -> INSState:
         """Advance one timestep. ``f`` is an optional MAC body force
-        (e.g. the spread IB force) held fixed over the step."""
+        (e.g. the spread IB force) held fixed over the step; ``q`` is an
+        optional cell-centered divergence source (internal fluid
+        sources/sinks — the IBStandardSourceGen analog, P14), imposed as
+        div u^{n+1} = q by the projection."""
         g = self.grid
         rho, mu = self.rho, self.mu
         dx = g.dx
@@ -169,7 +173,7 @@ class INSStaggeredIntegrator:
             tuple(rhs), dx, alpha=rho / dt, beta=-0.5 * mu)
 
         # 3-4. exact projection (phi0 = lap^{-1} div u*; phi = (rho/dt) phi0)
-        u_new, phi0 = self.project(u_star, dx)
+        u_new, phi0 = self.project(u_star, dx, q=q)
         phi = (rho / dt) * phi0
 
         # 5. pressure update (pressure-increment form w/ viscous correction)
@@ -197,10 +201,11 @@ class INSStaggeredIntegrator:
 
 
 def advance(integrator: INSStaggeredIntegrator, state: INSState, dt: float,
-            num_steps: int, f: Optional[Vel] = None) -> INSState:
+            num_steps: int, f: Optional[Vel] = None,
+            q: Optional[jnp.ndarray] = None) -> INSState:
     """Advance ``num_steps`` fixed-dt steps under one jitted lax.scan."""
     def body(s, _):
-        return integrator.step(s, dt, f), None
+        return integrator.step(s, dt, f, q=q), None
 
     out, _ = jax.lax.scan(body, state, None, length=num_steps)
     return out

@@ -129,11 +129,15 @@ class WallOps:
         return tuple(self.vel_solvers[d].solve(c, alpha, beta)
                      for d, c in enumerate(rhs))
 
-    def project(self, u: Vel, dx) -> Tuple[Vel, jnp.ndarray]:
+    def project(self, u: Vel, dx, q=None) -> Tuple[Vel, jnp.ndarray]:
         """Leray projection with wall BCs: div uses the roll stencil
         (exact — wall faces carry 0), phi solves the Neumann Poisson
-        problem, and the correction is masked at pinned faces."""
+        problem, and the correction is masked at pinned faces. ``q`` is
+        an optional cell-centered divergence source (P14); the Neumann
+        solve's nullspace projection handles any net component."""
         div = stencils.divergence(u, dx)
+        if q is not None:
+            div = div - q
         phi = self.p_solver.solve(div, 0.0, 1.0, zero_nullspace=True)
         g = self.pressure_gradient(phi, dx)
         u_new = tuple(self._pin_normal(c - gc, d)

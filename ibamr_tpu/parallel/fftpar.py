@@ -207,11 +207,14 @@ class PencilFFT:
         carried by the bound grid; accepted for signature parity)."""
         return tuple(self.helmholtz(c, alpha, beta) for c in rhs)
 
-    def project_divergence_free(self, u: Vel, dx) -> Tuple[Vel, jnp.ndarray]:
+    def project_divergence_free(self, u: Vel, dx,
+                                q=None) -> Tuple[Vel, jnp.ndarray]:
         """Drop-in for solvers.fft.project_divergence_free."""
         from ibamr_tpu.ops import stencils
 
         div = stencils.divergence(u, dx)
+        if q is not None:
+            div = div - q
         phi = self.poisson(div)
         g = stencils.gradient(phi, dx)
         return tuple(c - gc for c, gc in zip(u, g)), phi

@@ -103,11 +103,13 @@ def make_sharded_ins_step(integ, mesh: Mesh):
     integ = _with_pencil_solvers(integ, mesh)
     grid = integ.grid
 
-    def step(state, dt, f=None):
+    def step(state, dt, f=None, q=None):
         state = shard_state(state, grid, mesh)
         if f is not None:
             f = shard_state(f, grid, mesh)
-        return shard_state(integ.step(state, dt, f=f), grid, mesh)
+        if q is not None:
+            q = shard_state(q, grid, mesh)
+        return shard_state(integ.step(state, dt, f=f, q=q), grid, mesh)
 
     return jax.jit(step)
 

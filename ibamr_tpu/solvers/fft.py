@@ -102,13 +102,23 @@ def solve_stokes_periodic(f: Vel, dx: Sequence[float],
     return u, phi
 
 
-def project_divergence_free(u: Vel, dx: Sequence[float]) -> Tuple[Vel, jnp.ndarray]:
-    """Exact discrete Leray projection: phi = lap^{-1}(div u);
-    u_proj = u - grad(phi). Returns (u_proj, phi). div(u_proj) == 0 to
-    machine precision because the FFT inverse matches the stencils."""
+def project_divergence_free(u: Vel, dx: Sequence[float],
+                            q=None) -> Tuple[Vel, jnp.ndarray]:
+    """Exact discrete Leray projection: phi = lap^{-1}(div u - q);
+    u_proj = u - grad(phi). Returns (u_proj, phi). div(u_proj) == q (0
+    when q is None) to machine precision because the FFT inverse matches
+    the stencils.
+
+    ``q`` is an optional cell-centered divergence source (internal fluid
+    sources/sinks, the IBStandardSourceGen analog P14). A net (mean)
+    source has no periodic solution; the Poisson solve discards the k=0
+    mode, which IS the compatibility projection the reference enforces
+    by balancing sources against sinks."""
     from ibamr_tpu.ops import stencils
 
     div = stencils.divergence(u, dx)
+    if q is not None:
+        div = div - q
     phi = solve_poisson_periodic(div, dx)
     g = stencils.gradient(phi, dx)
     return tuple(c - gc for c, gc in zip(u, g)), phi
