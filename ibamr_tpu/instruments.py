@@ -45,13 +45,14 @@ class MeterSpecs(NamedTuple):
     seg: jnp.ndarray
 
 
-def make_meters(loops: Sequence[Sequence[int]], closed=True,
+def make_meters(loops: Sequence[Sequence[int]], closed,
                 dtype=jnp.float32) -> MeterSpecs:
     """Build padded meter specs from per-meter marker index lists.
 
-    ``closed``: bool or per-meter list — closed loops (3D spanning
-    surfaces) include the closing segment; open chains (2D cross-section
-    meters) do not.
+    ``closed`` (required): bool or per-meter list — closed loops (3D
+    spanning surfaces) include the closing segment; open chains (2D
+    cross-section meters) must NOT (a closed 2D contour integral of u.n
+    is ~0 for any near-div-free field, silently reading nothing).
     """
     B = len(loops)
     if isinstance(closed, bool):

@@ -77,9 +77,14 @@ class PenaltyIBIntegrator:
                                        scheme=self.inner.scheme)
         ib_new = stepper.step(ib_state, dt)
 
-        # symplectic-Euler mass-point update (reaction + gravity)
-        m_safe = jnp.maximum(self.mass, 1e-30)[:, None]
-        acc = -self.K * (Y - ib_new.X) / m_safe + self.gravity
+        # symplectic-Euler mass-point update (reaction + gravity);
+        # massless slots get acc == 0 via where (a tiny-mass clamp would
+        # overflow to inf and 0*inf = NaN under the mask)
+        acc = jnp.where(
+            self.mass[:, None] > 0.0,
+            -self.K * (Y - ib_new.X)
+            / jnp.where(self.mass > 0.0, self.mass, 1.0)[:, None]
+            + self.gravity, 0.0)
         V_new = massive * (V + dt * acc)
         Y_new = Y + dt * V_new * massive
         return PenaltyIBState(ib=ib_new, Y=Y_new, V=V_new)

@@ -32,11 +32,13 @@ class SourceSpecs(NamedTuple):
     enabled: jnp.ndarray    # (M,) 0/1 mask
 
 
-def make_sources(idx, Q, dtype=jnp.float32) -> SourceSpecs:
+def make_sources(idx, Q, enabled=None, dtype=jnp.float32) -> SourceSpecs:
     idx = jnp.asarray(idx, dtype=jnp.int32)
+    if enabled is None:
+        enabled = jnp.ones(idx.shape, dtype=dtype)
     return SourceSpecs(idx=idx,
                        Q=jnp.asarray(Q, dtype=dtype),
-                       enabled=jnp.ones(idx.shape, dtype=dtype))
+                       enabled=jnp.asarray(enabled, dtype=dtype))
 
 
 def eulerian_source(specs: SourceSpecs, grid: StaggeredGrid,

@@ -115,7 +115,7 @@ def test_3d_meter_flux_and_pressure():
     X = jnp.asarray(np.stack([np.full(m, 0.5),
                               0.5 + r * np.cos(th),
                               0.5 + r * np.sin(th)], axis=1), dtype=F64)
-    panel = InstrumentPanel(grid, make_meters([list(range(m))], dtype=F64))
+    panel = InstrumentPanel(grid, make_meters([list(range(m))], closed=True, dtype=F64))
     U0 = 0.6
     u = (jnp.full(grid.n, U0, dtype=F64),
          jnp.zeros(grid.n, dtype=F64), jnp.zeros(grid.n, dtype=F64))
