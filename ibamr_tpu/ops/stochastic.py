@@ -1,0 +1,126 @@
+"""Stochastic forcing for fluctuating hydrodynamics.
+
+Reference parity: ``INSStaggeredStochasticForcing`` + ``RNG`` +
+``AdvDiffStochasticForcing`` (P6, SURVEY.md §2.2) — the
+Landau-Lifshitz fluctuating stress: the momentum equation gains
+``div W`` with a Gaussian random stress of covariance
+
+    <W_ij W_kl> = 2 kT mu (delta_ik delta_jl + delta_il delta_jk)
+                  / (dV dt)
+
+so that, with the dissipative term, the fluid thermalizes to
+equipartition (fluctuation-dissipation). Discretely (Balboa-Usabiaga et
+al. staggered scheme, the one the reference follows): diagonal stress
+components live at cell centers, off-diagonal components at nodes
+(2D) / edges (3D), symmetrized, and the MAC force is the conservative
+staggered divergence — so the total momentum injected is EXACTLY zero
+(telescoping sums), which the tests enforce.
+
+TPU-first: ``jax.random`` (counter-based, reproducible, splittable)
+replaces the reference's seeded RNG stream; one ``sample`` call is a
+handful of fused normal draws + roll-stencil divergences, jitted into
+the step.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ibamr_tpu.grid import StaggeredGrid
+
+Vel = Tuple[jnp.ndarray, ...]
+
+
+class StochasticStressForcing:
+    """Fluctuating-stress MAC force generator (P6).
+
+    scale = sqrt(2 kT mu / (dV dt)); ``sample(key, dt)`` returns the
+    MAC body force div W for one step.
+    """
+
+    def __init__(self, grid: StaggeredGrid, mu: float, kT: float,
+                 dtype=jnp.float32):
+        self.grid = grid
+        self.mu = float(mu)
+        self.kT = float(kT)
+        self.dtype = dtype
+
+    def _scale(self, dt: float) -> float:
+        dV = self.grid.cell_volume
+        return math.sqrt(2.0 * self.kT * self.mu / (dV * dt))
+
+    def sample_stress(self, key, dt: float):
+        """Random stress fields: diag (dim arrays at cell centers) and
+        symmetrized off-diagonal (dict (i,j)->array at i-j edge/node
+        centering), scaled for fluctuation-dissipation."""
+        g = self.grid
+        dim = g.dim
+        s = self._scale(dt)
+        n_off = dim * (dim - 1) // 2
+        keys = jax.random.split(key, dim + n_off)
+        # diagonal: variance 2 s^2  (the (delta_ik delta_jl + ...) doubles
+        # the diagonal covariance)
+        diag = tuple(
+            s * math.sqrt(2.0)
+            * jax.random.normal(keys[d], g.n, dtype=self.dtype)
+            for d in range(dim))
+        off = {}
+        k = dim
+        for i in range(dim):
+            for j in range(i + 1, dim):
+                # W_ij = W_ji: one draw of variance s^2 shared by both
+                off[(i, j)] = s * jax.random.normal(keys[k], g.n,
+                                                    dtype=self.dtype)
+                k += 1
+        return diag, off
+
+    def sample(self, key, dt: float) -> Vel:
+        """MAC force (div W)_d = d_d W_dd + sum_{j!=d} d_j W_dj.
+
+        Centering bookkeeping (component d lives on lower d-faces):
+        - W_dd at cell centers: d_d via backward difference -> d-face;
+        - W_dj (j != d) at d-j edges (lower in both d and j): d_j via
+          forward difference -> d-face.
+        """
+        g = self.grid
+        dim = g.dim
+        dx = g.dx
+        diag, off = self.sample_stress(key, dt)
+        out = []
+        for d in range(dim):
+            acc = (diag[d] - jnp.roll(diag[d], 1, d)) / dx[d]
+            for j in range(dim):
+                if j == d:
+                    continue
+                W = off[(min(d, j), max(d, j))]
+                acc = acc + (jnp.roll(W, -1, j) - W) / dx[j]
+            out.append(acc)
+        return tuple(out)
+
+
+class StochasticFluxForcing:
+    """Scalar fluctuating flux for adv-diff (AdvDiffStochasticForcing):
+    dQ/dt += div( sqrt(2 kappa Q_ref / (dV dt)) Z ), Z iid normal on
+    faces; conservative by the same telescoping argument."""
+
+    def __init__(self, grid: StaggeredGrid, kappa: float,
+                 Q_ref: float = 1.0, dtype=jnp.float32):
+        self.grid = grid
+        self.kappa = float(kappa)
+        self.Q_ref = float(Q_ref)
+        self.dtype = dtype
+
+    def sample(self, key, dt: float) -> jnp.ndarray:
+        g = self.grid
+        s = math.sqrt(2.0 * self.kappa * self.Q_ref
+                      / (g.cell_volume * dt))
+        keys = jax.random.split(key, g.dim)
+        out = jnp.zeros(g.n, dtype=self.dtype)
+        for d in range(g.dim):
+            Z = s * jax.random.normal(keys[d], g.n, dtype=self.dtype)
+            out = out + (jnp.roll(Z, -1, d) - Z) / g.dx[d]
+        return out
