@@ -1,0 +1,139 @@
+"""Explicit Godunov advection: slope-limited MUSCL predictor with
+corner-transport-upwind (CTU) transverse corrections.
+
+Reference parity: ``AdvectorExplicitPredictorPatchOps`` (P20, SURVEY.md
+§2.2 — the m4 Fortran ``*godunov*`` predictor kernels) and the
+convective predictor inside
+``AdvDiffPredictorCorrectorHierarchyIntegrator`` (P19). The reference's
+default face reconstruction is PPM; this module provides the PLM/CTU
+member of the same family (2nd order, monotone with the MC limiter) —
+the ``INSStaggeredPPMConvectiveOperator`` role for scalars is covered by
+:mod:`ibamr_tpu.ops.convection`.
+
+TPU-first: the predictor is whole-array rolls + `jnp.where` upwind
+selects — no per-cell Fortran loops; everything fuses into one kernel
+per axis under jit.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+
+Vel = Tuple[jnp.ndarray, ...]
+
+
+def mc_limited_slope(Q: jnp.ndarray, axis: int) -> jnp.ndarray:
+    """Monotonized-central limited undivided slope (van Leer MC)."""
+    dp = jnp.roll(Q, -1, axis) - Q
+    dm = Q - jnp.roll(Q, 1, axis)
+    dc = 0.5 * (dp + dm)
+    s = jnp.sign(dc)
+    mag = jnp.minimum(jnp.abs(dc),
+                      2.0 * jnp.minimum(jnp.abs(dp), jnp.abs(dm)))
+    return jnp.where(dp * dm > 0.0, s * mag, 0.0)
+
+
+def _face_states(Q: jnp.ndarray, u: jnp.ndarray, d: int, dx: float,
+                 dt: float) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Left/right predicted states at the lower d-faces (PLM in space +
+    half-dt characteristic tracing along d)."""
+    slope = mc_limited_slope(Q, d)
+    nu = u * dt / dx           # face CFL number
+    # left state: from cell i-1, traced toward the face over dt/2
+    qL = jnp.roll(Q, 1, d) + 0.5 * (1.0 - jnp.maximum(nu, 0.0)) \
+        * jnp.roll(slope, 1, d)
+    # right state: from cell i
+    qR = Q - 0.5 * (1.0 + jnp.minimum(nu, 0.0)) * slope
+    return qL, qR
+
+
+def godunov_face_values(Q: jnp.ndarray, u: Vel,
+                        dx: Sequence[float], dt: float,
+                        ctu: bool = True) -> Vel:
+    """Time-centered face values q^{n+1/2} at the lower faces of each
+    axis; ``u`` is the advecting MAC velocity. With ``ctu``, transverse
+    derivative corrections (corner transport upwind) lift the stability
+    limit to the full multidimensional CFL."""
+    dim = Q.ndim
+    faces = []
+    for d in range(dim):
+        qL, qR = _face_states(Q, u[d], d, dx[d], dt)
+        if ctu:
+            corr = jnp.zeros_like(Q)
+            for a in range(dim):
+                if a == d:
+                    continue
+                # transverse donor-cell flux difference (Colella CTU):
+                # upwinded, so the predictor stays monotone
+                Fa = u[a] * jnp.where(u[a] > 0.0, jnp.roll(Q, 1, a), Q)
+                corr = corr + (jnp.roll(Fa, -1, a) - Fa) / dx[a]
+            qL = qL - 0.5 * dt * jnp.roll(corr, 1, d)
+            qR = qR - 0.5 * dt * corr
+        faces.append(jnp.where(u[d] > 0.0, qL,
+                     jnp.where(u[d] < 0.0, qR, 0.5 * (qL + qR))))
+    return tuple(faces)
+
+
+def advect(Q: jnp.ndarray, u: Vel, dx: Sequence[float], dt: float,
+           ctu: bool = True) -> jnp.ndarray:
+    """One conservative Godunov advection step:
+    Q - dt div(u q^{n+1/2}) (flux form -> exact mass conservation)."""
+    qf = godunov_face_values(Q, u, dx, dt, ctu=ctu)
+    out = Q
+    for d in range(Q.ndim):
+        F = u[d] * qf[d]
+        out = out - dt * (jnp.roll(F, -1, d) - F) / dx[d]
+    return out
+
+
+def advect_split(Q: jnp.ndarray, u: Vel, dx: Sequence[float],
+                 dt: float, parity: int = 0) -> jnp.ndarray:
+    """Strang dimensionally-split Godunov step: one 1D PLM sweep per
+    axis (alternate ``parity`` between steps for 2nd order). Each sweep
+    is TVD, so the split scheme is RIGOROUSLY monotone for constant
+    advection — the guarantee the unsplit CTU predictor trades for
+    unsplit accuracy (it allows O(0.1%) corner over/undershoots)."""
+    dim = Q.ndim
+    order = range(dim) if parity % 2 == 0 else reversed(range(dim))
+    for d in order:
+        qL, qR = _face_states(Q, u[d], d, dx[d], dt)
+        qf = jnp.where(u[d] > 0.0, qL,
+                       jnp.where(u[d] < 0.0, qR, 0.5 * (qL + qR)))
+        F = u[d] * qf
+        Q = Q - dt * (jnp.roll(F, -1, d) - F) / dx[d]
+    return Q
+
+
+class AdvDiffPredictorCorrector:
+    """Predictor-corrector advection-diffusion integrator.
+
+    Reference parity: ``AdvDiffPredictorCorrectorHierarchyIntegrator``
+    (P19) — Godunov predictor supplies the time-centered convective
+    flux; diffusion is Crank-Nicolson (FFT Helmholtz solve on the
+    periodic grid):
+      (1/dt - kappa/2 lap) Q^{n+1} =
+          (1/dt + kappa/2 lap) Q^n - div(u q^{n+1/2})
+    """
+
+    def __init__(self, grid, kappa: float = 0.0, ctu: bool = True):
+        self.grid = grid
+        self.kappa = float(kappa)
+        self.ctu = ctu
+
+    def step(self, Q: jnp.ndarray, u: Vel, dt: float) -> jnp.ndarray:
+        from ibamr_tpu.ops import stencils
+        from ibamr_tpu.solvers import fft
+
+        dx = self.grid.dx
+        qf = godunov_face_values(Q, u, dx, dt, ctu=self.ctu)
+        conv = jnp.zeros_like(Q)
+        for d in range(Q.ndim):
+            F = u[d] * qf[d]
+            conv = conv + (jnp.roll(F, -1, d) - F) / dx[d]
+        if self.kappa == 0.0:
+            return Q - dt * conv
+        rhs = Q / dt + 0.5 * self.kappa * stencils.laplacian(Q, dx) - conv
+        return fft.solve_helmholtz_periodic(rhs, dx, alpha=1.0 / dt,
+                                            beta=-0.5 * self.kappa)

@@ -1,0 +1,150 @@
+"""Godunov advector tests (P20): convergence on smooth profiles, strict
+monotonicity on discontinuous ones, exact conservation, and the
+predictor-corrector adv-diff integrator against an exact solution."""
+
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ibamr_tpu.grid import StaggeredGrid
+from ibamr_tpu.ops.godunov import (AdvDiffPredictorCorrector, advect,
+                                   godunov_face_values, mc_limited_slope)
+
+F64 = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+TWO_PI = 2.0 * math.pi
+
+
+def _uniform_u(grid, vel, dtype=F64):
+    return tuple(jnp.full(grid.n, v, dtype=dtype) for v in vel)
+
+
+def _advect_error(n, steps, vel=(0.7, 0.3)):
+    grid = StaggeredGrid(n=(n, n), x_lo=(0, 0), x_up=(1, 1))
+    xc, yc = grid.cell_centers(F64)
+    Q0 = jnp.broadcast_to(
+        jnp.sin(TWO_PI * xc) * jnp.sin(TWO_PI * yc), grid.n).astype(F64)
+    u = _uniform_u(grid, vel)
+    T = 0.5
+    dt = T / steps
+
+    def body(Q, _):
+        return advect(Q, u, grid.dx, dt), None
+
+    Q, _ = jax.lax.scan(body, Q0, None, length=steps)
+    xe = xc - vel[0] * T
+    ye = yc - vel[1] * T
+    Qe = jnp.broadcast_to(jnp.sin(TWO_PI * xe) * jnp.sin(TWO_PI * ye),
+                          grid.n)
+    # L1 norm: the MC limiter clips smooth extrema, degrading the MAX
+    # norm locally (expected for limited schemes); L1 shows the design
+    # order
+    return float(jnp.mean(jnp.abs(Q - Qe)))
+
+
+def test_smooth_advection_second_order():
+    e32 = _advect_error(32, 64)
+    e64 = _advect_error(64, 128)
+    order = math.log2(e32 / e64)
+    assert e64 < 2e-3
+    assert order > 1.6, (e32, e64, order)
+
+
+def test_square_pulse_monotone_and_conservative():
+    grid = StaggeredGrid(n=(64, 64), x_lo=(0, 0), x_up=(1, 1))
+    xc, yc = grid.cell_centers(F64)
+    Q0 = jnp.broadcast_to(
+        ((jnp.abs(xc - 0.3) < 0.1) & (jnp.abs(yc - 0.5) < 0.1))
+        .astype(F64), grid.n)
+    u = _uniform_u(grid, (0.9, 0.45))
+    dt = 0.4 * grid.dx[0] / 0.9
+
+    def body(Q, _):
+        return advect(Q, u, grid.dx, dt), None
+
+    Q, _ = jax.lax.scan(body, Q0, None, length=80)
+    # unsplit CTU: essentially non-oscillatory (sub-percent corner
+    # over/undershoots are inherent to unsplit predictors)
+    assert float(jnp.min(Q)) > -1e-2
+    assert float(jnp.max(Q)) < 1.0 + 1e-2
+    # flux form: exact conservation
+    assert abs(float(jnp.sum(Q) - jnp.sum(Q0))) < 1e-9 * float(
+        jnp.sum(Q0))
+
+
+def test_split_advection_strictly_monotone():
+    from ibamr_tpu.ops.godunov import advect_split
+    grid = StaggeredGrid(n=(64, 64), x_lo=(0, 0), x_up=(1, 1))
+    xc, yc = grid.cell_centers(F64)
+    Q0 = jnp.broadcast_to(
+        ((jnp.abs(xc - 0.3) < 0.1) & (jnp.abs(yc - 0.5) < 0.1))
+        .astype(F64), grid.n)
+    u = _uniform_u(grid, (0.9, 0.45))
+    dt = 0.4 * grid.dx[0] / 0.9
+
+    def body(Q, _):
+        Q = advect_split(Q, u, grid.dx, dt, parity=0)
+        Q = advect_split(Q, u, grid.dx, dt, parity=1)
+        return Q, None
+
+    Q, _ = jax.lax.scan(body, Q0, None, length=40)
+    assert float(jnp.min(Q)) > -1e-12
+    assert float(jnp.max(Q)) < 1.0 + 1e-12
+    assert abs(float(jnp.sum(Q) - jnp.sum(Q0))) < 1e-9 * float(jnp.sum(Q0))
+
+
+def test_variable_velocity_solid_body_rotation():
+    # rotating velocity field: a blob returns near its start after one
+    # revolution; mass conserved exactly
+    grid = StaggeredGrid(n=(64, 64), x_lo=(0, 0), x_up=(1, 1))
+    xf, yc = grid.face_centers(0, F64)
+    xc, yf = grid.face_centers(1, F64)
+    om = TWO_PI
+    u = (jnp.broadcast_to(-om * (yc - 0.5), grid.n).astype(F64),
+         jnp.broadcast_to(om * (xc - 0.5), grid.n).astype(F64))
+    cc = grid.cell_centers(F64)
+    r2 = (cc[0] - 0.5) ** 2 + (cc[1] - 0.7) ** 2
+    Q0 = jnp.broadcast_to(jnp.exp(-r2 / 0.01), grid.n).astype(F64)
+    steps = 400
+    dt = 1.0 / steps
+
+    def body(Q, _):
+        return advect(Q, u, grid.dx, dt), None
+
+    Q, _ = jax.lax.scan(body, Q0, None, length=steps)
+    assert abs(float(jnp.sum(Q) - jnp.sum(Q0))) < 1e-9 * float(jnp.sum(Q0))
+    # peak region overlaps the initial blob after a full revolution
+    i_pk = np.unravel_index(int(jnp.argmax(Q)), grid.n)
+    x_pk = (i_pk[0] + 0.5) * grid.dx[0]
+    y_pk = (i_pk[1] + 0.5) * grid.dx[1]
+    assert abs(x_pk - 0.5) < 0.06 and abs(y_pk - 0.7) < 0.06
+
+
+def test_mc_slope_zero_at_extrema():
+    Q = jnp.asarray([0.0, 1.0, 0.0, -1.0, 0.0, 1.0], dtype=F64)
+    s = np.asarray(mc_limited_slope(Q, 0))
+    assert s[1] == 0.0 and s[3] == 0.0   # local max / min
+
+
+def test_predictor_corrector_adv_diff_exact_decay():
+    # traveling decaying sine: dQ/dt + u dQ/dx = kappa lap Q
+    n, steps = 64, 128
+    grid = StaggeredGrid(n=(n, n), x_lo=(0, 0), x_up=(1, 1))
+    kappa, vel, T = 5e-3, (0.8, 0.0), 0.25
+    integ = AdvDiffPredictorCorrector(grid, kappa=kappa)
+    xc, yc = grid.cell_centers(F64)
+    Q = jnp.broadcast_to(jnp.sin(TWO_PI * xc) + 0 * yc, grid.n).astype(F64)
+    u = _uniform_u(grid, vel)
+    dt = T / steps
+
+    def body(Q, _):
+        return integ.step(Q, u, dt), None
+
+    Q, _ = jax.lax.scan(body, Q, None, length=steps)
+    decay = math.exp(-TWO_PI ** 2 * kappa * T)
+    Qe = jnp.broadcast_to(jnp.sin(TWO_PI * (xc - vel[0] * T)) * decay,
+                          grid.n)
+    assert float(jnp.max(jnp.abs(Q - Qe))) < 4e-3
