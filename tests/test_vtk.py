@@ -1,0 +1,71 @@
+"""VTK writer tests (T15/VisIt replacement): well-formed XML, value
+round-trip through the ASCII payload, polyline connectivity, and the
+time-series collection index."""
+
+import os
+import xml.etree.ElementTree as ET
+
+import numpy as np
+
+from ibamr_tpu.grid import StaggeredGrid
+from ibamr_tpu.io.vtk import VizWriter, write_vti, write_vtp
+
+
+def _data_array(root, name):
+    for da in root.iter("DataArray"):
+        if da.get("Name") == name:
+            return np.array(da.text.split(), dtype=np.float64)
+    raise KeyError(name)
+
+
+def test_vti_scalar_and_vector_roundtrip(tmp_path):
+    grid = StaggeredGrid(n=(4, 3), x_lo=(0, 0), x_up=(1, 0.75))
+    rng = np.random.RandomState(0)
+    p = rng.randn(4, 3)
+    u = (rng.randn(4, 3), rng.randn(4, 3))
+    path = write_vti(str(tmp_path / "out.vti"), grid,
+                     {"p": p, "u": u})
+    root = ET.parse(path).getroot()
+    img = root.find("ImageData")
+    assert img.get("WholeExtent") == "0 4 0 3 0 1"
+    assert img.get("Spacing").startswith("0.25 0.25")
+    vals = _data_array(root, "p")
+    assert np.allclose(vals, p.ravel(order="F"), atol=1e-5)
+    vec = _data_array(root, "u").reshape(-1, 3)
+    assert np.allclose(vec[:, 0], u[0].ravel(order="F"), atol=1e-5)
+    assert np.allclose(vec[:, 2], 0.0)
+
+
+def test_vtp_markers_and_fibers(tmp_path):
+    X = np.array([[0.1, 0.2], [0.3, 0.4], [0.5, 0.6], [0.7, 0.8]])
+    F = np.array([[1.0, 0.0], [0.0, 1.0], [1.0, 1.0], [0.5, 0.5]])
+    path = write_vtp(str(tmp_path / "m.vtp"), X,
+                     point_data={"force": F},
+                     lines=[[0, 1, 2], [2, 3]])
+    root = ET.parse(path).getroot()
+    piece = root.find("PolyData/Piece")
+    assert piece.get("NumberOfPoints") == "4"
+    assert piece.get("NumberOfLines") == "2"
+    conn = _data_array(root, "connectivity")
+    offs = _data_array(root, "offsets")
+    assert conn.astype(int).tolist() == [0, 1, 2, 2, 3]
+    assert offs.astype(int).tolist() == [3, 5]
+    frc = _data_array(root, "force").reshape(-1, 3)
+    assert np.allclose(frc[:, :2], F, atol=1e-6)
+
+
+def test_viz_writer_series(tmp_path):
+    grid = StaggeredGrid(n=(4, 4), x_lo=(0, 0), x_up=(1, 1))
+    w = VizWriter(str(tmp_path / "viz"), grid)
+    X = np.random.RandomState(1).rand(5, 2)
+    for k, t in ((0, 0.0), (10, 0.1)):
+        w.dump(k, t, cell_fields={"p": np.ones((4, 4)) * t},
+               markers=X + t, fibers=[[0, 1, 2, 3, 4, 0]])
+    names = sorted(os.listdir(tmp_path / "viz"))
+    assert "eulerian.pvd" in names and "lagrangian.pvd" in names
+    assert "eul_000000.vti" in names and "lag_000010.vtp" in names
+    pvd = ET.parse(str(tmp_path / "viz" / "eulerian.pvd")).getroot()
+    ds = list(pvd.iter("DataSet"))
+    assert len(ds) == 2
+    assert ds[1].get("timestep") == "0.1"
+    assert ds[1].get("file") == "eul_000010.vti"
