@@ -57,10 +57,14 @@ class IBMethod:
 
     def __init__(self, specs: force_mod.ForceSpecs,
                  kernel: Kernel = "IB_4",
-                 force_fn: Optional[Callable] = None):
+                 force_fn: Optional[Callable] = None,
+                 fast=None):
         self.specs = specs
         self.kernel = kernel
         self.force_fn = force_fn  # optional custom force strategy
+        # optional FastInteraction engine (ops.interaction_fast): the
+        # bucketed-MXU formulation of spread/interp; None = scatter path
+        self.fast = fast
 
     def compute_force(self, X: jnp.ndarray, U: jnp.ndarray,
                       t) -> jnp.ndarray:
@@ -68,14 +72,26 @@ class IBMethod:
             return self.force_fn(X, U, t)
         return force_mod.compute_lagrangian_force(X, U, self.specs)
 
+    def prepare(self, X: jnp.ndarray, mask: jnp.ndarray):
+        """Per-position transfer context (marker buckets), shared by all
+        spread/interp calls at the same X within a step."""
+        if self.fast is None:
+            return None
+        return self.fast.buckets(X, mask)
+
     def interpolate_velocity(self, u: Vel, grid: StaggeredGrid,
-                             X: jnp.ndarray,
-                             mask: jnp.ndarray) -> jnp.ndarray:
+                             X: jnp.ndarray, mask: jnp.ndarray,
+                             ctx=None) -> jnp.ndarray:
+        if self.fast is not None:
+            return self.fast.interpolate_vel(u, X, weights=mask, b=ctx)
         return interaction.interpolate_vel(u, grid, X, kernel=self.kernel,
                                            weights=mask)
 
     def spread_force(self, F: jnp.ndarray, grid: StaggeredGrid,
-                     X: jnp.ndarray, mask: jnp.ndarray) -> Vel:
+                     X: jnp.ndarray, mask: jnp.ndarray,
+                     ctx=None) -> Vel:
+        if self.fast is not None:
+            return self.fast.spread_vel(F, X, weights=mask, b=ctx)
         return interaction.spread_vel(F, grid, X, kernel=self.kernel,
                                       weights=mask)
 
@@ -110,18 +126,29 @@ class IBExplicitIntegrator:
         ib = self.ib
         u_n = state.ins.u
         X_n = state.X
+        # strategies may expose a per-position transfer context (marker
+        # buckets for the MXU path) shared across calls at the same X
+        prep = getattr(ib, "prepare", None)
+
+        def ctx_at(X):
+            return prep(X, state.mask) if prep is not None else None
 
         # structure prediction to the half step
-        U_n = ib.interpolate_velocity(u_n, grid, X_n, state.mask)
+        ctx_n = ctx_at(X_n)
+        U_n = ib.interpolate_velocity(u_n, grid, X_n, state.mask,
+                                      ctx=ctx_n)
         if self.scheme == "midpoint":
             X_half = X_n + 0.5 * dt * U_n
+            ctx_h = ctx_at(X_half)
         else:
             X_half = X_n
+            ctx_h = ctx_n
 
         # Lagrangian force at the half step, spread to the grid
         t_half = state.ins.t + 0.5 * dt
         F_half = ib.compute_force(X_half, U_n, t_half)
-        f_eul = ib.spread_force(F_half, grid, X_half, state.mask)
+        f_eul = ib.spread_force(F_half, grid, X_half, state.mask,
+                                ctx=ctx_h)
 
         # fluid solve with the IB body force
         ins_new = self.ins.step(state.ins, dt, f=f_eul)
@@ -129,7 +156,8 @@ class IBExplicitIntegrator:
         # corrector: move markers with the midpoint velocity
         if self.scheme == "midpoint":
             u_half = tuple(0.5 * (a + b) for a, b in zip(u_n, ins_new.u))
-            U_half = ib.interpolate_velocity(u_half, grid, X_half, state.mask)
+            U_half = ib.interpolate_velocity(u_half, grid, X_half,
+                                             state.mask, ctx=ctx_h)
             X_new = X_n + dt * U_half
             U_out = U_half
         else:

@@ -78,8 +78,8 @@ class IBFEMethod:
         return F
 
     def interpolate_velocity(self, u: Vel, grid: StaggeredGrid,
-                             X: jnp.ndarray,
-                             mask: jnp.ndarray) -> jnp.ndarray:
+                             X: jnp.ndarray, mask: jnp.ndarray,
+                             ctx=None) -> jnp.ndarray:
         if self.coupling == "nodal":
             return interaction.interpolate_vel(u, grid, X,
                                                kernel=self.kernel,
@@ -91,7 +91,8 @@ class IBFEMethod:
         return l2_project_from_quads(self.asm, Uq) * mask[:, None]
 
     def spread_force(self, F: jnp.ndarray, grid: StaggeredGrid,
-                     X: jnp.ndarray, mask: jnp.ndarray) -> Vel:
+                     X: jnp.ndarray, mask: jnp.ndarray,
+                     ctx=None) -> Vel:
         if self.coupling == "nodal":
             return interaction.spread_vel(F, grid, X, kernel=self.kernel,
                                           weights=mask)

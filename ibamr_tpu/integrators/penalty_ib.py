@@ -72,7 +72,8 @@ class PenaltyIBIntegrator:
             return base_force(X, U, t) + self.K * massive * (Y - X)
 
         ib_penalized = IBMethod(self.ib.specs, kernel=self.ib.kernel,
-                                force_fn=force_with_penalty)
+                                force_fn=force_with_penalty,
+                                fast=self.ib.fast)
         stepper = IBExplicitIntegrator(self.ins, ib_penalized,
                                        scheme=self.inner.scheme)
         ib_new = stepper.step(ib_state, dt)

@@ -105,9 +105,15 @@ def build_shell_example(
         mu: float = 0.05,
         kernel: str = "IB_4",
         convective_op_type: str = "centered",
+        use_fast_interaction: Optional[bool] = None,
         dtype=None,
         input_db=None) -> Tuple[IBExplicitIntegrator, IBState]:
-    """Assemble the ex4-equivalent simulation (3D periodic unit box)."""
+    """Assemble the ex4-equivalent simulation (3D periodic unit box).
+
+    ``use_fast_interaction``: use the bucketed-MXU spread/interp engine
+    (ops.interaction_fast). None = auto: on when the grid is
+    tile-divisible and the marker count is large enough to matter.
+    """
     import jax.numpy as jnp
 
     if dtype is None:
@@ -147,7 +153,23 @@ def build_shell_example(
         n_lat, n_lon, radius, center=center,
         stiffness=stiffness, rest_length_factor=rest_length_factor,
         aspect=aspect, bend_rigidity=bend_rigidity)
-    ib = IBMethod(structure.force_specs(dtype=dtype), kernel=kernel)
+    n_markers = structure.vertices.shape[0]
+    if use_fast_interaction is None:
+        use_fast_interaction = (n_markers >= 4096
+                                and all(v % 8 == 0 for v in n[:-1]))
+    fast = None
+    if use_fast_interaction:
+        from ibamr_tpu.ops.interaction_fast import (FastInteraction,
+                                                    suggest_cap)
+        cap = suggest_cap(grid, structure.vertices, kernel=kernel, tile=8,
+                          slack=1.2)
+        # pole-clustered tiles overflow into the compact scatter path;
+        # keep the dense capacity bounded so padding FLOPs stay sane
+        cap = min(cap, 1024)
+        fast = FastInteraction(grid, kernel=kernel, tile=8, cap=cap,
+                               overflow_cap=max(2048, n_markers // 4))
+    ib = IBMethod(structure.force_specs(dtype=dtype), kernel=kernel,
+                  fast=fast)
     integ = IBExplicitIntegrator(ins, ib, scheme="midpoint")
     state = integ.initialize(structure.vertices)
     return integ, state

@@ -1,0 +1,424 @@
+"""MXU-formulated spread/interpolate: bucketed one-hot matmul kernels.
+
+Reference parity: same operations as :mod:`ibamr_tpu.ops.interaction`
+(``LEInteractor::spread/interpolate``, T2 — the north-star hot path) —
+bitwise-equivalent math, radically different schedule.
+
+The problem with the direct formulation: XLA lowers the 64-point-per-
+marker scatter-add serially on TPU (~230 ms for 1e5 markers at 256^3).
+TPU-first redesign (SURVEY.md §7.3 hard-part #1): turn the scatter into
+DENSE MATMULS so the MXU does it:
+
+1. **Bucket** markers by the (x, y) tile containing their stencil origin
+   (one argsort + one scatter of N elements — cheap); fixed capacity
+   ``cap`` per tile (static shapes), overflow handled exactly by a
+   masked fallback to the scatter path under ``lax.cond``.
+2. **Dense per-axis weights.** For each marker evaluate the delta
+   kernel at ALL 13 = T+5 x-offsets of its tile (and 13 y-offsets) —
+   compact support makes everything outside the true 4-point stencil
+   exactly zero — and at all Nz wrapped z-offsets. No index arithmetic
+   survives into the hot loop.
+3. **Tensor-product accumulation as matmul.** Per tile b:
+       spread:  T[b, xy, z] = sum_m (Wx (x) Wy * F)[b, m, xy] Wz[b, m, z]
+       interp:  U[b, m] = sum_xy A[b, m, xy] sum_z T[b, xy, z] Wz[b, m, z]
+   — batched (169, cap) x (cap, Nz) contractions that run on the MXU at
+   TFLOP rates instead of serialized scatter updates.
+4. **Overlap-add** the (13, 13, Nz) tiles into the periodic grid with
+   core/spill reshapes + rolls (pure data movement).
+
+The weights are the same ``delta.get_kernel`` functions, so spread and
+interp remain exact adjoints of each other and agree with the reference
+formulation to floating-point roundoff (enforced by tests).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ibamr_tpu.grid import StaggeredGrid
+from ibamr_tpu.ops import interaction
+from ibamr_tpu.ops.delta import Kernel, get_kernel
+from ibamr_tpu.ops.interaction import _centering_offsets
+
+Vel = Tuple[jnp.ndarray, ...]
+
+
+class BucketGeometry(NamedTuple):
+    """Static bucketing configuration (python ints -> one compilation)."""
+    tile: Tuple[int, ...]     # tile extent per blocked axis (all but last)
+    nblk: Tuple[int, ...]     # number of tiles per blocked axis
+    cap: int                  # marker capacity per tile
+    support: int              # delta support s
+    width: Tuple[int, ...]    # tile + s + 1 per blocked axis
+
+
+def make_geometry(grid: StaggeredGrid, kernel: Kernel = "IB_4",
+                  tile: int = 8, cap: int = 256) -> BucketGeometry:
+    support, _ = get_kernel(kernel)
+    blocked = grid.n[:-1]
+    if tile < support + 1:
+        # the spill segment (support+1 wide) must fit inside one tile,
+        # or _overlap_add would silently drop it
+        raise ValueError(
+            f"tile {tile} must be >= support+1 = {support + 1}")
+    for n in blocked:
+        if n % tile != 0:
+            raise ValueError(f"grid extent {n} not divisible by tile {tile}")
+        if n < tile + support + 1:
+            # footprint wider than the axis: the wrapped footprint would
+            # overlap itself and double-count
+            raise ValueError(
+                f"grid extent {n} too small for tile {tile} + "
+                f"support {support} + 1")
+    return BucketGeometry(
+        tile=tuple(tile for _ in blocked),
+        nblk=tuple(n // tile for n in blocked),
+        cap=int(cap),
+        support=int(support),
+        width=tuple(tile + support + 1 for _ in blocked))
+
+
+def suggest_cap(grid: StaggeredGrid, X, kernel: Kernel = "IB_4",
+                tile: int = 8, slack: float = 1.5) -> int:
+    """Host-side capacity heuristic from a concrete marker distribution:
+    1.5x the max tile occupancy, rounded up to a multiple of 8."""
+    Xn = np.asarray(X)
+    support, _ = get_kernel(kernel)
+    bids = _block_ids_np(grid, Xn, support, tile)
+    counts = np.bincount(bids, minlength=int(np.prod(
+        [n // tile for n in grid.n[:-1]])))
+    cap = int(math.ceil(max(1, counts.max()) * slack / 8.0) * 8)
+    return cap
+
+
+def _block_ids_np(grid, Xn, support, tile):
+    dim = grid.dim
+    bid = np.zeros(len(Xn), dtype=np.int64)
+    for d in range(dim - 1):
+        xi = (Xn[:, d] - grid.x_lo[d]) / grid.dx[d] - 0.5
+        j0 = np.floor(xi - 0.5 * support).astype(np.int64) + 1
+        b = np.mod(j0, grid.n[d]) // tile
+        bid = bid * (grid.n[d] // tile) + b
+    return bid
+
+
+class Buckets(NamedTuple):
+    """Per-call bucketed marker layout (all shapes static)."""
+    Xb: jnp.ndarray         # (B, cap, dim) positions (junk in empty slots)
+    wb: jnp.ndarray         # (B, cap) weights incl. 0 padding
+    slot_of_marker: jnp.ndarray   # (N,) flat slot index or B*cap (dropped)
+    w_overflow: jnp.ndarray       # (N,) weights of dropped markers
+    o_idx: jnp.ndarray      # (ocap,) original indices of overflow markers
+    o_w: jnp.ndarray        # (ocap,) their weights (0 in pad slots)
+    any_overflow: jnp.ndarray     # () bool
+    exceeded: jnp.ndarray   # () bool: overflow count > ocap (rare)
+    x0: Tuple[jnp.ndarray, ...]   # per blocked axis: (B,) tile origin cell
+
+
+def bucket_markers(geom: BucketGeometry, grid: StaggeredGrid,
+                   X: jnp.ndarray,
+                   weights: Optional[jnp.ndarray] = None,
+                   overflow_cap: Optional[int] = None) -> Buckets:
+    N, dim = X.shape
+    if weights is None:
+        weights = jnp.ones((N,), dtype=X.dtype)
+    if overflow_cap is None:
+        overflow_cap = min(N, max(2048, 1 << int(math.ceil(
+            math.log2(max(N // 8, 1))))))
+    s = geom.support
+    # block id per marker from the cell-centered stencil origin
+    bid = jnp.zeros((N,), dtype=jnp.int32)
+    for d in range(dim - 1):
+        xi = (X[:, d] - grid.x_lo[d]) / grid.dx[d] - 0.5
+        j0 = jnp.floor(xi - 0.5 * s).astype(jnp.int32) + 1
+        b = jnp.mod(j0, grid.n[d]) // geom.tile[d]
+        bid = bid * geom.nblk[d] + b
+    B = int(np.prod(geom.nblk))
+    cap = geom.cap
+
+    order = jnp.argsort(bid)
+    bid_s = bid[order]
+    start = jnp.searchsorted(bid_s, jnp.arange(B, dtype=bid_s.dtype))
+    rank = jnp.arange(N, dtype=jnp.int32) - start[bid_s].astype(jnp.int32)
+    keep = rank < cap
+    slot_sorted = jnp.where(keep, bid_s * cap + rank, B * cap)
+
+    # scatter marker data into the padded pool (extra trailing slot
+    # swallows overflow writes)
+    Xb = jnp.zeros((B * cap + 1, dim), dtype=X.dtype)
+    Xb = Xb.at[slot_sorted].set(X[order])[:-1].reshape(B, cap, dim)
+    wb = jnp.zeros((B * cap + 1,), dtype=weights.dtype)
+    wb = wb.at[slot_sorted].set(
+        jnp.where(keep, weights[order], 0.0))[:-1].reshape(B, cap)
+
+    # slot per ORIGINAL marker index (for interp write-back)
+    slot_of_marker = jnp.zeros((N,), dtype=jnp.int32)
+    slot_of_marker = slot_of_marker.at[order].set(
+        slot_sorted.astype(jnp.int32))
+    w_overflow = jnp.zeros((N,), dtype=weights.dtype)
+    w_overflow = w_overflow.at[order].set(
+        jnp.where(keep, 0.0, weights[order]))
+
+    # compact overflow buffer: scatter cost is driven by INDEX count,
+    # so the fallback must see only the overflow markers, not all N
+    ord2 = jnp.argsort(keep)            # stable: overflow first
+    o_pos = ord2[:overflow_cap]
+    o_idx = order[o_pos].astype(jnp.int32)
+    o_w = jnp.where(keep[o_pos], 0.0, weights[order[o_pos]])
+    n_over = N - jnp.sum(keep)
+    exceeded = n_over > overflow_cap
+
+    # tile origins per blocked axis, broadcast over the flat block index
+    x0 = []
+    for d in range(dim - 1):
+        ids = jnp.arange(B, dtype=jnp.int32)
+        for a in range(dim - 1 - 1, d, -1):
+            ids = ids // geom.nblk[a]
+        x0.append((ids % geom.nblk[d]) * geom.tile[d])
+    return Buckets(Xb=Xb, wb=wb, slot_of_marker=slot_of_marker,
+                   w_overflow=w_overflow, o_idx=o_idx, o_w=o_w,
+                   any_overflow=n_over > 0, exceeded=exceeded,
+                   x0=tuple(x0))
+
+
+# -- dense per-axis weights --------------------------------------------------
+
+def _phi_safe(phi, support):
+    half = 0.5 * support
+
+    def f(t):
+        inside = jnp.abs(t) < half
+        return jnp.where(inside, phi(jnp.clip(t, -half, half)), 0.0)
+    return f
+
+
+def _blocked_axis_weights(geom, grid, b: Buckets, d: int, off: float, phi):
+    """(B, cap, width) weights over the tile footprint of blocked axis d
+    (footprint starts one cell below the tile origin)."""
+    n = grid.n[d]
+    xi = (b.Xb[..., d] - grid.x_lo[d]) / grid.dx[d] - off   # (B, cap)
+    l = jnp.arange(geom.width[d], dtype=xi.dtype)
+    base = b.x0[d].astype(xi.dtype)[:, None, None] - 1.0
+    t = xi[..., None] - (base + l)
+    # markers whose wrapped stencil landed them in an edge tile sit a
+    # full period away from the footprint coordinates
+    t = jnp.mod(t + 0.5 * n, float(n)) - 0.5 * n
+    return phi(t)
+
+
+def _full_axis_weights(grid, b: Buckets, d: int, off: float, phi):
+    """(B, cap, n_d) wrapped weights over the full (periodic) last axis."""
+    n = grid.n[d]
+    xi = (b.Xb[..., d] - grid.x_lo[d]) / grid.dx[d] - off
+    k = jnp.arange(n, dtype=xi.dtype)
+    t = xi[..., None] - k
+    t = jnp.mod(t + 0.5 * n, float(n)) - 0.5 * n
+    return phi(t)
+
+
+def _tile_weights(geom, grid, b: Buckets, centering, kernel):
+    support, phi0 = get_kernel(kernel)
+    phi = _phi_safe(phi0, support)
+    offs = _centering_offsets(grid, centering)
+    dim = grid.dim
+    Ws = [_blocked_axis_weights(geom, grid, b, d, offs[d], phi)
+          for d in range(dim - 1)]
+    Wlast = _full_axis_weights(grid, b, dim - 1, offs[dim - 1], phi)
+    # combine blocked axes into one footprint axis p
+    A = Ws[0]
+    for W in Ws[1:]:
+        A = A[..., :, None] * W[..., None, :]
+        A = A.reshape(A.shape[0], A.shape[1], -1)
+    return A, Wlast       # (B, cap, P), (B, cap, n_last)
+
+
+# -- overlap-add / tile extraction -------------------------------------------
+
+def _overlap_add(geom, grid, T: jnp.ndarray) -> jnp.ndarray:
+    """Accumulate tiles T (B, w0[, w1], n_last) into the periodic grid:
+    split each blocked axis into core [0, tile) and spill [tile, width)
+    segments, reshape each combination onto the grid, roll into place."""
+    dim = grid.dim
+    nb = geom.nblk
+    tl = geom.tile
+    wd = geom.width
+    n_last = grid.n[dim - 1]
+    B = T.shape[0]
+    T = T.reshape(tuple(nb) + tuple(wd) + (n_last,))
+    nblocked = dim - 1
+    out = jnp.zeros(grid.n, dtype=T.dtype)
+    for mask in range(2 ** nblocked):
+        seg = T
+        shift = []
+        ok = True
+        for d in range(nblocked):
+            spill = (mask >> d) & 1
+            lo, hi = (0, tl[d]) if not spill else (tl[d], wd[d])
+            sl = [slice(None)] * seg.ndim
+            sl[nblocked + d] = slice(lo, hi)
+            seg = seg[tuple(sl)]
+            # pad segment length up to tile (spill is s+1 <= tile)
+            pad = tl[d] - (hi - lo)
+            if pad < 0:
+                ok = False
+                break
+            if pad:
+                pw = [(0, 0)] * seg.ndim
+                pw[nblocked + d] = (0, pad)
+                seg = jnp.pad(seg, pw)
+            # core starts at x0 - 1; spill starts at x0 + tile - 1
+            shift.append(-1 if not spill else tl[d] - 1)
+        if not ok:
+            continue
+        # interleave (nb, tile) axis pairs -> grid layout
+        perm = []
+        for d in range(nblocked):
+            perm += [d, nblocked + d]
+        perm += [2 * nblocked]
+        seg = seg.transpose(perm).reshape(grid.n)
+        for d in range(nblocked):
+            seg = jnp.roll(seg, shift[d], axis=d)
+        out = out + seg
+    return out
+
+
+def _extract_tiles(geom, grid, f: jnp.ndarray) -> jnp.ndarray:
+    """Gather the (width..., n_last) tile of every block -> (B, P, n_last)."""
+    dim = grid.dim
+    nblocked = dim - 1
+    arr = f
+    # take along each blocked axis: axis d of arr is the grid axis d
+    for d in range(nblocked):
+        idx = (np.arange(geom.nblk[d])[:, None] * geom.tile[d] - 1
+               + np.arange(geom.width[d])[None, :]) % grid.n[d]
+        arr = jnp.take(arr, jnp.asarray(idx.reshape(-1)), axis=2 * d)
+        arr = arr.reshape(arr.shape[:2 * d]
+                          + (geom.nblk[d], geom.width[d])
+                          + arr.shape[2 * d + 1:])
+    # arr: (nb0, w0[, nb1, w1], n_last) -> (B, P, n_last)
+    if nblocked == 1:
+        B = geom.nblk[0]
+        return arr.reshape(B, geom.width[0], grid.n[dim - 1])
+    perm = (0, 2, 1, 3, 4)
+    arr = arr.transpose(perm)
+    B = geom.nblk[0] * geom.nblk[1]
+    return arr.reshape(B, geom.width[0] * geom.width[1], grid.n[dim - 1])
+
+
+# -- public ops --------------------------------------------------------------
+
+def spread_bucketed(geom: BucketGeometry, grid: StaggeredGrid,
+                    b: Buckets, F: jnp.ndarray, X: jnp.ndarray,
+                    centering, kernel: Kernel,
+                    weights: Optional[jnp.ndarray]) -> jnp.ndarray:
+    """Spread marker values F (N,) -> grid field; exact up to roundoff
+    vs interaction.spread (overflow markers go through that path)."""
+    inv_vol = 1.0 / math.prod(grid.dx)
+    # bucketed F with the same layout as Xb
+    N = F.shape[0]
+    Ff = jnp.zeros((b.Xb.shape[0] * b.Xb.shape[1] + 1,), dtype=F.dtype)
+    Ff = Ff.at[b.slot_of_marker].add(F)[:-1].reshape(b.wb.shape)
+    A, Wlast = _tile_weights(geom, grid, b, centering, kernel)
+    A = A * (Ff * b.wb * inv_vol)[..., None]
+    T = jnp.einsum("bmp,bmz->bpz", A, Wlast,
+                   precision=jax.lax.Precision.HIGHEST)
+    out = _overlap_add(geom, grid, T.reshape(
+        (T.shape[0],) + tuple(geom.width) + (grid.n[grid.dim - 1],)))
+
+    def compact(out):
+        return interaction.spread(F[b.o_idx], grid, X[b.o_idx],
+                                  centering=centering, kernel=kernel,
+                                  weights=b.o_w, out=out)
+
+    def full(out):
+        # overflow buffer itself overflowed (pathological clustering):
+        # exact but slow full-scatter fallback
+        return interaction.spread(F, grid, X, centering=centering,
+                                  kernel=kernel, weights=b.w_overflow,
+                                  out=out)
+
+    return jax.lax.cond(
+        b.exceeded, full,
+        lambda o: jax.lax.cond(b.any_overflow, compact,
+                               lambda oo: oo, o), out)
+
+
+def interpolate_bucketed(geom: BucketGeometry, grid: StaggeredGrid,
+                         b: Buckets, f: jnp.ndarray, X: jnp.ndarray,
+                         centering, kernel: Kernel,
+                         weights: Optional[jnp.ndarray]) -> jnp.ndarray:
+    """Interpolate grid field at markers -> (N,) (adjoint of spread)."""
+    T = _extract_tiles(geom, grid, f)                 # (B, P, n_last)
+    A, Wlast = _tile_weights(geom, grid, b, centering, kernel)
+    D = jnp.einsum("bpz,bmz->bmp", T, Wlast,
+                   precision=jax.lax.Precision.HIGHEST)
+    # wb already carries the caller's marker weights (bucket_markers)
+    Ub = jnp.sum(A * D, axis=-1) * b.wb               # (B, cap)
+    U = jnp.take(Ub.reshape(-1), jnp.minimum(
+        b.slot_of_marker, Ub.size - 1), axis=0)
+    U = jnp.where(b.slot_of_marker < Ub.size, U, 0.0)
+
+    def compact(U):
+        Uo = interaction.interpolate(f, grid, X[b.o_idx],
+                                     centering=centering, kernel=kernel,
+                                     weights=b.o_w)
+        return U.at[b.o_idx].add(Uo)
+
+    def full(U):
+        return U + interaction.interpolate(
+            f, grid, X, centering=centering, kernel=kernel,
+            weights=b.w_overflow)
+
+    return jax.lax.cond(
+        b.exceeded, full,
+        lambda u: jax.lax.cond(b.any_overflow, compact,
+                               lambda uu: uu, u), U)
+
+
+class FastInteraction:
+    """Drop-in spread/interp engine: bucket once per X, reuse for all
+    components and both directions within a timestep.
+
+    Marker ``weights`` are baked into the Buckets at build time; when a
+    prebuilt ``b`` is passed to spread/interp, the ``weights`` argument
+    is used only as the build input for ``b is None`` and MUST match
+    what the buckets were built with.
+    """
+
+    def __init__(self, grid: StaggeredGrid, kernel: Kernel = "IB_4",
+                 tile: int = 8, cap: int = 256,
+                 overflow_cap: Optional[int] = None):
+        self.grid = grid
+        self.kernel: Kernel = kernel
+        self.geom = make_geometry(grid, kernel, tile=tile, cap=cap)
+        self.overflow_cap = overflow_cap
+
+    def buckets(self, X: jnp.ndarray,
+                weights: Optional[jnp.ndarray] = None) -> Buckets:
+        return bucket_markers(self.geom, self.grid, X, weights,
+                              overflow_cap=self.overflow_cap)
+
+    def interpolate_vel(self, u: Vel, X: jnp.ndarray,
+                        weights: Optional[jnp.ndarray] = None,
+                        b: Optional[Buckets] = None) -> jnp.ndarray:
+        if b is None:
+            b = self.buckets(X, weights)
+        cols = [interpolate_bucketed(self.geom, self.grid, b, u[d], X,
+                                     d, self.kernel, weights)
+                for d in range(self.grid.dim)]
+        return jnp.stack(cols, axis=-1)
+
+    def spread_vel(self, F: jnp.ndarray, X: jnp.ndarray,
+                   weights: Optional[jnp.ndarray] = None,
+                   b: Optional[Buckets] = None) -> Vel:
+        if b is None:
+            b = self.buckets(X, weights)
+        return tuple(spread_bucketed(self.geom, self.grid, b, F[:, d], X,
+                                     d, self.kernel, weights)
+                     for d in range(self.grid.dim))
