@@ -1,0 +1,114 @@
+"""ctypes binding for the native host-runtime library.
+
+Reference parity: the reference's host runtime is C++ end to end
+(SURVEY.md §2.5); the rebuild keeps the TPU compute path in JAX/XLA and
+implements the host-side hot loops (structure-file parsing P10, binary
+viz encoding T15) natively in C++ (``native/ibamr_native.cpp``),
+bound via ctypes (no pybind11 in the image, per environment).
+
+The library is compiled on demand with g++ and cached under
+``native/build/``; every entry point has a NumPy fallback so the
+framework works (slower) on machines without a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO, "native", "ibamr_native.cpp")
+_BUILD_DIR = os.path.join(_REPO, "native", "build")
+_LIB_PATH = os.path.join(_BUILD_DIR, "libibamr_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _compile() -> Optional[str]:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    cmd = ["g++", "-O3", "-shared", "-fPIC", _SRC, "-o", _LIB_PATH]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return _LIB_PATH
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """Load (compiling if needed) the native library; None if
+    unavailable — callers fall back to NumPy."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        stale = (os.path.exists(_LIB_PATH) and os.path.exists(_SRC)
+                 and os.path.getmtime(_SRC) > os.path.getmtime(_LIB_PATH))
+        path = (_LIB_PATH if os.path.exists(_LIB_PATH) and not stale
+                else (_compile() if os.path.exists(_SRC) else None))
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+            if lib.ibamr_native_abi_version() != 2:
+                return None
+            lib.parse_table.restype = ctypes.c_long
+            lib.parse_table.argtypes = [
+                ctypes.c_char_p, ctypes.c_long,
+                ctypes.POINTER(ctypes.c_double), ctypes.c_long,
+                ctypes.c_long, ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_long)]
+            lib.encode_base64.restype = ctypes.c_long
+            lib.encode_base64.argtypes = [
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_long,
+                ctypes.c_char_p]
+            _lib = lib
+        except OSError:
+            _lib = None
+        return _lib
+
+
+def parse_table_native(text: bytes, max_cols: int
+                       ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Parse a numeric table with the C++ tokenizer -> (rows, ncols);
+    None if the native library is unavailable. ``ncols`` holds the TRUE
+    per-row column counts (callers validate bounds). Raises ValueError
+    on an invalid token (strict, matching the Python parser)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    # upper bound on rows: number of newlines + 1
+    max_rows = text.count(b"\n") + 1
+    out = np.empty((max_rows, max_cols), dtype=np.float64)
+    ncols = np.zeros(max_rows, dtype=np.int32)
+    status = ctypes.c_long(0)
+    n = lib.parse_table(
+        text, len(text),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        max_rows, max_cols,
+        ncols.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        ctypes.byref(status))
+    if status.value != 0:
+        raise ValueError(
+            f"invalid numeric token on line {status.value}")
+    return out[:n], ncols[:n]
+
+
+def base64_native(data: bytes) -> Optional[bytes]:
+    """RFC 4648 base64 via the C++ encoder; None if unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(data)
+    out = ctypes.create_string_buffer(4 * ((n + 2) // 3))
+    arr = (ctypes.c_uint8 * n).from_buffer_copy(data)
+    m = lib.encode_base64(arr, n, out)
+    return out.raw[:m]

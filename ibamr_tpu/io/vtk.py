@@ -22,27 +22,63 @@ import numpy as np
 from ibamr_tpu.grid import StaggeredGrid
 
 
-def _ascii(arr: np.ndarray) -> str:
-    return " ".join(f"{v:.7g}" for v in np.asarray(arr).ravel(order="F"))
+def _ascii(flat: np.ndarray) -> str:
+    """Float32-precision ascii payload (callers pass data pre-raveled
+    in the required order)."""
+    return " ".join(f"{v:.7g}" for v in np.asarray(flat).ravel())
+
+
+def _b64(data: bytes) -> str:
+    """Base64 via the native C++ encoder (io.native) with a stdlib
+    fallback — the binary-payload hot loop for large dumps."""
+    from ibamr_tpu.io.native import base64_native
+    out = base64_native(data)
+    if out is None:
+        import base64
+        out = base64.b64encode(data)
+    return out.decode("ascii")
+
+
+def _binary_payload(arr: np.ndarray) -> str:
+    """VTK inline-binary DataArray payload: uint32 byte count header +
+    raw little-endian data, base64 encoded."""
+    raw = np.ascontiguousarray(arr, dtype=np.float32).tobytes()
+    head = np.uint32(len(raw)).tobytes()
+    return _b64(head + raw)
 
 
 def write_vti(path: str, grid: StaggeredGrid,
-              cell_fields: Optional[Dict[str, np.ndarray]] = None) -> str:
+              cell_fields: Optional[Dict[str, np.ndarray]] = None,
+              fmt: str = "ascii") -> str:
     """Write cell-centered fields on the uniform grid as VTK ImageData.
 
     Vector fields may be passed as tuples/stacked (dim, *n) arrays —
     written as 3-component vectors (zero-padded in 2D).
+    ``fmt``: "ascii" (diff-friendly) or "binary" (inline base64 via the
+    native encoder — use for large grids).
     """
+    if fmt not in ("ascii", "binary"):
+        raise ValueError(f"unknown vti format {fmt!r}")
     cell_fields = cell_fields or {}
     dim = grid.dim
     n = tuple(grid.n) + (1,) * (3 - dim)
     dx = tuple(grid.dx) + (1.0,) * (3 - dim)
     x0 = tuple(grid.x_lo) + (0.0,) * (3 - dim)
 
+    def emit(parts, flat, name, ncomp):
+        comp_attr = (f'NumberOfComponents="{ncomp}" ' if ncomp > 1 else "")
+        parts.append(f'        <DataArray type="Float32" Name="{name}" '
+                     f'{comp_attr}format="{fmt}">\n')
+        if fmt == "ascii":
+            parts.append(_ascii(flat))
+        else:
+            parts.append(_binary_payload(flat))
+        parts.append('\n        </DataArray>\n')
+
     parts = []
     parts.append('<?xml version="1.0"?>\n')
     parts.append('<VTKFile type="ImageData" version="0.1" '
-                 'byte_order="LittleEndian">\n')
+                 'byte_order="LittleEndian" header_type="UInt32">\n')
     parts.append(f'  <ImageData WholeExtent="0 {n[0]} 0 {n[1]} 0 {n[2]}" '
                  f'Origin="{x0[0]} {x0[1]} {x0[2]}" '
                  f'Spacing="{dx[0]} {dx[1]} {dx[2]}">\n')
@@ -56,15 +92,9 @@ def write_vti(path: str, grid: StaggeredGrid,
             while len(comps) < 3:
                 comps.append(np.zeros_like(comps[0]))
             vec = np.stack([c.ravel(order="F") for c in comps], axis=1)
-            parts.append(f'        <DataArray type="Float32" Name="{name}" '
-                         'NumberOfComponents="3" format="ascii">\n')
-            parts.append(" ".join(f"{v:.7g}" for v in vec.ravel()))
-            parts.append('\n        </DataArray>\n')
+            emit(parts, vec, name, 3)
         else:
-            parts.append(f'        <DataArray type="Float32" Name="{name}" '
-                         'format="ascii">\n')
-            parts.append(_ascii(a))
-            parts.append('\n        </DataArray>\n')
+            emit(parts, a.ravel(order="F"), name, 1)
     parts.append('      </CellData>\n')
     parts.append('    </Piece>\n  </ImageData>\n</VTKFile>\n')
     with open(path, "w") as f:
@@ -98,7 +128,7 @@ def write_vtp(path: str, X: np.ndarray,
                  'NumberOfPolys="0">\n')
     parts.append('      <Points>\n        <DataArray type="Float32" '
                  'NumberOfComponents="3" format="ascii">\n')
-    parts.append(" ".join(f"{v:.7g}" for v in X.ravel()))
+    parts.append(_ascii(X))
     parts.append('\n        </DataArray>\n      </Points>\n')
 
     parts.append('      <PointData>\n')
@@ -113,7 +143,7 @@ def write_vtp(path: str, X: np.ndarray,
         else:
             parts.append(f'        <DataArray type="Float32" Name="{name}" '
                          'format="ascii">\n')
-        parts.append(" ".join(f"{v:.7g}" for v in a.ravel()))
+        parts.append(_ascii(a))
         parts.append('\n        </DataArray>\n')
     parts.append('      </PointData>\n')
 
