@@ -1,0 +1,188 @@
+"""Level-set machinery: signed-distance maintenance + interface calculus.
+
+Reference parity: ``src/level_set/`` (P22, SURVEY.md §2.2 —
+``RelaxationLSMethod``, ``FastSweepingLSMethod``, ``LevelSetUtilities``).
+The reference maintains signed-distance functions for interface-capturing
+(multiphase flow, Brinkman penalization) with two reinitialization
+engines; both are rebuilt TPU-first:
+
+- :func:`reinitialize` — the RelaxationLSMethod analog: pseudo-time
+  relaxation of |grad phi| -> 1 (Sussman-Smereka-Osher) with Godunov
+  upwinding and the Russo-Smereka subcell fix pinning the zero level.
+  A fixed iteration count under ``lax.fori_loop`` — fully jittable.
+- :func:`fast_sweeping_distance` — the FastSweepingLSMethod analog:
+  the reference's Gauss-Seidel ordered sweeps are inherently serial, so
+  the rebuild runs the SAME Eikonal update as Jacobi iterations
+  (whole-array rolls): each iteration propagates the solution one cell,
+  like one sweep front, but every cell updates in parallel on the VPU.
+
+Interface calculus (LevelSetUtilities analog): smoothed Heaviside/delta,
+phase volume, curvature — the ingredients the multiphase integrator
+(:mod:`ibamr_tpu.integrators.ins_vc`) consumes.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ibamr_tpu.grid import StaggeredGrid
+
+
+# -- smoothed interface functions -------------------------------------------
+
+def heaviside(phi: jnp.ndarray, eps: float) -> jnp.ndarray:
+    """Smoothed Heaviside H_eps(phi) over a band of half-width eps."""
+    core = 0.5 * (1.0 + phi / eps
+                  + jnp.sin(math.pi * phi / eps) / math.pi)
+    return jnp.where(phi < -eps, 0.0, jnp.where(phi > eps, 1.0, core))
+
+
+def delta(phi: jnp.ndarray, eps: float) -> jnp.ndarray:
+    """Smoothed interface delta (derivative of :func:`heaviside`)."""
+    core = 0.5 / eps * (1.0 + jnp.cos(math.pi * phi / eps))
+    return jnp.where(jnp.abs(phi) > eps, 0.0, core)
+
+
+def phase_volume(phi: jnp.ndarray, grid: StaggeredGrid,
+                 eps: float) -> jnp.ndarray:
+    """Volume of the phi < 0 phase (smoothed)."""
+    return jnp.sum(1.0 - heaviside(phi, eps)) * grid.cell_volume
+
+
+def gradient_norm(phi: jnp.ndarray, dx: Sequence[float]) -> jnp.ndarray:
+    """|grad phi| with central differences (diagnostic)."""
+    out = jnp.zeros_like(phi)
+    for d in range(phi.ndim):
+        g = (jnp.roll(phi, -1, d) - jnp.roll(phi, 1, d)) / (2.0 * dx[d])
+        out = out + g * g
+    return jnp.sqrt(out)
+
+
+def curvature(phi: jnp.ndarray, dx: Sequence[float]) -> jnp.ndarray:
+    """Interface curvature kappa = div(grad phi / |grad phi|)."""
+    dim = phi.ndim
+    grads = [(jnp.roll(phi, -1, d) - jnp.roll(phi, 1, d)) / (2.0 * dx[d])
+             for d in range(dim)]
+    mag = jnp.sqrt(sum(g * g for g in grads) + 1e-12)
+    kap = jnp.zeros_like(phi)
+    for d in range(dim):
+        nd = grads[d] / mag
+        kap = kap + (jnp.roll(nd, -1, d) - jnp.roll(nd, 1, d)) \
+            / (2.0 * dx[d])
+    return kap
+
+
+# -- Godunov Hamiltonian -----------------------------------------------------
+
+def _godunov_grad_mag(phi: jnp.ndarray, dx: Sequence[float],
+                      sgn: jnp.ndarray) -> jnp.ndarray:
+    """Godunov-upwinded |grad phi| for the reinitialization equation."""
+    dim = phi.ndim
+    acc = jnp.zeros_like(phi)
+    for d in range(dim):
+        dm = (phi - jnp.roll(phi, 1, d)) / dx[d]     # backward
+        dp = (jnp.roll(phi, -1, d) - phi) / dx[d]    # forward
+        # moving outward from the interface: use the upwind choice
+        a = jnp.where(sgn >= 0,
+                      jnp.maximum(jnp.maximum(dm, 0.0) ** 2,
+                                  jnp.minimum(dp, 0.0) ** 2),
+                      jnp.maximum(jnp.minimum(dm, 0.0) ** 2,
+                                  jnp.maximum(dp, 0.0) ** 2))
+        acc = acc + a
+    return jnp.sqrt(acc)
+
+
+def _interface_cells(phi: jnp.ndarray) -> jnp.ndarray:
+    """Mask of cells whose stencil straddles the zero level."""
+    near = jnp.zeros_like(phi, dtype=bool)
+    for d in range(phi.ndim):
+        near = near | (phi * jnp.roll(phi, 1, d) < 0.0) \
+            | (phi * jnp.roll(phi, -1, d) < 0.0)
+    return near
+
+
+def reinitialize(phi: jnp.ndarray, dx: Sequence[float],
+                 iters: int = 40,
+                 dtau: float = None) -> jnp.ndarray:
+    """Relaxation reinitialization toward a signed-distance function.
+
+    d phi / d tau = S(phi_0) (1 - |grad phi|), Godunov upwinding, with
+    the Russo-Smereka subcell fix in interface cells: there the update
+    drives phi toward (D * sgn) where D is the subcell distance estimate
+    phi_0 / |grad phi_0|, so the zero level set does not drift.
+    """
+    h = min(dx)
+    if dtau is None:
+        dtau = 0.5 * h
+    phi0 = phi
+    sgn = phi0 / jnp.sqrt(phi0 * phi0 + h * h)
+    near = _interface_cells(phi0)
+    g0 = jnp.maximum(gradient_norm(phi0, dx), 1e-8)
+    D = phi0 / g0                                   # subcell distance
+
+    def body(_, p):
+        gm = _godunov_grad_mag(p, dx, sgn)
+        upd_far = p + dtau * sgn * (1.0 - gm)
+        upd_near = p - dtau / h * (sgn * jnp.abs(p) - D)
+        return jnp.where(near, upd_near, upd_far)
+
+    return jax.lax.fori_loop(0, iters, body, phi)
+
+
+def fast_sweeping_distance(phi: jnp.ndarray, dx: Sequence[float],
+                           iters: int = None) -> jnp.ndarray:
+    """Signed distance by Jacobi-iterated Eikonal updates.
+
+    The FastSweepingLSMethod analog: the frozen interface band keeps its
+    subcell distances (phi / |grad phi|); every other cell repeatedly
+    applies the upwind Eikonal update  u = min_neighbors + solve of
+    sum_d ((u - a_d)/h_d)^2 = 1  until the front has swept the domain
+    (``iters`` defaults to the max grid extent, one cell per pass —
+    each Jacobi pass is one whole-array VPU kernel instead of the
+    reference's serial Gauss-Seidel sweeps).
+    """
+    dim = phi.ndim
+    if iters is None:
+        iters = int(max(phi.shape))
+    near = _interface_cells(phi)
+    g0 = jnp.maximum(gradient_norm(phi, dx), 1e-8)
+    d_band = jnp.abs(phi) / g0
+    sgn = jnp.where(phi >= 0, 1.0, -1.0)
+    big = float(sum(n * h for n, h in zip(phi.shape, dx)))
+    u0 = jnp.where(near, d_band, big)
+
+    def eikonal_update(u):
+        # per-axis upwind neighbor values
+        mins = [jnp.minimum(jnp.roll(u, 1, d), jnp.roll(u, -1, d))
+                for d in range(dim)]
+        if dim == 2:
+            a = jnp.minimum(mins[0], mins[1])
+            b = jnp.maximum(mins[0], mins[1])
+            h = dx[0]     # assume near-isotropic spacing
+            one_d = a + h
+            disc = 2.0 * h * h - (b - a) ** 2
+            two_d = 0.5 * (a + b + jnp.sqrt(jnp.maximum(disc, 0.0)))
+            cand = jnp.where(one_d <= b, one_d, two_d)
+        else:
+            s = jnp.sort(jnp.stack(mins, axis=-1), axis=-1)
+            h = dx[0]
+            a, b, c = s[..., 0], s[..., 1], s[..., 2]
+            u1 = a + h
+            disc2 = 2.0 * h * h - (b - a) ** 2
+            u2 = 0.5 * (a + b + jnp.sqrt(jnp.maximum(disc2, 0.0)))
+            sum3 = a + b + c
+            disc3 = sum3 ** 2 - 3.0 * (a * a + b * b + c * c - h * h)
+            u3 = (sum3 + jnp.sqrt(jnp.maximum(disc3, 0.0))) / 3.0
+            cand = jnp.where(u1 <= b, u1, jnp.where(u2 <= c, u2, u3))
+        return jnp.minimum(u, cand)
+
+    def body(_, u):
+        u = eikonal_update(u)
+        return jnp.where(near, d_band, u)
+
+    u = jax.lax.fori_loop(0, iters, body, u0)
+    return sgn * u
