@@ -56,24 +56,35 @@ def get_lib() -> Optional[ctypes.CDLL]:
                 else (_compile() if os.path.exists(_SRC) else None))
         if path is None:
             return None
-        try:
-            lib = ctypes.CDLL(path)
-            if lib.ibamr_native_abi_version() != 2:
-                return None
-            lib.parse_table.restype = ctypes.c_long
-            lib.parse_table.argtypes = [
-                ctypes.c_char_p, ctypes.c_long,
-                ctypes.POINTER(ctypes.c_double), ctypes.c_long,
-                ctypes.c_long, ctypes.POINTER(ctypes.c_int),
-                ctypes.POINTER(ctypes.c_long)]
-            lib.encode_base64.restype = ctypes.c_long
-            lib.encode_base64.argtypes = [
-                ctypes.POINTER(ctypes.c_uint8), ctypes.c_long,
-                ctypes.c_char_p]
-            _lib = lib
-        except OSError:
-            _lib = None
+        _lib = _load(path)
+        if _lib is None and os.path.exists(_SRC):
+            # stale cached .so with a different ABI (mtimes can lie after
+            # checkouts, ADVICE round 1): rebuild once and retry
+            if _compile() is not None:
+                _lib = _load(_LIB_PATH)
         return _lib
+
+
+def _load(path: str) -> Optional[ctypes.CDLL]:
+    """Load + ABI-check + declare signatures; None on any mismatch
+    (missing symbols raise AttributeError, not just OSError)."""
+    try:
+        lib = ctypes.CDLL(path)
+        if lib.ibamr_native_abi_version() != 2:
+            return None
+        lib.parse_table.restype = ctypes.c_long
+        lib.parse_table.argtypes = [
+            ctypes.c_char_p, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_double), ctypes.c_long,
+            ctypes.c_long, ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_long)]
+        lib.encode_base64.restype = ctypes.c_long
+        lib.encode_base64.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_long,
+            ctypes.c_char_p]
+        return lib
+    except (OSError, AttributeError):
+        return None
 
 
 def parse_table_native(text: bytes, max_cols: int

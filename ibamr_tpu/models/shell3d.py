@@ -154,9 +154,16 @@ def build_shell_example(
         stiffness=stiffness, rest_length_factor=rest_length_factor,
         aspect=aspect, bend_rigidity=bend_rigidity)
     n_markers = structure.vertices.shape[0]
+    from ibamr_tpu.ops.delta import get_kernel
+    support, _ = get_kernel(kernel)
     if use_fast_interaction is None:
-        use_fast_interaction = (n_markers >= 4096
-                                and all(v % 8 == 0 for v in n[:-1]))
+        # auto requires tile divisibility AND the make_geometry minimum
+        # extent (tile + support + 1) so small grids fall back to the
+        # scatter path instead of raising (ADVICE round 1)
+        use_fast_interaction = (
+            n_markers >= 4096
+            and all(v % 8 == 0 for v in n[:-1])
+            and all(v >= 8 + support + 1 for v in n[:-1]))
     fast = None
     if use_fast_interaction:
         from ibamr_tpu.ops.interaction_fast import (FastInteraction,

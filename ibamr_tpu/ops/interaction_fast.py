@@ -315,10 +315,14 @@ def _extract_tiles(geom, grid, f: jnp.ndarray) -> jnp.ndarray:
 
 def spread_bucketed(geom: BucketGeometry, grid: StaggeredGrid,
                     b: Buckets, F: jnp.ndarray, X: jnp.ndarray,
-                    centering, kernel: Kernel,
-                    weights: Optional[jnp.ndarray]) -> jnp.ndarray:
+                    centering, kernel: Kernel) -> jnp.ndarray:
     """Spread marker values F (N,) -> grid field; exact up to roundoff
-    vs interaction.spread (overflow markers go through that path)."""
+    vs interaction.spread (overflow markers go through that path).
+
+    Marker weights are the ones baked into ``b`` at bucket-build time
+    (``b.wb``/``b.o_w``/``b.w_overflow``) — there is deliberately no
+    per-call weights argument here, so stale-weights misuse is
+    impossible (ADVICE round 1)."""
     inv_vol = 1.0 / math.prod(grid.dx)
     # bucketed F with the same layout as Xb
     N = F.shape[0]
@@ -351,9 +355,9 @@ def spread_bucketed(geom: BucketGeometry, grid: StaggeredGrid,
 
 def interpolate_bucketed(geom: BucketGeometry, grid: StaggeredGrid,
                          b: Buckets, f: jnp.ndarray, X: jnp.ndarray,
-                         centering, kernel: Kernel,
-                         weights: Optional[jnp.ndarray]) -> jnp.ndarray:
-    """Interpolate grid field at markers -> (N,) (adjoint of spread)."""
+                         centering, kernel: Kernel) -> jnp.ndarray:
+    """Interpolate grid field at markers -> (N,) (adjoint of spread).
+    Marker weights come from ``b`` only — see spread_bucketed."""
     T = _extract_tiles(geom, grid, f)                 # (B, P, n_last)
     A, Wlast = _tile_weights(geom, grid, b, centering, kernel)
     D = jnp.einsum("bpz,bmz->bmp", T, Wlast,
@@ -410,7 +414,7 @@ class FastInteraction:
         if b is None:
             b = self.buckets(X, weights)
         cols = [interpolate_bucketed(self.geom, self.grid, b, u[d], X,
-                                     d, self.kernel, weights)
+                                     d, self.kernel)
                 for d in range(self.grid.dim)]
         return jnp.stack(cols, axis=-1)
 
@@ -420,5 +424,5 @@ class FastInteraction:
         if b is None:
             b = self.buckets(X, weights)
         return tuple(spread_bucketed(self.geom, self.grid, b, F[:, d], X,
-                                     d, self.kernel, weights)
+                                     d, self.kernel)
                      for d in range(self.grid.dim))

@@ -1,0 +1,116 @@
+"""Defensive JAX backend selection for driver entry points.
+
+The container pins ``JAX_PLATFORMS=axon`` (a single real TPU chip behind a
+loopback relay) and installs a sitecustomize hook that re-registers that
+backend in every interpreter — even when the caller exports
+``JAX_PLATFORMS=cpu``. Round 1 lost both driver artifacts to this:
+``dryrun_multichip`` hung in ``jax.devices()`` waiting on the relay, and
+``bench.py`` died on a transient ``UNAVAILABLE`` from backend setup
+(VERDICT.md round 1, "What's weak" #1). ``tests/conftest.py`` already
+carried the working guard; this module makes it available to every entry
+point.
+
+Two use cases:
+
+- :func:`force_cpu` — run on the host-CPU backend (optionally as an
+  N-virtual-device mesh). For multichip dryruns and tests.
+- :func:`init_backend_with_retry` — initialize whatever real accelerator
+  the environment provides, retrying transient failures, falling back to
+  CPU so a benchmark can still emit a (labelled) number instead of
+  nothing. For ``bench.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional, Tuple
+
+
+def _drop_axon_factory() -> None:
+    """Unregister the axon PJRT backend factory so no code path can
+    force-initialize the TPU relay. Private-API access is fully guarded:
+    if jax moves the symbol, we degrade to trusting JAX_PLATFORMS."""
+    try:
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+
+
+def _clear_backend_caches() -> None:
+    """Forget any initialized (or failed-to-initialize) backend state so
+    the next ``jax.devices()`` re-runs platform selection with the
+    current env/config."""
+    try:
+        from jax._src import xla_bridge as _xb
+
+        _xb._clear_backends()
+        return
+    except Exception:
+        pass
+    try:  # public-ish fallback
+        import jax.extend.backend as _jeb
+
+        _jeb.clear_backends()
+    except Exception:
+        pass
+
+
+def force_cpu(n_devices: Optional[int] = None):
+    """Pin jax to the host-CPU backend, defeating the axon hook.
+
+    ``n_devices``: request that many virtual CPU devices via
+    ``--xla_force_host_platform_device_count`` (honored only if the flag
+    is not already set — the driver may have set its own count).
+
+    Safe to call whether or not jax is already imported; must be called
+    before the first jax *compute* in this process. Returns the jax
+    module.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n_devices}"
+            ).strip()
+
+    import jax
+
+    _drop_axon_factory()
+    try:
+        from jax._src import xla_bridge as _xb
+
+        if _xb.backends_are_initialized():
+            _clear_backend_caches()
+    except Exception:
+        pass
+    jax.config.update("jax_platforms", "cpu")
+    return jax
+
+
+def init_backend_with_retry(retries: int = 3, delay: float = 10.0,
+                            ) -> Tuple[object, str, Optional[str]]:
+    """Initialize the default (accelerator) backend, retrying transient
+    failures; fall back to CPU rather than crash.
+
+    Returns ``(jax, platform, error)`` where ``platform`` is e.g.
+    ``"axon"``/``"tpu"``/``"cpu"`` and ``error`` is the last accelerator
+    init failure message when we fell back (None on clean init).
+    """
+    import jax
+
+    last_err: Optional[str] = None
+    for attempt in range(max(retries, 1)):
+        try:
+            devs = jax.devices()
+            return jax, devs[0].platform, None
+        except RuntimeError as e:  # backend setup failure (UNAVAILABLE...)
+            last_err = f"{type(e).__name__}: {e}"
+            _clear_backend_caches()
+            if attempt + 1 < retries:
+                time.sleep(delay * (attempt + 1))
+    jax = force_cpu()
+    return jax, "cpu", last_err
