@@ -24,6 +24,7 @@ control flow anywhere.
 from __future__ import annotations
 
 import math
+from functools import partial
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -51,14 +52,22 @@ class INSStaggeredIntegrator:
 
     Parameters mirror the reference's input-file vocabulary where sensible:
     ``rho`` (mass density), ``mu`` (dynamic viscosity), and
-    ``convective_op_type`` in {"centered", "upwind", "none"}.
+    ``convective_op_type`` in {"centered", "upwind", "ppm", "none"}
+    (case-insensitive; "ppm" is the reference's default operator).
+    ``wall_axes`` puts homogeneous no-slip walls on both sides of the
+    marked axes; ``wall_tangential[(d, e, side)]`` prescribes component
+    d's tangential velocity on the side(0=lo,1=hi) wall of axis e (a
+    moving lid).
     """
 
     def __init__(self, grid: StaggeredGrid, rho: float = 1.0,
                  mu: float = 0.01, convective_op_type: str = "centered",
                  dtype=jnp.float32,
-                 wall_axes: Optional[Tuple[bool, ...]] = None):
-        if convective_op_type not in ("centered", "upwind", "none"):
+                 wall_axes: Optional[Tuple[bool, ...]] = None,
+                 wall_tangential=None):
+        # reference input files spell these uppercase ("PPM", "CENTERED")
+        convective_op_type = convective_op_type.lower()
+        if convective_op_type not in ("centered", "upwind", "ppm", "none"):
             raise ValueError(f"unknown convective_op_type {convective_op_type!r}")
         self.grid = grid
         self.rho = float(rho)
@@ -72,6 +81,17 @@ class INSStaggeredIntegrator:
             raise ValueError(
                 f"wall_axes has {len(self.wall_axes)} entries for a "
                 f"{grid.dim}D grid")
+        self.wall_tangential = dict(wall_tangential or {})
+        for key, val in self.wall_tangential.items():
+            ok = (isinstance(key, tuple) and len(key) == 3
+                  and 0 <= key[0] < grid.dim and 0 <= key[1] < grid.dim
+                  and key[0] != key[1] and key[2] in (0, 1)
+                  and self.wall_axes[key[1]])
+            if not ok:
+                raise ValueError(
+                    f"wall_tangential key {key!r} must be (component d, "
+                    f"wall axis e != d, side in {{0, 1}}) with "
+                    f"wall_axes[e] set; wall_axes={self.wall_axes}")
         # Overridable solver seams (the StaggeredStokesSolver plugin
         # interface of the north star): the sharded path swaps these for
         # pencil-decomposed distributed FFT solves (parallel.fftpar); the
@@ -80,23 +100,36 @@ class INSStaggeredIntegrator:
         if any(self.wall_axes):
             from ibamr_tpu.integrators import ins_walls
 
-            if convective_op_type != "none":
-                raise NotImplementedError(
-                    "wall-bounded INS currently supports "
-                    "convective_op_type='none' (Stokes); wall-aware "
-                    "convection is a planned addition")
-            ops = ins_walls.WallOps(grid, self.wall_axes)
+            ops = ins_walls.WallOps(grid, self.wall_axes,
+                                    tangential=self.wall_tangential)
             self.helmholtz_vel_solve = ops.helmholtz_vel
             self.project = ops.project
             self.laplacian_vel = ops.laplacian_vel
             self.pressure_gradient = ops.pressure_gradient
             self.laplacian_cc = ops.laplacian_cc
         else:
+            if self.wall_tangential:
+                raise ValueError(
+                    "wall_tangential given but no wall_axes set")
             self.helmholtz_vel_solve = fft.solve_helmholtz_periodic_vel
             self.project = fft.project_divergence_free
             self.laplacian_vel = stencils.laplacian_vel
             self.pressure_gradient = stencils.gradient
             self.laplacian_cc = stencils.laplacian
+        # convective operator (P4 menu). Walls or PPM need the
+        # ghost-padded path; fully-periodic centered/upwind keep the
+        # original roll formulation.
+        from ibamr_tpu.ops.convection import convective_rate_bc
+        if convective_op_type == "none":
+            self._convective = None
+        elif any(self.wall_axes) or convective_op_type == "ppm":
+            self._convective = partial(
+                convective_rate_bc, scheme=convective_op_type,
+                wall_axes=self.wall_axes,
+                wall_tangential=self.wall_tangential)
+        else:
+            self._convective = partial(convective_rate,
+                                       scheme=convective_op_type)
 
     # -- state construction -------------------------------------------------
     def initialize(self, u0=None, u0_arrays: Optional[Vel] = None) -> INSState:
@@ -149,11 +182,11 @@ class INSStaggeredIntegrator:
         u, p = state.u, state.p
 
         # 1. convective extrapolation (AB2; Euler on the first step)
-        if self.convective_op_type == "none":
+        if self._convective is None:
             n_star = tuple(jnp.zeros_like(c) for c in u)
             n_curr = n_star
         else:
-            n_curr = convective_rate(u, dx, self.convective_op_type)
+            n_curr = self._convective(u, dx)
             c1 = jnp.where(state.k == 0, 1.0, 1.5).astype(self.dtype)
             c2 = jnp.where(state.k == 0, 0.0, -0.5).astype(self.dtype)
             n_star = tuple(c1 * a + c2 * b

@@ -100,16 +100,20 @@ class INSVCStaggeredIntegrator:
         div = div - jnp.mean(div)
         rho_ref = min(self.rho)
 
+        # cg requires a POSITIVE-definite system; -div((dt/rho) grad .)
+        # is SPD on the zero-mean subspace, so solve the negated system
+        # (round 2 fix: the unnegated operator tripped cg's pAp>0
+        # breakdown guard every iteration and the solve returned 0)
         def A(p):
             gp = stencils.gradient(p, dx)
             flux = tuple(dt / rf * gc for rf, gc in zip(rho_face, gp))
-            return stencils.divergence(flux, dx)
+            return -stencils.divergence(flux, dx)
 
         def M(r):
             # exact inverse of the constant-coefficient operator
-            return fft.solve_poisson_periodic(r / (dt / rho_ref), dx)
+            return -fft.solve_poisson_periodic(r / (dt / rho_ref), dx)
 
-        res = krylov.cg(A, div, M=M, tol=self.cg_tol,
+        res = krylov.cg(A, -div, M=M, tol=self.cg_tol,
                         maxiter=self.cg_maxiter)
         p = res.x - jnp.mean(res.x)
         gp = stencils.gradient(p, dx)

@@ -40,11 +40,21 @@ def _axis_bc(wall: bool, kind_builder) -> AxisBC:
 
 
 class WallOps:
-    """Per-grid wall-aware operators + solvers, built once per config."""
+    """Per-grid wall-aware operators + solvers, built once per config.
 
-    def __init__(self, grid: StaggeredGrid, wall_axes: Sequence[bool]):
+    ``tangential[(d, e, side)]`` prescribes component d's tangential
+    velocity on the side(0=lo,1=hi) wall of axis e != d (a moving lid,
+    e.g. the driven cavity). Inhomogeneous values enter the explicit
+    Laplacian through the Dirichlet ghost fill and the implicit
+    Helmholtz solve through RHS lifting (the ghost correction is a
+    state-independent constant, so the homogeneous fast-diagonalization
+    solver stays exact)."""
+
+    def __init__(self, grid: StaggeredGrid, wall_axes: Sequence[bool],
+                 tangential=None):
         self.grid = grid
         self.wall_axes = tuple(bool(w) for w in wall_axes)
+        self.tangential = dict(tangential or {})
         dim = grid.dim
 
         # velocity Helmholtz solvers: component d -> per-axis centering
@@ -77,10 +87,32 @@ class WallOps:
         self._p_lap_bc = DomainBC(axes=p_axes)
         self._vel_lap_bc = [
             DomainBC(axes=tuple(
-                dirichlet_axis() if (self.wall_axes[e] and e != d)
+                dirichlet_axis(self.tangential.get((d, e, 0), 0.0),
+                               self.tangential.get((d, e, 1), 0.0))
+                if (self.wall_axes[e] and e != d)
                 else AxisBC()
                 for e in range(dim)))
             for d in range(dim)]
+
+        # RHS lifting for the implicit solve: L_inhom u = L_hom u + lift,
+        # lift = 2*V/dx_e^2 in the cell rows adjacent to a moving wall
+        self._lift = []
+        for d in range(dim):
+            lift = None
+            for e in range(dim):
+                if not self.wall_axes[e] or e == d:
+                    continue
+                for side in (0, 1):
+                    v = self.tangential.get((d, e, side), 0.0)
+                    if v == 0.0:
+                        continue
+                    if lift is None:
+                        lift = jnp.zeros(grid.n)
+                    idx = [slice(None)] * dim
+                    idx[e] = slice(0, 1) if side == 0 else slice(-1, None)
+                    lift = lift.at[tuple(idx)].add(
+                        2.0 * v / grid.dx[e] ** 2)
+            self._lift.append(lift)
 
     # -- masks ---------------------------------------------------------------
     def _pin_normal(self, c: jnp.ndarray, d: int) -> jnp.ndarray:
@@ -126,8 +158,14 @@ class WallOps:
 
     # -- solver seams (signatures match the periodic fft module) -------------
     def helmholtz_vel(self, rhs: Vel, dx, alpha, beta) -> Vel:
-        return tuple(self.vel_solvers[d].solve(c, alpha, beta)
-                     for d, c in enumerate(rhs))
+        out = []
+        for d, c in enumerate(rhs):
+            if self._lift[d] is not None:
+                # (alpha + beta L_inhom) u = rhs
+                #   <=> (alpha + beta L_hom) u = rhs - beta*lift
+                c = c - beta * self._lift[d].astype(c.dtype)
+            out.append(self.vel_solvers[d].solve(c, alpha, beta))
+        return tuple(out)
 
     def project(self, u: Vel, dx, q=None) -> Tuple[Vel, jnp.ndarray]:
         """Leray projection with wall BCs: div uses the roll stencil

@@ -2,9 +2,10 @@
 
 Reference parity: the INSStaggered*ConvectiveOperator family (P4, SURVEY.md
 §2.2) — PPM/upwind/centered Godunov-type operators with Fortran flux loops.
-TPU-first redesign: the fluxes are whole-array fused stencels (jnp.roll),
-conservative (divergence) form on the MAC grid, so XLA fuses the entire
-N(u) evaluation into a few HBM passes; no per-cell Riemann logic.
+TPU-first redesign: the fluxes are whole-array fused stencils (jnp.roll or
+ghost-padded slices), conservative (divergence) form on the MAC grid, so
+XLA fuses the entire N(u) evaluation into a few HBM passes; no per-cell
+Riemann logic.
 
 Conventions as in ibamr_tpu.ops.stencils: u_d[i] at the lower d-face of
 cell i. The operator returns N(u)_d at u_d's own faces, where
@@ -16,15 +17,36 @@ Schemes:
   CFL with CN diffusion; the default for smooth acceptance configs).
 - "upwind": 1st-order donor-cell upwinding of the advected component
   (robust, diffusive; the stabilized fallback).
+- "ppm": piecewise-parabolic (Colella–Woodward 1984) limited
+  reconstruction, upwinded at faces — the reference's default operator
+  (``INSStaggeredPPMConvectiveOperator``), implemented as whole-array
+  limited interpolants instead of Fortran predictor loops.
+
+Two code paths:
+- :func:`convective_rate` — the original fully-periodic roll formulation
+  (centered/upwind only; kept as the minimal-HBM fast path).
+- :func:`convective_rate_bc` — ghost-padded formulation supporting all
+  schemes AND no-slip walls on any subset of axes (the wall-bounded
+  Navier–Stokes path, VERDICT round 1 item 4), including inhomogeneous
+  tangential wall velocities (moving lids). Wall storage follows
+  ibamr_tpu.integrators.ins_walls: the wall-NORMAL component pins slot 0
+  along its own axis to the lo wall face (and the hi wall face is the
+  wrap image of slot 0), so its beyond-wall ghosts are odd reflections
+  about the wall NODE; tangential components are cell-centered along the
+  wall axis, so their ghosts reflect about the wall PLANE
+  (ghost = 2*V_wall - interior).
 """
 
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+from typing import Dict, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 
 Vel = Tuple[jnp.ndarray, ...]
+
+# ghost depth of the padded path: PPM face states reach 3 cells out
+_G = 3
 
 
 def _avg_m(f: jnp.ndarray, axis: int) -> jnp.ndarray:
@@ -88,5 +110,185 @@ def convective_rate(u: Vel, dx: Sequence[float], scheme: str = "centered") -> Ve
                     q = _avg_m(u[d], e)
                 flux = adv * q                   # at edges (lower in e)
                 acc = acc + (jnp.roll(flux, -1, e) - flux) / dx[e]
+        out.append(acc)
+    return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# Ghost-padded path: walls + PPM (convective_rate_bc)
+# ---------------------------------------------------------------------------
+
+def _take(a: jnp.ndarray, axis: int, lo: int, hi: int) -> jnp.ndarray:
+    idx = [slice(None)] * a.ndim
+    idx[axis] = slice(lo, hi)
+    return a[tuple(idx)]
+
+
+def _pad_wrap(a: jnp.ndarray, axis: int, g: int) -> jnp.ndarray:
+    n = a.shape[axis]
+    return jnp.concatenate(
+        [_take(a, axis, n - g, n), a, _take(a, axis, 0, g)], axis)
+
+
+def _pad_cell_wall(a: jnp.ndarray, axis: int, g: int,
+                   v_lo: float = 0.0, v_hi: float = 0.0) -> jnp.ndarray:
+    """Ghosts for data at CELL CENTERS along a wall axis: odd reflection
+    about the wall plane through the Dirichlet value
+    (ghost[-1-k] = 2 v_lo - a[k]); v != 0 is a moving tangential wall."""
+    n = a.shape[axis]
+    lo = 2.0 * v_lo - jnp.flip(_take(a, axis, 0, g), axis)
+    hi = 2.0 * v_hi - jnp.flip(_take(a, axis, n - g, n), axis)
+    return jnp.concatenate([lo, a, hi], axis)
+
+
+def _pad_face_pinned_wall(a: jnp.ndarray, axis: int, g: int) -> jnp.ndarray:
+    """Ghosts for data at FACES along its own wall axis (pinned storage:
+    slot 0 == lo wall face == 0; hi wall face == wrap image). Odd
+    reflection about the wall nodes: a[-k] = -a[k]; a[n] = 0 (hi wall),
+    a[n+k] = -a[n-k]. No-penetration is homogeneous by construction."""
+    n = a.shape[axis]
+    lo = -jnp.flip(_take(a, axis, 1, g + 1), axis)
+    zero = jnp.zeros_like(_take(a, axis, 0, 1))
+    hi = jnp.concatenate(
+        [zero, -jnp.flip(_take(a, axis, n - (g - 1), n), axis)], axis)
+    return jnp.concatenate([lo, a, hi], axis)
+
+
+def _sh(ap: jnp.ndarray, axis: int, s: int, n: int, g: int) -> jnp.ndarray:
+    """Shifted view of a g-padded array: value at index i+s, i in [0, n)."""
+    return _take(ap, axis, g + s, g + s + n)
+
+
+def _ppm_states(ap: jnp.ndarray, axis: int, n: int, g: int):
+    """CW84 limited parabola edge states over the EXTENDED cell range
+    [-1, n] (length n+2 along ``axis``): returns (aL, aR) with aL/aR the
+    monotonized lower/upper-face states of each 1D cell."""
+    def ext(s):
+        return _take(ap, axis, g - 1 + s, g + 1 + s + n)
+
+    a, am, ap1 = ext(0), ext(-1), ext(1)
+    am2, ap2 = ext(-2), ext(2)
+
+    def mc_slope(c, m, p):
+        d = 0.5 * (p - m)
+        mono = (p - c) * (c - m) > 0.0
+        lim = jnp.minimum(jnp.abs(d),
+                          2.0 * jnp.minimum(jnp.abs(p - c), jnp.abs(c - m)))
+        return jnp.where(mono, jnp.sign(d) * lim, 0.0)
+
+    s0 = mc_slope(a, am, ap1)
+    sm = mc_slope(am, am2, a)
+    sp = mc_slope(ap1, a, ap2)
+    # 4th-order face interpolants with limited-slope correction (CW84 1.6)
+    fL = am + 0.5 * (a - am) - (1.0 / 6.0) * (s0 - sm)
+    fR = a + 0.5 * (ap1 - a) - (1.0 / 6.0) * (sp - s0)
+    # monotonize the parabola (CW84 1.10)
+    local_ext = (fR - a) * (a - fL) <= 0.0
+    aL = jnp.where(local_ext, a, fL)
+    aR = jnp.where(local_ext, a, fR)
+    diff = aR - aL
+    q6 = diff * (a - 0.5 * (aL + aR))
+    d2 = diff * diff / 6.0
+    aL = jnp.where(q6 > d2, 3.0 * a - 2.0 * aR, aL)
+    aR = jnp.where(q6 < -d2, 3.0 * a - 2.0 * aL, aR)
+    return aL, aR
+
+
+def _face_value_padded(ap: jnp.ndarray, adv: jnp.ndarray, axis: int,
+                       n: int, g: int, scheme: str,
+                       shift: int) -> jnp.ndarray:
+    """Advected value at the 1D faces ``i + shift - 1/2`` (shift=0: lower
+    face of cell i; shift=1: upper face) from the g-padded cell data
+    ``ap`` and the face-normal advecting velocity ``adv`` there."""
+    qm = _sh(ap, axis, shift - 1, n, g)
+    qp = _sh(ap, axis, shift, n, g)
+    if scheme == "centered":
+        return 0.5 * (qm + qp)
+    if scheme == "upwind":
+        return jnp.where(adv >= 0.0, qm, qp)
+    if scheme == "ppm":
+        aL, aR = _ppm_states(ap, axis, n, g)
+        up = _take(aR, axis, shift, shift + n)        # aR of cell i+shift-1
+        dn = _take(aL, axis, shift + 1, shift + 1 + n)  # aL of cell i+shift
+        return jnp.where(adv > 0.0, up,
+                         jnp.where(adv < 0.0, dn, 0.5 * (up + dn)))
+    raise ValueError(f"unknown convective scheme {scheme!r}")
+
+
+def _pin_wall_faces(a: jnp.ndarray, axis: int) -> jnp.ndarray:
+    idx = [slice(None)] * a.ndim
+    idx[axis] = slice(0, 1)
+    return a.at[tuple(idx)].set(0.0)
+
+
+def convective_rate_bc(
+        u: Vel, dx: Sequence[float], scheme: str = "ppm",
+        wall_axes: Optional[Sequence[bool]] = None,
+        wall_tangential: Optional[Dict[Tuple[int, int, int], float]] = None,
+) -> Vel:
+    """N(u)_d = sum_e d/dx_e(u_e u_d) with BC-aware ghost fills.
+
+    ``wall_axes[e]`` puts no-slip walls on both sides of axis e (storage
+    convention of ibamr_tpu.integrators.ins_walls); axes without walls
+    are periodic. ``wall_tangential[(d, e, side)]`` prescribes the
+    tangential velocity of component d on the side(0=lo,1=hi) wall of
+    axis e (a moving lid); unset entries are 0 (stationary no-slip).
+
+    Wall-edge momentum fluxes vanish identically (the advecting normal
+    velocity is 0 at walls), so the flux-divergence rolls stay exact;
+    the wall-normal output faces (pinned slots) are zeroed.
+    """
+    dim = len(u)
+    if wall_axes is None:
+        wall_axes = (False,) * dim
+    tang = wall_tangential or {}
+    g = _G
+    out = []
+    for d in range(dim):
+        acc = jnp.zeros_like(u[d])
+        n_d = u[d].shape
+        for e in range(dim):
+            n_e = n_d[e]
+            if e == d:
+                # 1D cells = the faces of u_d along d; fluxes at cell
+                # centers (the 1D upper faces, shift=1)
+                if wall_axes[d]:
+                    ud_p = _pad_face_pinned_wall(u[d], d, g)
+                else:
+                    ud_p = _pad_wrap(u[d], d, g)
+                adv = 0.5 * (_sh(ud_p, d, 0, n_e, g)
+                             + _sh(ud_p, d, 1, n_e, g))
+                q = _face_value_padded(ud_p, adv, d, n_e, g, scheme,
+                                       shift=1)
+                flux = adv * q
+                acc = acc + (flux - jnp.roll(flux, 1, d)) / dx[d]
+            else:
+                # fluxes at d-e edges (lower d-face x lower e-face).
+                # advecting u_e averaged along d (u_e is cell-centered
+                # along d; its wall value on axis d is its tangential
+                # Dirichlet datum there)
+                if wall_axes[d]:
+                    ue_p = _pad_cell_wall(
+                        u[e], d, 1,
+                        v_lo=tang.get((e, d, 0), 0.0),
+                        v_hi=tang.get((e, d, 1), 0.0))
+                else:
+                    ue_p = _pad_wrap(u[e], d, 1)
+                adv = 0.5 * (_sh(ue_p, d, -1, n_d[d], 1)
+                             + _sh(ue_p, d, 0, n_d[d], 1))
+                # advected u_d along e (cell-centered along e)
+                if wall_axes[e]:
+                    ud_p = _pad_cell_wall(
+                        u[d], e, g,
+                        v_lo=tang.get((d, e, 0), 0.0),
+                        v_hi=tang.get((d, e, 1), 0.0))
+                else:
+                    ud_p = _pad_wrap(u[d], e, g)
+                q = _face_value_padded(ud_p, adv, e, n_e, g, scheme,
+                                       shift=0)
+                flux = adv * q
+                acc = acc + (jnp.roll(flux, -1, e) - flux) / dx[e]
+        if wall_axes[d]:
+            acc = _pin_wall_faces(acc, d)
         out.append(acc)
     return tuple(out)

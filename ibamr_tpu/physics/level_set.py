@@ -119,7 +119,8 @@ def reinitialize(phi: jnp.ndarray, dx: Sequence[float],
     if dtau is None:
         dtau = 0.5 * h
     phi0 = phi
-    sgn = phi0 / jnp.sqrt(phi0 * phi0 + h * h)
+    sgn = phi0 / jnp.sqrt(phi0 * phi0 + h * h)      # smoothed (far field)
+    sgn_hard = jnp.where(phi0 >= 0.0, 1.0, -1.0)    # true sign (subcell fix)
     near = _interface_cells(phi0)
     g0 = jnp.maximum(gradient_norm(phi0, dx), 1e-8)
     D = phi0 / g0                                   # subcell distance
@@ -127,7 +128,10 @@ def reinitialize(phi: jnp.ndarray, dx: Sequence[float],
     def body(_, p):
         gm = _godunov_grad_mag(p, dx, sgn)
         upd_far = p + dtau * sgn * (1.0 - gm)
-        upd_near = p - dtau / h * (sgn * jnp.abs(p) - D)
+        # Russo-Smereka: relax interface cells to the frozen subcell
+        # distance. The TRUE sign is essential here — the smoothed sgn
+        # would rescale the fixed point to D/sgn (round-2 fix).
+        upd_near = p - dtau / h * (sgn_hard * jnp.abs(p) - D)
         return jnp.where(near, upd_near, upd_far)
 
     return jax.lax.fori_loop(0, iters, body, phi)

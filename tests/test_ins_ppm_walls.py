@@ -1,0 +1,171 @@
+"""Wall-bounded Navier-Stokes + PPM convective operator.
+
+Reference parity: ``INSStaggeredPPMConvectiveOperator`` (P4, the
+reference's default operator) and convecting wall-bounded flow
+(P2/P3/T9) — the round-1 gap items (VERDICT round 1, "Next round" #4).
+
+Oracles:
+- Taylor-Green vortex (periodic): PPM converges at >= 2nd order.
+- Poiseuille channel (periodic x, walls y, body force): exact discrete
+  steady state with convection enabled.
+- Lid-driven cavity at Re=100: centerline velocity profile vs the Ghia,
+  Ghia & Shin (1982) tabulated values.
+"""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ibamr_tpu.grid import StaggeredGrid
+from ibamr_tpu.integrators.ins import INSStaggeredIntegrator, advance
+from ibamr_tpu.ops.convection import convective_rate, convective_rate_bc
+
+TWO_PI = 2.0 * math.pi
+
+
+# --------------------------------------------------------------------------
+# operator-level checks
+# --------------------------------------------------------------------------
+
+def test_bc_path_matches_roll_path_periodic():
+    """The ghost-padded formulation reproduces the roll formulation
+    bitwise for periodic centered/upwind (same arithmetic)."""
+    rng = np.random.default_rng(3)
+    u = tuple(jnp.asarray(rng.standard_normal((16, 12))) for _ in range(2))
+    dx = (1.0 / 16, 1.0 / 12)
+    for scheme in ("centered", "upwind"):
+        a = convective_rate(u, dx, scheme)
+        b = convective_rate_bc(u, dx, scheme)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_ppm_reduces_to_centered_on_linear_field():
+    """PPM's limited parabola is exact for linear data, so N(u) matches
+    the centered operator away from periodic wrap seams."""
+    n = 32
+    xf = jnp.arange(n) / n
+    yc = (jnp.arange(n) + 0.5) / n
+    X, Y = jnp.meshgrid(xf, yc, indexing="ij")
+    # gentle linear-in-y shear advected by constant u; v = 0
+    u = (0.2 + 0.1 * Y, jnp.zeros((n, n)))
+    dx = (1.0 / n, 1.0 / n)
+    a = convective_rate(u, dx, "centered")
+    b = convective_rate_bc(u, dx, "ppm")
+    # exclude the wrap seam rows where the linear profile jumps
+    interior = (slice(None), slice(4, n - 4))
+    np.testing.assert_allclose(np.asarray(b[0][interior]),
+                               np.asarray(a[0][interior]), atol=1e-12)
+
+
+# --------------------------------------------------------------------------
+# periodic PPM: Taylor-Green convergence
+# --------------------------------------------------------------------------
+
+def _tg_exact(g, t, nu, dtype=jnp.float64):
+    decay = math.exp(-2.0 * TWO_PI ** 2 * nu * t)
+    xf, yc = g.face_centers(0, dtype)
+    xc, yf = g.face_centers(1, dtype)
+    u = jnp.sin(TWO_PI * xf) * jnp.cos(TWO_PI * yc) * decay + 0 * yc
+    v = -jnp.cos(TWO_PI * xc) * jnp.sin(TWO_PI * yf) * decay + 0 * xc
+    return u, v
+
+
+def _run_tg_ppm(n, steps, T, nu):
+    g = StaggeredGrid(n=(n, n), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    integ = INSStaggeredIntegrator(g, rho=1.0, mu=nu,
+                                   convective_op_type="ppm",
+                                   dtype=jnp.float64)
+    u0, v0 = _tg_exact(g, 0.0, nu)
+    st = integ.initialize(u0_arrays=(u0, v0))
+    st = advance(integ, st, T / steps, steps)
+    ue, ve = _tg_exact(g, T, nu)
+    return max(float(jnp.max(jnp.abs(st.u[0] - ue))),
+               float(jnp.max(jnp.abs(st.u[1] - ve))))
+
+
+def test_taylor_green_ppm_convergence():
+    nu, T = 0.01, 0.25
+    e16 = _run_tg_ppm(16, 32, T, nu)
+    e32 = _run_tg_ppm(32, 64, T, nu)
+    order = math.log2(e16 / e32)
+    assert e32 < 3e-3, (e16, e32)
+    assert order > 1.6, (e16, e32, order)
+
+
+def test_uppercase_scheme_names_accepted():
+    g = StaggeredGrid(n=(8, 8), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    integ = INSStaggeredIntegrator(g, convective_op_type="PPM")
+    assert integ.convective_op_type == "ppm"
+
+
+# --------------------------------------------------------------------------
+# wall-bounded Navier-Stokes
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("scheme", ["ppm", "centered", "upwind"])
+def test_poiseuille_with_convection(scheme):
+    """Channel flow driven by a body force: convection is analytically
+    zero for the unidirectional profile, so the convecting integrator
+    must reproduce the exact parabola to discretization error."""
+    n = 32
+    g = StaggeredGrid(n=(n, n), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    mu, G = 0.1, 1.0
+    integ = INSStaggeredIntegrator(g, rho=1.0, mu=mu,
+                                   convective_op_type=scheme,
+                                   dtype=jnp.float64,
+                                   wall_axes=(False, True))
+    st = integ.initialize()
+    f = (jnp.full(g.n, G), jnp.zeros(g.n))
+    st = advance(integ, st, 2e-3, 4500, f=f)   # t=9: transient ~ e^-t
+    yc = (np.arange(n) + 0.5) / n
+    exact = G / (2.0 * mu) * yc * (1.0 - yc)
+    prof = np.asarray(st.u[0][0, :])
+    rel = np.max(np.abs(prof - exact)) / exact.max()
+    assert rel < 5e-3, rel
+    assert float(integ.max_divergence(st)) < 1e-12
+
+
+# Ghia, Ghia & Shin (1982), Re=100: u through the vertical centerline
+_GHIA_Y = np.array([0.0547, 0.0625, 0.0703, 0.1016, 0.1719, 0.2813,
+                    0.4531, 0.5000, 0.6172, 0.7344, 0.8516, 0.9531,
+                    0.9609, 0.9688, 0.9766])
+_GHIA_U = np.array([-0.03717, -0.04192, -0.04775, -0.06434, -0.10150,
+                    -0.15662, -0.21090, -0.20581, -0.13641, 0.00332,
+                    0.23151, 0.68717, 0.73722, 0.78871, 0.84123])
+
+
+def test_lid_driven_cavity_re100_ghia():
+    """Re=100 driven cavity at 64^2 to t=30; the u(x=0.5, y) centerline
+    profile must match Ghia et al. to ~1% of the lid speed."""
+    n = 64
+    g = StaggeredGrid(n=(n, n), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    integ = INSStaggeredIntegrator(
+        g, rho=1.0, mu=0.01, convective_op_type="ppm", dtype=jnp.float64,
+        wall_axes=(True, True), wall_tangential={(0, 1, 1): 1.0})
+    st = integ.initialize()
+    st = advance(integ, st, 0.005, 6000)     # t = 30 (steady for Re=100)
+    uc = np.asarray(st.u[0][n // 2, :])
+    yc = (np.arange(n) + 0.5) / n
+    ui = np.interp(_GHIA_Y, yc, uc)
+    assert np.max(np.abs(ui - _GHIA_U)) < 1.2e-2, ui - _GHIA_U
+    # primary-vortex strength: u_min within ~2% of Ghia's -0.21090
+    assert abs(uc.min() - (-0.21090)) < 4e-3, uc.min()
+    assert float(integ.max_divergence(st)) < 1e-12
+
+
+def test_cavity_velocity_bounded_and_stable():
+    """Long cavity run stays bounded (no limiter-induced blowup) at
+    modest resolution with the upwind fallback too."""
+    n = 32
+    g = StaggeredGrid(n=(n, n), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    integ = INSStaggeredIntegrator(
+        g, rho=1.0, mu=0.01, convective_op_type="upwind",
+        dtype=jnp.float64,
+        wall_axes=(True, True), wall_tangential={(0, 1, 1): 1.0})
+    st = integ.initialize()
+    st = advance(integ, st, 0.01, 2000)
+    assert bool(jnp.all(jnp.isfinite(st.u[0])))
+    assert float(jnp.max(jnp.abs(st.u[0]))) <= 1.0 + 1e-6

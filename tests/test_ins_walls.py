@@ -133,11 +133,15 @@ def test_wall_axes_length_validated():
                                convective_op_type="none")
 
 
-def test_wall_convection_not_implemented():
+def test_wall_convection_supported():
+    """Round 1 hard-errored here; wall-aware convection is now a
+    first-class path (tests/test_ins_ppm_walls.py has the physics)."""
     grid = StaggeredGrid(n=(8, 8), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
-    with pytest.raises(NotImplementedError):
-        INSStaggeredIntegrator(grid, wall_axes=(False, True),
-                               convective_op_type="centered")
+    integ = INSStaggeredIntegrator(grid, wall_axes=(False, True),
+                                   convective_op_type="centered")
+    st = integ.initialize()
+    st = integ.step(st, 1e-3)
+    assert bool(jnp.all(jnp.isfinite(st.u[0])))
 
 
 def test_helmholtz_vel_wall_residual():

@@ -1,0 +1,233 @@
+"""Oracle tests for the P22 physics modules (VERDICT round 1 item 5).
+
+- physics.level_set: reinitialization drives |grad phi| -> 1 without
+  moving the zero level; fast-sweeping distances match the analytic
+  circle distance; Zalesak's slotted disk survives a full rotation.
+- integrators.ins_vc: the variable-density projection produces a
+  discretely divergence-free field; a heavy drop falls under gravity
+  while conserving phase volume and mirror symmetry.
+- physics.complex_fluids: Oldroyd-B equilibrium is a fixed point; the
+  steady simple-shear conformation matches the analytic solution;
+  the polymer-stress divergence converges to the analytic divergence.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ibamr_tpu.grid import StaggeredGrid
+from ibamr_tpu.ops.godunov import advect
+from ibamr_tpu.physics import level_set as ls
+from ibamr_tpu.physics.complex_fluids import (
+    OldroydB, identity_conformation, oldroyd_b_source, pack,
+    polymer_stress, stress_divergence_mac, unpack)
+
+
+def _circle_phi(n, R=0.3, cx=0.5, cy=0.5, dtype=jnp.float64):
+    c = (jnp.arange(n) + 0.5) / n
+    X, Y = jnp.meshgrid(c, c, indexing="ij")
+    return (jnp.sqrt((X - cx) ** 2 + (Y - cy) ** 2) - R).astype(dtype)
+
+
+# --------------------------------------------------------------------------
+# level set
+# --------------------------------------------------------------------------
+
+def test_reinitialize_gradient_norm_and_zero_level():
+    """A distorted (non-distance) level set with the right zero level is
+    relaxed to |grad phi| ~ 1 near the interface, and the interface
+    (measured by the smoothed phase volume) does not drift."""
+    n = 64
+    dx = (1.0 / n, 1.0 / n)
+    g = StaggeredGrid(n=(n, n), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    phi_d = _circle_phi(n)
+    # distortion: same zero level, |grad| between ~0.6 and ~3
+    phi = phi_d * (1.0 + 2.0 * phi_d ** 2) * jnp.exp(0.5 * phi_d)
+    eps = 1.5 / n
+    vol0 = float(ls.phase_volume(phi_d, g, eps))
+
+    out = ls.reinitialize(phi, dx, iters=80)
+    band = jnp.abs(phi_d) < 0.12
+    gn = ls.gradient_norm(out, dx)
+    err = float(jnp.max(jnp.abs(jnp.where(band, gn, 1.0) - 1.0)))
+    assert err < 0.12, err
+    vol1 = float(ls.phase_volume(out, g, eps))
+    assert abs(vol1 - vol0) / vol0 < 0.01, (vol0, vol1)
+
+
+def test_fast_sweeping_matches_circle_distance():
+    n = 64
+    dx = (1.0 / n, 1.0 / n)
+    phi0 = _circle_phi(n)
+    # destroy far-field magnitudes, keep the zero level
+    phi = jnp.tanh(8.0 * phi0) * 0.05
+    d = ls.fast_sweeping_distance(phi, dx)
+    # compare where the exact distance is the circle distance (inside
+    # the periodic box, away from the wrap seam)
+    c = (np.arange(n) + 0.5) / n
+    X, Y = np.meshgrid(c, c, indexing="ij")
+    exact = np.sqrt((X - 0.5) ** 2 + (Y - 0.5) ** 2) - 0.3
+    mask = np.abs(exact) < 0.15
+    err = np.max(np.abs(np.asarray(d) - exact)[mask])
+    assert err < 2.5 / n, err
+
+
+def test_zalesak_disk_full_rotation():
+    """Rigid-rotate the slotted disk once around the domain center with
+    the CTU Godunov advector: area conserved to roundoff (flux form)
+    and shape error (misclassified area fraction) bounded."""
+    n = 100
+    dx = (1.0 / n, 1.0 / n)
+    c = (jnp.arange(n) + 0.5) / n
+    X, Y = jnp.meshgrid(c, c, indexing="ij")
+    R, cx, cy, w, htop = 0.15, 0.5, 0.75, 0.05, 0.85
+    disk = (jnp.sqrt((X - cx) ** 2 + (Y - cy) ** 2) < R)
+    slot = (jnp.abs(X - cx) < w / 2) & (Y < htop)
+    ind0 = jnp.where(disk & ~slot, 1.0, 0.0).astype(jnp.float64)
+
+    # MAC rotation field about (0.5, 0.5), one revolution in T = 2 pi
+    xf = jnp.arange(n) / n
+    Xu, Yu = jnp.meshgrid(xf, c, indexing="ij")
+    Xv, Yv = jnp.meshgrid(c, xf, indexing="ij")
+    u = (-(Yu - 0.5), (Xv - 0.5))
+
+    T = 2.0 * math.pi
+    steps = 1600
+    dt = T / steps
+
+    def body(q, _):
+        return advect(q, u, dx, dt), None
+
+    out, _ = jax.lax.scan(body, ind0, None, length=steps)
+    # conservative flux form: total "mass" exact to roundoff
+    np.testing.assert_allclose(float(jnp.sum(out)), float(jnp.sum(ind0)),
+                               rtol=1e-12)
+    # shape: misclassified fraction (vs initial) after one revolution
+    mis = float(jnp.sum(jnp.abs((out > 0.5).astype(jnp.float64)
+                                - (ind0 > 0.5).astype(jnp.float64))))
+    area = float(jnp.sum(ind0 > 0.5))
+    # PLM/CTU at 100^2 keeps the slot; ~19% boundary-cell churn is the
+    # measured scheme behavior (1st-order upwind would exceed 50%)
+    assert mis / area < 0.25, mis / area
+
+
+# --------------------------------------------------------------------------
+# variable-coefficient (multiphase) INS
+# --------------------------------------------------------------------------
+
+def _vc_integ(n, **kw):
+    from ibamr_tpu.integrators.ins_vc import INSVCStaggeredIntegrator
+
+    g = StaggeredGrid(n=(n, n), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    kw.setdefault("dtype", jnp.float64)
+    return g, INSVCStaggeredIntegrator(g, **kw)
+
+
+def test_project_vc_divergence_free():
+    n = 32
+    g, integ = _vc_integ(n, rho0=1.0, rho1=10.0)
+    rng = np.random.default_rng(5)
+    u = tuple(jnp.asarray(rng.standard_normal(g.n)) for _ in range(2))
+    phi = _circle_phi(n)
+    rho = integ.density(phi)
+    u_new, _ = integ.project_vc(u, rho, dt=1e-2)
+    from ibamr_tpu.ops import stencils
+    div0 = float(jnp.max(jnp.abs(stencils.divergence(u, g.dx))))
+    div = float(jnp.max(jnp.abs(stencils.divergence(u_new, g.dx))))
+    # reduced by the CG relative tolerance (1e-8) modulo norm slack
+    assert div < 1e-6 * div0, (div, div0)
+
+
+def test_falling_drop_volume_and_symmetry():
+    """Heavy drop (phi<0 inside, rho0 heavy) in a light ambient under
+    downward gravity: the drop's center of mass must fall, its smoothed
+    volume must be conserved to ~1%, and x-mirror symmetry preserved."""
+    from ibamr_tpu.integrators.ins_vc import advance_vc
+
+    n = 48
+    g, integ = _vc_integ(n, rho0=5.0, rho1=1.0, mu0=0.05, mu1=0.02,
+                         gravity=(0.0, -5.0), reinit_interval=10)
+    phi = _circle_phi(n, R=0.2, cx=0.5, cy=0.65)
+    st = integ.initialize(phi)
+    vol0 = float(integ.heavy_phase_volume(st))
+
+    def com_y(phi):
+        w = 1.0 - ls.heaviside(phi, integ.eps)
+        c = (jnp.arange(n) + 0.5) / n
+        _, Y = jnp.meshgrid(c, c, indexing="ij")
+        return float(jnp.sum(w * Y) / jnp.sum(w))
+
+    y0 = com_y(st.phi)
+    st = advance_vc(integ, st, 2e-3, 150)
+    assert bool(jnp.all(jnp.isfinite(st.u[0])))
+    y1 = com_y(st.phi)
+    assert y1 < y0 - 0.01, (y0, y1)          # it fell
+    vol1 = float(integ.heavy_phase_volume(st))
+    assert abs(vol1 - vol0) / vol0 < 0.015, (vol0, vol1)
+    # mirror symmetry about x = 0.5: phi field symmetric under x-flip
+    phi_np = np.asarray(st.phi)
+    np.testing.assert_allclose(phi_np, phi_np[::-1, :], atol=1e-8)
+    assert float(integ.max_divergence(st)) < 1e-6
+
+
+# --------------------------------------------------------------------------
+# complex fluids (Oldroyd-B)
+# --------------------------------------------------------------------------
+
+def test_oldroyd_b_equilibrium_fixed_point():
+    g = StaggeredGrid(n=(16, 16), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    ob = OldroydB(g, mu_p=0.5, lam=1.0, dtype=jnp.float64)
+    C = ob.initialize()
+    u = tuple(jnp.zeros(g.n, dtype=jnp.float64) for _ in range(2))
+    C1 = ob.step(C, u, 0.05)
+    np.testing.assert_allclose(np.asarray(C1), np.asarray(C), atol=1e-14)
+    f = ob.body_force(C1)
+    for c in f:
+        np.testing.assert_allclose(np.asarray(c), 0.0, atol=1e-14)
+
+
+def test_oldroyd_b_steady_shear_analytic():
+    """ODE limit (homogeneous C, prescribed grad u): steady simple shear
+    u = (gd*y, 0) has C_xx = 1 + 2 (lam gd)^2, C_xy = lam gd, C_yy = 1."""
+    lam, gd = 0.8, 1.3
+    gu = jnp.zeros((1, 1, 2, 2), dtype=jnp.float64)
+    gu = gu.at[..., 0, 1].set(gd)           # du_x/dy
+    C = pack(jnp.broadcast_to(jnp.eye(2), (1, 1, 2, 2))).astype(jnp.float64)
+    dt = 0.01
+    for _ in range(4000):                   # t = 40 = 50 lambda
+        C = C + dt * oldroyd_b_source(C, gu, lam)
+    Cf = unpack(C, 2)[0, 0]
+    np.testing.assert_allclose(float(Cf[0, 0]), 1.0 + 2.0 * (lam * gd) ** 2,
+                               rtol=1e-6)
+    np.testing.assert_allclose(float(Cf[0, 1]), lam * gd, rtol=1e-6)
+    np.testing.assert_allclose(float(Cf[1, 1]), 1.0, rtol=1e-6)
+
+
+@pytest.mark.parametrize("n", [32, 64])
+def test_polymer_stress_divergence_accuracy(n):
+    """tau_xx = sin(2 pi x) (others 0): f_x = 2 pi cos(2 pi x) at
+    x-faces; the discrete divergence must converge at 2nd order."""
+    g = StaggeredGrid(n=(n, n), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    c = (jnp.arange(n, dtype=jnp.float64) + 0.5) / n
+    X, _ = jnp.meshgrid(c, c, indexing="ij")
+    tau = jnp.zeros(g.n + (3,), dtype=jnp.float64)
+    tau = tau.at[..., 0].set(jnp.sin(2.0 * math.pi * X))
+    f = stress_divergence_mac(tau, g)
+    xf = jnp.arange(n, dtype=jnp.float64) / n
+    Xf, _ = jnp.meshgrid(xf, c, indexing="ij")
+    exact = 2.0 * math.pi * jnp.cos(2.0 * math.pi * Xf)
+    # backward difference of cell sin to faces is 2nd order (centered
+    # about the face)
+    err = float(jnp.max(jnp.abs(f[0] - exact)))
+    assert err < 30.0 / n ** 2, err
+
+
+def test_polymer_stress_identity():
+    C = identity_conformation(
+        StaggeredGrid(n=(8, 8), x_lo=(0.0, 0.0), x_up=(1.0, 1.0)),
+        dtype=jnp.float64)
+    tau = polymer_stress(C, mu_p=1.0, lam=2.0, dim=2)
+    np.testing.assert_allclose(np.asarray(tau), 0.0, atol=1e-15)
