@@ -108,9 +108,8 @@ class INSStaggeredIntegrator:
             self.pressure_gradient = ops.pressure_gradient
             self.laplacian_cc = ops.laplacian_cc
         else:
-            if self.wall_tangential:
-                raise ValueError(
-                    "wall_tangential given but no wall_axes set")
+            # (non-empty wall_tangential with no wall axes is already
+            # rejected by the per-key validation above)
             self.helmholtz_vel_solve = fft.solve_helmholtz_periodic_vel
             self.project = fft.project_divergence_free
             self.laplacian_vel = stencils.laplacian_vel

@@ -136,3 +136,186 @@ def bicgstab(A: Operator, b: Pytree, x0: Optional[Pytree] = None,
         cond, body, (x0, r0, zeros, zeros, one, one, one, jnp.asarray(0)))
     rn = jnp.sqrt(tree_dot(r, r))
     return SolveResult(x=x, iters=k, resnorm=rn, converged=rn <= stop)
+
+
+# ---------------------------------------------------------------------------
+# FGMRES + Newton-Krylov (T6 completion: the reference's
+# PETScKrylovLinearSolver FGMRES default + PETScNewtonKrylovSolver/SNES
+# with matrix-free MFFD Jacobians — SURVEY.md §2.1 T6)
+# ---------------------------------------------------------------------------
+
+def _ravel(pytree):
+    from jax.flatten_util import ravel_pytree
+
+    flat, unravel = ravel_pytree(pytree)
+    return flat, unravel
+
+
+def _fgmres_flat(Aop, b, x0, Mop, m, tol, atol, restarts):
+    """Flexible right-preconditioned GMRES(m) on flat vectors.
+
+    TPU-first formulation: the Krylov basis is one (m+1, n) matrix, so
+    orthogonalization is two matmuls per Arnoldi step (all candidate
+    dots at once + rank-1 basis combination) instead of a data-dependent
+    inner loop — MXU-friendly and fully lax-traceable.
+    """
+    n = b.shape[0]
+    dtype = b.dtype
+    bnorm = jnp.linalg.norm(b)
+    stop = jnp.maximum(tol * bnorm, atol)
+
+    def restart_body(carry):
+        x, _, it = carry
+        r = b - Aop(x)
+        beta = jnp.linalg.norm(r)
+        beta_safe = jnp.where(beta == 0, 1.0, beta)
+        V0 = jnp.zeros((m + 1, n), dtype=dtype).at[0].set(r / beta_safe)
+        Z0 = jnp.zeros((m, n), dtype=dtype)
+        H0 = jnp.zeros((m + 1, m), dtype=dtype)
+
+        def arnoldi(j, st):
+            V, Z, H = st
+            v = V[j]
+            z = Mop(v)
+            w = Aop(z)
+            # classical Gram-Schmidt with reorthogonalization (CGS2):
+            # two batched-dot + rank-k-update rounds keep the basis
+            # orthogonal to working precision (important in f32) while
+            # staying all-matmul for the MXU
+            mask = (jnp.arange(m + 1) <= j).astype(dtype)
+            dots = (V @ w) * mask
+            w = w - V.T @ dots
+            dots2 = (V @ w) * mask
+            w = w - V.T @ dots2
+            wnorm = jnp.linalg.norm(w)
+            H = H.at[:, j].set(dots + dots2).at[j + 1, j].set(wnorm)
+            V = V.at[j + 1].set(w / jnp.where(wnorm == 0, 1.0, wnorm))
+            Z = Z.at[j].set(z)
+            return V, Z, H
+
+        V, Z, H = jax.lax.fori_loop(0, m, arnoldi, (V0, Z0, H0))
+        e1 = jnp.zeros(m + 1, dtype=dtype).at[0].set(beta)
+        y, *_ = jnp.linalg.lstsq(H, e1)
+        x = x + Z.T @ y
+        rn = jnp.linalg.norm(b - Aop(x))
+        return x, rn, it + 1
+
+    def cond(carry):
+        _, rn, it = carry
+        return jnp.logical_and(it < restarts, rn > stop)
+
+    x, rn, it = jax.lax.while_loop(
+        cond, restart_body,
+        (x0, jnp.asarray(jnp.inf, dtype=dtype), jnp.asarray(0)))
+    return x, rn, it
+
+
+def fgmres(A: Operator, b: Pytree, x0: Optional[Pytree] = None,
+           M: Optional[Operator] = None, m: int = 30,
+           tol: float = 1e-6, atol: float = 0.0,
+           restarts: int = 10) -> SolveResult:
+    """Flexible GMRES(m) over pytrees (general nonsymmetric systems;
+    the preconditioner may itself be an inner iteration)."""
+    bflat, unravel = _ravel(b)
+    if x0 is None:
+        x0flat = jnp.zeros_like(bflat)
+    else:
+        x0flat, _ = _ravel(x0)
+
+    def Aop(v):
+        out, _ = _ravel(A(unravel(v)))
+        return out
+
+    if M is None:
+        Mop = lambda v: v  # noqa: E731
+    else:
+        def Mop(v):
+            out, _ = _ravel(M(unravel(v)))
+            return out
+
+    x, rn, it = _fgmres_flat(Aop, bflat, x0flat, Mop, m, tol, atol,
+                             restarts)
+    bnorm = jnp.linalg.norm(bflat)
+    stop = jnp.maximum(tol * bnorm, atol)
+    return SolveResult(x=unravel(x), iters=it, resnorm=rn,
+                       converged=rn <= stop)
+
+
+class NewtonResult(NamedTuple):
+    x: Pytree
+    iters: jnp.ndarray
+    resnorm: jnp.ndarray
+    converged: jnp.ndarray
+
+
+def newton_krylov(F: Operator, x0: Pytree, tol: float = 1e-8,
+                  atol: float = 0.0, maxiter: int = 10,
+                  inner_m: int = 20, inner_restarts: int = 2,
+                  inner_tol: float = 1e-3) -> NewtonResult:
+    """Matrix-free Newton-Krylov: solve F(x) = 0 with exact JVP
+    Jacobians (jax.jvp — sharper than the reference's MFFD finite
+    differencing) and FGMRES inner solves. Fully lax-traceable, so an
+    implicit integrator can run it inside jit/scan.
+    """
+    x0flat, unravel = _ravel(x0)
+
+    def Fflat(v):
+        out, _ = _ravel(F(unravel(v)))
+        return out
+
+    f0 = Fflat(x0flat)
+    fnorm0 = jnp.linalg.norm(f0)
+    stop = jnp.maximum(tol * jnp.maximum(fnorm0, 1e-30), atol)
+
+    def cond(carry):
+        _, fnorm, it = carry
+        return jnp.logical_and(it < maxiter, fnorm > stop)
+
+    def body(carry):
+        x, fnorm, it = carry
+        # one primal evaluation yields both the residual and the
+        # tangent-only Jacobian map (cheaper than jax.jvp inside the
+        # Arnoldi loop, which would re-trace the primal every iteration)
+        fx, Jop = jax.linearize(Fflat, x)
+
+        dx, _, _ = _fgmres_flat(Jop, -fx, jnp.zeros_like(x),
+                                lambda v: v, inner_m, inner_tol, 0.0,
+                                inner_restarts)
+
+        # backtracking line search (the SNES 'bt' analog): halve the
+        # step until the residual norm decreases, tracking the BEST
+        # candidate seen — when no scale decreases (inexact Jacobian
+        # solve, kinked residual) taking the least-bad step keeps the
+        # iteration from wandering. All comparisons are written so a
+        # NaN/inf trial norm counts as NOT improved (NaN-safe).
+        def ls_cond(c):
+            s, fn, bs, bfn, tries = c
+            improved = fn < fnorm
+            return jnp.logical_and(tries < 6,
+                                   jnp.logical_not(improved))
+
+        def ls_body(c):
+            s, _, bs, bfn, tries = c
+            s = s * 0.5
+            fn = jnp.linalg.norm(Fflat(x + s * dx))
+            better = fn < bfn                      # False for NaN fn
+            bs = jnp.where(better, s, bs)
+            bfn = jnp.where(better, fn, bfn)
+            return s, fn, bs, bfn, tries + 1
+
+        fn_full = jnp.linalg.norm(Fflat(x + dx))
+        one = jnp.asarray(1.0, dtype=x.dtype)
+        full_ok = jnp.isfinite(fn_full)
+        bs0 = jnp.where(full_ok, one, one / 64.0)  # NaN full step: tiny
+        bfn0 = jnp.where(full_ok, fn_full,
+                         jnp.asarray(jnp.inf, dtype=x.dtype))
+        _, _, s, fn, _ = jax.lax.while_loop(
+            ls_cond, ls_body, (one, fn_full, bs0, bfn0,
+                               jnp.asarray(0)))
+        x = x + s * dx
+        return x, fn, it + 1
+
+    x, fnorm, it = jax.lax.while_loop(
+        cond, body, (x0flat, fnorm0, jnp.asarray(0)))
+    return NewtonResult(x=unravel(x), iters=it, resnorm=fnorm,
+                        converged=fnorm <= stop)
