@@ -241,11 +241,13 @@ def _ghost_slab_geometry(box: FineBox, ghost: int, dtype_name: str):
     """Static ghost-shell geometry: per slab, the padded-array slice and
     the coarse index coordinates of its points. One slab pair per axis in
     onion order (slabs of earlier axes carry the corners); cached because
-    it depends only on (box, ghost)."""
+    it depends only on (box, ghost). Built with NUMPY so the cached
+    values stay concrete — jnp ops executed while tracing a lax loop
+    would cache leaked tracers."""
     dim = box.dim
     g = ghost
     nf = box.fine_n
-    dtype = jnp.dtype(dtype_name)
+    dtype = np.dtype(dtype_name)
     slabs = []
     for d in range(dim):
         for side in (0, 1):
@@ -258,10 +260,10 @@ def _ghost_slab_geometry(box: FineBox, ghost: int, dtype_name: str):
                                else (nf[a] + g, nf[a] + 2 * g))
                 else:
                     rng.append((0, nf[a] + 2 * g))
-            axes = [_fine_to_coarse_coord(
-                box, a, jnp.arange(lo_i - g, hi_i - g, dtype=dtype))
+            axes = [np.asarray(_fine_to_coarse_coord(
+                box, a, np.arange(lo_i - g, hi_i - g, dtype=dtype)))
                 for a, (lo_i, hi_i) in enumerate(rng)]
-            pts = jnp.stack(jnp.meshgrid(*axes, indexing="ij"), axis=-1)
+            pts = np.stack(np.meshgrid(*axes, indexing="ij"), axis=-1)
             sl = tuple(slice(lo_i, hi_i) for lo_i, hi_i in rng)
             slabs.append((sl, pts))
     return tuple(slabs)

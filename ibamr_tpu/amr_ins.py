@@ -1,0 +1,610 @@
+"""Two-level composite incompressible Navier-Stokes (+ IB coupling).
+
+Reference parity: the reason IBAMR exists — running the INS solve on a
+locally-refined hierarchy around the immersed structure (SURVEY.md §0,
+§5.7; P2/P8 over T10/S4). Round 1 had all the coarse-fine machinery
+(amr.py) but only ever advanced a passive scalar with it (VERDICT round
+1 item 4); this module runs the FLUID on a composite two-level grid.
+
+Scheme (one static FineBox, refinement ratio 2, shared dt):
+
+1. explicit convective + viscous RHS per level — the fine box works on
+   ghost-extended arrays whose ghost shell is quadratically interpolated
+   from the coarse level at MAC positions (T10 CF interpolation);
+2. slave the covered coarse region to the restriction of the fine
+   predictor (coincident-face mean restriction, flux preserving);
+3. **composite projection**: one FGMRES solve over the pytree
+   (phi_coarse, phi_fine) of the true composite Poisson operator —
+   covered coarse cells carry the slaving identity
+   ``phi_c - restrict(phi_f) = 0``, uncovered cells the usual 5/7-point
+   Laplacian with the coarse flux through each coarse-fine interface
+   face REPLACED by the transverse mean of the fine-side fluxes (the
+   CoarsenSchedule flux-synchronization contract), and fine cells the
+   box Laplacian with CF-interpolated ghosts. Preconditioner = exact
+   periodic FFT inverse (coarse) + fast-diagonalization Dirichlet
+   inverse (fine box) — the FAC V-cycle collapsed to its two-level
+   exact-solver limit (SURVEY.md §3.3 TPU note);
+4. correct both levels with consistent gradients and synchronize
+   (covered coarse faces := restricted fine faces).
+
+After the solve the composite divergence vanishes to solver tolerance
+on fine interior cells AND uncovered coarse cells including the ring
+adjacent to the interface — the property the tests enforce.
+
+The IB coupling (``TwoLevelIBINS``) keeps the structure inside the fine
+box — the reference's canonical usage (refine around the structure):
+spread at FINE resolution only, restrict the force to the coarse level,
+interpolate marker velocities from the fine level.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ibamr_tpu.amr import (FineBox, _box_mac_divergence, fill_fine_ghosts,
+                           interp_periodic, prolong_mac_div_preserving,
+                           restrict_cc, restrict_mac)
+from ibamr_tpu.bc import DomainBC, dirichlet_axis
+from ibamr_tpu.grid import StaggeredGrid
+from ibamr_tpu.ops import stencils
+from ibamr_tpu.ops.convection import convective_rate
+from ibamr_tpu.solvers import fft
+from ibamr_tpu.solvers.fastdiag import FastDiagSolver
+from ibamr_tpu.solvers.krylov import fgmres
+
+Vel = Tuple[jnp.ndarray, ...]
+
+
+# --------------------------------------------------------------------------
+# box-local MAC helpers (component d has shape fine_n + e_d)
+# --------------------------------------------------------------------------
+
+def _shift(a, axis, s, n):
+    idx = [slice(None)] * a.ndim
+    idx[axis] = slice(s, s + n)
+    return a[tuple(idx)]
+
+
+def fill_fine_ghosts_mac(uf: Vel, uc: Vel, box: FineBox,
+                         ghost: int) -> Vel:
+    """Ghost-extend fine-box MAC components with quadratic CF
+    interpolation of the coarse MAC field at the fine face positions
+    (the side-centered twin of amr.fill_fine_ghosts)."""
+    dim = box.dim
+    g = ghost
+    r = box.ratio
+    out = []
+    for d in range(dim):
+        shp = tuple(box.fine_n[a] + (1 if a == d else 0) + 2 * g
+                    for a in range(dim))
+        ext = jnp.zeros(shp, dtype=uf[d].dtype)
+        inner = tuple(slice(g, g + box.fine_n[a] + (1 if a == d else 0))
+                      for a in range(dim))
+        ext = ext.at[inner].set(uf[d])
+        # interpolate the whole extended array from coarse, then put the
+        # interior back (the ghost shell is O(surface); interpolating the
+        # full box keeps the code simple and the interior is overwritten)
+        axes = []
+        for a in range(dim):
+            i = jnp.arange(-g, box.fine_n[a] + (1 if a == d else 0) + g,
+                           dtype=uc[d].dtype)
+            if a == d:
+                # fine face i sits at coarse FACE index lo + i/r
+                axes.append(box.lo[a] + i / r)
+            else:
+                # fine cell center -> coarse cell-center index coords
+                axes.append(box.lo[a] + (i + 0.5) / r - 0.5)
+        pts = jnp.stack(jnp.meshgrid(*axes, indexing="ij"), axis=-1)
+        full = interp_periodic(uc[d], pts, order=2)
+        ext = full.at[inner].set(uf[d])
+        out.append(ext)
+    return tuple(out)
+
+
+def _box_convective_rate(uext: Vel, dx_f, g: int, fine_n) -> Vel:
+    """Centered conservative N(u)_d on ghost-extended box MAC arrays;
+    returns component d at its own faces (shape fine_n + e_d). Same
+    arithmetic as ops.convection.convective_rate, box layout."""
+    dim = len(uext)
+    out = []
+    for d in range(dim):
+        nd = tuple(fine_n[a] + (1 if a == d else 0) for a in range(dim))
+        acc = jnp.zeros(nd, dtype=uext[d].dtype)
+        for e in range(dim):
+            if e == d:
+                # flux at cell centers along d (centers -1 .. n relative
+                # to the stored faces 0..n): face j's divergence needs
+                # centers j-1 and j, so n+2 centers from ghost faces
+                ncent = fine_n[d] + 2
+                a0 = _shift(uext[d], d, g - 1, ncent)   # faces -1..n
+                a1 = _shift(uext[d], d, g, ncent)       # faces 0..n+1
+                for a in range(dim):
+                    if a != d:
+                        a0 = _shift(a0, a, g, fine_n[a])
+                        a1 = _shift(a1, a, g, fine_n[a])
+                adv = 0.5 * (a0 + a1)
+                flux = adv * adv
+                acc = acc + (_shift(flux, d, 1, nd[d])
+                             - _shift(flux, d, 0, nd[d])) / dx_f[d]
+            else:
+                # edge fluxes at (lower d-face, lower e-face)
+                # adv = u_e averaged along d to the edge; edges j_e in
+                # [0, fine_n[e]] (one extra), faces i_d in [0, fine_n[d]]
+                ue = uext[e]
+                b0 = _shift(ue, d, g - 1, nd[d])
+                b1 = _shift(ue, d, g, nd[d])
+                for a in range(dim):
+                    if a == e:
+                        b0 = _shift(b0, a, g, fine_n[e] + 1)
+                        b1 = _shift(b1, a, g, fine_n[e] + 1)
+                    elif a != d:
+                        b0 = _shift(b0, a, g, fine_n[a])
+                        b1 = _shift(b1, a, g, fine_n[a])
+                adv = 0.5 * (b0 + b1)
+                ud = uext[d]
+                q0 = _shift(ud, e, g - 1, fine_n[e] + 1)
+                q1 = _shift(ud, e, g, fine_n[e] + 1)
+                for a in range(dim):
+                    if a == d:
+                        q0 = _shift(q0, a, g, nd[d])
+                        q1 = _shift(q1, a, g, nd[d])
+                    elif a != e:
+                        q0 = _shift(q0, a, g, fine_n[a])
+                        q1 = _shift(q1, a, g, fine_n[a])
+                q = 0.5 * (q0 + q1)
+                flux = adv * q                  # (.., nd[d] on d, ne+1 on e)
+                acc = acc + (_shift(flux, e, 1, fine_n[e])
+                             - _shift(flux, e, 0, fine_n[e])) / dx_f[e]
+        out.append(acc)
+    return tuple(out)
+
+
+def _box_laplacian(uext: Vel, dx_f, g: int, fine_n) -> Vel:
+    """Component Laplacians on ghost-extended box MAC arrays."""
+    dim = len(uext)
+    out = []
+    for d in range(dim):
+        nd = tuple(fine_n[a] + (1 if a == d else 0) for a in range(dim))
+        c = uext[d]
+        center = c
+        for a in range(dim):
+            center = _shift(center, a, g, nd[a])
+        acc = jnp.zeros_like(center)
+        for a in range(dim):
+            lo = c
+            hi = c
+            for b in range(dim):
+                lo = _shift(lo, b, g - (1 if b == a else 0), nd[b])
+                hi = _shift(hi, b, g + (1 if b == a else 0), nd[b])
+            acc = acc + (hi - 2.0 * center + lo) / dx_f[a] ** 2
+        out.append(acc)
+    return tuple(out)
+
+
+def _box_cc_laplacian(phi_ext: jnp.ndarray, dx_f, fine_n) -> jnp.ndarray:
+    """5/7-point Laplacian of a 1-ghost-extended box cell array."""
+    dim = phi_ext.ndim
+    center = phi_ext[tuple(slice(1, 1 + n) for n in fine_n)]
+    acc = jnp.zeros_like(center)
+    for a in range(dim):
+        lo = phi_ext[tuple(slice(1 - (1 if b == a else 0),
+                                 1 - (1 if b == a else 0) + fine_n[b])
+                           for b in range(dim))]
+        hi = phi_ext[tuple(slice(1 + (1 if b == a else 0),
+                                 1 + (1 if b == a else 0) + fine_n[b])
+                           for b in range(dim))]
+        acc = acc + (hi - 2.0 * center + lo) / dx_f[a] ** 2
+    return acc
+
+
+# --------------------------------------------------------------------------
+# composite projection
+# --------------------------------------------------------------------------
+
+class CompositeProjection:
+    """FGMRES solve of the two-level composite Poisson problem (see
+    module docstring), with velocity correction + interface sync."""
+
+    def __init__(self, grid: StaggeredGrid, box: FineBox,
+                 tol: float = 1e-9, m: int = 24, restarts: int = 8):
+        self.grid = grid
+        self.box = box
+        self.dx = grid.dx
+        self.dx_f = tuple(h / box.ratio for h in grid.dx)
+        self.tol = float(tol)
+        self.m = int(m)
+        self.restarts = int(restarts)
+        dim = grid.dim
+        self.box_sl = tuple(slice(box.lo[a], box.hi[a])
+                            for a in range(dim))
+        covered = np.zeros(grid.n, dtype=bool)
+        covered[tuple(np.s_[box.lo[a]:box.hi[a]] for a in range(dim))] = True
+        self._covered = jnp.asarray(covered)
+        self.fine_solver = FastDiagSolver(
+            box.fine_grid(grid),
+            DomainBC(axes=(dirichlet_axis(),) * dim), ("cc",) * dim)
+
+    # -- composite operator --------------------------------------------------
+    def _phi_eff(self, phi_c, phi_f):
+        return phi_c.at[self.box_sl].set(restrict_cc(phi_f))
+
+    def _interface_flux_correction(self, lap_c, phi_eff, phi_ext):
+        """Replace the coarse flux through each CF interface face by the
+        restricted fine flux, adjusting the Laplacian of the OUTSIDE
+        neighbor cells (the flux-sync rows)."""
+        box = self.box
+        dim = self.grid.dim
+        r = box.ratio
+        for d in range(dim):
+            for side in (0, 1):
+                # fine flux through the interface plane (outward = +-d)
+                # fine cells: first interior layer vs ghost layer
+                if side == 0:
+                    inner = 1
+                    ghostl = 0
+                    cout = box.lo[d] - 1      # outside coarse cell
+                    cin = box.lo[d]
+                else:
+                    inner = box.fine_n[d]
+                    ghostl = box.fine_n[d] + 1
+                    cout = box.hi[d]
+                    cin = box.hi[d] - 1
+                sl_in = [slice(1, 1 + n) for n in box.fine_n]
+                sl_gh = [slice(1, 1 + n) for n in box.fine_n]
+                sl_in[d] = slice(inner, inner + 1)
+                sl_gh[d] = slice(ghostl, ghostl + 1)
+                # gradient at the interface: fine spacing between ghost
+                # center and first interior center
+                gf = (phi_ext[tuple(sl_gh)] - phi_ext[tuple(sl_in)]) \
+                    / self.dx_f[d]
+                if side == 0:
+                    gf = -gf                  # make it the +d-face flux
+                # transverse restriction: mean over fine face pairs
+                gf = jnp.squeeze(gf, axis=d)
+                tshape = []
+                for a in range(dim):
+                    if a == d:
+                        continue
+                    tshape += [box.shape[a], r]
+                gf = gf.reshape(tshape)
+                gf = gf.mean(axis=tuple(range(1, 2 * (dim - 1), 2)))
+                gf = jnp.expand_dims(gf, axis=d)
+                # coarse flux lap_c already used through that face
+                sl_out = [slice(box.lo[a], box.hi[a]) for a in range(dim)]
+                sl_inn = [slice(box.lo[a], box.hi[a]) for a in range(dim)]
+                sl_out[d] = slice(cout, cout + 1)
+                sl_inn[d] = slice(cin, cin + 1)
+                gc = (phi_eff[tuple(sl_inn)] - phi_eff[tuple(sl_out)]) \
+                    / self.dx[d]
+                if side == 1:
+                    gc = -gc          # make gc the +d gradient (gf is
+                    #                   already +d-directed on both sides)
+                # outside cell: the shared face is its UPPER face on the
+                # lo side (+1/h) and its LOWER face on the hi side (-1/h)
+                sgn = 1.0 if side == 0 else -1.0
+                lap_c = lap_c.at[tuple(sl_out)].add(
+                    sgn * (gf - gc) / self.dx[d])
+        return lap_c
+
+    def operator(self, phi):
+        """Composite Poisson operator. The covered coarse DOFs are
+        decoupled identity rows at Laplacian-diagonal scale (they do not
+        feed phi_eff — the slaving uses restrict(phi_f) directly), so
+        the preconditioned spectrum stays Laplacian-like."""
+        phi_c, phi_f = phi
+        phi_eff = self._phi_eff(phi_c, phi_f)
+        lap_c = stencils.laplacian(phi_eff, self.dx)
+        phi_ext = fill_fine_ghosts(phi_f, phi_eff, self.box, ghost=1)
+        lap_c = self._interface_flux_correction(lap_c, phi_eff, phi_ext)
+        diag = sum(2.0 / h ** 2 for h in self.dx)
+        out_c = jnp.where(self._covered, -diag * phi_c, lap_c)
+        # rank-one shift removes the composite constant nullspace
+        out_c = out_c + diag * jnp.mean(phi_eff)
+        lap_f = _box_cc_laplacian(phi_ext, self.dx_f, self.box.fine_n)
+        return (out_c, lap_f)
+
+    def _precondition(self, r):
+        r_c, r_f = r
+        diag = sum(2.0 / h ** 2 for h in self.dx)
+        p_c = fft.solve_poisson_periodic(r_c, self.dx)
+        p_c = jnp.where(self._covered, -r_c / diag, p_c)
+        p_f = self.fine_solver.solve(r_f, 0.0, 1.0)
+        return (p_c, p_f)
+
+    # -- projection ----------------------------------------------------------
+    def project(self, uc: Vel, uf: Vel,
+                q_c: Optional[jnp.ndarray] = None,
+                q_f: Optional[jnp.ndarray] = None
+                ) -> Tuple[Vel, Vel, jnp.ndarray, jnp.ndarray]:
+        grid = self.grid
+        box = self.box
+        div_c = stencils.divergence(uc, self.dx)
+        if q_c is not None:
+            div_c = div_c - q_c
+        div_f = _box_mac_divergence(uf, self.dx_f)
+        if q_f is not None:
+            div_f = div_f - q_f
+        rhs_c = jnp.where(self._covered, 0.0, div_c)
+        sol = fgmres(self.operator, (rhs_c, div_f),
+                     M=self._precondition, m=self.m, tol=self.tol,
+                     restarts=self.restarts)
+        phi_c, phi_f = sol.x
+        phi_eff = self._phi_eff(phi_c, phi_f)
+
+        # coarse correction (periodic gradient everywhere; covered and
+        # interface faces are then overwritten by restriction)
+        gc = stencils.gradient(phi_eff, self.dx)
+        uc_new = tuple(c - g for c, g in zip(uc, gc))
+
+        # fine correction (gradients from the ghost-extended phi)
+        phi_ext = fill_fine_ghosts(phi_f, phi_eff, box, ghost=1)
+        uf_new = []
+        dim = grid.dim
+        for d in range(dim):
+            nf = box.fine_n
+            lo = [slice(1, 1 + n) for n in nf]
+            hi = [slice(1, 1 + n) for n in nf]
+            lo[d] = slice(0, nf[d] + 1)
+            hi[d] = slice(1, nf[d] + 2)
+            g = (phi_ext[tuple(hi)] - phi_ext[tuple(lo)]) / self.dx_f[d]
+            uf_new.append(uf[d] - g)
+        uf_new = tuple(uf_new)
+
+        uc_new = scatter_box_mac_to_coarse(uc_new, restrict_mac(uf_new),
+                                           box)
+        return uc_new, uf_new, phi_eff, phi_f
+
+
+def scatter_box_mac_to_coarse(uc: Vel, ur: Vel, box: FineBox) -> Vel:
+    """Overwrite the covered coarse faces (incl. the interface planes)
+    with the restricted fine faces — the CoarsenSchedule sync."""
+    dim = len(uc)
+    out = []
+    for d in range(dim):
+        sl = tuple(slice(box.lo[a],
+                         box.hi[a] + (1 if a == d else 0))
+                   for a in range(dim))
+        out.append(uc[d].at[sl].set(ur[d]))
+    return tuple(out)
+
+
+# --------------------------------------------------------------------------
+# the two-level integrator
+# --------------------------------------------------------------------------
+
+class TwoLevelINSState(NamedTuple):
+    uc: Vel
+    uf: Vel
+    t: jnp.ndarray
+    k: jnp.ndarray
+
+
+class TwoLevelINS:
+    """Composite two-level INS: explicit convection + diffusion, exact
+    composite projection per step (see module docstring). The explicit
+    treatment bounds dt by the FINE viscous/advective limits — the
+    trade for a fully matrix-free composite step; the uniform-grid
+    integrator keeps CN diffusion for production runs."""
+
+    def __init__(self, grid: StaggeredGrid, box: FineBox,
+                 rho: float = 1.0, mu: float = 0.01,
+                 convective: bool = True, proj_tol: float = 1e-9):
+        box.validate(grid, clearance=2)
+        self.grid = grid
+        self.box = box
+        self.fine = box.fine_grid(grid)
+        self.rho = float(rho)
+        self.mu = float(mu)
+        self.convective = bool(convective)
+        self.dx_f = tuple(h / box.ratio for h in grid.dx)
+        self.proj = CompositeProjection(grid, box, tol=proj_tol)
+
+    def initialize(self, uc: Vel) -> TwoLevelINSState:
+        """Fine level seeded by the divergence-preserving prolongation
+        (T10), so an initially div-free coarse field yields a div-free
+        composite state."""
+        uf = prolong_mac_div_preserving(uc, self.grid, self.box)
+        uc_sync = scatter_box_mac_to_coarse(uc, restrict_mac(uf), self.box)
+        return TwoLevelINSState(
+            uc=uc_sync, uf=uf,
+            t=jnp.zeros((), dtype=uc[0].dtype),
+            k=jnp.zeros((), dtype=jnp.int32))
+
+    def step(self, state: TwoLevelINSState, dt: float,
+             f_c: Optional[Vel] = None,
+             f_f: Optional[Vel] = None) -> TwoLevelINSState:
+        """One composite step. ``f_c``/``f_f`` are per-level MAC body
+        forces (f_f in box layout — e.g. the spread IB force)."""
+        g = self.grid
+        uc, uf = state.uc, state.uf
+        rho, mu = self.rho, self.mu
+
+        # -- explicit predictor on each level ---------------------------
+        lap_c = stencils.laplacian_vel(uc, g.dx)
+        n_c = (convective_rate(uc, g.dx, "centered") if self.convective
+               else tuple(jnp.zeros_like(c) for c in uc))
+        uc_star = []
+        for d in range(g.dim):
+            rhs = -n_c[d] + (mu * lap_c[d]) / rho
+            if f_c is not None:
+                rhs = rhs + f_c[d] / rho
+            uc_star.append(uc[d] + dt * rhs)
+
+        gext = 2
+        uext = fill_fine_ghosts_mac(uf, uc, self.box, ghost=gext)
+        lap_f = _box_laplacian(uext, self.dx_f, gext, self.box.fine_n)
+        if self.convective:
+            n_f = _box_convective_rate(uext, self.dx_f, gext,
+                                       self.box.fine_n)
+        else:
+            n_f = tuple(jnp.zeros_like(c) for c in lap_f)
+        uf_star = []
+        for d in range(g.dim):
+            rhs = -n_f[d] + (mu * lap_f[d]) / rho
+            if f_f is not None:
+                rhs = rhs + f_f[d] / rho
+            uf_star.append(uf[d] + dt * rhs)
+
+        # -- slave covered coarse to the fine predictor -----------------
+        uc_star = scatter_box_mac_to_coarse(tuple(uc_star),
+                                            restrict_mac(tuple(uf_star)),
+                                            self.box)
+
+        # -- composite projection --------------------------------------
+        uc_new, uf_new, _, _ = self.proj.project(uc_star, tuple(uf_star))
+        return TwoLevelINSState(uc=uc_new, uf=uf_new,
+                                t=state.t + dt, k=state.k + 1)
+
+    # -- diagnostics ---------------------------------------------------------
+    def max_divergence(self, state: TwoLevelINSState):
+        """(uncovered coarse incl. interface ring, fine interior)."""
+        div_c = stencils.divergence(state.uc, self.grid.dx)
+        div_c = jnp.where(self.proj._covered, 0.0, div_c)
+        div_f = _box_mac_divergence(state.uf, self.dx_f)
+        return jnp.maximum(jnp.max(jnp.abs(div_c)),
+                           jnp.max(jnp.abs(div_f)))
+
+
+def advance_two_level(integ: TwoLevelINS, state: TwoLevelINSState,
+                      dt: float, num_steps: int,
+                      f_c: Optional[Vel] = None,
+                      f_f: Optional[Vel] = None) -> TwoLevelINSState:
+    def body(s, _):
+        return integ.step(s, dt, f_c=f_c, f_f=f_f), None
+
+    out, _ = jax.lax.scan(body, state, None, length=num_steps)
+    return out
+
+
+# --------------------------------------------------------------------------
+# IB on the composite hierarchy (refine around the structure)
+# --------------------------------------------------------------------------
+
+class TwoLevelIBState(NamedTuple):
+    fluid: TwoLevelINSState
+    X: jnp.ndarray
+    U: jnp.ndarray
+    mask: jnp.ndarray
+
+
+def _box_mac_from_periodic(f_per: Vel) -> Vel:
+    """Periodic fine-grid MAC layout (shape nf) -> box layout (+1 normal
+    extent). Valid when no marker stencil wraps (structure keeps
+    delta-support clearance from the box boundary), so the duplicated
+    face carries zero."""
+    out = []
+    for d, f in enumerate(f_per):
+        first = jnp.take(f, jnp.asarray([0]), axis=d)
+        out.append(jnp.concatenate([f, first], axis=d))
+    return tuple(out)
+
+
+def _periodic_from_box_mac(u_box: Vel, fine_n) -> Vel:
+    out = []
+    for d, u in enumerate(u_box):
+        idx = [slice(None)] * u.ndim
+        idx[d] = slice(0, fine_n[d])
+        out.append(u[tuple(idx)])
+    return tuple(out)
+
+
+class TwoLevelIBINS:
+    """Explicit IB coupling on the two-level composite grid: the
+    structure lives inside the fine box (the canonical IBAMR usage —
+    refinement tracks the immersed boundary, SURVEY.md §0), transfers
+    run at FINE resolution, and the coarse level sees the restricted
+    force. The structure must keep delta-support clearance from the box
+    boundary (the proper-nesting analog)."""
+
+    def __init__(self, grid: StaggeredGrid, box: FineBox, ib,
+                 rho: float = 1.0, mu: float = 0.01,
+                 convective: bool = True, proj_tol: float = 1e-9):
+        self.core = TwoLevelINS(grid, box, rho=rho, mu=mu,
+                                convective=convective, proj_tol=proj_tol)
+        self.grid = grid
+        self.box = box
+        self.fine_grid = box.fine_grid(grid)
+        self.ib = ib
+
+    def initialize(self, X0, uc: Optional[Vel] = None) -> TwoLevelIBState:
+        g = self.grid
+        if uc is None:
+            uc = tuple(jnp.zeros(g.n, dtype=jnp.result_type(X0))
+                       for _ in range(g.dim))
+        fluid = self.core.initialize(uc)
+        X = jnp.asarray(X0)
+        return TwoLevelIBState(
+            fluid=fluid, X=X, U=jnp.zeros_like(X),
+            mask=jnp.ones(X.shape[0], dtype=X.dtype))
+
+    def _interp(self, uf_box: Vel, X, mask):
+        from ibamr_tpu.ops import interaction
+
+        u_per = _periodic_from_box_mac(uf_box, self.box.fine_n)
+        return interaction.interpolate_vel(u_per, self.fine_grid, X,
+                                           kernel=self.ib.kernel,
+                                           weights=mask)
+
+    def step(self, state: TwoLevelIBState, dt: float) -> TwoLevelIBState:
+        from ibamr_tpu.ops import interaction
+
+        fluid = state.fluid
+        X_n = state.X
+        U_n = self._interp(fluid.uf, X_n, state.mask)
+        X_half = X_n + 0.5 * dt * U_n
+        t_half = fluid.t + 0.5 * dt
+        F = self.ib.compute_force(X_half, U_n, t_half)
+        f_per = interaction.spread_vel(F, self.fine_grid, X_half,
+                                       kernel=self.ib.kernel,
+                                       weights=state.mask)
+        f_f = _box_mac_from_periodic(f_per)
+        # coarse sees the conservatively restricted force in the box
+        f_c = scatter_box_mac_to_coarse(
+            tuple(jnp.zeros(self.grid.n, dtype=f_per[0].dtype)
+                  for _ in range(self.grid.dim)),
+            restrict_mac(f_f), self.box)
+        fluid_new = self.core.step(fluid, dt, f_c=f_c, f_f=f_f)
+        u_mid = tuple(0.5 * (a + b)
+                      for a, b in zip(fluid.uf, fluid_new.uf))
+        U_half = self._interp(u_mid, X_half, state.mask)
+        X_new = X_n + dt * U_half
+        return TwoLevelIBState(fluid=fluid_new, X=X_new, U=U_half,
+                               mask=state.mask)
+
+
+def advance_two_level_ib(integ: TwoLevelIBINS, state: TwoLevelIBState,
+                         dt: float, num_steps: int) -> TwoLevelIBState:
+    def body(s, _):
+        return integ.step(s, dt), None
+
+    out, _ = jax.lax.scan(body, state, None, length=num_steps)
+    return out
+
+
+def box_from_markers(grid: StaggeredGrid, X, pad: int = 4,
+                     even: bool = True) -> FineBox:
+    """Tag the fine box from marker positions (host-side, at setup /
+    regrid time): the smallest coarse-cell box covering the structure
+    plus ``pad`` cells of clearance (delta support + motion headroom) —
+    the marker-tagging half of StandardTagAndInitialize (SURVEY.md
+    §3.4). ``even`` rounds the box to even extents (clean restriction)."""
+    Xn = np.asarray(X)
+    lo, hi = [], []
+    for d in range(grid.dim):
+        c = (Xn[:, d] - grid.x_lo[d]) / grid.dx[d]
+        l = int(np.floor(c.min())) - pad
+        h = int(np.ceil(c.max())) + pad
+        l = max(l, 2)
+        h = min(h, grid.n[d] - 2)
+        if even and (h - l) % 2:
+            h = h - 1 if h - l > 2 else h
+            if (h - l) % 2:
+                l = l + 1
+        lo.append(l)
+        hi.append(h)
+    return FineBox(lo=tuple(lo), shape=tuple(h - l for l, h in
+                                             zip(lo, hi)))

@@ -47,16 +47,25 @@ def _centering_offsets(grid: StaggeredGrid, centering) -> Tuple[float, ...]:
     return tuple(centering)
 
 
-def _axis_weights_indices(xi: jnp.ndarray, n: int, support: int, phi):
-    """Per-axis stencil indices (wrapped periodic) and weights.
+def _axis_weights_indices_raw(xi: jnp.ndarray, support: int, phi):
+    """Per-axis stencil indices (UNWRAPPED — may be negative or >= n)
+    and delta weights. The single source of the kernel-support index
+    math, shared with the sharded engine (parallel.lagrangian), which
+    needs contiguous indices for its halo-extended local buffers.
 
-    xi: (N,) continuous grid-unit coordinate of the markers along this axis
-    returns idx (N, support) int32, w (N, support)
+    xi: (N,) continuous grid-unit coordinate of the markers along this
+    axis; returns j (N, support) int32, w (N, support).
     """
     j0 = jnp.floor(xi - 0.5 * support).astype(jnp.int32) + 1
     offs = jnp.arange(support, dtype=jnp.int32)
     j = j0[:, None] + offs[None, :]
     w = phi(xi[:, None] - j.astype(xi.dtype))
+    return j, w
+
+
+def _axis_weights_indices(xi: jnp.ndarray, n: int, support: int, phi):
+    """Per-axis stencil indices (wrapped periodic) and weights."""
+    j, w = _axis_weights_indices_raw(xi, support, phi)
     return jnp.mod(j, n), w
 
 

@@ -14,6 +14,7 @@ XLA collectives over ICI. Two execution paths are provided:
   controlled analog of the reference's precomputed RefineSchedules.
 """
 
+from ibamr_tpu.parallel.lagrangian import ShardedInteraction  # noqa: F401
 from ibamr_tpu.parallel.mesh import (  # noqa: F401
     factor_devices,
     grid_pspec,

@@ -1,0 +1,376 @@
+"""Lagrangian co-partitioning: shard-owned markers + ppermute halos (S2).
+
+Reference parity: ``LDataManager``'s marker-to-rank co-partitioning
+(T1/S2, SURVEY.md §2.3) — each MPI rank owns the markers inside its
+patches, PETSc VecScatter builds ghost halos, redistribution follows
+regrid. Round 1 replicated markers on every device and let GSPMD
+scatter into the sharded grid (flagged by VERDICT round 1 item 3: the
+transfers materialize all-gathers and per-device work scales with the
+GLOBAL marker count).
+
+TPU-first redesign (the "sort + ppermute" plan of SURVEY.md §2.4
+"irregular scatter"):
+
+1. **Owner bucketing (the redistribution step).** Markers are bucketed
+   by the mesh block owning their cell — one argsort + scatter of N
+   rows (replicated arithmetic, cheap) producing fixed-capacity
+   per-shard pools ``(P * cap, ...)`` that are then sharded over the
+   mesh, so each device holds exactly its own markers. Re-bucketing
+   every call IS the migration strategy ("periodic global re-sort",
+   SURVEY.md §2.3 S2) — no incremental ghost bookkeeping to invalidate.
+2. **Local transfer + halo exchange.** Inside ``shard_map`` each device
+   spreads its ``cap`` markers into its local grid block extended by a
+   halo ring of width ``s//2 + 1`` (the delta support radius), then the
+   halo slabs are ``lax.ppermute``d to the ring neighbors and
+   accumulated — the RefineSchedule ghost-accumulate of SURVEY.md §3.2
+   as one explicit ICI neighbor push. Interpolation mirrors it: ghost
+   fill by ppermute, then a purely local gather (exact adjoint).
+3. **Overflow (fixed-capacity safety).** Markers beyond a shard's
+   capacity fall back to the round-1 replicated scatter path through a
+   COMPACT index buffer under ``lax.cond`` (same design as
+   ops.interaction_fast), so clustering degrades performance, never
+   correctness.
+
+Per-device spread/interp work scales with ``cap`` (~N/P * slack), not
+N — the S2 scaling contract.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ibamr_tpu.grid import StaggeredGrid
+from ibamr_tpu.ops import interaction
+from ibamr_tpu.ops.delta import Kernel, get_kernel
+from ibamr_tpu.ops.interaction import _centering_offsets
+
+Vel = Tuple[jnp.ndarray, ...]
+
+
+class ShardBuckets(NamedTuple):
+    """Owner-bucketed marker layout (all shapes static)."""
+    Xb: jnp.ndarray          # (P*cap, dim) positions, sharded rows
+    wb: jnp.ndarray          # (P*cap,) weights (0 in pad slots)
+    slot_of_marker: jnp.ndarray   # (N,) slot or P*cap (overflowed)
+    w_all: jnp.ndarray       # (N,) the caller's weights, global order
+    o_idx: jnp.ndarray       # (ocap,) original indices of overflow markers
+    o_w: jnp.ndarray         # (ocap,) their weights (0 in pad slots)
+    any_overflow: jnp.ndarray     # () bool
+    exceeded: jnp.ndarray    # () bool: overflow buffer itself overflowed
+
+
+class ShardedInteraction:
+    """Shard-owned spread/interp engine bound to one (grid, mesh) pair.
+
+    The leading ``len(mesh.axis_names)`` grid axes are sharded by the
+    mesh (the same convention as parallel.mesh.grid_pspec). ``cap`` is
+    the per-shard marker capacity (static); default ``slack`` x the
+    balanced share, rounded up to a multiple of 8.
+    """
+
+    def __init__(self, grid: StaggeredGrid, mesh: Mesh,
+                 kernel: Kernel = "IB_4", n_markers: Optional[int] = None,
+                 cap: Optional[int] = None, slack: float = 2.0,
+                 overflow_cap: Optional[int] = None):
+        self.grid = grid
+        self.mesh = mesh
+        self.kernel: Kernel = kernel
+        self.axes = tuple(mesh.axis_names)
+        self.sizes = tuple(mesh.shape[a] for a in self.axes)
+        self.n_sharded = len(self.axes)
+        if self.n_sharded > grid.dim:
+            raise ValueError("mesh has more axes than the grid")
+        self.nloc = []
+        for d, p in enumerate(self.sizes):
+            if grid.n[d] % p != 0:
+                raise ValueError(
+                    f"grid axis {d} ({grid.n[d]}) not divisible by mesh "
+                    f"axis {self.axes[d]!r} ({p})")
+            self.nloc.append(grid.n[d] // p)
+        support, _ = get_kernel(kernel)
+        self.support = support
+        # halo radius: stencil of a cell-owned marker spans at most
+        # [c - s//2, c + s//2] across all MAC centerings
+        self.w = support // 2 + 1
+        for d in range(self.n_sharded):
+            if self.nloc[d] < self.w:
+                raise ValueError(
+                    f"local block ({self.nloc[d]} cells on axis {d}) "
+                    f"thinner than the halo ({self.w}); use fewer devices "
+                    f"or a bigger grid")
+        self.P = int(np.prod(self.sizes))
+        if cap is None:
+            if n_markers is None:
+                raise ValueError("need n_markers or an explicit cap")
+            cap = int(math.ceil(n_markers * slack / self.P / 8.0) * 8)
+        self.cap = int(cap)
+        self.overflow_cap = overflow_cap
+        # row sharding of the (P*cap, ...) pools: all mesh axes, in order
+        row_axes = tuple(self.axes) if self.n_sharded > 1 else self.axes[0]
+        self.row_spec = P(row_axes)                 # (P*cap,)
+        self.row_spec2 = P(row_axes, None)          # (P*cap, dim)
+        self.grid_spec = P(*self.axes,
+                           *([None] * (grid.dim - self.n_sharded)))
+
+    # -- bucketing (replicated arithmetic) -----------------------------------
+    def buckets(self, X: jnp.ndarray,
+                weights: Optional[jnp.ndarray] = None) -> ShardBuckets:
+        grid = self.grid
+        N, dim = X.shape
+        if weights is None:
+            weights = jnp.ones((N,), dtype=X.dtype)
+        ocap = self.overflow_cap
+        if ocap is None:
+            ocap = min(N, max(256, N // 8))
+
+        # inactive (weight-0) markers spread nothing and interpolate to
+        # zero, so they must NOT occupy shard capacity: send them to the
+        # sentinel owner P (a parked fixed-capacity pool would otherwise
+        # evict real markers and force the replicated fallback)
+        active = weights != 0
+        owner = jnp.zeros((N,), dtype=jnp.int32)
+        for d in range(self.n_sharded):
+            c = jnp.floor(
+                (X[:, d] - grid.x_lo[d]) / grid.dx[d]).astype(jnp.int32)
+            c = jnp.mod(c, grid.n[d])
+            owner = owner * self.sizes[d] + c // self.nloc[d]
+        owner = jnp.where(active, owner, self.P)
+
+        cap = self.cap
+        Pn = self.P
+        order = jnp.argsort(owner)
+        owner_s = owner[order]
+        start = jnp.searchsorted(owner_s,
+                                 jnp.arange(Pn, dtype=owner_s.dtype))
+        rank = (jnp.arange(N, dtype=jnp.int32)
+                - start[jnp.minimum(owner_s, Pn - 1)].astype(jnp.int32))
+        keep = jnp.logical_and(owner_s < Pn, rank < cap)
+        slot_sorted = jnp.where(keep, owner_s * cap + rank, Pn * cap)
+
+        Xb = jnp.zeros((Pn * cap + 1, dim), dtype=X.dtype)
+        Xb = Xb.at[slot_sorted].set(X[order])[:-1]
+        wb = jnp.zeros((Pn * cap + 1,), dtype=weights.dtype)
+        wb = wb.at[slot_sorted].set(
+            jnp.where(keep, weights[order], 0.0))[:-1]
+
+        slot_of_marker = jnp.zeros((N,), dtype=jnp.int32)
+        slot_of_marker = slot_of_marker.at[order].set(
+            slot_sorted.astype(jnp.int32))
+
+        # compact fallback buffer: only ACTIVE unselected markers need
+        # it (inactive ones must not crowd out real overflow)
+        need = jnp.logical_and(jnp.logical_not(keep), active[order])
+        ord2 = jnp.argsort(jnp.logical_not(need))   # stable: needy first
+        o_pos = ord2[:ocap]
+        o_idx = order[o_pos].astype(jnp.int32)
+        o_w = jnp.where(need[o_pos], weights[order[o_pos]], 0.0)
+        n_over = jnp.sum(need)
+
+        Xb = lax.with_sharding_constraint(
+            Xb, NamedSharding(self.mesh, self.row_spec2))
+        wb = lax.with_sharding_constraint(
+            wb, NamedSharding(self.mesh, self.row_spec))
+        return ShardBuckets(Xb=Xb, wb=wb, slot_of_marker=slot_of_marker,
+                            w_all=weights, o_idx=o_idx, o_w=o_w,
+                            any_overflow=n_over > 0,
+                            exceeded=n_over > ocap)
+
+    # -- local stencil helpers ----------------------------------------------
+    def _local_stencil(self, Xl, starts, centering):
+        """Per-device flattened stencil indices into the halo-extended
+        local buffer + tensor-product weights. Xl: (cap, dim)."""
+        grid = self.grid
+        support, phi = get_kernel(self.kernel)
+        offs = _centering_offsets(grid, centering)
+        dim = grid.dim
+        w = self.w
+        C = Xl.shape[0]
+        ext_shape = tuple(
+            (self.nloc[d] + 2 * w) if d < self.n_sharded else grid.n[d]
+            for d in range(dim))
+
+        idxs, wgts = [], []
+        for d in range(dim):
+            xi = (Xl[:, d] - grid.x_lo[d]) / grid.dx[d]
+            if d < self.n_sharded:
+                # wrap into [0, n) by the marker's CELL (keeps the
+                # stencil contiguous around the owned cell)
+                shift = jnp.mod(jnp.floor(xi), grid.n[d]) - jnp.floor(xi)
+                xi = xi + shift
+            j, wg = interaction._axis_weights_indices_raw(
+                xi - offs[d], support, phi)
+            if d < self.n_sharded:
+                j = j - starts[d] + w          # local, NO wrap
+            else:
+                j = jnp.mod(j, grid.n[d])
+            idxs.append(j)
+            wgts.append(wg)
+
+        lin = idxs[0]
+        wgt = wgts[0]
+        for d in range(1, dim):
+            lin = lin[..., :, None] * ext_shape[d] + idxs[d].reshape(
+                (C,) + (1,) * (lin.ndim - 1) + (support,))
+            wgt = wgt[..., :, None] * wgts[d].reshape(
+                (C,) + (1,) * (wgt.ndim - 1) + (support,))
+        return lin.reshape(C, -1), wgt.reshape(C, -1), ext_shape
+
+    def _starts(self):
+        return [lax.axis_index(self.axes[d]) * self.nloc[d]
+                for d in range(self.n_sharded)]
+
+    def _take(self, a, d, lo, hi):
+        idx = [slice(None)] * a.ndim
+        idx[d] = slice(lo, hi)
+        return a[tuple(idx)]
+
+    def _halo_add(self, buf, d):
+        """Push this device's halo slabs along local axis d to the ring
+        neighbors and accumulate; returns the axis-d interior."""
+        ax = self.axes[d]
+        Pd = self.sizes[d]
+        w, nl = self.w, self.nloc[d]
+        lo_slab = self._take(buf, d, 0, w)
+        hi_slab = self._take(buf, d, nl + w, nl + 2 * w)
+        # lo ghost belongs to the PREVIOUS block; receive the next
+        # block's lo slab into our interior tail (and mirrored for hi)
+        fwd = [(i, (i - 1) % Pd) for i in range(Pd)]
+        bwd = [(i, (i + 1) % Pd) for i in range(Pd)]
+        from_next = lax.ppermute(lo_slab, ax, perm=fwd)
+        from_prev = lax.ppermute(hi_slab, ax, perm=bwd)
+        interior = self._take(buf, d, w, w + nl)
+        idx_hi = [slice(None)] * buf.ndim
+        idx_hi[d] = slice(nl - w, nl)
+        idx_lo = [slice(None)] * buf.ndim
+        idx_lo[d] = slice(0, w)
+        interior = interior.at[tuple(idx_hi)].add(from_next)
+        interior = interior.at[tuple(idx_lo)].add(from_prev)
+        return interior
+
+    def _ghost_fill(self, f, d):
+        """Extend local field f with w ghost layers along axis d from
+        the ring neighbors."""
+        ax = self.axes[d]
+        Pd = self.sizes[d]
+        w, nl = self.w, self.nloc[d]
+        fwd = [(i, (i + 1) % Pd) for i in range(Pd)]
+        bwd = [(i, (i - 1) % Pd) for i in range(Pd)]
+        lo_ghost = lax.ppermute(self._take(f, d, nl - w, nl), ax, perm=fwd)
+        hi_ghost = lax.ppermute(self._take(f, d, 0, w), ax, perm=bwd)
+        return jnp.concatenate([lo_ghost, f, hi_ghost], axis=d)
+
+    # -- public ops ----------------------------------------------------------
+    def spread(self, F: jnp.ndarray, X: jnp.ndarray, centering,
+               b: ShardBuckets) -> jnp.ndarray:
+        """Spread marker values F (N,) -> sharded grid field."""
+        grid = self.grid
+        inv_vol = 1.0 / math.prod(grid.dx)
+        # bucket F with the same layout as Xb
+        Fb = jnp.zeros((self.P * self.cap + 1,), dtype=F.dtype)
+        Fb = Fb.at[b.slot_of_marker].add(F)[:-1]
+        Fb = lax.with_sharding_constraint(
+            Fb, NamedSharding(self.mesh, self.row_spec))
+
+        def kernel(Xl, Fl, wl):
+            starts = self._starts()
+            lin, wgt, ext_shape = self._local_stencil(Xl, starts, centering)
+            vals = (Fl * wl * inv_vol)[:, None] * wgt
+            buf = jnp.zeros(ext_shape, dtype=vals.dtype)
+            buf = buf.reshape(-1).at[lin.reshape(-1)].add(
+                vals.reshape(-1)).reshape(ext_shape)
+            for d in range(self.n_sharded):
+                buf = self._halo_add(buf, d)
+            return buf
+
+        out = jax.shard_map(
+            kernel, mesh=self.mesh,
+            in_specs=(self.row_spec2, self.row_spec, self.row_spec),
+            out_specs=self.grid_spec)(b.Xb, Fb, b.wb)
+
+        def compact(o):
+            return interaction.spread(F[b.o_idx], grid, X[b.o_idx],
+                                      centering=centering,
+                                      kernel=self.kernel,
+                                      weights=b.o_w, out=o)
+
+        def full(o):
+            # overflow buffer exceeded: exact full fallback carrying the
+            # CALLER's weights for every non-selected marker (masked
+            # markers must stay masked here too)
+            w_over = jnp.where(b.slot_of_marker < self.P * self.cap,
+                               0.0, b.w_all)
+            return interaction.spread(F, grid, X, centering=centering,
+                                      kernel=self.kernel,
+                                      weights=w_over, out=o)
+
+        return lax.cond(
+            b.exceeded, full,
+            lambda o: lax.cond(b.any_overflow, compact,
+                               lambda oo: oo, o), out)
+
+    def interpolate(self, f: jnp.ndarray, X: jnp.ndarray, centering,
+                    b: ShardBuckets) -> jnp.ndarray:
+        """Interpolate a sharded grid field at the markers -> (N,)."""
+        grid = self.grid
+
+        def kernel(fl, Xl, wl):
+            for d in range(self.n_sharded):
+                fl = self._ghost_fill(fl, d)
+            starts = self._starts()
+            lin, wgt, _ = self._local_stencil(Xl, starts, centering)
+            vals = jnp.take(fl.reshape(-1), lin, axis=0)
+            return jnp.sum(vals * wgt, axis=-1) * wl
+
+        Ub = jax.shard_map(
+            kernel, mesh=self.mesh,
+            in_specs=(self.grid_spec, self.row_spec2, self.row_spec),
+            out_specs=self.row_spec)(f, b.Xb, b.wb)
+
+        # map back to global marker order (slot gather; the sentinel
+        # slot P*cap maps overflowed markers to 0)
+        U = jnp.take(Ub, jnp.minimum(b.slot_of_marker, Ub.shape[0] - 1),
+                     axis=0)
+        U = jnp.where(b.slot_of_marker < Ub.shape[0], U, 0.0)
+
+        def compact(u):
+            Uo = interaction.interpolate(f, grid, X[b.o_idx],
+                                         centering=centering,
+                                         kernel=self.kernel, weights=b.o_w)
+            return u.at[b.o_idx].add(Uo)
+
+        def full(u):
+            w_over = jnp.where(b.slot_of_marker < self.P * self.cap,
+                               0.0, b.w_all)
+            return u + interaction.interpolate(
+                f, grid, X, centering=centering, kernel=self.kernel,
+                weights=w_over)
+
+        return lax.cond(
+            b.exceeded, full,
+            lambda u: lax.cond(b.any_overflow, compact,
+                               lambda uu: uu, u), U)
+
+    # drop-in FastInteraction-shaped surface (IBMethod engine seam)
+    def interpolate_vel(self, u: Vel, X: jnp.ndarray,
+                        weights: Optional[jnp.ndarray] = None,
+                        b: Optional[ShardBuckets] = None) -> jnp.ndarray:
+        if b is None:
+            b = self.buckets(X, weights)
+        cols = [self.interpolate(u[d], X, d, b)
+                for d in range(self.grid.dim)]
+        return jnp.stack(cols, axis=-1)
+
+    def spread_vel(self, F: jnp.ndarray, X: jnp.ndarray,
+                   weights: Optional[jnp.ndarray] = None,
+                   b: Optional[ShardBuckets] = None) -> Vel:
+        if b is None:
+            b = self.buckets(X, weights)
+        return tuple(self.spread(F[:, d], X, d, b)
+                     for d in range(self.grid.dim))

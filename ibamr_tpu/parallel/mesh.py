@@ -3,10 +3,12 @@
 Reference parity: SAMRAI `LoadBalancer` patch->rank assignment (S1,
 SURVEY.md §2.3) — here the "patches" are equal blocks of each uniform
 level, laid out over a 1D or 2D `jax.sharding.Mesh` so halo traffic rides
-ICI neighbor links. Marker arrays stay replicated (every device evaluates
-all Lagrangian forces — cheap at O(1e5) markers next to the grid work);
-the spread scatter and interp gather are partitioned by XLA against the
-sharded grid, which is the VecScatter analog (§2.4 "irregular scatter").
+ICI neighbor links. Marker POSITIONS and force arithmetic stay
+replicated (O(N) elementwise work, negligible next to the grid work),
+but the spread/interp TRANSFERS — the actual hot path — run through the
+S2 co-partitioned engine (parallel.lagrangian): owner-bucketed per-shard
+marker pools, local scatter/gather, ppermute halo accumulation (the
+VecScatter analog of §2.4 "irregular scatter").
 
 The GSPMD contract: the step function is the SAME pure function as the
 single-device path; only `with_sharding_constraint` pins where arrays
@@ -143,15 +145,88 @@ def make_sharded_adv_diff_step(integ, mesh: Mesh):
     return jax.jit(step)
 
 
-def make_sharded_ib_step(integ, mesh: Mesh):
+def make_sharded_ib_step(integ, mesh: Mesh, sharded_markers: bool = True,
+                         marker_cap: Optional[int] = None,
+                         marker_slack: float = 2.0):
     """Jitted coupled IB step (interp -> force -> spread -> fluid solve ->
     correct) with the Eulerian state sharded over ``mesh``. This is the
-    whole-timestep SPMD program of SURVEY.md §3.2's device-boundary note."""
+    whole-timestep SPMD program of SURVEY.md §3.2's device-boundary note.
+
+    With ``sharded_markers`` (default), the spread/interp transfers run
+    through the S2 co-partitioned engine (parallel.lagrangian): markers
+    are owner-bucketed onto the mesh every step and each device scatters
+    /gathers only its own ~N/P markers, with ppermute halo exchange —
+    instead of replicated markers + GSPMD-resolved transfers (round-1
+    behavior, kept via ``sharded_markers=False``). Positions and forces
+    stay replicated (O(N) arithmetic is negligible next to the grid
+    work; SURVEY.md §2.3 S2)."""
     import copy
 
     grid = integ.ins.grid
     integ = copy.copy(integ)
     integ.ins = _with_pencil_solvers(integ.ins, mesh)
+
+    if sharded_markers:
+        from ibamr_tpu.integrators.ib import IBMethod
+        from ibamr_tpu.parallel.lagrangian import ShardedInteraction
+
+        base_ib = integ.ib
+        # The S2 facade understands marker-point transfers only; other
+        # strategies (IBFE quadrature coupling, custom plugins) keep the
+        # GSPMD-resolved path. Geometry constraints (axis divisibility,
+        # halo >= local block) are probed up front so ineligible
+        # (grid, mesh) pairs fall back instead of failing at trace time.
+        eligible = isinstance(base_ib, IBMethod)
+        if eligible:
+            try:
+                ShardedInteraction(grid, mesh, kernel=base_ib.kernel,
+                                   cap=8)
+            except ValueError as e:
+                import warnings
+
+                warnings.warn(
+                    f"sharded markers disabled for this (grid, mesh): {e}")
+                eligible = False
+
+        if eligible:
+            engines = {}
+
+            def get_engine(N):
+                # keyed by marker count: a retrace with a different N
+                # must not reuse a capacity sized for the old N
+                if N not in engines:
+                    engines[N] = ShardedInteraction(
+                        grid, mesh, kernel=base_ib.kernel, n_markers=N,
+                        cap=marker_cap, slack=marker_slack)
+                return engines[N]
+
+            class _ShardedIB:
+                """IBMethod facade routing transfers through the S2
+                engine; force evaluation stays with the base method."""
+
+                def __init__(self):
+                    self.specs = base_ib.specs
+                    self.kernel = base_ib.kernel
+
+                def compute_force(self, X, U, t):
+                    return base_ib.compute_force(X, U, t)
+
+                def prepare(self, X, mask):
+                    return get_engine(X.shape[0]).buckets(X, mask)
+
+                def interpolate_velocity(self, u, g, X, mask, ctx=None):
+                    eng = get_engine(X.shape[0])
+                    if ctx is None:
+                        ctx = eng.buckets(X, mask)
+                    return eng.interpolate_vel(u, X, weights=mask, b=ctx)
+
+                def spread_force(self, F, g, X, mask, ctx=None):
+                    eng = get_engine(X.shape[0])
+                    if ctx is None:
+                        ctx = eng.buckets(X, mask)
+                    return eng.spread_vel(F, X, weights=mask, b=ctx)
+
+            integ.ib = _ShardedIB()
 
     def step(state, dt):
         state = state._replace(ins=shard_state(state.ins, grid, mesh))

@@ -1,0 +1,170 @@
+"""Composite two-level INS + IB (VERDICT round 1 item 3).
+
+Reference parity: INS on a locally-refined hierarchy with the structure
+inside the refined region — the core IBAMR usage (SURVEY.md §0, §5.7,
+P2/P8/T10).
+
+Oracles:
+- the composite projection drives the composite divergence (fine
+  interior + uncovered coarse incl. the interface ring) to solver
+  tolerance on random data;
+- a compact vortex refined by the box: the two-level solution in the
+  refined region is several times closer to the uniform-fine solution
+  than the uniform-coarse solution is;
+- a membrane inside the box: marker trajectories track the
+  uniform-fine IB run far better than the uniform-coarse one.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ibamr_tpu.amr import FineBox, _box_mac_divergence, restrict_mac
+from ibamr_tpu.amr_ins import (CompositeProjection, TwoLevelIBINS,
+                               TwoLevelINS, advance_two_level,
+                               advance_two_level_ib, box_from_markers,
+                               scatter_box_mac_to_coarse)
+from ibamr_tpu.grid import StaggeredGrid
+from ibamr_tpu.integrators.ib import (IBExplicitIntegrator, IBMethod,
+                                      advance_ib, polygon_area)
+from ibamr_tpu.integrators.ins import INSStaggeredIntegrator
+from ibamr_tpu.models.membrane2d import make_circle_membrane
+from ibamr_tpu.ops import stencils
+from ibamr_tpu.ops.convection import convective_rate
+from ibamr_tpu.solvers import fft
+
+
+def _grid(n):
+    return StaggeredGrid(n=(n, n), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+
+
+def _vortex_u(g, A=0.05, s=0.08):
+    nx, ny = g.n
+    X, Y = np.meshgrid(np.arange(nx) * g.dx[0],
+                       np.arange(ny) * g.dx[1], indexing="ij")
+    psi = A * np.exp(-((X - 0.5) ** 2 + (Y - 0.5) ** 2) / s ** 2)
+    u = (np.roll(psi, -1, 1) - psi) / g.dx[1]
+    v = -(np.roll(psi, -1, 0) - psi) / g.dx[0]
+    return jnp.asarray(u), jnp.asarray(v)
+
+
+def test_composite_projection_exact():
+    g = _grid(32)
+    box = FineBox(lo=(8, 8), shape=(16, 16))
+    proj = CompositeProjection(g, box, tol=1e-12, m=30, restarts=20)
+    rng = np.random.default_rng(0)
+    uc = tuple(jnp.asarray(rng.standard_normal(g.n)) * 0.1
+               for _ in range(2))
+    uf = tuple(jnp.asarray(rng.standard_normal(
+        (box.fine_n[0] + (1 if d == 0 else 0),
+         box.fine_n[1] + (1 if d == 1 else 0)))) * 0.1 for d in range(2))
+    uc = scatter_box_mac_to_coarse(uc, restrict_mac(uf), box)
+    uc2, uf2, _, _ = proj.project(uc, uf)
+    dc = jnp.where(proj._covered, 0.0, stencils.divergence(uc2, g.dx))
+    df = _box_mac_divergence(uf2, proj.dx_f)
+    assert float(jnp.max(jnp.abs(dc))) < 1e-10
+    assert float(jnp.max(jnp.abs(df))) < 1e-10
+
+
+def test_initialize_div_free_composite():
+    g = _grid(32)
+    box = FineBox(lo=(8, 8), shape=(16, 16))
+    integ = TwoLevelINS(g, box, mu=0.005)
+    st = integ.initialize(_vortex_u(g))
+    assert float(integ.max_divergence(st)) < 1e-12
+
+
+def _uniform_explicit_run(n, T, steps, mu):
+    """Uniform-grid run with the SAME explicit time discretization as
+    TwoLevelINS, so the comparison isolates the spatial composite."""
+    g = _grid(n)
+    u = _vortex_u(g)
+    dt = T / steps
+
+    def step(u, _):
+        lap = stencils.laplacian_vel(u, g.dx)
+        nc = convective_rate(u, g.dx, "centered")
+        us = tuple(c + dt * (-a + mu * l)
+                   for c, a, l in zip(u, nc, lap))
+        un, _ = fft.project_divergence_free(us, g.dx)
+        return un, None
+
+    u, _ = jax.lax.scan(step, u, None, length=steps)
+    return u
+
+
+def test_vortex_matches_uniform_fine():
+    """Compact vortex inside the box: the refined region must be much
+    closer to uniform-fine than uniform-coarse is (measured: 7x)."""
+    T, steps, mu = 0.25, 400, 0.002
+    u64 = _uniform_explicit_run(64, T, steps, mu)
+    u32 = _uniform_explicit_run(32, T, steps, mu)
+
+    g = _grid(32)
+    box = FineBox(lo=(8, 8), shape=(16, 16))
+    integ = TwoLevelINS(g, box, rho=1.0, mu=mu, proj_tol=1e-11)
+    st = integ.initialize(_vortex_u(g))
+    st = advance_two_level(integ, st, T / steps, steps)
+    assert float(integ.max_divergence(st)) < 1e-9
+
+    # u-faces of the box region on the uniform-64 grid
+    err_2lev = float(jnp.max(jnp.abs(
+        st.uf[0] - u64[0][16:49, 16:48])))
+    # coarse u-face value ~ mean of the two coincident fine faces
+    u_ref_avg = 0.5 * (u64[0][16:50:2, 16:48:2]
+                       + u64[0][16:50:2, 17:48:2])
+    err_c32 = float(jnp.max(jnp.abs(u32[0][8:25, 8:24] - u_ref_avg)))
+    assert err_2lev < 0.35 * err_c32, (err_2lev, err_c32)
+    umax = float(jnp.max(jnp.abs(u64[0])))
+    assert err_2lev < 0.02 * umax, (err_2lev, umax)
+
+
+def test_membrane_in_refined_box_tracks_uniform_fine():
+    """Membrane inside the fine box: two-level IB marker trajectories
+    match the uniform-fine IB run ~200x closer than uniform-coarse
+    (measured 6.5e-6 vs 1.5e-3 at these parameters)."""
+    struct = make_circle_membrane(64, 0.15, (0.5, 0.5), stiffness=2.0,
+                                  aspect=1.2, rest_length_factor=0.7)
+    X0 = jnp.asarray(struct.vertices)
+    dt, steps = 5e-4, 300
+
+    g = _grid(32)
+    box = FineBox(lo=(8, 8), shape=(16, 16))
+    ib = IBMethod(struct.force_specs(dtype=jnp.float64), kernel="IB_4")
+    integ = TwoLevelIBINS(g, box, ib, rho=1.0, mu=0.02, proj_tol=1e-10)
+    st = integ.initialize(X0)
+    a0 = float(polygon_area(st.X))
+    st = advance_two_level_ib(integ, st, dt, steps)
+    assert float(integ.core.max_divergence(st.fluid)) < 1e-9
+    assert abs(float(polygon_area(st.X)) - a0) / a0 < 5e-4
+
+    def uniform_run(n):
+        gu = _grid(n)
+        ins = INSStaggeredIntegrator(gu, rho=1.0, mu=0.02,
+                                     convective_op_type="centered",
+                                     dtype=jnp.float64)
+        iu = IBExplicitIntegrator(
+            ins, IBMethod(struct.force_specs(dtype=jnp.float64)),
+            scheme="midpoint")
+        su = iu.initialize(X0)
+        return advance_ib(iu, su, dt, steps)
+
+    fine = uniform_run(64)
+    coarse = uniform_run(32)
+    err_2lev = float(jnp.max(jnp.abs(st.X - fine.X)))
+    err_c = float(jnp.max(jnp.abs(coarse.X - fine.X)))
+    assert err_2lev < 0.05 * err_c, (err_2lev, err_c)
+
+
+def test_box_from_markers_tags_structure():
+    g = _grid(64)
+    struct = make_circle_membrane(32, 0.1, (0.4, 0.6), stiffness=1.0)
+    box = box_from_markers(g, struct.vertices, pad=4)
+    box.validate(g)
+    # structure strictly inside with >= pad-1 coarse cells of margin
+    Xn = struct.vertices
+    for d in range(2):
+        c = Xn[:, d] / g.dx[d]
+        assert box.lo[d] <= c.min() - 3
+        assert box.hi[d] >= c.max() + 3
+    assert all(s % 2 == 0 for s in box.shape)
