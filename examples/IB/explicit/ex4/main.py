@@ -4,7 +4,10 @@
 Run:  python examples/IB/explicit/ex4/main.py [input3d] [restart_dir step]
 Multi-device: the Eulerian grid shards over all visible devices
 automatically when more than one device is present (spatial domain
-decomposition, SURVEY.md §2.3 S1).
+decomposition + the S2 sharded marker transfers).
+
+The advance/viz/restart/health loop is the shared HierarchyDriver
+skeleton (T13); this file is config + callbacks only.
 """
 
 import os
@@ -20,6 +23,7 @@ import numpy as np  # noqa: E402
 from ibamr_tpu.models.shell3d import build_shell_example, shell_volume  # noqa: E402
 from ibamr_tpu.utils import MetricsLogger, TimerManager, parse_input_file  # noqa: E402
 from ibamr_tpu.utils.checkpoint import restore_checkpoint, save_checkpoint  # noqa: E402
+from ibamr_tpu.utils.hierarchy_driver import HierarchyDriver, RunConfig  # noqa: E402
 
 
 def main(argv):
@@ -49,41 +53,47 @@ def main(argv):
                                                   step=int(argv[3]))
         print(f"restarted from {argv[2]} at step {start_step}")
 
-    dt = ins_db.get_float("dt")
-    num_steps = ins_db.get_int("num_steps")
-    viz_int = main_db.get_int("viz_dump_interval", 0)
-    rst_int = main_db.get_int("restart_interval", 0)
     viz_dir = main_db.get_string("viz_dirname", "viz_ex4")
     rst_dir = main_db.get_string("restart_dirname", "restart_ex4")
     os.makedirs(viz_dir, exist_ok=True)
-
     geo = db.get_database_with_default("CartesianGeometry")
     x_lo = geo.get_array("x_lo", [0.0, 0.0, 0.0])
     x_up = geo.get_array("x_up", [1.0, 1.0, 1.0])
     center = tuple(0.5 * (lo + hi) for lo, hi in zip(x_lo, x_up))
+
+    viz_int = main_db.get_int("viz_dump_interval", 0)
+    cfg = RunConfig(
+        dt=ins_db.get_float("dt"),
+        num_steps=ins_db.get_int("num_steps"),
+        viz_dump_interval=viz_int,
+        restart_interval=main_db.get_int("restart_interval", 0),
+        health_interval=min(20, viz_int) if viz_int else 20)
+
     tm = TimerManager.instance()
-    with MetricsLogger(main_db.get_string("log_file"), echo=True) as metrics:
-        step = start_step
-        while step < num_steps:
-            chunk = min(viz_int or 20, num_steps - step)
-            with tm.scope("IB::advanceHierarchy"):
-                for _ in range(chunk):
-                    state = step_fn(state, dt)
-                jax.block_until_ready(state.X)
-            step += chunk
-            metrics.log({
+    with MetricsLogger(main_db.get_string("log_file"), echo=True) as log:
+
+        def metrics_fn(s, step):
+            rec = {
                 "step": step,
-                "t": state.ins.t,
-                "volume": shell_volume(state.X, center),
-                "ke": integ.ins.kinetic_energy(state.ins),
-                "max_div": integ.ins.max_divergence(state.ins),
-                "cfl_dt": integ.ins.cfl_dt(state.ins),
-            })
-            if viz_int:
-                np.savetxt(os.path.join(viz_dir, f"markers.{step:06d}.csv"),
-                           np.asarray(state.X), delimiter=",")
-            if rst_int and step % rst_int == 0:
-                save_checkpoint(rst_dir, state, step)
+                "t": s.ins.t,
+                "volume": shell_volume(s.X, center),
+                "ke": integ.ins.kinetic_energy(s.ins),
+                "max_div": integ.ins.max_divergence(s.ins),
+                "cfl_dt": integ.ins.cfl_dt(s.ins),
+            }
+            log.log(rec)
+            return rec
+
+        def viz_fn(s, step):
+            np.savetxt(os.path.join(viz_dir, f"markers.{step:06d}.csv"),
+                       np.asarray(s.X), delimiter=",")
+
+        driver = HierarchyDriver(
+            integ, cfg, step_fn=step_fn, metrics_fn=metrics_fn,
+            viz_fn=viz_fn,
+            checkpoint_fn=lambda s, k: save_checkpoint(rst_dir, s, k),
+            timer=tm, timer_name="IB::advanceHierarchy")
+        state = driver.run(state, start_step=start_step)
     print(tm.report())
     return state
 

@@ -1,8 +1,9 @@
 """Readers/writers for the reference's structure input file formats.
 
-Reference parity: ``IBStandardInitializer`` (P10) parsing of
-``<name>.vertex/.spring/.beam/.target`` files (formats per SURVEY.md
-Appendix B):
+Reference parity: ``IBStandardInitializer`` (P10) parsing of the full
+``<name>.*`` menu (formats per SURVEY.md Appendix B; the .rod/.anchor/
+.mass/.source/.inst column layouts are the canonical-IBAMR convention,
+tagged [U] because the reference mount was empty at survey time):
 
   name.vertex: line 1 = N;  then N lines  "x y [z]"
   name.spring: line 1 = M;  then M lines  "idx0 idx1 stiffness rest_length
@@ -10,11 +11,24 @@ Appendix B):
   name.beam:   line 1 = M;  then M lines  "prev mid next bend_rigidity
                                            [curvature components]"
   name.target: line 1 = M;  then M lines  "idx stiffness [damping]"
+  name.rod:    line 1 = M;  then M lines  "curr next ds a1 a2 a3 b1 b2 b3
+                                           kappa1 kappa2 tau"
+               (a* = bending/twist moduli, b* = shear/stretch moduli,
+                kappa1/kappa2/tau = intrinsic curvature + twist —
+                IBRodForceSpec's 10 material parameters, P12)
+  name.anchor: line 1 = M;  then M lines  "idx"            (pinned nodes)
+  name.mass:   line 1 = M;  then M lines  "idx mass [stiffness]"
+               (massive nodes + penalty spring constant, P14)
+  name.source: line 1 = M;  then M lines  "idx strength"   (P14 sources)
+  name.inst:   line 1 = M;  then M lines  "idx meter_idx node_idx"
+               (flow-meter membership, P13)
 
 Indices are 0-based within the structure, as in the reference. Parsing is
 host-side (NumPy); the result converts to device SoA specs via
-``StructureData.force_specs()``. A writer is provided for tests and
-example generation (the reference ships pre-generated files instead).
+``StructureData.force_specs()`` and the ``rod_specs / source_specs /
+meter_specs / mass_arrays / anchors_to_targets`` helpers. A writer is
+provided for tests and example generation (the reference ships
+pre-generated files instead).
 """
 
 from __future__ import annotations
@@ -112,6 +126,11 @@ class StructureData:
     springs: Optional[np.ndarray] = None   # (M, >=4): idx0 idx1 k L0 [fcn]
     beams: Optional[np.ndarray] = None     # (M, >=4): prev mid next c [C0...]
     targets: Optional[np.ndarray] = None   # (M, >=2): idx kappa [damping]
+    rods: Optional[np.ndarray] = None      # (M, 12): curr next + 10 params
+    anchors: Optional[np.ndarray] = None   # (M, 1): idx
+    masses: Optional[np.ndarray] = None    # (M, >=2): idx mass [stiffness]
+    sources: Optional[np.ndarray] = None   # (M, 2): idx strength
+    inst: Optional[np.ndarray] = None      # (M, 3): idx meter node
     index_offset: int = 0                # global offset when concatenating
     extra: dict = field(default_factory=dict)
 
@@ -156,6 +175,93 @@ class StructureData:
         return forces.ForceSpecs(springs=springs, beams=beams,
                                  targets=targets)
 
+    # -- converters for the extended-file menu -------------------------------
+    def rod_specs(self, dtype=None):
+        """Device rod specs (P12) from the .rod table."""
+        from ibamr_tpu.ops import rods as rods_mod
+        import jax.numpy as jnp
+
+        if self.rods is None or not len(self.rods):
+            return None
+        if dtype is None:
+            dtype = jnp.float32
+        r = self.rods
+        off = self.index_offset
+        return rods_mod.make_rods(
+            r[:, 0].astype(np.int32) + off,
+            r[:, 1].astype(np.int32) + off,
+            b=r[:, 3:6], s=r[:, 6:9], kappa=r[:, 9:12],
+            ds=r[:, 2], dtype=dtype)
+
+    def source_specs(self, dtype=None):
+        """Device source specs (P14) from the .source table."""
+        from ibamr_tpu.ops import sources as src_mod
+        import jax.numpy as jnp
+
+        if self.sources is None or not len(self.sources):
+            return None
+        if dtype is None:
+            dtype = jnp.float32
+        s = self.sources
+        return src_mod.make_sources(
+            s[:, 0].astype(np.int32) + self.index_offset, s[:, 1],
+            dtype=dtype)
+
+    def meter_specs(self, closed=True, dtype=None):
+        """Instrument meters (P13) from the .inst table: group rows by
+        meter index, order nodes within each meter by node index."""
+        from ibamr_tpu import instruments
+        import jax.numpy as jnp
+
+        if self.inst is None or not len(self.inst):
+            return None
+        if dtype is None:
+            dtype = jnp.float32
+        tbl = self.inst
+        loops = []
+        for m in sorted(set(int(v) for v in tbl[:, 1])):
+            rows = tbl[tbl[:, 1] == m]
+            order = np.argsort(rows[:, 2])
+            loops.append([int(v) + self.index_offset
+                          for v in rows[order, 0]])
+        return instruments.make_meters(loops, closed=closed, dtype=dtype)
+
+    def mass_arrays(self, dtype=np.float64):
+        """(mass(N,), penalty_stiffness(N,)) dense arrays for the
+        penalty-IB integrator (P14) from the .mass table."""
+        if self.masses is None or not len(self.masses):
+            return None
+        N = self.num_markers
+        mass = np.zeros(N, dtype=dtype)
+        kappa = np.zeros(N, dtype=dtype)
+        m = self.masses
+        idx = m[:, 0].astype(np.int64)
+        mass[idx] = m[:, 1]
+        kappa[idx] = m[:, 2] if m.shape[1] > 2 else 0.0
+        return mass, kappa
+
+    def anchors_to_targets(self, stiffness: float) -> None:
+        """Realize anchored nodes (.anchor) as stiff target points at
+        their initial positions, appended to the .target table — the
+        fixed-point semantics of the reference's anchor nodes within
+        the SoA force framework."""
+        if self.anchors is None or not len(self.anchors):
+            return
+        rows = np.zeros((len(self.anchors), 2))
+        rows[:, 0] = self.anchors[:, 0]
+        rows[:, 1] = float(stiffness)
+        self.anchors = None       # consume: repeated calls must not
+        #                           stack duplicate pin springs
+        if self.targets is None:
+            self.targets = rows
+        else:
+            w = max(self.targets.shape[1], 2)
+            old = np.zeros((len(self.targets), w))
+            old[:, :self.targets.shape[1]] = self.targets
+            new = np.zeros((len(rows), w))
+            new[:, :2] = rows
+            self.targets = np.concatenate([old, new])
+
 
 def read_structure(basename: str, dim: Optional[int] = None) -> StructureData:
     """Read ``basename.vertex`` (+ optional .spring/.beam/.target)."""
@@ -173,6 +279,31 @@ def read_structure(basename: str, dim: Optional[int] = None) -> StructureData:
         data.beams = _read_table(basename + ".beam", 4, 4 + d, "beam")
     if os.path.exists(basename + ".target"):
         data.targets = _read_table(basename + ".target", 2, 3, "target")
+    if os.path.exists(basename + ".rod"):
+        data.rods = _read_table(basename + ".rod", 12, 12, "rod")
+    if os.path.exists(basename + ".anchor"):
+        data.anchors = _read_table(basename + ".anchor", 1, 1, "anchor")
+    if os.path.exists(basename + ".mass"):
+        data.masses = _read_table(basename + ".mass", 2, 3, "mass")
+    if os.path.exists(basename + ".source"):
+        data.sources = _read_table(basename + ".source", 2, 2, "source")
+    if os.path.exists(basename + ".inst"):
+        data.inst = _read_table(basename + ".inst", 3, 3, "inst")
+    # index sanity across every table that names vertices
+    n = verts.shape[0]
+    for attr, ext, col in (
+            ("springs", "spring", (0, 1)), ("beams", "beam", (0, 1, 2)),
+            ("targets", "target", (0,)), ("rods", "rod", (0, 1)),
+            ("anchors", "anchor", (0,)), ("masses", "mass", (0,)),
+            ("sources", "source", (0,)), ("inst", "inst", (0,))):
+        tbl = getattr(data, attr)
+        if tbl is not None and len(tbl):
+            for c in col:
+                bad = (tbl[:, c] < 0) | (tbl[:, c] >= n)
+                if bad.any():
+                    raise ValueError(
+                        f"{basename}.{ext}: vertex index out of range "
+                        f"(N={n}) on entry {int(np.argmax(bad))}")
     return data
 
 
@@ -198,3 +329,20 @@ def write_structure(basename: str, data: StructureData) -> None:
         _dump(basename + ".target", data.targets,
               lambda r: f"{int(r[0])} " +
               " ".join(f"{v:.17g}" for v in r[1:]))
+    if data.rods is not None:
+        _dump(basename + ".rod", data.rods,
+              lambda r: f"{int(r[0])} {int(r[1])} " +
+              " ".join(f"{v:.17g}" for v in r[2:]))
+    if data.anchors is not None:
+        _dump(basename + ".anchor", data.anchors,
+              lambda r: f"{int(r[0])}")
+    if data.masses is not None:
+        _dump(basename + ".mass", data.masses,
+              lambda r: f"{int(r[0])} " +
+              " ".join(f"{v:.17g}" for v in r[1:]))
+    if data.sources is not None:
+        _dump(basename + ".source", data.sources,
+              lambda r: f"{int(r[0])} {r[1]:.17g}")
+    if data.inst is not None:
+        _dump(basename + ".inst", data.inst,
+              lambda r: f"{int(r[0])} {int(r[1])} {int(r[2])}")

@@ -17,9 +17,11 @@ reference's Fortran loops).
 
 from __future__ import annotations
 
+import functools
 import math
-from typing import Callable, Dict, Tuple, Union
+from typing import Callable, Dict, List, Tuple, Union
 
+import numpy as np
 import jax.numpy as jnp
 
 KernelFn = Callable[[jnp.ndarray], jnp.ndarray]
@@ -71,11 +73,74 @@ def _make_bspline(order: int) -> KernelFn:
     return phi
 
 
+@functools.lru_cache(maxsize=4)
+def _ib6_table(m: int = 4096) -> np.ndarray:
+    """Tabulate the 6-point C3 kernel on a uniform r-grid over [-3, 3].
+
+    Construction (the Bao-Kaye-Peskin 2016 family): for each fractional
+    position x the six weights are the smooth solution of
+      m0 = 1,  m1 = 0,  m2 = K,  m3 = 0,  sum_even = sum_odd = 1/2,
+      sum of squares = C,
+    with the published second-moment constant K = 59/60 - sqrt(29)/20
+    and C pinned by phi(+-3) = 0. Solved numerically at import (host
+    numpy) with branch continuity tracked in x; the result is positive,
+    C3-smooth, continuous across stencil windows to 1e-15, and
+    satisfies the moment conditions to machine precision (validated in
+    tests/test_delta_kernels.py). Evaluation then interpolates this
+    table linearly (interp error ~ (1/m)^2 ~ 6e-8 at the default m)."""
+    Kc = 59.0 / 60.0 - math.sqrt(29.0) / 20.0
+    s6 = np.arange(-2, 4)
+    even = (s6 % 2 == 0).astype(float)
+
+    def lin(x):
+        p = x - s6
+        A = np.stack([np.ones(6), p, p * p, p ** 3, even])
+        b = np.array([1.0, 0.0, Kc, 0.0, 0.5])
+        w0, *_ = np.linalg.lstsq(A, b, rcond=None)
+        _, _, Vt = np.linalg.svd(A)
+        return w0, Vt[-1]
+
+    w0, v = lin(0.0)
+    t0 = -w0[5] / v[5]
+    C = (w0 + t0 * v) @ (w0 + t0 * v)
+
+    xs = np.linspace(0.0, 1.0, m, endpoint=False)
+    W = np.zeros((m, 6))
+    prev = w0 + t0 * v
+    for i, x in enumerate(xs):
+        w0, v = lin(x)
+        t = math.sqrt(max(C - w0 @ w0, 0.0))
+        ca, cb = w0 + t * v, w0 - t * v
+        W[i] = ca if (np.linalg.norm(ca - prev)
+                      <= np.linalg.norm(cb - prev)) else cb
+        prev = W[i]
+    # segment j of the table covers r in [-3+j, -2+j): the weight of
+    # point s = 3-j at fractional position x = r - (-3+j) ... = r + 3 - j
+    tab = np.zeros(6 * m + 1)
+    for j in range(6):
+        tab[j * m:(j + 1) * m] = W[:, 5 - j]
+    tab[-1] = 0.0
+    return tab
+
+
+def _phi_ib6(r: jnp.ndarray) -> jnp.ndarray:
+    tab = jnp.asarray(_ib6_table())
+    m = (tab.shape[0] - 1) // 6
+    t = (jnp.clip(r, -3.0, 3.0) + 3.0) * m
+    i = jnp.clip(jnp.floor(t).astype(jnp.int32), 0, 6 * m - 1)
+    frac = t - i
+    lo = jnp.take(tab, i)
+    hi = jnp.take(tab, i + 1)
+    val = lo + frac * (hi - lo)
+    return jnp.where(jnp.abs(r) < 3.0, val, 0.0).astype(r.dtype)
+
+
 _KERNELS: Dict[str, KernelSpec] = {
     "PIECEWISE_LINEAR": (2, _phi_piecewise_linear),
     "COSINE": (4, _phi_cosine),
     "IB_3": (3, _phi_ib3),
     "IB_4": (4, _phi_ib4),
+    "IB_6": (6, _phi_ib6),
     "BSPLINE_2": (2, _make_bspline(2)),
     "BSPLINE_3": (3, _make_bspline(3)),
     "BSPLINE_4": (4, _make_bspline(4)),
@@ -83,26 +148,76 @@ _KERNELS: Dict[str, KernelSpec] = {
     "BSPLINE_6": (6, _make_bspline(6)),
 }
 
+# Composite B-spline kernels (the Lee-Griffith divergence-compatible
+# family, LEInteractor [vintage: modern]): a MAC velocity component uses
+# order n along its OWN (face-normal) axis and order n-1 along the
+# tangential axes; cell-centered fields use order n on every axis.
+# (Axis assignment is the [U] interpretation of SURVEY.md's
+# COMPOSITE_BSPLINE row — the reference mount was empty.)
+_COMPOSITE: Dict[str, Tuple[int, int]] = {
+    "COMPOSITE_BSPLINE_32": (3, 2),
+    "COMPOSITE_BSPLINE_43": (4, 3),
+    "COMPOSITE_BSPLINE_54": (5, 4),
+}
+
 Kernel = Union[str, KernelSpec]
+
+
+def is_composite(kernel: Kernel) -> bool:
+    return isinstance(kernel, str) and kernel.upper() in _COMPOSITE
+
+
+def get_kernel_axes(kernel: Kernel, centering, dim: int
+                    ) -> List[KernelSpec]:
+    """Per-axis (support, phi) specs for a field of the given centering
+    ("cell" or the int component of a MAC velocity). Plain kernels are
+    isotropic; composite B-splines pick order by normal/tangential."""
+    if is_composite(kernel):
+        n_norm, n_tang = _COMPOSITE[kernel.upper()]
+        if isinstance(centering, int):
+            return [get_kernel(f"BSPLINE_{n_norm}") if d == centering
+                    else get_kernel(f"BSPLINE_{n_tang}")
+                    for d in range(dim)]
+        if centering != "cell":
+            # an explicit offset tuple carries no normal-axis identity;
+            # guessing would silently drop the normal/tangential split
+            raise ValueError(
+                "composite B-spline kernels need centering='cell' or an "
+                "int MAC component (to identify the normal axis); got "
+                f"{centering!r}")
+        return [get_kernel(f"BSPLINE_{n_norm}")] * dim
+    return [get_kernel(kernel)] * dim
 
 
 def get_kernel(kernel: Kernel) -> KernelSpec:
     """Resolve a kernel name (or a user-defined ``(support, phi)`` pair —
-    the USER_DEFINED path of the reference)."""
+    the USER_DEFINED path of the reference). Composite kernels are
+    anisotropic; resolve them per axis with :func:`get_kernel_axes`
+    (the MXU-bucketed and sharded engines are isotropic-only and reject
+    them here)."""
     if isinstance(kernel, str):
+        name = kernel.upper()
+        if name in _COMPOSITE:
+            raise ValueError(
+                f"{kernel!r} is a composite (anisotropic) kernel; use "
+                "get_kernel_axes / the scatter interaction path")
         try:
-            return _KERNELS[kernel.upper()]
+            return _KERNELS[name]
         except KeyError:
             raise ValueError(
-                f"unknown delta kernel {kernel!r}; have {sorted(_KERNELS)}")
+                f"unknown delta kernel {kernel!r}; have "
+                f"{sorted(_KERNELS) + sorted(_COMPOSITE)}")
     support, fn = kernel
     return int(support), fn
 
 
 def stencil_size(kernel: Kernel) -> int:
-    """Reference parity: LEInteractor::getStencilSize."""
+    """Reference parity: LEInteractor::getStencilSize (max over axes
+    for composite kernels)."""
+    if is_composite(kernel):
+        return max(_COMPOSITE[kernel.upper()])
     return get_kernel(kernel)[0]
 
 
 def available_kernels() -> Tuple[str, ...]:
-    return tuple(sorted(_KERNELS))
+    return tuple(sorted(_KERNELS) + sorted(_COMPOSITE))

@@ -32,7 +32,7 @@ from typing import Optional, Sequence, Tuple
 import jax.numpy as jnp
 
 from ibamr_tpu.grid import StaggeredGrid
-from ibamr_tpu.ops.delta import Kernel, get_kernel
+from ibamr_tpu.ops.delta import Kernel, get_kernel_axes
 
 Vel = Tuple[jnp.ndarray, ...]
 
@@ -70,14 +70,17 @@ def _axis_weights_indices(xi: jnp.ndarray, n: int, support: int, phi):
 
 
 def _stencil(grid: StaggeredGrid, X: jnp.ndarray, centering, kernel: Kernel):
-    """Flattened linear indices (N, s^dim) and tensor-product weights."""
-    support, phi = get_kernel(kernel)
+    """Flattened linear indices (N, prod(s_d)) and tensor-product
+    weights. Kernels may be anisotropic (composite B-splines pick a
+    different order along the face-normal axis, delta.get_kernel_axes)."""
+    specs = get_kernel_axes(kernel, centering, grid.dim)
     offsets = _centering_offsets(grid, centering)
     dim = grid.dim
     idxs, ws = [], []
     for d in range(dim):
+        support_d, phi_d = specs[d]
         xi = (X[:, d] - grid.x_lo[d]) / grid.dx[d] - offsets[d]
-        idx, w = _axis_weights_indices(xi, grid.n[d], support, phi)
+        idx, w = _axis_weights_indices(xi, grid.n[d], support_d, phi_d)
         idxs.append(idx)
         ws.append(w)
 
@@ -86,10 +89,11 @@ def _stencil(grid: StaggeredGrid, X: jnp.ndarray, centering, kernel: Kernel):
     lin = idxs[0]
     wgt = ws[0]
     for d in range(1, dim):
+        s_d = specs[d][0]
         lin = lin[..., :, None] * grid.n[d] + idxs[d].reshape(
-            (N,) + (1,) * (lin.ndim - 1) + (support,))
+            (N,) + (1,) * (lin.ndim - 1) + (s_d,))
         wgt = wgt[..., :, None] * ws[d].reshape(
-            (N,) + (1,) * (wgt.ndim - 1) + (support,))
+            (N,) + (1,) * (wgt.ndim - 1) + (s_d,))
     return lin.reshape(N, -1), wgt.reshape(N, -1)
 
 

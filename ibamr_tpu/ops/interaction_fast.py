@@ -313,6 +313,36 @@ def _extract_tiles(geom, grid, f: jnp.ndarray) -> jnp.ndarray:
 
 # -- public ops --------------------------------------------------------------
 
+def bucketed_channel(b: Buckets, F: jnp.ndarray) -> jnp.ndarray:
+    """Scatter a per-marker channel (N,) into the bucket-slot layout
+    (B, cap) of ``b`` (shared by the MXU and Pallas spread engines)."""
+    Ff = jnp.zeros((b.Xb.shape[0] * b.Xb.shape[1] + 1,), dtype=F.dtype)
+    return Ff.at[b.slot_of_marker].add(F)[:-1].reshape(b.wb.shape)
+
+
+def spread_overflow_fallbacks(out: jnp.ndarray, b: Buckets,
+                              F: jnp.ndarray, X: jnp.ndarray,
+                              grid: StaggeredGrid, centering,
+                              kernel: Kernel) -> jnp.ndarray:
+    """Accumulate the overflow markers' contribution into ``out``:
+    compact scatter for the buffered overflow, exact full-scatter when
+    the buffer itself overflowed (shared by both bucketed engines)."""
+    def compact(o):
+        return interaction.spread(F[b.o_idx], grid, X[b.o_idx],
+                                  centering=centering, kernel=kernel,
+                                  weights=b.o_w, out=o)
+
+    def full(o):
+        return interaction.spread(F, grid, X, centering=centering,
+                                  kernel=kernel, weights=b.w_overflow,
+                                  out=o)
+
+    return jax.lax.cond(
+        b.exceeded, full,
+        lambda o: jax.lax.cond(b.any_overflow, compact,
+                               lambda oo: oo, o), out)
+
+
 def spread_bucketed(geom: BucketGeometry, grid: StaggeredGrid,
                     b: Buckets, F: jnp.ndarray, X: jnp.ndarray,
                     centering, kernel: Kernel) -> jnp.ndarray:
@@ -324,33 +354,15 @@ def spread_bucketed(geom: BucketGeometry, grid: StaggeredGrid,
     per-call weights argument here, so stale-weights misuse is
     impossible (ADVICE round 1)."""
     inv_vol = 1.0 / math.prod(grid.dx)
-    # bucketed F with the same layout as Xb
-    N = F.shape[0]
-    Ff = jnp.zeros((b.Xb.shape[0] * b.Xb.shape[1] + 1,), dtype=F.dtype)
-    Ff = Ff.at[b.slot_of_marker].add(F)[:-1].reshape(b.wb.shape)
+    Ff = bucketed_channel(b, F)
     A, Wlast = _tile_weights(geom, grid, b, centering, kernel)
     A = A * (Ff * b.wb * inv_vol)[..., None]
     T = jnp.einsum("bmp,bmz->bpz", A, Wlast,
                    precision=jax.lax.Precision.HIGHEST)
     out = _overlap_add(geom, grid, T.reshape(
         (T.shape[0],) + tuple(geom.width) + (grid.n[grid.dim - 1],)))
-
-    def compact(out):
-        return interaction.spread(F[b.o_idx], grid, X[b.o_idx],
-                                  centering=centering, kernel=kernel,
-                                  weights=b.o_w, out=out)
-
-    def full(out):
-        # overflow buffer itself overflowed (pathological clustering):
-        # exact but slow full-scatter fallback
-        return interaction.spread(F, grid, X, centering=centering,
-                                  kernel=kernel, weights=b.w_overflow,
-                                  out=out)
-
-    return jax.lax.cond(
-        b.exceeded, full,
-        lambda o: jax.lax.cond(b.any_overflow, compact,
-                               lambda oo: oo, o), out)
+    return spread_overflow_fallbacks(out, b, F, X, grid, centering,
+                                     kernel)
 
 
 def interpolate_bucketed(geom: BucketGeometry, grid: StaggeredGrid,

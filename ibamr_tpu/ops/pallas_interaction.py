@@ -1,0 +1,166 @@
+"""Pallas TPU kernel for the bucketed spread (SURVEY.md §7.3 #1).
+
+Reference parity: the Fortran ``lagrangian_ib4_spread_3d`` inner loop
+(T2/P23) — the north-star scatter. The framework already has two
+formulations: the XLA scatter-add (ops.interaction) and the MXU
+one-hot-matmul (ops.interaction_fast). This module adds the bespoke
+TPU schedule SURVEY.md names as hard-part #1: markers bucketed by tile
+(reusing interaction_fast's Buckets layout), then ONE Pallas program
+per tile accumulating its (W*W, NZ) dense tile in VMEM — per-marker
+rank-1 outer-product updates on VPU-friendly (169, NZ) shapes, with no
+(B, 169, NZ)-sized HBM intermediate and no scatter at all. The
+periodic overlap-add of the finished tiles reuses
+interaction_fast._overlap_add (pure data movement).
+
+Weights evaluate the SAME delta.get_kernel functions at ALL W tile
+offsets — compact support zeroes everything outside the true stencil,
+so no dynamic slicing (and none of its TPU layout constraints) is
+needed inside the kernel.
+
+Correctness oracle: bitwise-level agreement with ops.interaction.spread
+(tested in interpret mode on CPU).
+
+Hardware status (2026-07-30): this container's TPU relay routes Pallas
+through a remote-compile service that stalls on this kernel (plain XLA
+programs compile fine), so compiled-TPU timings could not be captured
+this round; the kernel stays OFF the default paths (scatter and the
+MXU bucketed formulation remain the production spread engines) until a
+environment with local Pallas compilation can profile it. The intended
+schedule advantage over the MXU path: identical FLOPs but no
+(B, 169, NZ) HBM intermediate and no overlap-add traffic.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ibamr_tpu.grid import StaggeredGrid
+from ibamr_tpu.ops.delta import Kernel, get_kernel
+from ibamr_tpu.ops.interaction import _centering_offsets
+from ibamr_tpu.ops.interaction_fast import (BucketGeometry, Buckets,
+                                            _overlap_add, _phi_safe)
+
+
+def _spread_kernel_3d(geom: BucketGeometry, grid: StaggeredGrid,
+                      offs, phi, interpret: bool):
+    """Build the per-tile Pallas program (static closure)."""
+    W0, W1 = geom.width
+    nz = grid.n[2]
+    nb0, nb1 = geom.nblk
+    t0, t1 = geom.tile
+    cap = geom.cap
+    lo = grid.x_lo
+    dx = grid.dx
+
+    def kernel(Xb_ref, coef_ref, out_ref):
+        b = pl.program_id(0)
+        bx = b // nb1
+        by = b % nb1
+        x0 = bx * t0 - 1          # tile footprint origin (cells)
+        y0 = by * t1 - 1
+
+        ox = jax.lax.broadcasted_iota(jnp.float32, (W0, 1), 0)
+        oy = jax.lax.broadcasted_iota(jnp.float32, (W1, 1), 0)
+        kz = jax.lax.broadcasted_iota(jnp.float32, (1, nz), 1)
+
+        def body(i, acc):
+            x = Xb_ref[0, i, 0]
+            y = Xb_ref[0, i, 1]
+            z = Xb_ref[0, i, 2]
+            c = coef_ref[0, i, 0]
+            xi = (x - lo[0]) / dx[0] - offs[0]
+            yi = (y - lo[1]) / dx[1] - offs[1]
+            zi = (z - lo[2]) / dx[2] - offs[2]
+            # wrapped distances (periodic) at every tile/axis offset
+            tx = xi - (x0 + ox)
+            tx = tx - jnp.round(tx / grid.n[0]) * grid.n[0]
+            ty = yi - (y0 + oy)
+            ty = ty - jnp.round(ty / grid.n[1]) * grid.n[1]
+            tz = zi - kz
+            tz = tz - jnp.round(tz / nz) * nz
+            wx = phi(tx)                      # (W0, 1)
+            wy = phi(ty)                      # (W1, 1)
+            wz = phi(tz)                      # (1, nz)
+            wxy = (wx * wy.T).reshape(W0 * W1, 1)
+            return acc + wxy * (c * wz)       # rank-1 VPU update
+
+        acc = jnp.zeros((W0 * W1, nz), dtype=out_ref.dtype)
+        out_ref[0] = jax.lax.fori_loop(0, cap, body, acc)
+
+    def call(Xb, coef):
+        B = Xb.shape[0]
+        # trailing singleton keeps the TPU block-shape rule happy (last
+        # two dims must divide (8, 128) or equal the array dims)
+        coef = coef[:, :, None]
+        return pl.pallas_call(
+            kernel,
+            grid=(B,),
+            in_specs=[
+                pl.BlockSpec((1, cap, 3), lambda b: (b, 0, 0)),
+                pl.BlockSpec((1, cap, 1), lambda b: (b, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, W0 * W1, nz), lambda b: (b, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((B, W0 * W1, nz), Xb.dtype),
+            interpret=interpret,
+        )(Xb, coef)
+
+    return call
+
+
+class PallasSpread3D:
+    """Spread engine: interaction_fast bucketing + a Pallas tile kernel.
+
+    3D only (the north-star configuration); falls back is the caller's
+    concern. ``interpret=True`` runs the same program in the Pallas
+    interpreter (CPU testing).
+    """
+
+    def __init__(self, grid: StaggeredGrid, kernel: Kernel = "IB_4",
+                 tile: int = 8, cap: int = 256,
+                 interpret: Optional[bool] = None):
+        from ibamr_tpu.ops.interaction_fast import make_geometry
+
+        if grid.dim != 3:
+            raise ValueError("PallasSpread3D is 3D-only")
+        self.grid = grid
+        self.kernel: Kernel = kernel
+        self.geom = make_geometry(grid, kernel, tile=tile, cap=cap)
+        if interpret is None:
+            interpret = jax.default_backend() == "cpu"
+        self.interpret = bool(interpret)
+        support, phi0 = get_kernel(kernel)
+        self._phi = _phi_safe(phi0, support)
+
+    def spread(self, F: jnp.ndarray, X: jnp.ndarray, centering,
+               b: Buckets) -> jnp.ndarray:
+        """Spread one scalar channel (N,) -> grid field, exact vs
+        ops.interaction.spread for in-capacity markers (overflow flows
+        through the caller's fallback exactly as in interaction_fast)."""
+        from ibamr_tpu.ops.interaction_fast import (
+            bucketed_channel, spread_overflow_fallbacks)
+
+        geom = self.geom
+        grid = self.grid
+        inv_vol = 1.0 / math.prod(grid.dx)
+        offs = _centering_offsets(grid, centering)
+        coef = bucketed_channel(b, F) * b.wb * inv_vol
+        # accumulate in the caller's dtype (f32 states stay f32; an f64
+        # caller keeps full precision end to end)
+        call = _spread_kernel_3d(geom, grid, offs, self._phi,
+                                 self.interpret)
+        T = call(b.Xb.astype(coef.dtype), coef)
+        T = T.reshape((T.shape[0],) + tuple(geom.width) + (grid.n[2],))
+        out = _overlap_add(geom, grid, T.astype(F.dtype))
+        return spread_overflow_fallbacks(out, b, F, X, grid, centering,
+                                         self.kernel)
+
+    def spread_vel(self, F: jnp.ndarray, X: jnp.ndarray,
+                   b: Buckets) -> tuple:
+        return tuple(self.spread(F[:, d], X, d, b)
+                     for d in range(self.grid.dim))

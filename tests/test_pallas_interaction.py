@@ -1,0 +1,89 @@
+"""Pallas bucketed-spread kernel (SURVEY.md §7.3 hard-part #1, P23).
+
+Runs in Pallas interpret mode on the CPU suite; the compiled-TPU path
+is exercised by ``bench.py`` (spread_paths comparison). Oracle: the
+XLA scatter path (ops.interaction.spread) at f32 tolerances.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ibamr_tpu.grid import StaggeredGrid
+from ibamr_tpu.ops import interaction
+from ibamr_tpu.ops.interaction_fast import FastInteraction
+from ibamr_tpu.ops.pallas_interaction import PallasSpread3D
+
+
+def _setup(n=(16, 16, 32), N=300, cap=64, seed=0):
+    rng = np.random.default_rng(seed)
+    g = StaggeredGrid(n=n, x_lo=(0.0,) * 3, x_up=(1.0,) * 3)
+    X = jnp.asarray(rng.uniform(0, 1, (N, 3)), dtype=jnp.float32)
+    F = jnp.asarray(rng.standard_normal((N, 3)), dtype=jnp.float32)
+    fast = FastInteraction(g, kernel="IB_4", tile=8, cap=cap)
+    ps = PallasSpread3D(g, kernel="IB_4", tile=8, cap=cap,
+                        interpret=True)
+    return g, X, F, fast, ps
+
+
+def test_pallas_spread_matches_scatter():
+    g, X, F, fast, ps = _setup()
+    b = fast.buckets(X)
+    f_pl = ps.spread_vel(F, X, b)
+    f_ref = interaction.spread_vel(F, g, X, kernel="IB_4")
+    for a, c in zip(f_ref, f_pl):
+        scale = float(jnp.max(jnp.abs(a)))
+        np.testing.assert_allclose(np.asarray(c), np.asarray(a),
+                                   atol=2e-6 * scale)
+
+
+def test_pallas_spread_cell_centering():
+    g, X, F, fast, ps = _setup(seed=1)
+    b = fast.buckets(X)
+    f_pl = ps.spread(F[:, 0], X, "cell", b)
+    f_ref = interaction.spread(F[:, 0], g, X, centering="cell",
+                               kernel="IB_4")
+    scale = float(jnp.max(jnp.abs(f_ref)))
+    np.testing.assert_allclose(np.asarray(f_pl), np.asarray(f_ref),
+                               atol=2e-6 * scale)
+
+
+def test_pallas_spread_overflow_fallback():
+    """Tile overflow flows through the compact scatter fallback."""
+    rng = np.random.default_rng(2)
+    g = StaggeredGrid(n=(16, 16, 16), x_lo=(0.0,) * 3, x_up=(1.0,) * 3)
+    N = 200
+    # cluster into one tile
+    X = jnp.asarray(np.stack([rng.uniform(0.0, 0.05, N),
+                              rng.uniform(0.0, 0.05, N),
+                              rng.uniform(0, 1, N)], axis=1),
+                    dtype=jnp.float32)
+    F = jnp.asarray(rng.standard_normal((N, 3)), dtype=jnp.float32)
+    fast = FastInteraction(g, kernel="IB_4", tile=8, cap=16)
+    ps = PallasSpread3D(g, kernel="IB_4", tile=8, cap=16, interpret=True)
+    b = fast.buckets(X)
+    assert bool(b.any_overflow)
+    f_pl = ps.spread_vel(F, X, b)
+    f_ref = interaction.spread_vel(F, g, X, kernel="IB_4")
+    for a, c in zip(f_ref, f_pl):
+        scale = float(jnp.max(jnp.abs(a)))
+        np.testing.assert_allclose(np.asarray(c), np.asarray(a),
+                                   atol=2e-6 * scale)
+
+
+def test_pallas_total_force_conserved():
+    """Spreading conserves the total force integral exactly (zeroth
+    moment of the kernel), including across tile seams."""
+    g, X, F, fast, ps = _setup(seed=3)
+    b = fast.buckets(X)
+    f_pl = ps.spread_vel(F, X, b)
+    for d in range(3):
+        np.testing.assert_allclose(
+            float(jnp.sum(f_pl[d])) * g.cell_volume,
+            float(jnp.sum(F[:, d])), rtol=1e-5)
+
+
+def test_pallas_rejects_2d():
+    g = StaggeredGrid(n=(16, 16), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    with pytest.raises(ValueError, match="3D"):
+        PallasSpread3D(g)
