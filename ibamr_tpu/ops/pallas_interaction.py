@@ -35,7 +35,6 @@ from __future__ import annotations
 import math
 from typing import Optional
 
-import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl

@@ -45,6 +45,46 @@ def _path_str(path) -> str:
     return "/".join(parts) if parts else "_root"
 
 
+SCHEMA_VERSION = 1
+
+
+def state_schema(state: Any) -> Dict[str, Any]:
+    """Schema fingerprint of a state pytree: every leaf's path, shape
+    and dtype (the putToDatabase registry analog). Stored in the
+    metadata sidecar so restore can DIAGNOSE refactored state layouts
+    instead of silently orphaning old checkpoints (VERDICT round 1,
+    weak #9)."""
+    leaves = jax.tree_util.tree_flatten_with_path(state)[0]
+    return {
+        "version": SCHEMA_VERSION,
+        "leaves": {
+            _path_str(p): [list(np.shape(l)),
+                           str(getattr(l, "dtype", np.asarray(l).dtype))]
+            for p, l in leaves},
+    }
+
+
+def _schema_diff(stored: Dict[str, Any], current: Dict[str, Any]) -> str:
+    s_leaves = stored.get("leaves", {})
+    c_leaves = current["leaves"]
+    lines = []
+    for k in sorted(set(s_leaves) - set(c_leaves)):
+        lines.append(f"  checkpoint-only leaf: {k} {s_leaves[k]}")
+    for k in sorted(set(c_leaves) - set(s_leaves)):
+        lines.append(f"  template-only leaf:   {k} {c_leaves[k]}")
+    for k in sorted(set(c_leaves) & set(s_leaves)):
+        if s_leaves[k][0] != c_leaves[k][0]:
+            lines.append(f"  shape mismatch at {k}: checkpoint "
+                         f"{s_leaves[k][0]} vs template {c_leaves[k][0]}")
+        elif (np.dtype(s_leaves[k][1]).kind
+              != np.dtype(c_leaves[k][1]).kind):
+            # width changes (f64 checkpoint -> f32 run) are a supported
+            # cast; KIND changes (float -> int) are a refactor
+            lines.append(f"  dtype-kind mismatch at {k}: checkpoint "
+                         f"{s_leaves[k][1]} vs template {c_leaves[k][1]}")
+    return "\n".join(lines)
+
+
 def save_checkpoint(directory: str, state: Any, step: int,
                     metadata: Optional[Dict[str, Any]] = None,
                     keep: int = 3) -> str:
@@ -59,6 +99,7 @@ def save_checkpoint(directory: str, state: Any, step: int,
     np.savez(fname, **arrays)
     meta = dict(metadata or {})
     meta["step"] = step
+    meta["schema"] = state_schema(state)
     with open(fname.replace(".npz", ".json"), "w") as f:
         json.dump(meta, f)
     _prune(directory, keep)
@@ -113,6 +154,17 @@ def restore_checkpoint(directory: str, template: Any,
             metadata = json.load(f)
 
     paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
+    # schema validation: a refactored state NamedTuple produces a clear
+    # named diff instead of a bare missing-key error deep in the loop
+    stored_schema = metadata.get("schema")
+    if stored_schema is not None:
+        diff = _schema_diff(stored_schema, state_schema(template))
+        if diff:
+            raise ValueError(
+                f"checkpoint {fname} was written with an incompatible "
+                f"state schema (version "
+                f"{stored_schema.get('version', '?')}):\n{diff}")
+
     new_leaves = []
     for path, leaf in paths_and_leaves:
         key = _path_str(path)

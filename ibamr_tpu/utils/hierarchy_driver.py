@@ -144,7 +144,11 @@ class HierarchyDriver:
                                 cfg.regrid_interval) if i]
         while step < cfg.num_steps:
             if cfg.cfl is not None:
-                dt = min(cfg.dt, self.integ.cfl_dt(state, cfg.cfl))
+                # float() keeps dt a weak-typed Python scalar whichever
+                # branch wins (a device-scalar cfl_dt would otherwise
+                # flip the aval and retrace)
+                dt = float(min(cfg.dt,
+                               self.integ.cfl_dt(state, cfg.cfl)))
             n = min(cfg.health_interval, cfg.num_steps - step)
             for i in cadences:               # land exactly on cadences
                 n = min(n, i - step % i)
@@ -172,4 +176,8 @@ class HierarchyDriver:
             if (cfg.regrid_interval and self.regrid_fn is not None
                     and step % cfg.regrid_interval == 0):
                 state = self.regrid_fn(state, step)
+        # always visualize the final configuration, aligned or not
+        if (cfg.viz_dump_interval and self.viz_fn is not None
+                and step % cfg.viz_dump_interval != 0):
+            self.viz_fn(state, step)
         return state
