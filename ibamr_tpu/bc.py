@@ -25,19 +25,39 @@ import jax.numpy as jnp
 PERIODIC = "periodic"
 DIRICHLET = "dirichlet"
 NEUMANN = "neumann"
-_KINDS = (PERIODIC, DIRICHLET, NEUMANN)
+ROBIN = "robin"
+_KINDS = (PERIODIC, DIRICHLET, NEUMANN, ROBIN)
 
 
 @dataclasses.dataclass(frozen=True)
 class SideBC:
-    """One side's condition. ``value`` is the (constant) boundary datum g;
-    spatially-varying data enters via the solvers' RHS lifting hooks."""
+    """One side's condition a*Q + b*dQ/dn = g (the full Robin form of
+    the reference's RobinBcCoefStrategy). ``kind`` names the common
+    cases; ``robin`` uses the explicit (a, b). ``value`` is the
+    CONSTANT boundary datum g; spatially-varying g arrives at fill time
+    through the ``bdry_data`` argument of the ghost-fill/Laplacian
+    functions (the muParserRobinBcCoefs analog), keeping this dataclass
+    hashable static metadata."""
     kind: str = PERIODIC
     value: float = 0.0
+    a: float = 1.0             # robin coefficient on Q
+    b: float = 0.0             # robin coefficient on dQ/dn
 
     def __post_init__(self):
         if self.kind not in _KINDS:
             raise ValueError(f"unknown BC kind {self.kind!r}")
+        if self.kind == ROBIN and self.a == 0.0 and self.b == 0.0:
+            raise ValueError("robin BC needs a != 0 or b != 0")
+
+    def coeffs(self):
+        """(a, b) of a*Q + b*dQ/dn = g for any non-periodic kind."""
+        if self.kind == DIRICHLET:
+            return 1.0, 0.0
+        if self.kind == NEUMANN:
+            return 0.0, 1.0
+        if self.kind == ROBIN:
+            return self.a, self.b
+        raise ValueError("periodic side has no Robin coefficients")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -66,6 +86,13 @@ def neumann_axis(lo: float = 0.0, hi: float = 0.0) -> AxisBC:
     return AxisBC(SideBC(NEUMANN, lo), SideBC(NEUMANN, hi))
 
 
+def robin_axis(a: float, b: float, lo: float = 0.0,
+               hi: float = 0.0) -> AxisBC:
+    """a*Q + b*dQ/dn = g on both sides (g = lo/hi constants)."""
+    return AxisBC(SideBC(ROBIN, lo, a=a, b=b),
+                  SideBC(ROBIN, hi, a=a, b=b))
+
+
 @dataclasses.dataclass(frozen=True)
 class DomainBC:
     """Per-axis BCs for one scalar (cell-centered) field, or one velocity
@@ -86,27 +113,41 @@ class DomainBC:
 # ---------------------------------------------------------------------------
 
 def _ghost_values_cc(Q: jnp.ndarray, axis: int, side: SideBC, h: float,
-                     lo_side: bool) -> jnp.ndarray:
-    """One ghost layer for a cell-centered field beyond a wall: linear
-    extrapolation through the boundary-face value (dirichlet) or slope
-    (neumann). Outward normal points lo-ward on the lo side."""
+                     lo_side: bool, g=None) -> jnp.ndarray:
+    """One ghost layer for a cell-centered field beyond a wall, from
+    the Robin condition a*Q + b*dQ/dn = g evaluated at the boundary
+    face with Q_face ~ (ghost + interior)/2 and dQ/dn ~
+    (ghost - interior)/h (outward normal; the ghost lies outward on
+    both sides):
+
+        ghost = (g - interior*(a/2 - b/h)) / (a/2 + b/h)
+
+    which reduces to 2g - i (dirichlet) and i + g*h (neumann). ``g``
+    optionally overrides the constant ``side.value`` with a
+    spatially-varying array broadcastable to the face slab."""
     idx = [slice(None)] * Q.ndim
     idx[axis] = slice(0, 1) if lo_side else slice(-1, None)
     interior = Q[tuple(idx)]
-    if side.kind == DIRICHLET:
-        return 2.0 * side.value - interior
-    if side.kind == NEUMANN:
-        # dQ/dn = g with n the OUTWARD normal: on either side the ghost
-        # lies outward of the interior cell, so (ghost - interior)/h = g.
-        return interior + h * side.value
-    raise ValueError(side.kind)
+    a, b = side.coeffs()
+    denom = 0.5 * a + b / h
+    if denom == 0.0:
+        raise ValueError(
+            f"ill-posed ghost fill: a/2 + b/h == 0 for {side}")
+    if g is None:
+        g = side.value
+    return (g - interior * (0.5 * a - b / h)) / denom
 
 
 def fill_ghosts_cc(Q: jnp.ndarray, bc: DomainBC,
-                   dx: Sequence[float]) -> jnp.ndarray:
+                   dx: Sequence[float],
+                   bdry_data: Optional[dict] = None) -> jnp.ndarray:
     """Pad a cell-centered field with ONE ghost layer per side honoring
     the BCs (periodic wrap or wall extrapolation). Output shape n+2 per
-    axis; stencil consumers slice the interior back out."""
+    axis; stencil consumers slice the interior back out.
+
+    ``bdry_data``: optional {(axis, side0or1): array} of
+    spatially-varying boundary data g (each broadcastable to the face
+    slab of that side), overriding the per-side constants."""
     out = Q
     for d, axbc in enumerate(bc.axes):
         if axbc.periodic:
@@ -116,17 +157,53 @@ def fill_ghosts_cc(Q: jnp.ndarray, bc: DomainBC,
             hi_idx[d] = slice(0, 1)
             lo_ghost, hi_ghost = out[tuple(lo_idx)], out[tuple(hi_idx)]
         else:
-            lo_ghost = _ghost_values_cc(out, d, axbc.lo, dx[d], True)
-            hi_ghost = _ghost_values_cc(out, d, axbc.hi, dx[d], False)
+            g_lo = g_hi = None
+            if bdry_data is not None:
+                g_lo = bdry_data.get((d, 0))
+                g_hi = bdry_data.get((d, 1))
+            lo_ghost = _ghost_values_cc(out, d, axbc.lo, dx[d], True,
+                                        g=_pad_bdry(g_lo, out, d))
+            hi_ghost = _ghost_values_cc(out, d, axbc.hi, dx[d], False,
+                                        g=_pad_bdry(g_hi, out, d))
         out = jnp.concatenate([lo_ghost, out, hi_ghost], axis=d)
     return out
 
 
+def _pad_bdry(g, out, d):
+    """Boundary-data arrays are sized for the UNPADDED grid; make them
+    broadcast against the partially-padded array: align axes the numpy
+    way (prepend singleton axes up to full rank), let extent-1 axes
+    broadcast, and edge-pad true-extent axes that earlier loop
+    iterations already grew by 2 ghost layers."""
+    if g is None or not hasattr(g, "ndim") or g.ndim == 0:
+        return g
+    if g.ndim > out.ndim:
+        raise ValueError(
+            f"boundary data has rank {g.ndim} > field rank {out.ndim}")
+    g = jnp.reshape(g, (1,) * (out.ndim - g.ndim) + tuple(g.shape))
+    target = list(out.shape)
+    target[d] = 1
+    if list(g.shape) == target:
+        return g
+    pads = []
+    for gs, ts in zip(g.shape, target):
+        if gs == ts or gs == 1:
+            pads.append((0, 0))
+        elif gs == ts - 2:
+            pads.append((1, 1))
+        else:
+            raise ValueError(
+                f"boundary data shape {g.shape} incompatible with face "
+                f"slab {tuple(target)}")
+    return jnp.pad(g, pads, mode="edge")
+
+
 def laplacian_cc(Q: jnp.ndarray, bc: DomainBC,
-                 dx: Sequence[float]) -> jnp.ndarray:
+                 dx: Sequence[float],
+                 bdry_data: Optional[dict] = None) -> jnp.ndarray:
     """BC-aware 2d+1-point Laplacian of a cell-centered field (ghost-fill
     then difference; XLA fuses the pad into the stencil)."""
-    G = fill_ghosts_cc(Q, bc, dx)
+    G = fill_ghosts_cc(Q, bc, dx, bdry_data=bdry_data)
     dim = Q.ndim
     center = tuple(slice(1, -1) for _ in range(dim))
     out = jnp.zeros_like(Q)

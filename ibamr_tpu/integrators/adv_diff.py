@@ -57,6 +57,9 @@ class TransportedQuantity(NamedTuple):
     convective_op_type: str = "upwind"   # "centered" | "upwind" | "none"
     init: Optional[Callable] = None      # Q0(coords) -> array
     bc: Optional[object] = None          # bc.DomainBC or None
+    # spatially-varying boundary data {(axis, side): array} overriding
+    # the per-side constants (muParserRobinBcCoefs analog, T9)
+    bdry_data: Optional[dict] = None
 
 
 def convective_flux_divergence(Q: jnp.ndarray, u: Vel,
@@ -155,9 +158,12 @@ class AdvDiffSemiImplicitIntegrator:
                     # kappa/2 (A Q^n) + kappa b = kappa/2 lap_bc(Q^n)
                     # + kappa/2 b on the RHS of (1/dt - kappa/2 A).
                     b_vec = bc_mod.laplacian_cc(
-                        jnp.zeros_like(Q), q.bc, dx)
+                        jnp.zeros_like(Q), q.bc, dx,
+                        bdry_data=q.bdry_data)
                     rhs = rhs + 0.5 * q.kappa * (
-                        bc_mod.laplacian_cc(Q, q.bc, dx) + b_vec)
+                        bc_mod.laplacian_cc(Q, q.bc, dx,
+                                            bdry_data=q.bdry_data)
+                        + b_vec)
                 else:
                     from ibamr_tpu.ops import stencils
                     rhs = rhs + 0.5 * q.kappa * stencils.laplacian(Q, dx)

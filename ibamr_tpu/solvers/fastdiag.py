@@ -38,12 +38,19 @@ from typing import Sequence, Tuple
 import jax.numpy as jnp
 import numpy as np
 
-from ibamr_tpu.bc import DIRICHLET, NEUMANN, AxisBC, DomainBC
+from ibamr_tpu.bc import AxisBC, DomainBC
 from ibamr_tpu.grid import StaggeredGrid
 
 
 def laplacian_1d_cc(n: int, h: float, axbc: AxisBC) -> np.ndarray:
-    """BC-modified tridiagonal for a cell-centered axis (homogeneous)."""
+    """BC-modified tridiagonal for a cell-centered axis (homogeneous).
+
+    The boundary row uses the Robin reflection of bc._ghost_values_cc:
+    homogeneous ghost = r * interior with r = -(a/2 - b/h)/(a/2 + b/h),
+    so the end diagonal is (-2 + r)/h^2 — which reproduces the classic
+    -3 (dirichlet, r=-1) and -1 (neumann, r=+1) rows and covers general
+    a*Q + b*dQ/dn = g (T9). The modification is diagonal-only, so the
+    matrix stays symmetric and eigh applies."""
     A = np.zeros((n, n))
     inv = 1.0 / (h * h)
     for i in range(n):
@@ -53,12 +60,14 @@ def laplacian_1d_cc(n: int, h: float, axbc: AxisBC) -> np.ndarray:
         if i < n - 1:
             A[i, i + 1] = inv
     for side, i in ((axbc.lo, 0), (axbc.hi, n - 1)):
-        if side.kind == DIRICHLET:
-            A[i, i] = -3.0 * inv
-        elif side.kind == NEUMANN:
-            A[i, i] = -1.0 * inv
-        else:
+        if side.kind == "periodic":
             raise ValueError("periodic axis has no 1D matrix")
+        a, b = side.coeffs()
+        denom = 0.5 * a + b / h
+        if denom == 0.0:
+            raise ValueError(f"ill-posed boundary row for {side}")
+        r = -(0.5 * a - b / h) / denom
+        A[i, i] = (-2.0 + r) * inv
     return A
 
 

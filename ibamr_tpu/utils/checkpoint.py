@@ -76,13 +76,25 @@ def _schema_diff(stored: Dict[str, Any], current: Dict[str, Any]) -> str:
         if s_leaves[k][0] != c_leaves[k][0]:
             lines.append(f"  shape mismatch at {k}: checkpoint "
                          f"{s_leaves[k][0]} vs template {c_leaves[k][0]}")
-        elif (np.dtype(s_leaves[k][1]).kind
-              != np.dtype(c_leaves[k][1]).kind):
+        elif _dtype_kind(s_leaves[k][1]) != _dtype_kind(c_leaves[k][1]):
             # width changes (f64 checkpoint -> f32 run) are a supported
             # cast; KIND changes (float -> int) are a refactor
             lines.append(f"  dtype-kind mismatch at {k}: checkpoint "
                          f"{s_leaves[k][1]} vs template {c_leaves[k][1]}")
     return "\n".join(lines)
+
+
+def _dtype_kind(name: str) -> str:
+    """numpy kind, with ml_dtypes extensions (bfloat16 etc., numpy kind
+    'V') classified as floating so f32 <-> bf16 restarts stay legal."""
+    import jax.numpy as jnp
+
+    try:
+        if jnp.issubdtype(jnp.dtype(name), jnp.floating):
+            return "f"
+    except TypeError:
+        pass
+    return np.dtype(name).kind
 
 
 def save_checkpoint(directory: str, state: Any, step: int,

@@ -1,0 +1,139 @@
+"""General Robin BCs + spatially-varying boundary data (T9 upgrade,
+SURVEY.md §2.1 T9 — RobinBcCoefStrategy / muParserRobinBcCoefs).
+"""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ibamr_tpu import bc as bc_mod
+from ibamr_tpu.bc import (AxisBC, DomainBC, SideBC, dirichlet_axis,
+                          neumann_axis, robin_axis)
+from ibamr_tpu.grid import StaggeredGrid
+from ibamr_tpu.integrators.adv_diff import (AdvDiffSemiImplicitIntegrator,
+                                            TransportedQuantity,
+                                            advance_adv_diff)
+from ibamr_tpu.solvers.fastdiag import FastDiagSolver
+
+
+def test_robin_reduces_to_dirichlet_neumann():
+    """robin(1,0) == dirichlet and robin(0,1) == neumann ghosts."""
+    rng = np.random.default_rng(0)
+    Q = jnp.asarray(rng.standard_normal((8, 8)))
+    dx = (0.1, 0.1)
+    for named, a, b in ((dirichlet_axis(0.7, -0.3), 1.0, 0.0),
+                        (neumann_axis(0.7, -0.3), 0.0, 1.0)):
+        rob = robin_axis(a, b, lo=0.7, hi=-0.3)
+        g_named = bc_mod.fill_ghosts_cc(
+            Q, DomainBC(axes=(named, AxisBC())), dx)
+        g_rob = bc_mod.fill_ghosts_cc(
+            Q, DomainBC(axes=(rob, AxisBC())), dx)
+        np.testing.assert_allclose(np.asarray(g_rob), np.asarray(g_named),
+                                   atol=1e-13)
+
+
+def test_robin_ghost_satisfies_condition():
+    """The filled ghost reproduces a*Q_face + b*dQ/dn = g discretely."""
+    rng = np.random.default_rng(1)
+    Q = jnp.asarray(rng.standard_normal((8, 6)))
+    h = 0.125
+    a, b, g = 2.0, 0.5, 1.3
+    dom = DomainBC(axes=(robin_axis(a, b, lo=g, hi=g), AxisBC()))
+    G = bc_mod.fill_ghosts_cc(Q, dom, (h, h))
+    ghost_lo = np.asarray(G[0, 1:-1])
+    int_lo = np.asarray(Q[0, :])
+    q_face = 0.5 * (ghost_lo + int_lo)
+    dqdn = (ghost_lo - int_lo) / h      # outward normal on the lo side
+    np.testing.assert_allclose(a * q_face + b * dqdn, g, atol=1e-12)
+
+
+def test_fastdiag_robin_solve_consistent():
+    """(alpha + beta lap_robin) solve(rhs) == rhs through the
+    BC-aware Laplacian (the homogeneous-operator contract)."""
+    rng = np.random.default_rng(2)
+    g = StaggeredGrid(n=(16, 12), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    dom = DomainBC(axes=(robin_axis(1.5, 0.25), AxisBC()))
+    solver = FastDiagSolver(g, dom, ("cc", "cc"))
+    rhs = jnp.asarray(rng.standard_normal(g.n))
+    alpha, beta = 3.0, -0.7
+    Q = solver.solve(rhs, alpha, beta)
+    resid = alpha * Q + beta * bc_mod.laplacian_cc(Q, dom, g.dx)
+    np.testing.assert_allclose(np.asarray(resid), np.asarray(rhs),
+                               atol=1e-10)
+
+
+def _steady_robin_error(n):
+    """Steady diffusion with Robin walls on x: exact Q = x(1-x) + 1
+    satisfies 2*Q + 1*dQ/dn = 1 on both walls with source 2*kappa."""
+    g = StaggeredGrid(n=(n, 8), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    kappa = 1.0
+    dom = DomainBC(axes=(robin_axis(2.0, 1.0, lo=1.0, hi=1.0), AxisBC()))
+    q = TransportedQuantity(name="Q", kappa=kappa,
+                            source=lambda c, t, Q: 2.0 * kappa,
+                            convective_op_type="none", bc=dom)
+    integ = AdvDiffSemiImplicitIntegrator(g, [q], dtype=jnp.float64)
+    st = integ.initialize([jnp.ones(g.n, dtype=jnp.float64)])
+    st = advance_adv_diff(integ, st, 0.05, 400)      # t = 20: steady
+    xc = (np.arange(n) + 0.5) / n
+    exact = xc * (1.0 - xc) + 1.0
+    return float(np.max(np.abs(np.asarray(st.Q[0][:, 0]) - exact)))
+
+
+def test_robin_steady_state_convergence():
+    e16 = _steady_robin_error(16)
+    e32 = _steady_robin_error(32)
+    assert e32 < 2e-3, (e16, e32)
+    assert e16 / e32 > 3.0, (e16, e32)        # ~2nd order
+
+
+def _laplace_dirichlet_strip_error(n):
+    """Laplace equation on [0,1]^2 with spatially-varying Dirichlet
+    data g(x) = sin(pi x) on the y=0 wall (zero on the others):
+    exact Q = sin(pi x) sinh(pi (1-y)) / sinh(pi)."""
+    g = StaggeredGrid(n=(n, n), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    dom = DomainBC(axes=(dirichlet_axis(), dirichlet_axis()))
+    xc = (jnp.arange(n, dtype=jnp.float64) + 0.5) / n
+    gdata = {(1, 0): jnp.sin(math.pi * xc)[:, None]}
+    solver = FastDiagSolver(g, dom, ("cc", "cc"))
+    # lap Q = 0 with inhomogeneous data: A Q = -b, b = lap_bc(0)
+    b_vec = bc_mod.laplacian_cc(jnp.zeros(g.n, dtype=jnp.float64), dom,
+                                g.dx, bdry_data=gdata)
+    Q = solver.solve(-b_vec, 0.0, 1.0)
+    X, Y = np.meshgrid((np.arange(n) + 0.5) / n,
+                       (np.arange(n) + 0.5) / n, indexing="ij")
+    exact = np.sin(np.pi * X) * np.sinh(np.pi * (1 - Y)) / np.sinh(np.pi)
+    return float(np.max(np.abs(np.asarray(Q) - exact)))
+
+
+def test_spatially_varying_dirichlet_laplace():
+    e32 = _laplace_dirichlet_strip_error(32)
+    e64 = _laplace_dirichlet_strip_error(64)
+    assert e64 < 1.5e-3, (e32, e64)
+    assert e32 / e64 > 3.0, (e32, e64)        # 2nd order
+
+
+def test_time_varying_data_through_integrator():
+    """bdry_data threads through the CN lifting: a heated strip drives
+    the interior above the initial value only near the strip."""
+    n = 32
+    g = StaggeredGrid(n=(n, n), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    dom = DomainBC(axes=(AxisBC(), dirichlet_axis()))
+    xc = (jnp.arange(n, dtype=jnp.float64) + 0.5) / n
+    strip = jnp.where(jnp.abs(xc - 0.5) < 0.1, 1.0, 0.0)[:, None]
+    q = TransportedQuantity(name="T", kappa=0.05,
+                            convective_op_type="none", bc=dom,
+                            bdry_data={(1, 0): strip})
+    integ = AdvDiffSemiImplicitIntegrator(g, [q], dtype=jnp.float64)
+    st = integ.initialize([jnp.zeros(g.n, dtype=jnp.float64)])
+    st = advance_adv_diff(integ, st, 0.01, 200)
+    Q = np.asarray(st.Q[0])
+    assert Q[n // 2, 0] > 0.5          # hot under the strip
+    assert abs(Q[2, 0]) < 0.05         # cold away from it
+    assert Q[n // 2, 0] > Q[n // 2, n // 2] > Q[n // 2, -1] >= -1e-6
+
+
+def test_robin_requires_nonzero_coeffs():
+    with pytest.raises(ValueError, match="robin"):
+        SideBC("robin", 0.0, a=0.0, b=0.0)
