@@ -210,9 +210,14 @@ class CompositeProjection:
     module docstring), with velocity correction + interface sync."""
 
     def __init__(self, grid: StaggeredGrid, box: FineBox,
-                 tol: float = 1e-9, m: int = 24, restarts: int = 8):
+                 tol: float = 1e-9, m: int = 24, restarts: int = 8,
+                 preconditioner=None):
         self.grid = grid
         self.box = box
+        # optional external preconditioner (e.g. the FAC V-cycle of
+        # ibamr_tpu.solvers.fac.FACCompositePoisson) replacing the
+        # default FFT+fastdiag level-solver combination
+        self._external_precond = preconditioner
         self.dx = grid.dx
         self.dx_f = tuple(h / box.ratio for h in grid.dx)
         self.tol = float(tol)
@@ -308,6 +313,8 @@ class CompositeProjection:
         return (out_c, lap_f)
 
     def _precondition(self, r):
+        if self._external_precond is not None:
+            return self._external_precond(r)
         r_c, r_f = r
         diag = sum(2.0 / h ** 2 for h in self.dx)
         p_c = fft.solve_poisson_periodic(r_c, self.dx)

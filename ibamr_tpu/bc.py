@@ -60,6 +60,19 @@ class SideBC:
         raise ValueError("periodic side has no Robin coefficients")
 
 
+def ghost_reflect_coeff(side: SideBC, h: float) -> float:
+    """ghost = c * interior under the HOMOGENEOUS condition
+    a*Q + b*dQ/dn = 0 discretized at the face (see _ghost_values_cc):
+    c = -(a/2 - b/h) / (a/2 + b/h). Shared by the ghost fill, the
+    fast-diagonalization 1D matrices, and the multigrid diagonals so
+    the smoothers always match the operator discretization."""
+    a, b = side.coeffs()
+    denom = 0.5 * a + b / h
+    if denom == 0.0:
+        raise ValueError(f"ill-posed ghost fill: a/2 + b/h == 0 for {side}")
+    return -(0.5 * a - b / h) / denom
+
+
 @dataclasses.dataclass(frozen=True)
 class AxisBC:
     lo: SideBC = SideBC()

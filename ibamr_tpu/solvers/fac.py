@@ -1,0 +1,117 @@
+"""Two-level FAC (Fast Adaptive Composite) multigrid preconditioner.
+
+Reference parity: ``FACPreconditioner`` + ``CCPoissonPointRelaxationFACOperator``
+(T8, SURVEY.md §2.1) — the V-cycle over AMR levels that smooths on the
+refined patch, solves a full-domain coarse correction (with the fine
+residual restricted underneath the patch — the defining FAC move), and
+interpolates the correction back through the coarse-fine interface.
+
+TPU-first shape: the fine patch is one dense box array, smoothing is
+masked red-black half-sweeps (whole-array stencils, no point loops), the
+coarse "bottom solve" is a :class:`~ibamr_tpu.solvers.multigrid.PoissonMultigrid`
+V-cycle (the hypre-level-solver analog), and the CF interpolation reuses
+the quadratic ghost machinery of :mod:`ibamr_tpu.amr`. The whole cycle is
+traceable, so it rides inside the jitted FGMRES solve of
+:class:`ibamr_tpu.amr_ins.CompositeProjection` as a drop-in ``M``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ibamr_tpu.amr import (FineBox, fill_fine_ghosts, prolong_cc,
+                           restrict_cc)
+from ibamr_tpu.amr_ins import _box_cc_laplacian as _box_lap
+from ibamr_tpu.bc import DomainBC
+from ibamr_tpu.solvers.multigrid import (PoissonMultigrid,
+                                         checkerboard_masks)
+
+Array = jnp.ndarray
+
+
+class FACCompositePoisson:
+    """FAC preconditioner for the two-level composite Poisson system of
+    :class:`ibamr_tpu.amr_ins.CompositeProjection` (residual pytree
+    ``(r_coarse, r_fine_box)``; covered coarse rows are decoupled
+    identity rows at Laplacian-diagonal scale).
+
+    ``precondition`` applies one FAC V(nu,nu)-cycle:
+
+    1. red-black smoothing of the patch correction (zero CF ghosts);
+    2. full-domain coarse MG V-cycle on the composite residual — the
+       covered region carries the RESTRICTED FINE residual;
+    3. CF interpolation of the coarse correction onto the patch;
+    4. post-smoothing with live CF ghosts from the coarse correction.
+    """
+
+    def __init__(self, coarse_shape, bc: DomainBC, dx, box: FineBox,
+                 nu: int = 2, mg: Optional[PoissonMultigrid] = None,
+                 dtype=jnp.float64):
+        self.box = box
+        self.bc = bc
+        self.dx = tuple(float(h) for h in dx)
+        self.dx_f = tuple(h / box.ratio for h in self.dx)
+        self.nu = int(nu)
+        dim = len(coarse_shape)
+        self.box_sl = tuple(slice(box.lo[a], box.hi[a])
+                            for a in range(dim))
+        covered = np.zeros(tuple(coarse_shape), dtype=bool)
+        covered[tuple(np.s_[box.lo[a]:box.hi[a]]
+                      for a in range(dim))] = True
+        self._covered = jnp.asarray(covered)
+        self.mg_c = mg if mg is not None else PoissonMultigrid(
+            coarse_shape, bc, self.dx,
+            dtype=jax.dtypes.canonicalize_dtype(dtype))
+        self._diag_c = sum(2.0 / h ** 2 for h in self.dx)
+        self._diag_f = sum(-2.0 / h ** 2 for h in self.dx_f)
+        self._masks = checkerboard_masks(box.fine_n)
+
+    def _smooth_fine(self, e_f: Array, r_f: Array,
+                     e_c: Optional[Array], sweeps: int) -> Array:
+        """Masked red-black relaxation of lap_f e_f = r_f on the patch.
+        ``e_c`` supplies CF ghosts (None = homogeneous zero ghosts)."""
+        fine_n = self.box.fine_n
+
+        def ghosted(e_f):
+            if e_c is None:
+                pad = [(1, 1)] * e_f.ndim
+                return jnp.pad(e_f, pad)
+            e_eff = e_c.at[self.box_sl].set(restrict_cc(e_f))
+            return fill_fine_ghosts(e_f, e_eff, self.box, ghost=1)
+
+        def sweep(_, e_f):
+            for mask in self._masks:
+                lap = _box_lap(ghosted(e_f), self.dx_f, fine_n)
+                e_f = e_f + jnp.where(mask, (r_f - lap) / self._diag_f,
+                                      0.0)
+            return e_f
+
+        return jax.lax.fori_loop(0, sweeps, sweep, e_f)
+
+    def precondition(self, r: Tuple[Array, Array]
+                     ) -> Tuple[Array, Array]:
+        r_c, r_f = r
+        # 1. patch pre-smoothing (zero ghosts: correction quantity)
+        e_f = self._smooth_fine(jnp.zeros_like(r_f), r_f, None, self.nu)
+        # 2. composite residual on the coarse level: restricted fine
+        #    residual underneath the patch — the FAC signature
+        pad = [(1, 1)] * e_f.ndim
+        res_f = r_f - _box_lap(jnp.pad(e_f, pad), self.dx_f,
+                               self.box.fine_n)
+        rr_c = r_c.at[self.box_sl].set(restrict_cc(res_f))
+        if self.mg_c.has_nullspace:
+            rr_c = rr_c - jnp.mean(rr_c)
+        e_c = self.mg_c.vcycle(jnp.zeros_like(rr_c), rr_c)
+        if self.mg_c.has_nullspace:
+            e_c = e_c - jnp.mean(e_c)
+        # 3. correction transfer: CF interpolation onto the patch
+        e_f = e_f + prolong_cc(e_c, self.box)
+        # 4. post-smoothing with live CF ghosts
+        e_f = self._smooth_fine(e_f, r_f, e_c, self.nu)
+        # covered coarse rows are decoupled -diag*phi identity rows
+        e_c_out = jnp.where(self._covered, -r_c / self._diag_c, e_c)
+        return (e_c_out, e_f)

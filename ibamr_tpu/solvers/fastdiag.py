@@ -38,7 +38,7 @@ from typing import Sequence, Tuple
 import jax.numpy as jnp
 import numpy as np
 
-from ibamr_tpu.bc import AxisBC, DomainBC
+from ibamr_tpu.bc import AxisBC, DomainBC, ghost_reflect_coeff
 from ibamr_tpu.grid import StaggeredGrid
 
 
@@ -62,11 +62,7 @@ def laplacian_1d_cc(n: int, h: float, axbc: AxisBC) -> np.ndarray:
     for side, i in ((axbc.lo, 0), (axbc.hi, n - 1)):
         if side.kind == "periodic":
             raise ValueError("periodic axis has no 1D matrix")
-        a, b = side.coeffs()
-        denom = 0.5 * a + b / h
-        if denom == 0.0:
-            raise ValueError(f"ill-posed boundary row for {side}")
-        r = -(0.5 * a - b / h) / denom
+        r = ghost_reflect_coeff(side, h)
         A[i, i] = (-2.0 + r) * inv
     return A
 

@@ -1,0 +1,384 @@
+"""Geometric multigrid for cell-centered Poisson/Helmholtz with general
+Robin boundary conditions, plus a two-level FAC composite preconditioner.
+
+Reference parity: the FAC-multigrid + hypre level-solver stack (T8,
+SURVEY.md §2.1) — ``FACPreconditioner`` V-cycles over
+``CCPoissonPointRelaxationFACOperator`` (red-black Gauss-Seidel
+smoothers, Fortran-kernel level relaxation) with hypre PFMG/SMG bottom
+solves (``CCPoissonHypreLevelSolver``) — rebuilt the TPU way:
+
+- **smoothing** is two masked Jacobi half-sweeps per red-black pass:
+  the full residual stencil is evaluated once per color and the update
+  applied through a checkerboard mask, so each sweep is a handful of
+  fused elementwise/stencil ops that XLA pipelines through the VPU (no
+  sequential point loop — the reference's F77 ``rbgs`` kernels become
+  whole-array ops);
+- **boundary conditions** enter through the ghost-fill arithmetic of
+  :mod:`ibamr_tpu.bc` and an analytically assembled diagonal (the
+  ghost-reflection coefficient folds into the boundary-cell diagonal),
+  so the same code path serves Dirichlet/Neumann/Robin/periodic — the
+  analog of the reference's RobinBcCoefStrategy-aware smoothers;
+- **grid transfer** is full-weighting restriction (2^d block mean) and
+  BC-aware piecewise-linear prolongation — strided reshapes, no
+  indirection;
+- the V-cycle recursion is unrolled at trace time (level shapes are
+  static), and the outer iteration is a ``lax.while_loop``, so a whole
+  ``solve`` compiles into one XLA computation usable inside jit/scan —
+  the analog of a PETSc KSP(richardson)+PCMG solve, minus the host
+  round-trips.
+
+Variable-coefficient problems (the reference's
+``VCSCViscousOperator``-class systems and ``PoissonSpecifications``
+with cell data D) are handled by rediscretized coarse operators: the
+cell diffusivity is block-mean coarsened per level and the operator
+applied in face-flux (conservative) form on every level.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ibamr_tpu.amr import restrict_cc
+from ibamr_tpu.bc import (AxisBC, DomainBC, SideBC, PERIODIC,
+                          fill_ghosts_cc, ghost_reflect_coeff)
+
+Array = jnp.ndarray
+
+
+def checkerboard_masks(shape) -> Tuple[Array, Array]:
+    """(red, black) boolean checkerboard masks for red-black sweeps."""
+    grids = jnp.meshgrid(*[jnp.arange(s) for s in shape], indexing="ij")
+    parity = sum(grids) % 2
+    return parity == 0, parity == 1
+
+
+# ---------------------------------------------------------------------------
+# BC utilities
+# ---------------------------------------------------------------------------
+
+def homogeneous_bc(bc: DomainBC) -> DomainBC:
+    """The same BC kinds with zero boundary data — correction equations
+    on coarse levels satisfy the homogeneous version of the fine BCs."""
+    axes = []
+    for ax in bc.axes:
+        axes.append(AxisBC(
+            dataclasses.replace(ax.lo, value=0.0),
+            dataclasses.replace(ax.hi, value=0.0)))
+    return DomainBC(axes=tuple(axes))
+
+
+_reflect_coeff = ghost_reflect_coeff
+
+
+def _nullspace(bc: DomainBC) -> bool:
+    """True when the Poisson operator has the constant nullspace: every
+    axis periodic or pure-Neumann on both sides."""
+    for ax in bc.axes:
+        if ax.periodic:
+            continue
+        for s in (ax.lo, ax.hi):
+            a, b = s.coeffs()
+            if a != 0.0:
+                return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Level operator: alpha*Q + div(D grad Q), D face-averaged from cell data
+# (D=None means constant-coefficient beta*lap)
+# ---------------------------------------------------------------------------
+
+class _Level(NamedTuple):
+    """Static per-level discretization data (closed over by the jitted
+    solve — all leaves are arrays or hashable)."""
+    shape: Tuple[int, ...]
+    dx: Tuple[float, ...]
+    diag: Array            # operator diagonal incl. BC corrections
+    D_face: Optional[Tuple[Array, ...]]  # face diffusivity per axis, or None
+
+
+def _face_coeffs(D: Array, bc: DomainBC) -> Tuple[Array, ...]:
+    """Arithmetic-mean face diffusivities from cell-centered D, one
+    array per axis with shape n + e_d (interior + boundary faces).
+    Boundary faces use the one-sided cell value (periodic: wrap mean)."""
+    out = []
+    for d in range(D.ndim):
+        if bc.axes[d].periodic:
+            Dm = 0.5 * (D + jnp.roll(D, 1, axis=d))       # face i = mean(i-1, i)
+            # append the wrap face at the high end so shape = n+1
+            lo = [slice(None)] * D.ndim
+            lo[d] = slice(0, 1)
+            Df = jnp.concatenate([Dm, Dm[tuple(lo)]], axis=d)
+        else:
+            pad = [(0, 0)] * D.ndim
+            pad[d] = (1, 1)
+            Dg = jnp.pad(D, pad, mode="edge")
+            sl_lo = [slice(None)] * D.ndim
+            sl_hi = [slice(None)] * D.ndim
+            sl_lo[d] = slice(0, -1)
+            sl_hi[d] = slice(1, None)
+            Df = 0.5 * (Dg[tuple(sl_lo)] + Dg[tuple(sl_hi)])
+        out.append(Df)
+    return tuple(out)
+
+
+def _apply_op(Q: Array, level: _Level, bc: DomainBC, alpha: float,
+              beta: float, bdry_data: Optional[dict] = None) -> Array:
+    """alpha*Q + beta*div(grad Q)  (constant coefficient), or
+    alpha*Q + div(D grad Q) when the level carries face coefficients.
+    Conservative face-flux form so coarse operators stay symmetric."""
+    dim = Q.ndim
+    dx = level.dx
+    G = fill_ghosts_cc(Q, bc, dx, bdry_data=bdry_data)
+    center = tuple(slice(1, -1) for _ in range(dim))
+    out = alpha * Q
+    for d in range(dim):
+        lo = list(center)
+        hi = list(center)
+        lo[d] = slice(0, -2)
+        hi[d] = slice(2, None)
+        if level.D_face is None:
+            out = out + beta * (G[tuple(lo)] - 2.0 * Q + G[tuple(hi)]) \
+                / dx[d] ** 2
+        else:
+            Df = level.D_face[d]
+            sl_lo = [slice(None)] * dim
+            sl_hi = [slice(None)] * dim
+            sl_lo[d] = slice(0, -1)
+            sl_hi[d] = slice(1, None)
+            flux_hi = Df[tuple(sl_hi)] * (G[tuple(hi)] - Q) / dx[d]
+            flux_lo = Df[tuple(sl_lo)] * (Q - G[tuple(lo)]) / dx[d]
+            out = out + (flux_hi - flux_lo) / dx[d]
+    return out
+
+
+def _assemble_diag(shape, bc: DomainBC, dx, alpha: float, beta: float,
+                   D_face, dtype) -> Array:
+    """Exact operator diagonal including the ghost-reflection
+    contribution at boundary cells (the ghost of a boundary cell is a
+    multiple c of the cell itself under homogeneous BCs, so c folds
+    into that cell's diagonal)."""
+    dim = len(shape)
+    if D_face is None:
+        diag = jnp.full(shape, alpha + beta * sum(-2.0 / h ** 2
+                                                  for h in dx),
+                        dtype=dtype)
+        for d in range(dim):
+            ax = bc.axes[d]
+            if ax.periodic:
+                continue
+            for s, side in ((0, ax.lo), (1, ax.hi)):
+                c = _reflect_coeff(side, dx[d])
+                idx = [slice(None)] * dim
+                idx[d] = slice(0, 1) if s == 0 else slice(-1, None)
+                diag = diag.at[tuple(idx)].add(beta * c / dx[d] ** 2)
+        return diag
+    # variable-coefficient: diag = alpha - (D_hi + D_lo)/h^2 per axis,
+    # with boundary-face reflection corrections
+    diag = jnp.full(shape, alpha, dtype=dtype)
+    for d in range(dim):
+        Df = D_face[d]
+        sl_lo = [slice(None)] * dim
+        sl_hi = [slice(None)] * dim
+        sl_lo[d] = slice(0, -1)
+        sl_hi[d] = slice(1, None)
+        diag = diag - (Df[tuple(sl_lo)] + Df[tuple(sl_hi)]) / dx[d] ** 2
+        ax = bc.axes[d]
+        if ax.periodic:
+            continue
+        for s, side in ((0, ax.lo), (1, ax.hi)):
+            c = _reflect_coeff(side, dx[d])
+            idx = [slice(None)] * dim
+            idx[d] = slice(0, 1) if s == 0 else slice(-1, None)
+            fidx = [slice(None)] * dim
+            fidx[d] = slice(0, 1) if s == 0 else slice(-1, None)
+            diag = diag.at[tuple(idx)].add(
+                c * Df[tuple(fidx)] / dx[d] ** 2)
+    return diag
+
+
+# ---------------------------------------------------------------------------
+# Grid transfer
+# ---------------------------------------------------------------------------
+
+def restrict_full_weighting(r: Array) -> Array:
+    """2^d block mean — the cell-centered full-weighting restriction
+    (shared with the AMR coarsen op: amr.restrict_cc)."""
+    return restrict_cc(r, ratio=2)
+
+
+def _axis_ghost_hom(C: Array, axis: int, ax: AxisBC, h: float) -> Array:
+    """Pad ONE axis with one ghost layer under homogeneous BCs."""
+    lo_idx = [slice(None)] * C.ndim
+    hi_idx = [slice(None)] * C.ndim
+    if ax.periodic:
+        lo_idx[axis] = slice(-1, None)
+        hi_idx[axis] = slice(0, 1)
+        lo_g, hi_g = C[tuple(lo_idx)], C[tuple(hi_idx)]
+    else:
+        lo_idx[axis] = slice(0, 1)
+        hi_idx[axis] = slice(-1, None)
+        lo_g = _reflect_coeff(ax.lo, h) * C[tuple(lo_idx)]
+        hi_g = _reflect_coeff(ax.hi, h) * C[tuple(hi_idx)]
+    return jnp.concatenate([lo_g, C, hi_g], axis=axis)
+
+
+def prolong_linear(C: Array, bc: DomainBC, dx_coarse) -> Array:
+    """BC-aware piecewise-linear prolongation (cell-centered, ratio 2):
+    child values are the 3/4-1/4 axis-separable interpolants of the
+    parent and its neighbor toward the child, with homogeneous-BC ghosts
+    beyond walls (correction quantities vanish/reflect there)."""
+    out = C
+    for d in range(C.ndim):
+        G = _axis_ghost_hom(out, d, bc.axes[d], dx_coarse[d])
+        sl_c = [slice(None)] * out.ndim
+        sl_m = [slice(None)] * out.ndim
+        sl_p = [slice(None)] * out.ndim
+        sl_c[d] = slice(1, -1)
+        sl_m[d] = slice(0, -2)
+        sl_p[d] = slice(2, None)
+        left = 0.75 * G[tuple(sl_c)] + 0.25 * G[tuple(sl_m)]
+        right = 0.75 * G[tuple(sl_c)] + 0.25 * G[tuple(sl_p)]
+        stacked = jnp.stack([left, right], axis=d + 1)
+        new_shape = list(out.shape)
+        new_shape[d] = out.shape[d] * 2
+        out = stacked.reshape(new_shape)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The solver
+# ---------------------------------------------------------------------------
+
+class MGSolveResult(NamedTuple):
+    x: Array
+    iters: jnp.ndarray
+    resnorm: jnp.ndarray
+    converged: jnp.ndarray
+
+
+class PoissonMultigrid:
+    """Geometric-multigrid solver for
+    ``alpha*Q + beta*lap(Q) = f``   (D=None), or
+    ``alpha*Q + div(D grad Q) = f`` (cell-centered D),
+    under the full Robin BC menu of :mod:`ibamr_tpu.bc`.
+
+    Setup is static (level shapes/diagonals precomputed); ``solve`` is
+    fully traceable. Matches the role of the reference's
+    ``CCPoissonSolverManager`` default (FAC-preconditioned Krylov with
+    point-relaxation smoothers) — SURVEY.md §2.1 T8.
+    """
+
+    def __init__(self, shape: Sequence[int], bc: DomainBC,
+                 dx: Sequence[float], alpha: float = 0.0,
+                 beta: float = 1.0, D: Optional[Array] = None,
+                 nu_pre: int = 2, nu_post: int = 2,
+                 nu_coarse: int = 40, min_cells: int = 4,
+                 dtype=jnp.float64):
+        self.bc = bc
+        self.bc_hom = homogeneous_bc(bc)
+        # respect the session's enabled precision (f32 on TPU, f64 in
+        # the x64 test env) without requested-dtype truncation warnings
+        dtype = jax.dtypes.canonicalize_dtype(dtype)
+        self.alpha = float(alpha)
+        self.beta = float(beta)
+        self.nu_pre = nu_pre
+        self.nu_post = nu_post
+        self.nu_coarse = nu_coarse
+        self.has_nullspace = (alpha == 0.0) and _nullspace(bc)
+
+        shape = tuple(int(v) for v in shape)
+        dx = tuple(float(v) for v in dx)
+        self.levels: List[_Level] = []
+        Dl = D
+        while True:
+            D_face = None if Dl is None else _face_coeffs(Dl, bc)
+            diag = _assemble_diag(shape, bc, dx, self.alpha, self.beta,
+                                  D_face, dtype)
+            self.levels.append(_Level(shape=shape, dx=dx, diag=diag,
+                                      D_face=D_face))
+            if any(s % 2 != 0 or s // 2 < min_cells for s in shape):
+                break
+            shape = tuple(s // 2 for s in shape)
+            dx = tuple(h * 2.0 for h in dx)
+            if Dl is not None:
+                Dl = restrict_full_weighting(Dl)
+        # red-black checkerboard masks per level
+        self._masks = [checkerboard_masks(lv.shape)
+                       for lv in self.levels]
+
+    # -- level pieces -------------------------------------------------------
+    def _op(self, Q, li: int, bdry_data=None, hom=True):
+        bc = self.bc_hom if hom else self.bc
+        return _apply_op(Q, self.levels[li], bc, self.alpha, self.beta,
+                         bdry_data=bdry_data)
+
+    def _smooth(self, Q, f, li: int, sweeps: int):
+        red, black = self._masks[li]
+        diag = self.levels[li].diag
+
+        def sweep(_, Q):
+            for mask in (red, black):
+                r = f - self._op(Q, li)
+                Q = Q + jnp.where(mask, r / diag, 0.0)
+            return Q
+
+        return jax.lax.fori_loop(0, sweeps, sweep, Q)
+
+    def _vcycle(self, Q, f, li: int):
+        if li == len(self.levels) - 1:
+            return self._smooth(Q, f, li, self.nu_coarse)
+        Q = self._smooth(Q, f, li, self.nu_pre)
+        r = f - self._op(Q, li)
+        rc = restrict_full_weighting(r)
+        ec = self._vcycle(jnp.zeros_like(rc), rc, li + 1)
+        Q = Q + prolong_linear(ec, self.bc_hom,
+                               self.levels[li + 1].dx)
+        return self._smooth(Q, f, li, self.nu_post)
+
+    # -- public API ---------------------------------------------------------
+    def vcycle(self, Q: Array, f: Array) -> Array:
+        """One homogeneous-BC V-cycle (use as a preconditioner)."""
+        return self._vcycle(Q, f, 0)
+
+    def solve(self, f: Array, x0: Optional[Array] = None,
+              tol: float = 1e-8, maxiter: int = 50,
+              bdry_data: Optional[dict] = None) -> MGSolveResult:
+        """V-cycle iteration to ``|r| <= tol*|f|``. Inhomogeneous
+        boundary data is folded into the right-hand side once (the ghost
+        fill is affine in Q: op_inhom(Q) = op_hom(Q) + bc_terms), so the
+        cycle itself runs homogeneous."""
+        f = jnp.asarray(f)
+        if x0 is None:
+            x0 = jnp.zeros_like(f)
+        # fold inhomogeneous boundary terms into the rhs:
+        zero = jnp.zeros_like(f)
+        bc_terms = _apply_op(zero, self.levels[0], self.bc, self.alpha,
+                             self.beta, bdry_data=bdry_data)
+        f_eff = f - bc_terms
+        if self.has_nullspace:
+            f_eff = f_eff - jnp.mean(f_eff)
+        fnorm = jnp.linalg.norm(f_eff.ravel())
+        stop = tol * jnp.maximum(fnorm, 1e-30)
+
+        def cond(carry):
+            Q, rn, it = carry
+            return jnp.logical_and(it < maxiter, rn > stop)
+
+        def body(carry):
+            Q, _, it = carry
+            Q = self._vcycle(Q, f_eff, 0)
+            if self.has_nullspace:
+                Q = Q - jnp.mean(Q)
+            rn = jnp.linalg.norm((f_eff - self._op(Q, 0)).ravel())
+            return Q, rn, it + 1
+
+        rn0 = jnp.linalg.norm((f_eff - self._op(x0, 0)).ravel())
+        Q, rn, it = jax.lax.while_loop(
+            cond, body, (x0, rn0, jnp.asarray(0)))
+        return MGSolveResult(x=Q, iters=it, resnorm=rn,
+                             converged=rn <= stop)

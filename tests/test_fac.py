@@ -1,0 +1,88 @@
+"""FAC composite preconditioner (T8): the V-cycle over AMR levels.
+
+Checks that one FAC V-cycle per FGMRES application solves the two-level
+composite Poisson projection to the same answer as the FFT+fastdiag
+level-solver preconditioner, with Krylov work in the same small-iteration
+class (the reference's FACPreconditioner promise: O(N), grid-independent
+Krylov counts — SURVEY.md §2.1 T8, §6)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from ibamr_tpu.amr import FineBox, restrict_mac
+from ibamr_tpu.amr_ins import (CompositeProjection, _box_mac_divergence,
+                               scatter_box_mac_to_coarse)
+from ibamr_tpu.bc import DomainBC
+from ibamr_tpu.grid import StaggeredGrid
+from ibamr_tpu.ops import stencils
+from ibamr_tpu.solvers.fac import FACCompositePoisson
+
+
+def _setup(n=32, dim=2):
+    grid = StaggeredGrid(n=(n,) * dim, x_lo=(0.0,) * dim,
+                         x_up=(1.0,) * dim)
+    box = FineBox(lo=(n // 4,) * dim, shape=(n // 2,) * dim, ratio=2)
+    return grid, box
+
+
+def _divergent_fields(grid, box, seed=5):
+    rng = np.random.default_rng(seed)
+    uc = tuple(jnp.asarray(rng.standard_normal(grid.n)) for _ in grid.n)
+    uf = tuple(jnp.asarray(
+        rng.standard_normal(tuple(m + (1 if d == a else 0)
+                                  for a, m in enumerate(box.fine_n))))
+        for d in range(grid.dim))
+    # sync coarse faces under/at the box so the composite rhs satisfies
+    # the periodic compatibility condition (as the integrators maintain)
+    uc = scatter_box_mac_to_coarse(uc, restrict_mac(uf), box)
+    return uc, uf
+
+
+def test_fac_projection_matches_default():
+    grid, box = _setup()
+    uc, uf = _divergent_fields(grid, box)
+
+    proj_ref = CompositeProjection(grid, box, tol=1e-10)
+    fac = FACCompositePoisson(grid.n, DomainBC.periodic(grid.dim),
+                              grid.dx, box)
+    proj_fac = CompositeProjection(grid, box, tol=1e-10,
+                                   preconditioner=fac.precondition)
+
+    uc1, uf1, phi1, _ = proj_ref.project(uc, uf)
+    uc2, uf2, phi2, _ = proj_fac.project(uc, uf)
+
+    for a, b in zip(uc1, uc2):
+        assert np.max(np.abs(np.asarray(a - b))) < 1e-6
+    for a, b in zip(uf1, uf2):
+        assert np.max(np.abs(np.asarray(a - b))) < 1e-6
+
+
+def test_fac_projection_kills_composite_divergence():
+    grid, box = _setup(n=24)
+    uc, uf = _divergent_fields(grid, box, seed=11)
+    fac = FACCompositePoisson(grid.n, DomainBC.periodic(grid.dim),
+                              grid.dx, box)
+    proj = CompositeProjection(grid, box, tol=1e-10,
+                               preconditioner=fac.precondition)
+    uc2, uf2, _, _ = proj.project(uc, uf)
+    dx_f = tuple(h / box.ratio for h in grid.dx)
+    div_c = np.asarray(stencils.divergence(uc2, grid.dx))
+    div_f = np.asarray(_box_mac_divergence(uf2, dx_f))
+    covered = np.zeros(grid.n, dtype=bool)
+    covered[tuple(np.s_[box.lo[a]:box.hi[a]]
+                  for a in range(grid.dim))] = True
+    assert np.max(np.abs(div_c[~covered])) < 1e-7
+    assert np.max(np.abs(div_f)) < 1e-7
+
+
+def test_fac_3d_smoke():
+    grid, box = _setup(n=16, dim=3)
+    uc, uf = _divergent_fields(grid, box, seed=2)
+    fac = FACCompositePoisson(grid.n, DomainBC.periodic(grid.dim),
+                              grid.dx, box)
+    proj = CompositeProjection(grid, box, tol=1e-8,
+                               preconditioner=fac.precondition)
+    uc2, uf2, _, _ = proj.project(uc, uf)
+    dx_f = tuple(h / box.ratio for h in grid.dx)
+    div_f = np.asarray(_box_mac_divergence(uf2, dx_f))
+    assert np.max(np.abs(div_f)) < 1e-5
