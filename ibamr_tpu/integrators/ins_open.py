@@ -1,0 +1,187 @@
+"""Navier–Stokes integrator for inflow/outflow (open-boundary) domains.
+
+Reference parity: the ``INSStaggeredHierarchyIntegrator`` configuration
+that every non-periodic, non-enclosed acceptance scenario uses — channel
+and jet flows with prescribed-velocity inflows and traction-free open
+outflows (P2/P3 + ``INSProjectionBcCoef``/``INSIntermediateVelocityBcCoef``
+boundary plumbing, SURVEY.md §2.2). The enclosed/no-slip configurations
+are served by :mod:`ibamr_tpu.integrators.ins_walls`; the periodic ones
+by :mod:`ibamr_tpu.integrators.ins`. This module completes the boundary
+menu with the open/traction case, driven by the coupled saddle solver of
+:mod:`ibamr_tpu.solvers.stokes`.
+
+Scheme: explicit first-order-upwind convection + backward-Euler viscous
+step, coupled velocity–pressure solve each step (the reference's
+"stokes solve per timestep" path, not the split projection):
+
+    (1/dt) u^{n+1} - mu lap u^{n+1} + grad p = (1/dt) u^n - N(u^n) + f
+    div u^{n+1} = 0
+
+Everything is jit-traceable; the FGMRES saddle solve compiles into the
+step function.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ibamr_tpu.solvers.stokes import StaggeredStokesSolver, StokesBC
+
+Array = jnp.ndarray
+Vel = Tuple[Array, ...]
+
+
+class OpenINSState(NamedTuple):
+    u: Vel
+    p: Array
+    t: Array
+
+
+class INSOpenIntegrator:
+    """Incompressible NS on a box domain with inflow/wall/open sides.
+
+    ``bdry`` is the boundary-data dict of
+    :meth:`StaggeredStokesSolver.make_rhs` — {(d, e, side): value}
+    (inflow profiles, moving-wall tangential values), fixed at
+    construction so the compiled step is data-free.
+    """
+
+    def __init__(self, n, dx, bc: StokesBC, mu: float, dt: float,
+                 bdry: Optional[Dict] = None, rho: float = 1.0,
+                 tol: float = 1e-8, dtype=jnp.float64):
+        self.mu = float(mu)
+        self.rho = float(rho)
+        self.dt = float(dt)
+        self.alpha = self.rho / self.dt
+        self.solver = StaggeredStokesSolver(
+            n, dx, bc, alpha=self.alpha, mu=self.mu, tol=tol,
+            dtype=dtype)
+        self.bdry = dict(bdry or {})
+        self.n = self.solver.n
+        self.dx = self.solver.dx
+
+    # ------------------------------------------------------------------
+    def initialize(self, u: Optional[Vel] = None) -> OpenINSState:
+        s = self.solver
+        if u is None:
+            u = tuple(jnp.zeros(sh, dtype=s.dtype) for sh in s.shapes)
+        p = jnp.zeros(s.n, dtype=s.dtype)
+        return OpenINSState(u=tuple(u), p=p,
+                            t=jnp.asarray(0.0, dtype=s.dtype))
+
+    # -- advection helpers ---------------------------------------------
+    def _ghost_with_data(self, c: Array, d: int) -> Array:
+        """One ghost layer per axis honoring the ACTUAL boundary data
+        (unlike the solver's homogeneous pad): prescribed tangential
+        sides reflect around the data value; open sides copy; periodic
+        wraps; own-axis boundary faces already carry their data (the
+        saddle solve's identity rows keep them exact)."""
+        s = self.solver
+        out = c
+        for e in range(c.ndim):
+            lo_idx = [slice(None)] * out.ndim
+            hi_idx = [slice(None)] * out.ndim
+            if s.bc.periodic(e):
+                lo_idx[e] = slice(-1, None)
+                hi_idx[e] = slice(0, 1)
+                lo_g, hi_g = out[tuple(lo_idx)], out[tuple(hi_idx)]
+            else:
+                lo_idx[e] = slice(0, 1)
+                hi_idx[e] = slice(-1, None)
+                lo_g, hi_g = out[tuple(lo_idx)], out[tuple(hi_idx)]
+                if e != d:
+                    if s.bc.side(e, 0).prescribed:
+                        v = self.bdry.get((d, e, 0), 0.0)
+                        lo_g = 2.0 * jnp.asarray(v, c.dtype) - lo_g
+                    if s.bc.side(e, 1).prescribed:
+                        v = self.bdry.get((d, e, 1), 0.0)
+                        hi_g = 2.0 * jnp.asarray(v, c.dtype) - hi_g
+            out = jnp.concatenate([lo_g, out, hi_g], axis=e)
+        return out
+
+    def _to_cells(self, u: Vel) -> Vel:
+        """Average every MAC component to cell centers (shape n)."""
+        s = self.solver
+        out = []
+        for e, c in enumerate(u):
+            if s.bc.periodic(e):
+                out.append(0.5 * (c + jnp.roll(c, -1, axis=e)))
+            else:
+                lo = [slice(None)] * c.ndim
+                hi = [slice(None)] * c.ndim
+                lo[e] = slice(0, -1)
+                hi[e] = slice(1, None)
+                out.append(0.5 * (c[tuple(lo)] + c[tuple(hi)]))
+        return tuple(out)
+
+    def _advect(self, u: Vel) -> Vel:
+        """First-order upwind N(u)_d = sum_e a_e * d(u_d)/dx_e with
+        BC-data ghosts; advecting velocities interpolated through cell
+        centers (compact, layout-uniform)."""
+        s = self.solver
+        uc = self._to_cells(u)                   # all at cells, shape n
+        out = []
+        for d, c in enumerate(u):
+            G = self._ghost_with_data(c, d)
+            center = tuple(slice(1, -1) for _ in range(c.ndim))
+            N = jnp.zeros_like(c)
+            for e in range(c.ndim):
+                lo = list(center)
+                hi = list(center)
+                lo[e] = slice(0, -2)
+                hi[e] = slice(2, None)
+                dm = (c - G[tuple(lo)]) / s.dx[e]
+                dp = (G[tuple(hi)] - c) / s.dx[e]
+                a = self._advecting(uc, u, d, e)
+                N = N + jnp.where(a > 0, a * dm, a * dp)
+            out.append(N)
+        return tuple(out)
+
+    def _advecting(self, uc: Vel, u: Vel, d: int, e: int) -> Array:
+        """Velocity component e evaluated at component d's faces."""
+        s = self.solver
+        if e == d:
+            return u[d]
+        ce = uc[e]                      # cell-centered, shape n
+        if s.bc.periodic(d):
+            return 0.5 * (ce + jnp.roll(ce, 1, axis=d))
+        # interior faces: mean of adjacent cells; boundary faces: edge
+        pad = [(0, 0)] * ce.ndim
+        pad[d] = (1, 1)
+        Gp = jnp.pad(ce, pad, mode="edge")
+        lo = [slice(None)] * ce.ndim
+        hi = [slice(None)] * ce.ndim
+        lo[d] = slice(0, -1)
+        hi[d] = slice(1, None)
+        return 0.5 * (Gp[tuple(lo)] + Gp[tuple(hi)])
+
+    # ------------------------------------------------------------------
+    def step(self, state: OpenINSState,
+             f: Optional[Vel] = None) -> OpenINSState:
+        s = self.solver
+        N = self._advect(state.u)
+        f_u = []
+        for d in range(len(s.n)):
+            r = self.alpha * state.u[d] - self.rho * N[d]
+            if f is not None:
+                r = r + f[d]
+            f_u.append(r)
+        rhs = s.make_rhs(f_u=tuple(f_u), bdry=self.bdry)
+        sol = s.solve(rhs, x0=(state.u, state.p))
+        return OpenINSState(u=sol.u, p=sol.p, t=state.t + self.dt)
+
+    def max_divergence(self, state: OpenINSState) -> Array:
+        return jnp.max(jnp.abs(self.solver.divergence(state.u)))
+
+
+def advance(integ: INSOpenIntegrator, state: OpenINSState,
+            nsteps: int, f: Optional[Vel] = None) -> OpenINSState:
+    """jit/scan-rolled advance of ``nsteps`` steps."""
+    def body(st, _):
+        return integ.step(st, f=f), None
+
+    out, _ = jax.lax.scan(body, state, None, length=nsteps)
+    return out

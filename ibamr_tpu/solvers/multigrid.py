@@ -43,8 +43,8 @@ import jax
 import jax.numpy as jnp
 
 from ibamr_tpu.amr import restrict_cc
-from ibamr_tpu.bc import (AxisBC, DomainBC, SideBC, PERIODIC,
-                          fill_ghosts_cc, ghost_reflect_coeff)
+from ibamr_tpu.bc import (AxisBC, DomainBC, fill_ghosts_cc,
+                          ghost_reflect_coeff)
 
 Array = jnp.ndarray
 
@@ -71,7 +71,6 @@ def homogeneous_bc(bc: DomainBC) -> DomainBC:
     return DomainBC(axes=tuple(axes))
 
 
-_reflect_coeff = ghost_reflect_coeff
 
 
 def _nullspace(bc: DomainBC) -> bool:
@@ -172,7 +171,7 @@ def _assemble_diag(shape, bc: DomainBC, dx, alpha: float, beta: float,
             if ax.periodic:
                 continue
             for s, side in ((0, ax.lo), (1, ax.hi)):
-                c = _reflect_coeff(side, dx[d])
+                c = ghost_reflect_coeff(side, dx[d])
                 idx = [slice(None)] * dim
                 idx[d] = slice(0, 1) if s == 0 else slice(-1, None)
                 diag = diag.at[tuple(idx)].add(beta * c / dx[d] ** 2)
@@ -191,7 +190,7 @@ def _assemble_diag(shape, bc: DomainBC, dx, alpha: float, beta: float,
         if ax.periodic:
             continue
         for s, side in ((0, ax.lo), (1, ax.hi)):
-            c = _reflect_coeff(side, dx[d])
+            c = ghost_reflect_coeff(side, dx[d])
             idx = [slice(None)] * dim
             idx[d] = slice(0, 1) if s == 0 else slice(-1, None)
             fidx = [slice(None)] * dim
@@ -222,8 +221,8 @@ def _axis_ghost_hom(C: Array, axis: int, ax: AxisBC, h: float) -> Array:
     else:
         lo_idx[axis] = slice(0, 1)
         hi_idx[axis] = slice(-1, None)
-        lo_g = _reflect_coeff(ax.lo, h) * C[tuple(lo_idx)]
-        hi_g = _reflect_coeff(ax.hi, h) * C[tuple(hi_idx)]
+        lo_g = ghost_reflect_coeff(ax.lo, h) * C[tuple(lo_idx)]
+        hi_g = ghost_reflect_coeff(ax.hi, h) * C[tuple(hi_idx)]
     return jnp.concatenate([lo_g, C, hi_g], axis=axis)
 
 
@@ -294,6 +293,12 @@ class PoissonMultigrid:
         shape = tuple(int(v) for v in shape)
         dx = tuple(float(v) for v in dx)
         self.levels: List[_Level] = []
+        # fold beta into the cell coefficient so the natural
+        # variable-viscosity Helmholtz form alpha + beta*div(D grad)
+        # works: it equals alpha + div((beta*D) grad)
+        if D is not None and beta != 1.0:
+            D = beta * D
+            self.beta = 1.0
         Dl = D
         while True:
             D_face = None if Dl is None else _face_coeffs(Dl, bc)
