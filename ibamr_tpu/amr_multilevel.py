@@ -1,0 +1,293 @@
+"""L-level nested AMR hierarchy for advection-diffusion (T4/S4
+completion: composite math beyond two levels).
+
+Reference parity: the general ``PatchHierarchy`` with
+``max_levels > 2`` — recursive level-by-level subcycled advance with
+per-pair coarse-fine synchronization (SURVEY.md §3.4: each level
+advances r substeps per parent step; restriction + refluxing run at
+EVERY coarse-fine interface, not just one). The two-level machinery of
+:mod:`ibamr_tpu.amr` / :mod:`ibamr_tpu.amr_dynamic` is the building
+block; this module composes the same primitives recursively.
+
+TPU-first shape: the hierarchy is a static tuple of dense per-level box
+arrays (one fixed box per level, nested with clearance). The recursion
+over levels unrolls at trace time — an L-level composite step compiles
+into ONE XLA computation with no host control flow; level l advances
+2^l substeps per composite step (ratio-2 subcycling), all unrolled.
+
+Conservation: advective+diffusive face fluxes; covered regions are
+restricted from the finer level and the uncovered neighbor cells
+refluxed with (time-averaged transverse-restricted fine flux - coarse
+flux) at every CF interface, so the composite integral is conserved to
+roundoff (tested)."""
+
+from __future__ import annotations
+
+from typing import Callable, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from ibamr_tpu.amr import FineBox, fill_fine_ghosts, restrict_cc
+from ibamr_tpu.grid import StaggeredGrid
+
+Array = jnp.ndarray
+Vel = Tuple[Array, ...]
+
+
+class LevelSpec(NamedTuple):
+    """Static geometry of one level: its box in the PARENT level's
+    index space (None for the root) and its own grid geometry."""
+    box: Optional[FineBox]
+    grid: StaggeredGrid
+
+
+def build_hierarchy(grid: StaggeredGrid,
+                    boxes: Sequence[FineBox]) -> List[LevelSpec]:
+    """Validate and materialize an L-level nested hierarchy: ``boxes[l]``
+    is level l+1's box inside level l. Each box keeps >= 2 cells of
+    clearance inside its parent so the quadratic CF interpolation
+    stencils and the interface refluxing stay interior."""
+    levels = [LevelSpec(box=None, grid=grid)]
+    parent = grid
+    for box in boxes:
+        box.validate(parent)
+        fine = box.fine_grid(parent)
+        levels.append(LevelSpec(box=box, grid=fine))
+        parent = fine
+    return levels
+
+
+class MultiLevelAdvDiff:
+    """Composite L-level advance of dQ/dt + div(u Q) = kappa lap(Q),
+    velocity frozen per level (the transport configuration of the
+    reference's adv-diff + AMR acceptance tests).
+
+    Level 0 is periodic; levels 1..L-1 are nested ratio-2 boxes.
+    ``vel_fn(mesh_tuple) -> tuple(component arrays)`` is evaluated at
+    every level's faces at build time."""
+
+    GHOST = 1      # centered/upwind fluxes need one ghost layer
+
+    def __init__(self, grid: StaggeredGrid, boxes: Sequence[FineBox],
+                 kappa: float = 0.0, scheme: str = "centered",
+                 vel_fn: Optional[Callable] = None,
+                 dtype=jnp.float64):
+        self.levels = build_hierarchy(grid, boxes)
+        self.L = len(self.levels)
+        self.kappa = float(kappa)
+        if scheme not in ("centered", "upwind"):
+            raise ValueError(f"unknown scheme {scheme!r}")
+        self.scheme = scheme
+        import jax
+
+        self.dtype = jax.dtypes.canonicalize_dtype(dtype)
+
+        # face velocities per level: component d on faces along d.
+        # level 0: periodic lower-face shape n; levels >= 1: complete
+        # faces, shape n + e_d.
+        self.u_faces: List[Optional[Vel]] = []
+        for l, spec in enumerate(self.levels):
+            if vel_fn is None:
+                self.u_faces.append(None)
+                continue
+            g = spec.grid
+            comps = []
+            for d in range(g.dim):
+                shape = tuple(g.n[e] + (1 if (l > 0 and e == d) else 0)
+                              for e in range(g.dim))
+                coords = []
+                for e in range(g.dim):
+                    if e == d:
+                        c = g.x_lo[e] + np.arange(shape[e]) * g.dx[e]
+                    else:
+                        c = g.x_lo[e] + (np.arange(shape[e]) + 0.5) \
+                            * g.dx[e]
+                    coords.append(c)
+                mesh = np.meshgrid(*coords, indexing="ij")
+                comps.append(jnp.asarray(vel_fn(mesh)[d], dtype=dtype))
+            self.u_faces.append(tuple(comps))
+
+    # ------------------------------------------------------------------
+    def initialize(self, fn) -> Tuple[Array, ...]:
+        out = []
+        for spec in self.levels:
+            Q = jnp.asarray(fn(spec.grid.cell_centers(self.dtype)),
+                            dtype=self.dtype)
+            out.append(jnp.broadcast_to(Q, spec.grid.n))
+        return tuple(out)
+
+    # -- flux machinery -------------------------------------------------
+    def _fluxes(self, l: int, Q: Array, Qg: Optional[Array]) -> Vel:
+        """Face fluxes of u*Q - kappa*dQ/dx on level l. Level 0 uses
+        periodic rolls (lower-face arrays); levels >= 1 use the 1-ghost
+        extension ``Qg`` (complete-face arrays)."""
+        g = self.levels[l].grid
+        dim = g.dim
+        out = []
+        for d in range(dim):
+            h = g.dx[d]
+            if l == 0:
+                QL, QR = jnp.roll(Q, 1, axis=d), Q
+            else:
+                lo = [slice(1, 1 + g.n[e]) for e in range(dim)]
+                hi = [slice(1, 1 + g.n[e]) for e in range(dim)]
+                lo[d] = slice(0, g.n[d] + 1)
+                hi[d] = slice(1, g.n[d] + 2)
+                QL, QR = Qg[tuple(lo)], Qg[tuple(hi)]
+            u = self.u_faces[l][d]
+            if self.scheme == "upwind":
+                adv = jnp.where(u > 0, u * QL, u * QR)
+            else:
+                adv = u * 0.5 * (QL + QR)
+            out.append(adv - self.kappa * (QR - QL) / h)
+        return tuple(out)
+
+    @staticmethod
+    def _div(F: Vel, g: StaggeredGrid, complete: bool) -> Array:
+        acc = None
+        for d, f in enumerate(F):
+            if complete:
+                lo = [slice(None)] * g.dim
+                hi = [slice(None)] * g.dim
+                lo[d] = slice(0, -1)
+                hi[d] = slice(1, None)
+                t = (f[tuple(hi)] - f[tuple(lo)]) / g.dx[d]
+            else:
+                t = (jnp.roll(f, -1, d) - f) / g.dx[d]
+            acc = t if acc is None else acc + t
+        return acc
+
+    @staticmethod
+    def _bdry_slabs(F: Vel) -> List[Tuple[Array, Array]]:
+        """(lo, hi) boundary-face flux slabs per axis of a complete-face
+        flux tuple."""
+        out = []
+        for d, f in enumerate(F):
+            lo_sl = [slice(None)] * f.ndim
+            hi_sl = [slice(None)] * f.ndim
+            lo_sl[d] = slice(0, 1)
+            hi_sl[d] = slice(-1, None)
+            out.append((f[tuple(lo_sl)], f[tuple(hi_sl)]))
+        return out
+
+    @staticmethod
+    def _transverse_restrict(slab: Array, d: int, r: int) -> Array:
+        """Mean over r-blocks in every axis except d (slab has extent 1
+        along d)."""
+        dim = slab.ndim
+        shape = []
+        for a in range(dim):
+            if a == d:
+                shape += [1]
+            else:
+                shape += [slab.shape[a] // r, r]
+        arr = slab.reshape(shape)
+        mean_axes = []
+        i = 0
+        for a in range(dim):
+            if a == d:
+                i += 1
+            else:
+                mean_axes.append(i + 1)
+                i += 2
+        return arr.mean(axis=tuple(mean_axes))
+
+    # -- recursive composite step ---------------------------------------
+    def _advance_level(self, l: int, Qs: List[Array],
+                       p_ghost_src: Optional[Array], dt: float
+                       ) -> Tuple[List[Array],
+                                  Optional[List[Tuple[Array, Array]]]]:
+        """Advance level l (and recursively all finer levels) by ONE
+        step of its local ``dt``. ``p_ghost_src`` is the parent array
+        (time-interpolated to this substep's start) for CF ghosts.
+        Returns the updated arrays and level l's boundary-face flux
+        slabs (None at the root) for the parent's reflux."""
+        spec = self.levels[l]
+        g = spec.grid
+
+        Q_old = Qs[l]
+        if l == 0:
+            F = self._fluxes(0, Q_old, None)
+            Q_new = Q_old - dt * self._div(F, g, complete=False)
+        else:
+            Qg = fill_fine_ghosts(Q_old, p_ghost_src, spec.box,
+                                  ghost=self.GHOST)
+            F = self._fluxes(l, Q_old, Qg)
+            Q_new = Q_old - dt * self._div(F, g, complete=True)
+
+        Qs = list(Qs)
+        Qs[l] = Q_new
+
+        if l + 1 < self.L:
+            child = self.levels[l + 1]
+            box = child.box
+            r = box.ratio
+            dim = g.dim
+            acc: Optional[List[Tuple[Array, Array]]] = None
+            for m in range(r):
+                theta = m / r
+                p_src = (1.0 - theta) * Q_old + theta * Q_new
+                Qs, slabs = self._advance_level(l + 1, Qs, p_src,
+                                                dt / r)
+                if acc is None:
+                    acc = slabs
+                else:
+                    acc = [(a0 + s0, a1 + s1)
+                           for (a0, a1), (s0, s1) in zip(acc, slabs)]
+
+            # restriction onto the covered region of level l
+            box_sl = tuple(slice(box.lo[a], box.hi[a])
+                           for a in range(dim))
+            Ql = Qs[l].at[box_sl].set(restrict_cc(Qs[l + 1]))
+
+            # reflux level l's uncovered neighbors at the CF interface
+            for d in range(dim):
+                favg_lo = self._transverse_restrict(acc[d][0], d, r) / r
+                favg_hi = self._transverse_restrict(acc[d][1], d, r) / r
+                # coarse flux planes through the interface faces
+                lo_face = [slice(box.lo[a], box.hi[a])
+                           for a in range(dim)]
+                hi_face = list(lo_face)
+                lo_face[d] = slice(box.lo[d], box.lo[d] + 1)
+                hi_face[d] = slice(box.hi[d], box.hi[d] + 1)
+                # (level-0 lower-face arrays index interface faces
+                # identically to the complete-face arrays of l >= 1)
+                fc_lo = F[d][tuple(lo_face)]
+                fc_hi = F[d][tuple(hi_face)]
+                nb_lo = list(lo_face)
+                nb_lo[d] = slice(box.lo[d] - 1, box.lo[d])
+                nb_hi = list(hi_face)
+                nb_hi[d] = slice(box.hi[d], box.hi[d] + 1)
+                Ql = Ql.at[tuple(nb_lo)].add(
+                    (-dt / g.dx[d]) * (favg_lo - fc_lo))
+                Ql = Ql.at[tuple(nb_hi)].add(
+                    (dt / g.dx[d]) * (favg_hi - fc_hi))
+            Qs[l] = Ql
+
+        slabs = None if l == 0 else self._bdry_slabs(F)
+        return Qs, slabs
+
+    # -- public API -----------------------------------------------------
+    def step(self, Qs: Sequence[Array], dt: float) -> Tuple[Array, ...]:
+        out, _ = self._advance_level(0, list(Qs), None, dt)
+        return tuple(out)
+
+    def total(self, Qs: Sequence[Array]) -> Array:
+        """Composite conserved integral: uncovered cells per level +
+        the full finest level."""
+        acc = jnp.asarray(0.0, dtype=self.dtype)
+        for l, spec in enumerate(self.levels):
+            g = spec.grid
+            vol = float(np.prod(g.dx))
+            Q = Qs[l]
+            if l + 1 < self.L:
+                box = self.levels[l + 1].box
+                mask = np.ones(g.n, dtype=bool)
+                mask[tuple(np.s_[box.lo[a]:box.hi[a]]
+                           for a in range(g.dim))] = False
+                acc = acc + vol * jnp.sum(jnp.where(jnp.asarray(mask),
+                                                    Q, 0.0))
+            else:
+                acc = acc + vol * jnp.sum(Q)
+        return acc

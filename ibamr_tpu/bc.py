@@ -62,7 +62,7 @@ class SideBC:
 
 def ghost_reflect_coeff(side: SideBC, h: float) -> float:
     """ghost = c * interior under the HOMOGENEOUS condition
-    a*Q + b*dQ/dn = 0 discretized at the face (see _ghost_values_cc):
+    a*Q + b*dQ/dn = 0 discretized at the face (see _ghost_layers_cc):
     c = -(a/2 - b/h) / (a/2 + b/h). Shared by the ghost fill, the
     fast-diagonalization 1D matrices, and the multigrid diagonals so
     the smoothers always match the operator discretization."""
@@ -125,69 +125,84 @@ class DomainBC:
 # Ghost filling for cell-centered fields
 # ---------------------------------------------------------------------------
 
-def _ghost_values_cc(Q: jnp.ndarray, axis: int, side: SideBC, h: float,
-                     lo_side: bool, g=None) -> jnp.ndarray:
-    """One ghost layer for a cell-centered field beyond a wall, from
-    the Robin condition a*Q + b*dQ/dn = g evaluated at the boundary
-    face with Q_face ~ (ghost + interior)/2 and dQ/dn ~
-    (ghost - interior)/h (outward normal; the ghost lies outward on
-    both sides):
-
-        ghost = (g - interior*(a/2 - b/h)) / (a/2 + b/h)
-
-    which reduces to 2g - i (dirichlet) and i + g*h (neumann). ``g``
-    optionally overrides the constant ``side.value`` with a
-    spatially-varying array broadcastable to the face slab."""
-    idx = [slice(None)] * Q.ndim
-    idx[axis] = slice(0, 1) if lo_side else slice(-1, None)
-    interior = Q[tuple(idx)]
+def _ghost_layers_cc(Q: jnp.ndarray, axis: int, side: SideBC, h: float,
+                     lo_side: bool, width: int, g=None) -> jnp.ndarray:
+    """``width`` ghost layers beyond a wall from the Robin condition,
+    reflecting each (ghost_k, interior_k) pair symmetrically about the
+    boundary face:  a*(ghost+int)/2 + b*(ghost-int)/((2k-1)h) = g
+    (reduces to odd reflection 2g - int_k for Dirichlet and the mirrored
+    int_k + (2k-1)h*g for Neumann — the reference's multi-width
+    RobinBcCoefStrategy fill, T5/T9). Layers are returned in array
+    order (outermost first on the lo side)."""
     a, b = side.coeffs()
-    denom = 0.5 * a + b / h
-    if denom == 0.0:
-        raise ValueError(
-            f"ill-posed ghost fill: a/2 + b/h == 0 for {side}")
     if g is None:
         g = side.value
-    return (g - interior * (0.5 * a - b / h)) / denom
+    layers = []
+    for k in range(1, width + 1):
+        idx = [slice(None)] * Q.ndim
+        idx[axis] = slice(k - 1, k) if lo_side else \
+            slice(Q.shape[axis] - k, Q.shape[axis] - k + 1)
+        interior = Q[tuple(idx)]
+        heff = (2 * k - 1) * h
+        denom = 0.5 * a + b / heff
+        if denom == 0.0:
+            raise ValueError(
+                f"ill-posed ghost fill: a/2 + b/h == 0 for {side}")
+        layers.append((g - interior * (0.5 * a - b / heff)) / denom)
+    if lo_side:
+        layers = layers[::-1]
+    return jnp.concatenate(layers, axis=axis) if width > 1 else layers[0]
 
 
 def fill_ghosts_cc(Q: jnp.ndarray, bc: DomainBC,
                    dx: Sequence[float],
-                   bdry_data: Optional[dict] = None) -> jnp.ndarray:
-    """Pad a cell-centered field with ONE ghost layer per side honoring
-    the BCs (periodic wrap or wall extrapolation). Output shape n+2 per
-    axis; stencil consumers slice the interior back out.
+                   bdry_data: Optional[dict] = None,
+                   width: int = 1) -> jnp.ndarray:
+    """Pad a cell-centered field with ``width`` ghost layers per side
+    honoring the BCs (periodic wrap or Robin wall extrapolation).
+    Output shape n + 2*width per axis; stencil consumers slice the
+    interior back out. Multi-width fills serve the wide-stencil
+    consumers (PPM/Godunov predictors) the way the reference's
+    variable-ghost-width RefineSchedules do (T5).
 
     ``bdry_data``: optional {(axis, side0or1): array} of
     spatially-varying boundary data g (each broadcastable to the face
     slab of that side), overriding the per-side constants."""
+    if width < 1:
+        raise ValueError(f"ghost width must be >= 1, got {width}")
+    if any(width > s for s in Q.shape):
+        raise ValueError(
+            f"ghost width {width} exceeds field extent {Q.shape}")
     out = Q
     for d, axbc in enumerate(bc.axes):
         if axbc.periodic:
             lo_idx = [slice(None)] * out.ndim
             hi_idx = [slice(None)] * out.ndim
-            lo_idx[d] = slice(-1, None)
-            hi_idx[d] = slice(0, 1)
+            lo_idx[d] = slice(-width, None)
+            hi_idx[d] = slice(0, width)
             lo_ghost, hi_ghost = out[tuple(lo_idx)], out[tuple(hi_idx)]
         else:
             g_lo = g_hi = None
             if bdry_data is not None:
                 g_lo = bdry_data.get((d, 0))
                 g_hi = bdry_data.get((d, 1))
-            lo_ghost = _ghost_values_cc(out, d, axbc.lo, dx[d], True,
-                                        g=_pad_bdry(g_lo, out, d))
-            hi_ghost = _ghost_values_cc(out, d, axbc.hi, dx[d], False,
-                                        g=_pad_bdry(g_hi, out, d))
+            lo_ghost = _ghost_layers_cc(out, d, axbc.lo, dx[d], True,
+                                        width,
+                                        g=_pad_bdry(g_lo, out, d, width))
+            hi_ghost = _ghost_layers_cc(out, d, axbc.hi, dx[d], False,
+                                        width,
+                                        g=_pad_bdry(g_hi, out, d, width))
         out = jnp.concatenate([lo_ghost, out, hi_ghost], axis=d)
     return out
 
 
-def _pad_bdry(g, out, d):
+def _pad_bdry(g, out, d, width: int = 1):
     """Boundary-data arrays are sized for the UNPADDED grid; make them
     broadcast against the partially-padded array: align axes the numpy
     way (prepend singleton axes up to full rank), let extent-1 axes
     broadcast, and edge-pad true-extent axes that earlier loop
-    iterations already grew by 2 ghost layers."""
+    iterations already grew by exactly 2*width ghost layers (any other
+    size mismatch is a caller bug and raises)."""
     if g is None or not hasattr(g, "ndim") or g.ndim == 0:
         return g
     if g.ndim > out.ndim:
@@ -202,12 +217,12 @@ def _pad_bdry(g, out, d):
     for gs, ts in zip(g.shape, target):
         if gs == ts or gs == 1:
             pads.append((0, 0))
-        elif gs == ts - 2:
-            pads.append((1, 1))
+        elif gs == ts - 2 * width:
+            pads.append((width, width))
         else:
             raise ValueError(
                 f"boundary data shape {g.shape} incompatible with face "
-                f"slab {tuple(target)}")
+                f"slab {tuple(target)} (ghost width {width})")
     return jnp.pad(g, pads, mode="edge")
 
 

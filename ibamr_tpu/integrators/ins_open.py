@@ -93,12 +93,17 @@ class INSOpenIntegrator:
                 hi_idx[e] = slice(-1, None)
                 lo_g, hi_g = out[tuple(lo_idx)], out[tuple(hi_idx)]
                 if e != d:
+                    from ibamr_tpu.bc import _pad_bdry
                     if s.bc.side(e, 0).prescribed:
-                        v = self.bdry.get((d, e, 0), 0.0)
-                        lo_g = 2.0 * jnp.asarray(v, c.dtype) - lo_g
+                        v = _pad_bdry(jnp.asarray(
+                            self.bdry.get((d, e, 0), 0.0), c.dtype),
+                            out, e)
+                        lo_g = 2.0 * v - lo_g
                     if s.bc.side(e, 1).prescribed:
-                        v = self.bdry.get((d, e, 1), 0.0)
-                        hi_g = 2.0 * jnp.asarray(v, c.dtype) - hi_g
+                        v = _pad_bdry(jnp.asarray(
+                            self.bdry.get((d, e, 1), 0.0), c.dtype),
+                            out, e)
+                        hi_g = 2.0 * v - hi_g
             out = jnp.concatenate([lo_g, out, hi_g], axis=e)
         return out
 

@@ -135,3 +135,45 @@ def vorticity_magnitude_cc(u: Sequence[jnp.ndarray], dx: Sequence[float]) -> jnp
         return jnp.abs(curl_2d_cc(u, dx))
     w = curl_3d_cc(u, dx)
     return jnp.sqrt(w[0] ** 2 + w[1] ** 2 + w[2] ** 2)
+
+
+# --------------------------------------------------------------------------
+# Strain rate (T4 hierarchy-math completion: the reference's
+# side-centered->cell strain/deformation diagnostics used by viscosity
+# models and data post-processing)
+# --------------------------------------------------------------------------
+
+def strain_rate_cc(u: Sequence[jnp.ndarray],
+                   dx: Sequence[float]) -> Tuple[Tuple[jnp.ndarray, ...], ...]:
+    """Symmetric strain-rate tensor E_ij = (du_i/dx_j + du_j/dx_i)/2 at
+    cell centers (periodic stencils). Diagonal entries use the exact MAC
+    face differences (native centering); off-diagonals use centered
+    differences of the cell-averaged components."""
+    dim = len(u)
+    ucc = fc_to_cc(u)
+
+    def dcc(f, axis, h):
+        return (jnp.roll(f, -1, axis) - jnp.roll(f, 1, axis)) / (2.0 * h)
+
+    E = [[None] * dim for _ in range(dim)]
+    for i in range(dim):
+        # du_i/dx_i from the two faces bounding the cell: exact MAC
+        E[i][i] = (jnp.roll(u[i], -1, i) - u[i]) / dx[i]
+        for j in range(i + 1, dim):
+            Eij = 0.5 * (dcc(ucc[i], j, dx[j]) + dcc(ucc[j], i, dx[i]))
+            E[i][j] = Eij
+            E[j][i] = Eij
+    return tuple(tuple(row) for row in E)
+
+
+def strain_rate_magnitude_cc(u: Sequence[jnp.ndarray],
+                             dx: Sequence[float]) -> jnp.ndarray:
+    """|E| = sqrt(2 E:E) — the shear-rate scalar of generalized-Newtonian
+    viscosity models."""
+    E = strain_rate_cc(u, dx)
+    acc = None
+    for row in E:
+        for e in row:
+            t = e * e
+            acc = t if acc is None else acc + t
+    return jnp.sqrt(2.0 * acc)

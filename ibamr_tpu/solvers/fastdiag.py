@@ -45,7 +45,7 @@ from ibamr_tpu.grid import StaggeredGrid
 def laplacian_1d_cc(n: int, h: float, axbc: AxisBC) -> np.ndarray:
     """BC-modified tridiagonal for a cell-centered axis (homogeneous).
 
-    The boundary row uses the Robin reflection of bc._ghost_values_cc:
+    The boundary row uses the Robin reflection of bc._ghost_layers_cc:
     homogeneous ghost = r * interior with r = -(a/2 - b/h)/(a/2 + b/h),
     so the end diagonal is (-2 + r)/h^2 — which reproduces the classic
     -3 (dirichlet, r=-1) and -1 (neumann, r=+1) rows and covers general

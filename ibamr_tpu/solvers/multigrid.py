@@ -71,8 +71,6 @@ def homogeneous_bc(bc: DomainBC) -> DomainBC:
     return DomainBC(axes=tuple(axes))
 
 
-
-
 def _nullspace(bc: DomainBC) -> bool:
     """True when the Poisson operator has the constant nullspace: every
     axis periodic or pure-Neumann on both sides."""
@@ -128,8 +126,9 @@ def _face_coeffs(D: Array, bc: DomainBC) -> Tuple[Array, ...]:
 def _apply_op(Q: Array, level: _Level, bc: DomainBC, alpha: float,
               beta: float, bdry_data: Optional[dict] = None) -> Array:
     """alpha*Q + beta*div(grad Q)  (constant coefficient), or
-    alpha*Q + div(D grad Q) when the level carries face coefficients.
-    Conservative face-flux form so coarse operators stay symmetric."""
+    alpha*Q + beta*div(D grad Q) when the level carries face
+    coefficients. Conservative face-flux form so coarse operators stay
+    symmetric."""
     dim = Q.ndim
     dx = level.dx
     G = fill_ghosts_cc(Q, bc, dx, bdry_data=bdry_data)
@@ -151,7 +150,7 @@ def _apply_op(Q: Array, level: _Level, bc: DomainBC, alpha: float,
             sl_hi[d] = slice(1, None)
             flux_hi = Df[tuple(sl_hi)] * (G[tuple(hi)] - Q) / dx[d]
             flux_lo = Df[tuple(sl_lo)] * (Q - G[tuple(lo)]) / dx[d]
-            out = out + (flux_hi - flux_lo) / dx[d]
+            out = out + beta * (flux_hi - flux_lo) / dx[d]
     return out
 
 
@@ -176,8 +175,8 @@ def _assemble_diag(shape, bc: DomainBC, dx, alpha: float, beta: float,
                 idx[d] = slice(0, 1) if s == 0 else slice(-1, None)
                 diag = diag.at[tuple(idx)].add(beta * c / dx[d] ** 2)
         return diag
-    # variable-coefficient: diag = alpha - (D_hi + D_lo)/h^2 per axis,
-    # with boundary-face reflection corrections
+    # variable-coefficient: diag = alpha - beta*(D_hi + D_lo)/h^2 per
+    # axis, with boundary-face reflection corrections
     diag = jnp.full(shape, alpha, dtype=dtype)
     for d in range(dim):
         Df = D_face[d]
@@ -185,7 +184,8 @@ def _assemble_diag(shape, bc: DomainBC, dx, alpha: float, beta: float,
         sl_hi = [slice(None)] * dim
         sl_lo[d] = slice(0, -1)
         sl_hi[d] = slice(1, None)
-        diag = diag - (Df[tuple(sl_lo)] + Df[tuple(sl_hi)]) / dx[d] ** 2
+        diag = diag - beta * (Df[tuple(sl_lo)] + Df[tuple(sl_hi)]) \
+            / dx[d] ** 2
         ax = bc.axes[d]
         if ax.periodic:
             continue
@@ -196,7 +196,7 @@ def _assemble_diag(shape, bc: DomainBC, dx, alpha: float, beta: float,
             fidx = [slice(None)] * dim
             fidx[d] = slice(0, 1) if s == 0 else slice(-1, None)
             diag = diag.at[tuple(idx)].add(
-                c * Df[tuple(fidx)] / dx[d] ** 2)
+                beta * c * Df[tuple(fidx)] / dx[d] ** 2)
     return diag
 
 
@@ -293,12 +293,6 @@ class PoissonMultigrid:
         shape = tuple(int(v) for v in shape)
         dx = tuple(float(v) for v in dx)
         self.levels: List[_Level] = []
-        # fold beta into the cell coefficient so the natural
-        # variable-viscosity Helmholtz form alpha + beta*div(D grad)
-        # works: it equals alpha + div((beta*D) grad)
-        if D is not None and beta != 1.0:
-            D = beta * D
-            self.beta = 1.0
         Dl = D
         while True:
             D_face = None if Dl is None else _face_coeffs(Dl, bc)

@@ -1,0 +1,195 @@
+"""L-level nested AMR (T4/S4 beyond two levels) + T4/T5 diagnostics.
+
+Oracles: exact composite conservation over a 3-level hierarchy with
+advection + diffusion (the reflux correctness proof), accuracy against
+a uniform-fine reference on a smooth profile, strain-rate analytic
+checks, and multi-width Robin ghost fills reproducing exact linear
+profiles layer by layer."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from ibamr_tpu.amr import FineBox
+from ibamr_tpu.amr_multilevel import MultiLevelAdvDiff, build_hierarchy
+from ibamr_tpu.bc import (DomainBC, dirichlet_axis, fill_ghosts_cc,
+                          neumann_axis, robin_axis)
+from ibamr_tpu.grid import StaggeredGrid
+from ibamr_tpu.ops.stencils import (strain_rate_cc,
+                                    strain_rate_magnitude_cc)
+
+
+def _gauss(c):
+    X, Y = c
+    return jnp.exp(-((X - 0.4) ** 2 + (Y - 0.5) ** 2) / 0.02)
+
+
+def _vel(mesh):
+    # constant advection velocity (u, v)
+    return (0.7 + 0.0 * mesh[0], 0.3 + 0.0 * mesh[1])
+
+
+def _three_level(n=32, kappa=0.002, scheme="centered"):
+    g = StaggeredGrid(n=(n, n), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    boxes = [FineBox(lo=(n // 4, n // 4), shape=(n // 2, n // 2)),
+             FineBox(lo=(n // 4, n // 4), shape=(n // 2, n // 2))]
+    return MultiLevelAdvDiff(g, boxes, kappa=kappa, scheme=scheme,
+                             vel_fn=_vel)
+
+
+def test_hierarchy_validates_nesting():
+    g = StaggeredGrid(n=(32, 32), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    levels = build_hierarchy(
+        g, [FineBox(lo=(8, 8), shape=(16, 16)),
+            FineBox(lo=(8, 8), shape=(16, 16))])
+    assert len(levels) == 3
+    assert levels[1].grid.n == (32, 32)
+    assert levels[2].grid.n == (32, 32)
+    # level-2 spacing is 4x finer than the root
+    assert np.isclose(levels[2].grid.dx[0], g.dx[0] / 4)
+
+
+def test_three_level_conservation():
+    """Composite integral conserved to roundoff across an L=3 subcycled
+    advance with BOTH advection and diffusion active — requires correct
+    refluxing at both CF interfaces."""
+    ml = _three_level()
+    Qs = ml.initialize(_gauss)
+    t0 = float(ml.total(Qs))
+    dt = 0.2 / 32   # CFL ~ 0.2 on the root
+    for _ in range(20):
+        Qs = ml.step(Qs, dt)
+    t1 = float(ml.total(Qs))
+    assert abs(t1 - t0) < 1e-12 * max(1.0, abs(t0))
+
+
+def _composite_error_vs_uniform(n, steps):
+    """Max error of the 3-level composite level-2 solution against a
+    uniform 4x-resolution periodic reference, after ``steps`` root
+    steps at fixed physical dt*steps."""
+    ml = _three_level(n=n, kappa=0.001)
+    Qs = ml.initialize(_gauss)
+    gf = StaggeredGrid(n=(4 * n, 4 * n), x_lo=(0.0, 0.0),
+                       x_up=(1.0, 1.0))
+    ref = MultiLevelAdvDiff(gf, [], kappa=0.001, vel_fn=_vel)
+    Qr = ref.initialize(_gauss)
+
+    dt = 0.1 / n          # fixed CFL across resolutions
+    for _ in range(steps):
+        Qs = ml.step(Qs, dt)
+    for _ in range(4 * steps):
+        Qr = ref.step(Qr, dt / 4)
+
+    # level-2 covers root cells [3n/8, 5n/8): level-1 lo n/4 plus half
+    # of level-2's lo (n/4 level-1 cells = n/8 root cells)
+    lo_root = n // 4 + n // 8
+    ext = n // 4
+    sl = np.s_[4 * lo_root:4 * (lo_root + ext)]
+    ref_region = np.asarray(Qr[0])[sl, sl]
+    return np.max(np.abs(np.asarray(Qs[2]) - ref_region))
+
+
+def test_three_level_tracks_uniform_fine_and_converges():
+    """The composite solution tracks a uniform 4x reference closely and
+    improves under refinement. (The gaussian's tails extend beyond the
+    level-2 box, so the comparison includes coarse-level error advected
+    through the CF interface — clean 2nd-order ratios are not
+    measurable at these sizes; the absolute-accuracy bound plus
+    monotone improvement is the meaningful check, with conservation
+    tested to roundoff separately.)"""
+    e16 = _composite_error_vs_uniform(16, 8)
+    e32 = _composite_error_vs_uniform(32, 16)
+    assert e32 < 3e-3
+    assert e16 > e32
+
+
+def test_strain_rate_analytic():
+    """Linear shear u = (gamma*y, 0): E_xy = gamma/2 exact, diagonal 0,
+    |E| = sqrt(2*(2*(gamma/2)^2)) = gamma*sqrt... check both."""
+    n = 16
+    h = 1.0 / n
+    gamma = 0.8
+    y_cc = (np.arange(n) + 0.5) * h
+    u = jnp.asarray(np.broadcast_to(gamma * y_cc[None, :], (n, n)))
+    v = jnp.zeros((n, n))
+    E = strain_rate_cc((u, v), (h, h))
+    assert np.max(np.abs(np.asarray(E[0][0]))) < 1e-12
+    assert np.max(np.abs(np.asarray(E[1][1]))) < 1e-12
+    interior = np.s_[:, 1:-1]   # periodic wrap pollutes the y edges
+    assert np.max(np.abs(np.asarray(E[0][1])[interior]
+                         - gamma / 2)) < 1e-12
+    mag = np.asarray(strain_rate_magnitude_cc((u, v), (h, h)))
+    assert np.max(np.abs(mag[interior] - gamma)) < 1e-10
+
+
+def test_multiwidth_ghost_fill_linear_exact():
+    """Width-3 fills must extend an affine field exactly for Dirichlet
+    and Neumann data consistent with it (each ghost pair straddles the
+    face symmetrically, so affine profiles are represented exactly)."""
+    n = 8
+    h = 1.0 / n
+    x = (np.arange(n) + 0.5) * h
+    Q = jnp.asarray(np.broadcast_to((2.0 * x)[:, None], (n, n)))
+    # axis 0: dirichlet with the exact face values (0 at lo, 2 at hi);
+    # axis 1: neumann 0 (field constant along y)
+    bc = DomainBC((dirichlet_axis(0.0, 2.0), neumann_axis()))
+    for w in (1, 2, 3):
+        G = np.asarray(fill_ghosts_cc(Q, bc, (h, h), width=w))
+        xg = (np.arange(-w, n + w) + 0.5) * h
+        expect = np.broadcast_to((2.0 * xg)[:, None], (n + 2 * w, n + 2 * w))
+        assert np.max(np.abs(G - expect)) < 1e-12, w
+
+
+def test_multiwidth_rejects_oversized_width_and_bad_data():
+    """width > field extent raises (no silent truncation), and
+    wrongly-sized boundary data raises instead of silently padding."""
+    import pytest
+
+    n = 4
+    h = 1.0 / n
+    Q = jnp.zeros((n, n))
+    bc = DomainBC((dirichlet_axis(), dirichlet_axis()))
+    with pytest.raises(ValueError):
+        fill_ghosts_cc(Q, bc, (h, h), width=n + 1)
+    with pytest.raises(ValueError):
+        # data sized n-2 along the already-grown axis: misaligned
+        fill_ghosts_cc(Q, bc, (h, h),
+                       bdry_data={(1, 0): jnp.zeros((n - 2, 1))})
+
+
+def test_open_channel_varying_lid_profile():
+    """Spatially-varying tangential wall data must flow through the
+    advection ghosts (regression: broadcast failure on grown slabs)."""
+    import jax
+
+    from ibamr_tpu.integrators.ins_open import INSOpenIntegrator
+    from ibamr_tpu.solvers.stokes import channel_bc
+
+    nx, ny = 12, 8
+    lid = jnp.asarray(0.1 * np.sin(np.pi * np.arange(nx + 1) / nx))
+    integ = INSOpenIntegrator((nx, ny), (1.0 / nx, 1.0 / ny),
+                              channel_bc(2), mu=0.1, dt=0.01,
+                              bdry={(0, 0, 0): 0.3,
+                                    (0, 1, 1): lid[:, None]},
+                              tol=1e-7)
+    st = integ.initialize()
+    st = jax.jit(integ.step)(st)
+    assert np.all(np.isfinite(np.asarray(st.u[0])))
+
+
+def test_multiwidth_robin_consistency():
+    """Width-2 Robin fill: each ghost pair satisfies the Robin relation
+    at the face with its own pair spacing."""
+    n = 8
+    h = 1.0 / n
+    rng = np.random.default_rng(0)
+    Q = jnp.asarray(rng.standard_normal((n, n)))
+    a, b, g = 2.0, 0.7, 0.3
+    bc = DomainBC((robin_axis(a, b, lo=g, hi=g), neumann_axis()))
+    G = np.asarray(fill_ghosts_cc(Q, bc, (h, h), width=2))
+    Qn = np.asarray(Q)
+    for k in (1, 2):
+        ghost = G[2 - k, 2:-2]          # k-th lo ghost layer
+        interior = Qn[k - 1, :]
+        heff = (2 * k - 1) * h
+        resid = a * (ghost + interior) / 2 + b * (ghost - interior) / heff
+        assert np.max(np.abs(resid - g)) < 1e-12
