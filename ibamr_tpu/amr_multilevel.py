@@ -105,7 +105,8 @@ class MultiLevelAdvDiff:
                             * g.dx[e]
                     coords.append(c)
                 mesh = np.meshgrid(*coords, indexing="ij")
-                comps.append(jnp.asarray(vel_fn(mesh)[d], dtype=dtype))
+                comps.append(jnp.asarray(vel_fn(mesh)[d],
+                                         dtype=self.dtype))
             self.u_faces.append(tuple(comps))
 
     # ------------------------------------------------------------------

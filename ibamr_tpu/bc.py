@@ -188,15 +188,15 @@ def fill_ghosts_cc(Q: jnp.ndarray, bc: DomainBC,
                 g_hi = bdry_data.get((d, 1))
             lo_ghost = _ghost_layers_cc(out, d, axbc.lo, dx[d], True,
                                         width,
-                                        g=_pad_bdry(g_lo, out, d, width))
+                                        g=pad_boundary_data(g_lo, out, d, width))
             hi_ghost = _ghost_layers_cc(out, d, axbc.hi, dx[d], False,
                                         width,
-                                        g=_pad_bdry(g_hi, out, d, width))
+                                        g=pad_boundary_data(g_hi, out, d, width))
         out = jnp.concatenate([lo_ghost, out, hi_ghost], axis=d)
     return out
 
 
-def _pad_bdry(g, out, d, width: int = 1):
+def pad_boundary_data(g, out, d, width: int = 1):
     """Boundary-data arrays are sized for the UNPADDED grid; make them
     broadcast against the partially-padded array: align axes the numpy
     way (prepend singleton axes up to full rank), let extent-1 axes

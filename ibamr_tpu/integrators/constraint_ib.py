@@ -103,7 +103,9 @@ class ConstraintIBMethod:
                  prescribed_fn: Optional[Callable] = None,
                  deformation_fn: Optional[Callable] = None,
                  kernel: Kernel = "IB_4",
-                 indicator_floor: float = 1e-4):
+                 indicator_floor: float = 1e-4,
+                 density_ratio=None, gravity=None,
+                 virtual_mass: float = 1.0):
         self.ins = ins
         self.bodies = bodies
         dim = ins.grid.dim
@@ -117,6 +119,22 @@ class ConstraintIBMethod:
         # spread-indicator threshold below which a cell is treated as
         # outside every body (no correction applied)
         self.indicator_floor = float(indicator_floor)
+        # inertial (time-dependent) rigid-body dynamics: per-body
+        # density ratio rho_body/rho_fluid (the reference's free-moving
+        # ConstraintIB bodies with excess inertia — Bhalla et al. 2013
+        # §2.4). ratio == 1 (or None) is the neutrally-buoyant limit
+        # where the momentum projection alone IS the dynamics.
+        self.density_ratio = None if density_ratio is None else \
+            jnp.asarray(density_ratio, dtype=ins.dtype).reshape(-1, 1)
+        # virtual-mass stabilization weight (0 = raw explicit
+        # Newton-Euler update; 1 = interior-fluid added mass)
+        self.virtual_mass = float(virtual_mass)
+        if gravity is None:
+            self._g_modes = None
+        else:
+            g = jnp.asarray(gravity, dtype=ins.dtype)
+            self._g_modes = jnp.concatenate(
+                [g, jnp.zeros(modes - dim, dtype=ins.dtype)])[None, :]
 
     # -- normalized velocity imposition --------------------------------------
     def _impose(self, u: Vel, X: jnp.ndarray, dU: jnp.ndarray) -> Vel:
@@ -154,6 +172,22 @@ class ConstraintIBMethod:
 
         # 3. rigid projection; free DOFs keep it, others prescribed
         U_proj = project_rigid(X, bodies, U_i)
+        # 3b. excess-inertia update for density-mismatched free bodies.
+        # Momentum balance of body + slaved interior fluid gives
+        #   V = V_fluid + (s-1)/s * (V_prev + dt g - V_fluid),
+        # s = rho_b/rho_f — but the explicit form is added-mass
+        # UNSTABLE for light bodies (1/s amplifies the per-step
+        # innovation). The virtual-mass-stabilized update divides by
+        # (s + vm) instead: |(s-1)/(s+1)| < 1 for every s > 0, the
+        # equilibrium (terminal velocity) is unchanged, and s == 1
+        # still reduces exactly to the pure projection.
+        if self.density_ratio is not None:
+            s = self.density_ratio
+            U_prev = state.U_body
+            if self._g_modes is not None:
+                U_prev = U_prev + dt * self._g_modes
+            U_proj = U_proj + (s - 1.0) / (s + self.virtual_mass) \
+                * (U_prev - U_proj)
         if self.prescribed_fn is not None:
             U_pres = jnp.asarray(self.prescribed_fn(t_new),
                                  dtype=U_proj.dtype)

@@ -28,6 +28,7 @@ from typing import Dict, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from ibamr_tpu.bc import pad_boundary_data
 from ibamr_tpu.solvers.stokes import StaggeredStokesSolver, StokesBC
 
 Array = jnp.ndarray
@@ -93,14 +94,13 @@ class INSOpenIntegrator:
                 hi_idx[e] = slice(-1, None)
                 lo_g, hi_g = out[tuple(lo_idx)], out[tuple(hi_idx)]
                 if e != d:
-                    from ibamr_tpu.bc import _pad_bdry
                     if s.bc.side(e, 0).prescribed:
-                        v = _pad_bdry(jnp.asarray(
+                        v = pad_boundary_data(jnp.asarray(
                             self.bdry.get((d, e, 0), 0.0), c.dtype),
                             out, e)
                         lo_g = 2.0 * v - lo_g
                     if s.bc.side(e, 1).prescribed:
-                        v = _pad_bdry(jnp.asarray(
+                        v = pad_boundary_data(jnp.asarray(
                             self.bdry.get((d, e, 1), 0.0), c.dtype),
                             out, e)
                         hi_g = 2.0 * v - hi_g

@@ -84,17 +84,30 @@ def _stencil(grid: StaggeredGrid, X: jnp.ndarray, centering, kernel: Kernel):
         idxs.append(idx)
         ws.append(w)
 
-    # tensor-product combine: linear index and weight per stencil point
-    N = X.shape[0]
+    return (_combine_linear(idxs, specs, grid, X.shape[0]),
+            _combine_tensor(ws, specs, X.shape[0]))
+
+
+def _combine_linear(idxs, specs, grid, N):
+    """Tensor-product linear grid index per stencil point (N, S) — the
+    single source of the index linearization (shared by the value and
+    gradient transfers)."""
     lin = idxs[0]
-    wgt = ws[0]
-    for d in range(1, dim):
+    for d in range(1, len(idxs)):
         s_d = specs[d][0]
         lin = lin[..., :, None] * grid.n[d] + idxs[d].reshape(
             (N,) + (1,) * (lin.ndim - 1) + (s_d,))
-        wgt = wgt[..., :, None] * ws[d].reshape(
-            (N,) + (1,) * (wgt.ndim - 1) + (s_d,))
-    return lin.reshape(N, -1), wgt.reshape(N, -1)
+    return lin.reshape(N, -1)
+
+
+def _combine_tensor(factors, specs, N):
+    """Tensor-product combine of per-axis (N, s_d) factors -> (N, S)."""
+    t = factors[0]
+    for d in range(1, len(factors)):
+        s_d = specs[d][0]
+        t = t[..., :, None] * factors[d].reshape(
+            (N,) + (1,) * (t.ndim - 1) + (s_d,))
+    return t.reshape(N, -1)
 
 
 def interpolate(field: jnp.ndarray, grid: StaggeredGrid, X: jnp.ndarray,
@@ -145,3 +158,98 @@ def spread_vel(F: jnp.ndarray, grid: StaggeredGrid, X: jnp.ndarray,
     return tuple(spread(F[:, d], grid, X, centering=d, kernel=kernel,
                         weights=weights)
                  for d in range(grid.dim))
+
+
+# --------------------------------------------------------------------------
+# Kernel-GRADIENT transfers (P18 IMP material points: velocity-gradient
+# interpolation dF/dt = (grad u) F and divergence-form stress spreading
+# f = -sum_p V_p P F^T grad(delta) — the reference's IMPMethod kernels)
+# --------------------------------------------------------------------------
+
+def _stencil_with_grad(grid: StaggeredGrid, X: jnp.ndarray, centering,
+                       kernel: Kernel):
+    """Like :func:`_stencil` but additionally returns the spatial
+    gradient of each tensor-product weight w.r.t. the marker position:
+    lin (N, S), W (N, S), dW (N, S, dim) with
+    dW[..., j] = (phi_j'(r)/h_j) * prod_{d != j} phi_d(r_d)."""
+    import jax
+
+    specs = get_kernel_axes(kernel, centering, grid.dim)
+    offsets = _centering_offsets(grid, centering)
+    dim = grid.dim
+    idxs, ws, dws = [], [], []
+    for d in range(dim):
+        support_d, phi_d = specs[d]
+        xi = (X[:, d] - grid.x_lo[d]) / grid.dx[d] - offsets[d]
+        j_raw, w = _axis_weights_indices_raw(xi, support_d, phi_d)
+        # derivative of phi at the same offsets: d/dX = phi'(r)/h
+        r = xi[:, None] - j_raw.astype(xi.dtype)
+        dphi = jax.vmap(jax.grad(phi_d))(r.reshape(-1)).reshape(r.shape)
+        idxs.append(jnp.mod(j_raw, grid.n[d]))
+        ws.append(w)
+        dws.append(dphi / grid.dx[d])
+
+    N = X.shape[0]
+    lin = _combine_linear(idxs, specs, grid, N)
+    W = _combine_tensor(ws, specs, N)
+    dW = jnp.stack([_combine_tensor([dws[d] if d == j else ws[d]
+                                     for d in range(dim)], specs, N)
+                    for j in range(dim)], axis=-1)
+    return lin, W, dW
+
+
+def interpolate_vel_and_gradient(u: Sequence[jnp.ndarray],
+                                 grid: StaggeredGrid, X: jnp.ndarray,
+                                 kernel: Kernel = "BSPLINE_3",
+                                 weights: Optional[jnp.ndarray] = None):
+    """Fused (U, grad u) at markers: one stencil build + one gather per
+    component serves both the value (N, dim) and the gradient
+    (N, dim, dim) — the IMP step's hot transfer."""
+    dim = grid.dim
+    vals_rows, grad_rows = [], []
+    for i in range(dim):
+        lin, W, dW = _stencil_with_grad(grid, X, i, kernel)
+        vals = jnp.take(u[i].reshape(-1), lin, axis=0)
+        vals_rows.append(jnp.sum(vals * W, axis=-1))
+        grad_rows.append(jnp.sum(vals[..., None] * dW, axis=1))
+    U = jnp.stack(vals_rows, axis=-1)
+    G = jnp.stack(grad_rows, axis=1)
+    if weights is not None:
+        U = U * weights[:, None]
+        G = G * weights[:, None, None]
+    return U, G
+
+
+def interpolate_gradient_vel(u: Sequence[jnp.ndarray],
+                             grid: StaggeredGrid, X: jnp.ndarray,
+                             kernel: Kernel = "BSPLINE_3",
+                             weights: Optional[jnp.ndarray] = None
+                             ) -> jnp.ndarray:
+    """Velocity gradient at markers: G[:, i, j] = du_i/dx_j (N, dim,
+    dim), each component sampled at its own MAC centering."""
+    _, G = interpolate_vel_and_gradient(u, grid, X, kernel=kernel,
+                                        weights=weights)
+    return G
+
+
+def spread_stress(PFt: jnp.ndarray, V: jnp.ndarray, grid: StaggeredGrid,
+                  X: jnp.ndarray, kernel: Kernel = "BSPLINE_3",
+                  weights: Optional[jnp.ndarray] = None) -> Vel:
+    """Divergence-form internal-force spreading of the per-point stress
+    ``PFt = P(F) F^T`` (N, dim, dim) with reference volumes V (N,):
+    f_i(x_g) = -(1/h^dim) sum_p V_p sum_j PFt[p, i, j] dW_g/dx_j.
+    The total spread force vanishes identically (sum_g dW = 0), so
+    momentum is conserved to roundoff."""
+    dim = grid.dim
+    inv_vol = 1.0 / math.prod(grid.dx)
+    out = []
+    for i in range(dim):
+        lin, _, dW = _stencil_with_grad(grid, X, i, kernel)
+        coeff = PFt[:, i, :] * V[:, None]
+        if weights is not None:
+            coeff = coeff * weights[:, None]
+        vals = -inv_vol * jnp.sum(coeff[:, None, :] * dW, axis=-1)
+        f = jnp.zeros(grid.n, dtype=vals.dtype).reshape(-1)
+        f = f.at[lin.reshape(-1)].add(vals.reshape(-1))
+        out.append(f.reshape(grid.n))
+    return tuple(out)
