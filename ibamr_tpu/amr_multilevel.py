@@ -82,6 +82,16 @@ class MultiLevelAdvDiff:
         import jax
 
         self.dtype = jax.dtypes.canonicalize_dtype(dtype)
+        # Optional sharding pinned at the two level-synchronization
+        # points (set by parallel.mesh.make_sharded_multilevel_step):
+        # the CF ghost-extended child array and each level's
+        # post-flux-update array. These are the hierarchy's boundary-
+        # exchange moments; pinning them (replicated) makes the
+        # exchanges explicit all-gathers and keeps XLA's SPMD
+        # partitioner from mis-propagating through the scatter/gather
+        # composites (observed wrong-value miscompilation when left
+        # unconstrained). Stencil/flux compute stays sharded.
+        self.sync_sharding = None
 
         # face velocities per level: component d on faces along d.
         # level 0: periodic lower-face shape n; levels >= 1: complete
@@ -110,6 +120,15 @@ class MultiLevelAdvDiff:
             self.u_faces.append(tuple(comps))
 
     # ------------------------------------------------------------------
+    def _sync(self, x: Array) -> Array:
+        """Apply the level-synchronization sharding pin (no-op when
+        unsharded)."""
+        if self.sync_sharding is None:
+            return x
+        import jax
+
+        return jax.lax.with_sharding_constraint(x, self.sync_sharding)
+
     def initialize(self, fn) -> Tuple[Array, ...]:
         out = []
         for spec in self.levels:
@@ -210,12 +229,15 @@ class MultiLevelAdvDiff:
         Q_old = Qs[l]
         if l == 0:
             F = self._fluxes(0, Q_old, None)
-            Q_new = Q_old - dt * self._div(F, g, complete=False)
+            Q_new = self._sync(Q_old - dt * self._div(F, g,
+                                                      complete=False))
         else:
-            Qg = fill_fine_ghosts(Q_old, p_ghost_src, spec.box,
-                                  ghost=self.GHOST)
+            Qg = self._sync(fill_fine_ghosts(Q_old, p_ghost_src,
+                                             spec.box,
+                                             ghost=self.GHOST))
             F = self._fluxes(l, Q_old, Qg)
-            Q_new = Q_old - dt * self._div(F, g, complete=True)
+            Q_new = self._sync(Q_old - dt * self._div(F, g,
+                                                      complete=True))
 
         Qs = list(Qs)
         Qs[l] = Q_new
@@ -264,7 +286,7 @@ class MultiLevelAdvDiff:
                     (-dt / g.dx[d]) * (favg_lo - fc_lo))
                 Ql = Ql.at[tuple(nb_hi)].add(
                     (dt / g.dx[d]) * (favg_hi - fc_hi))
-            Qs[l] = Ql
+            Qs[l] = self._sync(Ql)
 
         slabs = None if l == 0 else self._bdry_slabs(F)
         return Qs, slabs

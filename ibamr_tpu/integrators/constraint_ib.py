@@ -132,6 +132,11 @@ class ConstraintIBMethod:
         if gravity is None:
             self._g_modes = None
         else:
+            if self.density_ratio is None:
+                raise ValueError(
+                    "gravity without density_ratio has no effect: a "
+                    "neutrally-buoyant body feels no net gravity; pass "
+                    "density_ratio to enable the excess-mass dynamics")
             g = jnp.asarray(gravity, dtype=ins.dtype)
             self._g_modes = jnp.concatenate(
                 [g, jnp.zeros(modes - dim, dtype=ins.dtype)])[None, :]

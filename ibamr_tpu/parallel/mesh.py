@@ -145,6 +145,41 @@ def make_sharded_adv_diff_step(integ, mesh: Mesh):
     return jax.jit(step)
 
 
+def make_sharded_multilevel_step(ml, mesh: Mesh):
+    """Level-by-level AMR parallelism (S4): every level of a
+    :class:`~ibamr_tpu.amr_multilevel.MultiLevelAdvDiff` hierarchy is
+    sharded over the SAME device mesh (each level is a dense box array,
+    so equal-block GSPMD sharding balances each level independently —
+    the reference's per-level LoadBalancer pass). Coarse-fine transfer
+    (quadratic ghost gathers, restriction, reflux slabs) crosses the
+    level shardings as XLA-inserted collectives — the Refine/Coarsen
+    schedule analog (SURVEY.md §2.3 S4)."""
+    import copy
+
+    dim = len(ml.levels[0].grid.n)
+    ml = copy.copy(ml)
+    # pin the level-synchronization arrays (CF ghost fills, post-update
+    # level states) replicated: these are the hierarchy's boundary
+    # exchanges, and leaving their sharding to SPMD propagation
+    # miscompiles (wrong values, observed on the CPU mesh); flux and
+    # stencil compute between the pins stays sharded
+    ml.sync_sharding = NamedSharding(mesh, P(*([None] * dim)))
+
+    shardings = []
+    for spec in ml.levels:
+        pspec = grid_pspec(mesh, len(spec.grid.n))
+        shardings.append(NamedSharding(mesh, pspec))
+
+    def constrain(Qs):
+        return tuple(jax.lax.with_sharding_constraint(q, s)
+                     for q, s in zip(Qs, shardings))
+
+    def step(Qs, dt):
+        return constrain(ml.step(constrain(tuple(Qs)), dt))
+
+    return jax.jit(step)
+
+
 def make_sharded_ib_step(integ, mesh: Mesh, sharded_markers: bool = True,
                          marker_cap: Optional[int] = None,
                          marker_slack: float = 2.0):

@@ -1,0 +1,100 @@
+"""S3 workload-balanced partitioning + S4 sharded multilevel AMR.
+
+Oracles: the cost model must steer the mesh factorization AWAY from
+splitting through a marker cluster (picking the axis that balances it),
+capacity sizing must cover the measured peak, the rebalance trigger
+must fire exactly when pools would overflow or a much better partition
+exists, and the sharded 3-level composite step must equal the
+single-device result to roundoff on the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ibamr_tpu.amr import FineBox
+from ibamr_tpu.amr_multilevel import MultiLevelAdvDiff
+from ibamr_tpu.grid import StaggeredGrid
+from ibamr_tpu.parallel.mesh import make_sharded_multilevel_step
+from ibamr_tpu.parallel.workload import (choose_mesh, needs_rebalance,
+                                         recommended_capacity,
+                                         shard_marker_counts,
+                                         workload_estimate)
+
+
+def _grid(n=64):
+    return StaggeredGrid(n=(n, n), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+
+
+def test_counts_match_owner_math():
+    g = _grid(16)
+    X = np.array([[0.1, 0.1], [0.9, 0.1], [0.9, 0.9], [0.6, 0.2]])
+    counts = shard_marker_counts(X, g, (2, 2))
+    assert counts.tolist() == [[1, 0], [2, 1]]
+
+
+def test_choose_mesh_avoids_splitting_cluster():
+    """Markers concentrated in a thin x-slab: sharding along x puts
+    nearly all markers on one device; the cost model must prefer the
+    y-split (or a mixed split with lower max cost)."""
+    g = _grid(64)
+    rng = np.random.default_rng(0)
+    X = np.stack([0.5 + 0.01 * rng.standard_normal(4000),
+                  rng.random(4000)], axis=-1)
+    rep = choose_mesh(X, g, 8, max_axes=2, min_block=4)
+    # max cost under the chosen split beats the pure-x split clearly
+    counts_x = shard_marker_counts(X, g, (8, 1))
+    cost_x = workload_estimate(counts_x, g).max()
+    assert rep.cost_per_shard.max() < 0.5 * cost_x
+    # and the chosen split balances markers well
+    assert rep.max_markers < 4000 // 2
+
+
+def test_capacity_covers_peak():
+    g = _grid(32)
+    rng = np.random.default_rng(1)
+    X = rng.random((1000, 2))
+    counts = shard_marker_counts(X, g, (4, 2))
+    cap = recommended_capacity(counts, slack=1.5)
+    assert cap >= counts.max()
+    assert cap % 8 == 0
+
+
+def test_needs_rebalance_triggers_on_drift():
+    g = _grid(64)
+    rng = np.random.default_rng(2)
+    # balanced start
+    X0 = rng.random((2000, 2))
+    rep = choose_mesh(X0, g, 8, min_block=4)
+    assert not needs_rebalance(X0, g, rep.sizes, rep.capacity,
+                               min_block=4)
+    # everything drifts into one corner: pools overflow -> rebalance
+    X1 = 0.1 * X0
+    assert needs_rebalance(X1, g, rep.sizes, rep.capacity, min_block=4)
+
+
+def test_sharded_multilevel_matches_single_device(mesh8):
+    """S4: the 3-level composite advance under an 8-device mesh equals
+    the unsharded result to roundoff (CF transfers ride collectives)."""
+    n = 32
+    g = _grid(n)
+    ml = MultiLevelAdvDiff(
+        g, [FineBox(lo=(8, 8), shape=(16, 16)),
+            FineBox(lo=(8, 8), shape=(16, 16))],
+        kappa=0.002,
+        vel_fn=lambda m: (0.7 + 0 * m[0], 0.3 + 0 * m[1]))
+    Qs0 = ml.initialize(lambda c: jnp.exp(
+        -((c[0] - 0.45) ** 2 + (c[1] - 0.5) ** 2) / 0.02))
+    dt = 0.2 / n
+
+    Qs_ref = Qs0
+    for _ in range(5):
+        Qs_ref = ml.step(Qs_ref, dt)
+
+    step = make_sharded_multilevel_step(ml, mesh8)
+    Qs_sh = Qs0
+    for _ in range(5):
+        Qs_sh = step(Qs_sh, dt)
+
+    for a, b in zip(Qs_ref, Qs_sh):
+        assert np.max(np.abs(np.asarray(a) - np.asarray(b))) < 1e-12
