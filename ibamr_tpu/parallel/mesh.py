@@ -96,6 +96,9 @@ def _with_pencil_solvers(ins_integ, mesh: Mesh):
     integ2 = copy.copy(ins_integ)
     integ2.helmholtz_vel_solve = pencil.helmholtz_vel
     integ2.project = pencil.project_divergence_free
+    # the fused single-device spectral path bypasses the seams above;
+    # sharded stepping must go through the pencil transposes
+    integ2.fused_stokes = None
     return integ2
 
 

@@ -114,10 +114,11 @@ def choose_mesh(X: np.ndarray, grid: StaggeredGrid, n_devices: int,
                 mask: Optional[np.ndarray] = None) -> WorkloadReport:
     """Evaluate every mesh factorization of ``n_devices`` over at most
     ``max_axes`` leading grid axes against the marker histogram; return
-    the factorization minimizing the maximum per-shard cost (ties break
-    toward fewer sharded axes, then lower imbalance). ``min_block``
-    rejects factorizations whose local blocks are thinner than the
-    transfer halo."""
+    the factorization minimizing the maximum per-shard cost. Ties keep
+    the earliest candidate — fewer sharded axes first (mean cost is
+    factorization-invariant, so equal max cost implies equal
+    imbalance). ``min_block`` rejects factorizations whose local blocks
+    are thinner than the transfer halo."""
     best: Optional[WorkloadReport] = None
     naxes = min(max_axes, grid.dim)
     for k in range(1, naxes + 1):

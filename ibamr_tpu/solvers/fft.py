@@ -102,6 +102,68 @@ def solve_stokes_periodic(f: Vel, dx: Sequence[float],
     return u, phi
 
 
+def _staggered_div_symbols(shape: Sequence[int], dx: Sequence[float],
+                           cdtype) -> Tuple[jnp.ndarray, ...]:
+    """Per-axis spectral symbols of the staggered MAC divergence
+    D_d = (e^{i theta_d} - 1)/h_d (lower-face storage: div at cell i
+    takes u_d[i+1] - u_d[i]). The matching staggered gradient symbol is
+    -conj(D_d), and sum_d |D_d|^2 = -laplacian_symbol — the identities
+    that make the spectral projection exactly mirror the stencils."""
+    dim = len(shape)
+    out = []
+    for d in range(dim):
+        n = shape[d]
+        f = (jnp.fft.rfftfreq(n) if d == dim - 1 else jnp.fft.fftfreq(n))
+        theta = 2.0 * math.pi * f
+        Dd = (jnp.exp(1j * theta) - 1.0) / dx[d]
+        bshape = [1] * dim
+        bshape[d] = Dd.shape[0]
+        out.append(Dd.reshape(bshape).astype(cdtype))
+    return tuple(out)
+
+
+def helmholtz_project_periodic(rhs: Vel, dx: Sequence[float],
+                               alpha: float, beta: float,
+                               pinc_coeffs: Tuple[float, float]
+                               ) -> Tuple[Vel, jnp.ndarray]:
+    """Fused spectral Stokes substep: one forward transform per MAC
+    component, then the Helmholtz inverse, the staggered Leray
+    projection, AND the pressure-increment assembly all as elementwise
+    spectral arithmetic, then one inverse transform per output — 7 big
+    transforms total instead of the 8 + three full-grid stencil passes
+    of the unfused helmholtz_vel_solve -> project -> laplacian_cc
+    pipeline (the projection-preconditioner collapse of SURVEY.md §3.3
+    taken to its fixed point; HBM traffic is the TPU bottleneck, so
+    fewer full-array passes is the whole game).
+
+    Returns ``(u_new, p_inc)`` with
+    ``u_new = P (alpha + beta lap)^{-1} rhs`` (divergence-free to
+    roundoff) and ``p_inc = (a + b lap) phi0`` for
+    ``pinc_coeffs = (a, b)``, ``phi0 = lap^{-1} div u_star``."""
+    shape = rhs[0].shape
+    dim = len(shape)
+    rdtype = rhs[0].dtype
+    sym = laplacian_symbol(shape, dx, rdtype)
+    uh = [jnp.fft.rfftn(c) for c in rhs]
+    cdtype = uh[0].dtype
+    denom = (alpha + beta * sym).astype(rdtype)
+    uh = [c / denom for c in uh]
+    D = _staggered_div_symbols(shape, dx, cdtype)
+    divh = None
+    for d in range(dim):
+        t = D[d] * uh[d]
+        divh = t if divh is None else divh + t
+    sym_safe = jnp.where(sym == 0, 1.0, sym)
+    phih = jnp.where(sym == 0, 0.0, divh / sym_safe)
+    u_new = tuple(
+        jnp.fft.irfftn(uh[d] + jnp.conj(D[d]) * phih,
+                       s=shape).astype(rdtype)
+        for d in range(dim))
+    a, b = pinc_coeffs
+    pinc = jnp.fft.irfftn((a + b * sym) * phih, s=shape).astype(rdtype)
+    return u_new, pinc
+
+
 def project_divergence_free(u: Vel, dx: Sequence[float],
                             q=None) -> Tuple[Vel, jnp.ndarray]:
     """Exact discrete Leray projection: phi = lap^{-1}(div u - q);

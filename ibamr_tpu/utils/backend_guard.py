@@ -91,18 +91,65 @@ def force_cpu(n_devices: Optional[int] = None):
     return jax
 
 
+def probe_backend(timeout_s: float) -> Tuple[Optional[str], Optional[str]]:
+    """Check IN A SUBPROCESS whether the default backend can initialize
+    within ``timeout_s``. The TPU relay can HANG ``jax.devices()``
+    indefinitely (not just error) — a hang in-process is unrecoverable
+    because backend init holds the xla_bridge lock, so the probe must be
+    a child process we can kill. Returns (platform, None) on success or
+    (None, reason) on timeout/failure."""
+    import subprocess
+    import sys
+
+    code = "import jax; print(jax.devices()[0].platform)"
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True,
+                           timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return None, f"backend init hung > {timeout_s:.0f}s (relay down?)"
+    if r.returncode != 0:
+        tail = (r.stderr or "").strip().splitlines()[-1:]
+        return None, f"backend init failed: {' '.join(tail)}"
+    return r.stdout.strip().splitlines()[-1], None
+
+
 def init_backend_with_retry(retries: int = 3, delay: float = 10.0,
+                            probe_timeout: float = 180.0,
                             ) -> Tuple[object, str, Optional[str]]:
     """Initialize the default (accelerator) backend, retrying transient
-    failures; fall back to CPU rather than crash.
+    failures; fall back to CPU rather than crash, and guard against the
+    common hang mode.
+
+    A subprocess probe (``probe_timeout`` seconds, overridable via
+    ``IBAMR_BACKEND_PROBE_TIMEOUT``) guards against the relay hanging
+    backend init indefinitely; only after the probe succeeds do we
+    initialize in-process. Residual race: if the relay wedges in the
+    window between a successful probe and the in-process init, that
+    init can still block — un-guardable in-process because backend init
+    holds the xla_bridge lock; callers needing a hard bound should run
+    under an external timeout as the driver does.
 
     Returns ``(jax, platform, error)`` where ``platform`` is e.g.
     ``"axon"``/``"tpu"``/``"cpu"`` and ``error`` is the last accelerator
     init failure message when we fell back (None on clean init).
     """
+    probe_timeout = float(os.environ.get("IBAMR_BACKEND_PROBE_TIMEOUT",
+                                         probe_timeout))
+    last_err: Optional[str] = None
+    for attempt in range(max(retries, 1)):
+        platform, err = probe_backend(probe_timeout)
+        if platform is not None:
+            break
+        last_err = err
+        if attempt + 1 < retries:
+            time.sleep(delay * (attempt + 1))
+    else:
+        jax = force_cpu()
+        return jax, "cpu", last_err
+
     import jax
 
-    last_err: Optional[str] = None
     for attempt in range(max(retries, 1)):
         try:
             devs = jax.devices()
