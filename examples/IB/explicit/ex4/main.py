@@ -18,6 +18,13 @@ import jax.numpy as jnp
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), *[".."] * 4))
 
+# backend guard BEFORE any jax compute: honors JAX_PLATFORMS=cpu
+# (defeating the axon sitecustomize override) and probes the TPU
+# relay with a timeout instead of hanging when it is down
+from ibamr_tpu.utils.backend_guard import auto_backend  # noqa: E402
+
+auto_backend()
+
 import numpy as np  # noqa: E402
 
 from ibamr_tpu.models.shell3d import build_shell_example, shell_volume  # noqa: E402

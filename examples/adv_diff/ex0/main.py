@@ -14,6 +14,13 @@ import jax.numpy as jnp
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), *[".."] * 3))
 
+# backend guard BEFORE any jax compute: honors JAX_PLATFORMS=cpu
+# (defeating the axon sitecustomize override) and probes the TPU
+# relay with a timeout instead of hanging when it is down
+from ibamr_tpu.utils.backend_guard import auto_backend  # noqa: E402
+
+auto_backend()
+
 import numpy as np  # noqa: E402
 
 from ibamr_tpu.integrators.adv_diff import (  # noqa: E402

@@ -195,7 +195,13 @@ def _fgmres_flat(Aop, b, x0, Mop, m, tol, atol, restarts):
 
         V, Z, H = jax.lax.fori_loop(0, m, arnoldi, (V0, Z0, H0))
         e1 = jnp.zeros(m + 1, dtype=dtype).at[0].set(beta)
-        y, *_ = jnp.linalg.lstsq(H, e1)
+        # rcond = raw machine eps, NOT jax's default eps*max(m,n):
+        # a strongly-scaled preconditioner (e.g. the Stokes Schur
+        # proxy) inflates sigma_max, and the default cutoff then
+        # truncates the small-but-essential singular direction --
+        # observed as an f32 FGMRES that makes ZERO progress. True
+        # breakdown columns (converged early) still fall below eps.
+        y, *_ = jnp.linalg.lstsq(H, e1, rcond=float(jnp.finfo(dtype).eps))
         x = x + Z.T @ y
         rn = jnp.linalg.norm(b - Aop(x))
         return x, rn, it + 1

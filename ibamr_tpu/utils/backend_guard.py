@@ -91,6 +91,21 @@ def force_cpu(n_devices: Optional[int] = None):
     return jax
 
 
+def auto_backend():
+    """Example-driver entry guard: honor an explicit
+    ``JAX_PLATFORMS=cpu`` request (defeating the axon hook that would
+    override it and hang on a downed relay), otherwise initialize the
+    accelerator with the probe+retry+fallback path. Returns the jax
+    module. Call BEFORE the first jax compute."""
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        return force_cpu()
+    jax, _, err = init_backend_with_retry()
+    if err:
+        print(f"[backend] accelerator unavailable ({err}); running on "
+              f"CPU", flush=True)
+    return jax
+
+
 def probe_backend(timeout_s: float) -> Tuple[Optional[str], Optional[str]]:
     """Check IN A SUBPROCESS whether the default backend can initialize
     within ``timeout_s``. The TPU relay can HANG ``jax.devices()``
