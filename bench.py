@@ -91,6 +91,10 @@ def main():
     ap.add_argument("--deadline", type=float, default=1500.0,
                     help="soft wall-clock budget (s); later stages are "
                          "skipped once exceeded")
+    ap.add_argument("--profile", type=str, default="",
+                    help="capture a jax device profile of the final "
+                         "stage into this directory (TensorBoard/"
+                         "Perfetto viewable)")
     args = ap.parse_args()
 
     t_start = time.perf_counter()
@@ -130,8 +134,11 @@ def main():
             n_lon = max(16, int(round(args.n_lon * frac)))
             try:
                 log(f"[bench] stage n={n} markers~{n_lat * n_lon} ...")
-                stage = run_stage(jax, n, n_lat, n_lon, args.steps,
-                                  args.warmup, args.dt)
+                from ibamr_tpu.utils.timers import profile_trace
+
+                with profile_trace(args.profile if n == args.n else ""):
+                    stage = run_stage(jax, n, n_lat, n_lon, args.steps,
+                                      args.warmup, args.dt)
                 log(f"[bench] stage n={n}: {stage['steps_per_sec']} "
                     "steps/s")
                 result["stages"].append(stage)

@@ -82,7 +82,7 @@ def main(argv):
                          "max_div": float(integ.max_divergence(state))})
             print(f"step {k + 1}: outflow flux {flux:.5f}")
 
-    timers.report()
+    print(timers.report())
     un = np.asarray(state.u[0])
     err = float(np.max(np.abs(un[3 * n[0] // 4, :] - profile)))
     print(f"developed-profile error vs Poiseuille: {err:.2e}")

@@ -157,9 +157,16 @@ def init_backend_with_retry(retries: int = 3, delay: float = 10.0,
         if platform is not None:
             break
         last_err = err
+        if err and "hung" in err:
+            # a hard hang will not heal in seconds: one full-timeout
+            # probe is the evidence; go straight to the CPU fallback
+            break
         if attempt + 1 < retries:
             time.sleep(delay * (attempt + 1))
     else:
+        jax = force_cpu()
+        return jax, "cpu", last_err
+    if platform is None:
         jax = force_cpu()
         return jax, "cpu", last_err
 

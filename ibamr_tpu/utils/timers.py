@@ -96,3 +96,23 @@ def timer(name: str, block_on=None):
     """Module-level convenience: ``with timer("IB::spreadForce"): ...``"""
     with TimerManager.instance().scope(name, block_on=block_on) as t:
         yield t
+
+
+@contextmanager
+def profile_trace(log_dir: Optional[str]):
+    """Capture a jax/XLA device profile for the enclosed region
+    (SURVEY.md §5.1 — the deep-dive layer under TimerManager's wall
+    timers, viewable in TensorBoard / Perfetto). No-op when ``log_dir``
+    is falsy, so call sites can thread a ``--profile DIR`` flag through
+    unconditionally. The ``named_scope`` annotations that TimerManager
+    already emits show up as trace regions."""
+    if not log_dir:
+        yield
+        return
+    import jax.profiler as _prof
+
+    _prof.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        _prof.stop_trace()
