@@ -139,16 +139,15 @@ def make_sharded_adv_diff_step(integ, mesh: Mesh):
 
     from ibamr_tpu.parallel.fftpar import PencilFFT
 
-    if any(s is not None
-           for s in getattr(integ, '_wall_solvers', ())):
-        # wall axes: keep the integrator's own fast-diagonalization
-        # solves — per-axis dense matmuls that the SPMD partitioner
-        # distributes directly (see make_sharded_ins_step)
-        integ = copy.copy(integ)
-    else:
-        pencil = PencilFFT(integ.grid, mesh)
-        integ = copy.copy(integ)
-        integ.helmholtz_solve = pencil.helmholtz_cc
+    # Quantities with wall BCs keep their fast-diagonalization solves
+    # (per-axis dense matmuls the SPMD partitioner distributes
+    # directly, see make_sharded_ins_step); fully-periodic quantities
+    # always get the pencil-FFT Helmholtz — the integrator consults
+    # helmholtz_solve only where _wall_solvers[i] is None, so installing
+    # it is correct for mixed wall/periodic quantity sets too.
+    pencil = PencilFFT(integ.grid, mesh)
+    integ = copy.copy(integ)
+    integ.helmholtz_solve = pencil.helmholtz_cc
     grid = integ.grid
 
     def step(state, dt, u=None, sources=None):

@@ -152,6 +152,7 @@ def init_backend_with_retry(retries: int = 3, delay: float = 10.0,
     probe_timeout = float(os.environ.get("IBAMR_BACKEND_PROBE_TIMEOUT",
                                          probe_timeout))
     last_err: Optional[str] = None
+    platform = None
     for attempt in range(max(retries, 1)):
         platform, err = probe_backend(probe_timeout)
         if platform is not None:
@@ -163,9 +164,6 @@ def init_backend_with_retry(retries: int = 3, delay: float = 10.0,
             break
         if attempt + 1 < retries:
             time.sleep(delay * (attempt + 1))
-    else:
-        jax = force_cpu()
-        return jax, "cpu", last_err
     if platform is None:
         jax = force_cpu()
         return jax, "cpu", last_err

@@ -142,3 +142,46 @@ def test_checkpoint_schema_mismatch_diagnosed(tmp_path):
     bad2 = {"u": jnp.zeros((8, 8)), "t": jnp.zeros(())}
     with pytest.raises(ValueError, match="shape mismatch"):
         restore_checkpoint(str(tmp_path), bad2)
+
+
+def test_async_checkpoint_writer(tmp_path):
+    """Async writes must produce checkpoints identical to sync ones,
+    keep ordering under multiple enqueues, and surface worker errors on
+    wait (S6)."""
+    import jax.numpy as jnp
+
+    from ibamr_tpu.utils.checkpoint import (AsyncCheckpointWriter,
+                                            latest_step,
+                                            restore_checkpoint,
+                                            save_checkpoint)
+
+    state = {"u": jnp.arange(12.0).reshape(3, 4), "t": jnp.asarray(1.5)}
+    sync_dir = str(tmp_path / "sync")
+    async_dir = str(tmp_path / "async")
+    save_checkpoint(sync_dir, state, 7)
+
+    w = AsyncCheckpointWriter(async_dir, keep=2)
+    for k in (5, 6, 7):
+        st_k = {"u": state["u"] + k, "t": state["t"]}
+        w.save(st_k, k)
+    w.wait()
+    assert latest_step(async_dir) == 7
+    template = {"u": jnp.zeros((3, 4)), "t": jnp.asarray(0.0)}
+    got, step, _ = restore_checkpoint(async_dir, template)
+    assert step == 7
+    import numpy as np
+    assert np.allclose(np.asarray(got["u"]),
+                       np.asarray(state["u"]) + 7)
+    # keep=2 pruned the oldest
+    assert latest_step(async_dir) == 7
+    import os
+    files = [f for f in os.listdir(async_dir) if f.endswith(".npz")]
+    assert len(files) == 2
+    w.close()
+
+    # error propagation: unwritable directory surfaces on wait
+    bad = AsyncCheckpointWriter("/proc/definitely/not/writable")
+    bad.save(state, 1)
+    import pytest
+    with pytest.raises(Exception):
+        bad.wait()
