@@ -592,6 +592,133 @@ def advance_two_level_ib(integ: TwoLevelIBINS, state: TwoLevelIBState,
     return out
 
 
+def _window_lo_from_markers(grid: StaggeredGrid, X, shape,
+                            clearance: int = 2) -> Tuple[int, ...]:
+    """Origin of a FIXED-SHAPE window centered on the marker bbox,
+    clipped to proper nesting (host-side)."""
+    Xn = np.asarray(X)
+    lo = []
+    for d in range(grid.dim):
+        c = (Xn[:, d] - grid.x_lo[d]) / grid.dx[d]
+        center = 0.5 * (c.min() + c.max())
+        l = int(round(center - shape[d] / 2.0))
+        l = max(clearance, min(l, grid.n[d] - shape[d] - clearance))
+        # the clipped window must still CONTAIN the structure (plus a
+        # delta-support margin): markers outside the fine box would be
+        # transferred against the wrong level silently. The framework
+        # does not wrap marker coordinates, so a structure crossing the
+        # periodic boundary needs a re-centered coordinate frame (or a
+        # bigger window) — fail loudly instead.
+        margin = 3
+        if c.min() < l + margin or c.max() > l + shape[d] - margin:
+            raise ValueError(
+                f"axis {d}: marker span [{c.min():.1f}, {c.max():.1f}] "
+                f"cells does not fit the clipped window "
+                f"[{l}, {l + shape[d]}] with margin {margin}; enlarge "
+                f"the window shape or re-center the domain")
+        lo.append(l)
+    return tuple(lo)
+
+
+def regrid_two_level_ib(integ: TwoLevelIBINS, state: TwoLevelIBState,
+                        move_threshold: int = 2
+                        ) -> Tuple[TwoLevelIBINS, TwoLevelIBState]:
+    """Host-side moving-window regrid for the composite IB/INS
+    hierarchy (the marker-tagged regrid of SURVEY.md §3.4 applied to
+    the FLAGSHIP path — closing round 1's 'regrid is marker-blind'
+    gap): retag a fixed-shape fine window from the CURRENT markers;
+    when it moves, rebuild the window integrator and transfer the fluid
+    state:
+
+    1. new fine velocity = divergence-preserving MAC prolongation of
+       the coarse field over the new window (T10);
+    2. surviving fine data copied across the old∩new overlap (the
+       refine-schedule copy — fine-resolution information is never
+       thrown away where the windows agree);
+    3. one composite projection cleans the copy/prolongation seam back
+       to div-free at solver tolerance.
+
+    Runs on host between jitted chunks (the reference's regrid cadence
+    is host-side too); a moved window implies one recompilation of the
+    step at the new static origin — the cost model matches the
+    reference's repartition-at-regrid. Returns (integ, state), both
+    unchanged when the window did not move."""
+    grid = integ.grid
+    old = integ.box
+    lo_new = _window_lo_from_markers(grid, state.X, old.shape)
+    if max(abs(a - b) for a, b in zip(lo_new, old.lo)) < move_threshold:
+        return integ, state
+
+    new_box = FineBox(lo=lo_new, shape=old.shape, ratio=old.ratio)
+    core = integ.core
+    integ2 = TwoLevelIBINS(grid, new_box, integ.ib, rho=core.rho,
+                           mu=core.mu, convective=core.convective,
+                           proj_tol=core.proj.tol)
+
+    uc = state.fluid.uc
+    # 1. prolong the coarse field over the new window
+    uf_new = list(prolong_mac_div_preserving(uc, grid, new_box))
+    # 2. copy surviving fine data across the overlap (fine indices)
+    r = old.ratio
+    ov_lo = [max(a, b) for a, b in zip(old.lo, lo_new)]
+    ov_hi = [min(a, b) for a, b in zip(old.hi, new_box.hi)]
+    if all(h > l for l, h in zip(ov_lo, ov_hi)):
+        for d in range(grid.dim):
+            src = [slice(r * (ov_lo[e] - old.lo[e]),
+                         r * (ov_hi[e] - old.lo[e])
+                         + (1 if e == d else 0))
+                   for e in range(grid.dim)]
+            dst = [slice(r * (ov_lo[e] - lo_new[e]),
+                         r * (ov_hi[e] - lo_new[e])
+                         + (1 if e == d else 0))
+                   for e in range(grid.dim)]
+            uf_new[d] = uf_new[d].at[tuple(dst)].set(
+                state.fluid.uf[d][tuple(src)])
+    # 3. sync + composite projection cleans the seam
+    uc_sync = scatter_box_mac_to_coarse(uc, restrict_mac(tuple(uf_new)),
+                                        new_box)
+    uc_p, uf_p, _, _ = integ2.core.proj.project(uc_sync, tuple(uf_new))
+    fluid = TwoLevelINSState(uc=uc_p, uf=uf_p, t=state.fluid.t,
+                             k=state.fluid.k)
+    return integ2, TwoLevelIBState(fluid=fluid, X=state.X, U=state.U,
+                                   mask=state.mask)
+
+
+def advance_two_level_ib_regridding(integ: TwoLevelIBINS,
+                                    state: TwoLevelIBState, dt: float,
+                                    num_steps: int,
+                                    regrid_interval: int = 20
+                                    ) -> Tuple[TwoLevelIBINS,
+                                               TwoLevelIBState]:
+    """Advance with the window tracking the structure: jitted chunks of
+    ``regrid_interval`` steps with host-side marker-tagged regrids in
+    between (the reference's regrid cadence)."""
+    # cache the jitted chunk per (integrator, length): a static window
+    # re-traces nothing; only a MOVED window (new integrator, new
+    # static origin) compiles anew — the documented cost model
+    chunks = {}
+
+    def chunk(n):
+        key = (id(integ), n)
+        if key not in chunks:
+            local_integ = integ
+
+            def run(s, dt):
+                return advance_two_level_ib(local_integ, s, dt, n)
+
+            chunks[key] = jax.jit(run)
+        return chunks[key]
+
+    done = 0
+    while done < num_steps:
+        n = min(regrid_interval, num_steps - done)
+        state = chunk(n)(state, dt)
+        done += n
+        if done < num_steps:
+            integ, state = regrid_two_level_ib(integ, state)
+    return integ, state
+
+
 def box_from_markers(grid: StaggeredGrid, X, pad: int = 4,
                      even: bool = True) -> FineBox:
     """Tag the fine box from marker positions (host-side, at setup /

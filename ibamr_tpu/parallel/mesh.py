@@ -142,12 +142,14 @@ def make_sharded_adv_diff_step(integ, mesh: Mesh):
     # Quantities with wall BCs keep their fast-diagonalization solves
     # (per-axis dense matmuls the SPMD partitioner distributes
     # directly, see make_sharded_ins_step); fully-periodic quantities
-    # always get the pencil-FFT Helmholtz — the integrator consults
-    # helmholtz_solve only where _wall_solvers[i] is None, so installing
-    # it is correct for mixed wall/periodic quantity sets too.
-    pencil = PencilFFT(integ.grid, mesh)
+    # get the pencil-FFT Helmholtz — the integrator consults
+    # helmholtz_solve only where _wall_solvers[i] is None, so the
+    # pencil plan is built exactly when some quantity needs it (an
+    # all-wall integrator must not trip pencil divisibility checks).
     integ = copy.copy(integ)
-    integ.helmholtz_solve = pencil.helmholtz_cc
+    if any(s is None for s in getattr(integ, '_wall_solvers', (None,))):
+        pencil = PencilFFT(integ.grid, mesh)
+        integ.helmholtz_solve = pencil.helmholtz_cc
     grid = integ.grid
 
     def step(state, dt, u=None, sources=None):
