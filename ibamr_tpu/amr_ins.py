@@ -715,7 +715,13 @@ def advance_two_level_ib_regridding(integ: TwoLevelIBINS,
         state = chunk(n)(state, dt)
         done += n
         if done < num_steps:
-            integ, state = regrid_two_level_ib(integ, state)
+            integ2, state = regrid_two_level_ib(integ, state)
+            if integ2 is not integ:
+                # the moved window's old executables are unreachable
+                # (cache keys are id-based); drop them so a long run
+                # with many moves does not pin stale compilations
+                chunks.clear()
+                integ = integ2
     return integ, state
 
 

@@ -13,9 +13,11 @@ TPU-first setting:
   heavier constraint, the documented trade of the non-conservative
   variant at this stage);
 - variable-density projection  div( (1/rho) grad p ) = div(u*)/dt
-  solved matrix-free with CG preconditioned by the constant-coefficient
-  FFT Poisson inverse (the collapse of the reference's FAC-multigrid
-  preconditioner to its exact periodic limit, SURVEY.md §3.3 note);
+  (harmonic-density face coefficients) solved matrix-free with CG
+  preconditioned by ONE V-cycle of the true variable-coefficient
+  multigrid (ratio-robust, ~10 iterations at density ratio 1000 — the
+  reference's FAC-preconditioned VC Poisson, T8) or optionally the
+  constant-coefficient FFT inverse;
 - continuum-surface-force surface tension  f = sigma kappa delta(phi)
   grad phi  and gravity  rho g;
 - the level set is advected with the Godunov advector and periodically
@@ -64,6 +66,7 @@ class INSVCStaggeredIntegrator:
                  interface_eps: Optional[float] = None,
                  reinit_interval: int = 10,
                  cg_tol: float = 1e-8, cg_maxiter: int = 200,
+                 precond: str = "mg",
                  dtype=jnp.float32):
         self.grid = grid
         self.rho = (float(rho0), float(rho1))
@@ -77,6 +80,13 @@ class INSVCStaggeredIntegrator:
         self.reinit_interval = int(reinit_interval)
         self.cg_tol = float(cg_tol)
         self.cg_maxiter = int(cg_maxiter)
+        if precond not in ("fft", "mg"):
+            raise ValueError(f"unknown preconditioner {precond!r}")
+        # "fft": exact constant-coefficient inverse (iterations grow
+        # with the density ratio); "mg": one V-cycle of the TRUE
+        # variable-coefficient operator (ratio-robust — the reference's
+        # FAC-preconditioned VC Poisson, SURVEY.md T8/P22)
+        self.precond = precond
         self.dtype = dtype
 
     # -- material fields -----------------------------------------------------
@@ -92,10 +102,19 @@ class INSVCStaggeredIntegrator:
     def project_vc(self, u: Vel, rho_cc: jnp.ndarray,
                    dt: float) -> Tuple[Vel, jnp.ndarray]:
         """Solve div((dt/rho) grad p) = div u*, correct
-        u <- u* - (dt/rho) grad p. CG + FFT preconditioner."""
+        u <- u* - (dt/rho) grad p. CG with the configured
+        preconditioner (VC multigrid V-cycle or FFT)."""
         g = self.grid
         dx = g.dx
-        rho_face = tuple(_cc_to_face(rho_cc, d) for d in range(g.dim))
+        # harmonic-density face coefficients (arithmetic mean of 1/rho):
+        # the standard VC-projection choice for large density jumps, and
+        # EXACTLY the face rule the multigrid preconditioner's
+        # coefficient coarsening uses — so the "mg" V-cycle
+        # preconditions the true operator, keeping CG counts
+        # ratio-robust. The velocity correction uses the SAME
+        # coefficient so div(u_new) = 0 holds discretely.
+        inv_rho_face = tuple(_cc_to_face(1.0 / rho_cc, d)
+                             for d in range(g.dim))
         div = stencils.divergence(u, dx)
         div = div - jnp.mean(div)
         rho_ref = min(self.rho)
@@ -106,19 +125,37 @@ class INSVCStaggeredIntegrator:
         # breakdown guard every iteration and the solve returned 0)
         def A(p):
             gp = stencils.gradient(p, dx)
-            flux = tuple(dt / rf * gc for rf, gc in zip(rho_face, gp))
+            flux = tuple(dt * rf * gc
+                         for rf, gc in zip(inv_rho_face, gp))
             return -stencils.divergence(flux, dx)
 
-        def M(r):
-            # exact inverse of the constant-coefficient operator
-            return -fft.solve_poisson_periodic(r / (dt / rho_ref), dx)
+        if self.precond == "mg":
+            from ibamr_tpu.bc import DomainBC
+            from ibamr_tpu.solvers.multigrid import PoissonMultigrid
+
+            # one V-cycle of the true VC operator div((dt/rho) grad .)
+            # — the level hierarchy (coefficient coarsening, diagonals)
+            # traces into the step; shapes are static so this compiles
+            # once. Note A is the NEGATED operator, so M negates too.
+            mg = PoissonMultigrid(g.n, DomainBC.periodic(g.dim), dx,
+                                  D=dt / rho_cc, dtype=rho_cc.dtype)
+
+            def M(r):
+                r = r - jnp.mean(r)
+                q = mg.vcycle(jnp.zeros_like(r), r)
+                return -(q - jnp.mean(q))
+        else:
+            def M(r):
+                # exact inverse of the constant-coefficient operator
+                return -fft.solve_poisson_periodic(r / (dt / rho_ref),
+                                                   dx)
 
         res = krylov.cg(A, -div, M=M, tol=self.cg_tol,
                         maxiter=self.cg_maxiter)
         p = res.x - jnp.mean(res.x)
         gp = stencils.gradient(p, dx)
-        u_new = tuple(c - dt / rf * gc
-                      for c, rf, gc in zip(u, rho_face, gp))
+        u_new = tuple(c - dt * rf * gc
+                      for c, rf, gc in zip(u, inv_rho_face, gp))
         return u_new, p
 
     # -- variable-viscosity stress -------------------------------------------
@@ -192,7 +229,13 @@ class INSVCStaggeredIntegrator:
 
         rho_cc = self.density(phi)
         mu_cc = self.viscosity(phi)
-        rho_face = tuple(_cc_to_face(rho_cc, d) for d in range(g.dim))
+        # harmonic-density face weights: the SAME discrete (1/rho)
+        # operator as project_vc, so the accumulated-pressure gradient
+        # in the predictor and the increment correction stay consistent
+        # (mixing arithmetic/harmonic faces inflates splitting error by
+        # the density ratio at interface faces)
+        inv_rho_face = tuple(_cc_to_face(1.0 / rho_cc, d)
+                             for d in range(g.dim))
 
         # convection (AB2)
         if self.convective_op_type == "none":
@@ -212,9 +255,9 @@ class INSVCStaggeredIntegrator:
         u_star = []
         for d in range(g.dim):
             rhs = (-n_star[d]
-                   + (visc[d] + body[d] - gp[d]) / rho_face[d])
+                   + (visc[d] + body[d] - gp[d]) * inv_rho_face[d])
             if f is not None:
-                rhs = rhs + f[d] / rho_face[d]
+                rhs = rhs + f[d] * inv_rho_face[d]
             u_star.append(u[d] + dt * rhs)
 
         # variable-density pressure-increment projection
