@@ -30,6 +30,7 @@ from typing import NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ibamr_tpu.grid import StaggeredGrid
 from ibamr_tpu.ops import stencils
@@ -191,13 +192,25 @@ class INSVCStaggeredIntegrator:
     # -- surface tension + gravity -------------------------------------------
     def _interface_forces(self, phi: jnp.ndarray,
                           rho_cc: jnp.ndarray) -> Vel:
+        """Interface FORCE densities: CSF surface tension + buoyancy in
+        the net-force-free periodic form (rho - mean(rho)) g.
+
+        Why the anomaly form: uniform acceleration g in a periodic box
+        is pure free fall (equivalence principle — the projection's
+        mean mode is div-free and absorbs nothing), and building rho*g
+        with one face rule while dividing by another scales gravity
+        O(ratio) wrong at interface faces. The density-ANOMALY force
+        yields exact hydrostatic quiescence for flat pools, genuine
+        relative buoyancy for drops/bubbles, and injects zero net
+        momentum (both regression-tested)."""
         g = self.grid
         dx = g.dx
         out = []
         kap = ls.curvature(phi, dx) if self.sigma else None
         dlt = ls.delta(phi, self.eps) if self.sigma else None
+        drho = rho_cc - jnp.mean(rho_cc)
         for d in range(g.dim):
-            f = _cc_to_face(rho_cc, d) * self.gravity[d]
+            f = _cc_to_face(drho, d) * self.gravity[d]
             if self.sigma:
                 gphi = (phi - jnp.roll(phi, 1, d)) / dx[d]
                 f = f + self.sigma * _cc_to_face(kap * dlt, d) * gphi
@@ -279,7 +292,16 @@ class INSVCStaggeredIntegrator:
         return jnp.max(jnp.abs(stencils.divergence(state.u, self.grid.dx)))
 
     def heavy_phase_volume(self, state: VCINSState) -> jnp.ndarray:
-        return ls.phase_volume(state.phi, self.grid, self.eps)
+        """Volume of the DENSER phase: phi>0 carries rho1 (density()
+        blends rho0 -> rho1 with H(phi)), so the heavy phase is phi>0
+        when rho1 >= rho0, else phi<0. (Regression: this used to
+        return the phi<0 volume unconditionally — normalizing a drop's
+        'volume drift' by the ~20x larger ambient volume.)"""
+        vol_neg = ls.phase_volume(state.phi, self.grid, self.eps)
+        total = float(np.prod(self.grid.n)) * self.grid.cell_volume
+        if self.rho[1] >= self.rho[0]:
+            return total - vol_neg
+        return vol_neg
 
 
 def advance_vc(integ: INSVCStaggeredIntegrator, state: VCINSState,

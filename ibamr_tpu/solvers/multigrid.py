@@ -316,12 +316,18 @@ class PoissonMultigrid:
         return _apply_op(Q, self.levels[li], bc, self.alpha, self.beta,
                          bdry_data=bdry_data)
 
-    def _smooth(self, Q, f, li: int, sweeps: int):
+    def _smooth(self, Q, f, li: int, sweeps: int,
+                reverse: bool = False):
+        """Red-black relaxation; ``reverse`` sweeps black-then-red.
+        Post-smoothing in reversed color order makes the V-cycle a
+        SYMMETRIC operator — required when the cycle preconditions CG
+        (a nonsymmetric M can trip CG's rz>0 breakdown guard)."""
         red, black = self._masks[li]
         diag = self.levels[li].diag
+        order = (black, red) if reverse else (red, black)
 
         def sweep(_, Q):
-            for mask in (red, black):
+            for mask in order:
                 r = f - self._op(Q, li)
                 Q = Q + jnp.where(mask, r / diag, 0.0)
             return Q
@@ -330,14 +336,18 @@ class PoissonMultigrid:
 
     def _vcycle(self, Q, f, li: int):
         if li == len(self.levels) - 1:
-            return self._smooth(Q, f, li, self.nu_coarse)
+            # palindromic ordering keeps the bottom solve symmetric too
+            half = self.nu_coarse // 2
+            Q = self._smooth(Q, f, li, half)
+            return self._smooth(Q, f, li, self.nu_coarse - half,
+                                reverse=True)
         Q = self._smooth(Q, f, li, self.nu_pre)
         r = f - self._op(Q, li)
         rc = restrict_full_weighting(r)
         ec = self._vcycle(jnp.zeros_like(rc), rc, li + 1)
         Q = Q + prolong_linear(ec, self.bc_hom,
                                self.levels[li + 1].dx)
-        return self._smooth(Q, f, li, self.nu_post)
+        return self._smooth(Q, f, li, self.nu_post, reverse=True)
 
     # -- public API ---------------------------------------------------------
     def vcycle(self, Q: Array, f: Array) -> Array:

@@ -283,3 +283,65 @@ def test_vc_projection_mg_preconditioner_ratio_robust():
     assert iters["mg"] * 4 < iters["fft"]
     for a, b in zip(sols["fft"], sols["mg"]):
         assert np.max(np.abs(np.asarray(a - b))) < 1e-7
+
+
+def test_hydrostatic_balance_no_spurious_currents():
+    """A flat heavy-over-nothing pool under gravity must stay
+    quiescent: gravity enters as the uniform acceleration g and the
+    harmonic-coefficient projection absorbs it into a discrete
+    hydrostatic pressure exactly (regression: building rho*g with
+    arithmetic faces and dividing by harmonic faces scaled gravity
+    O(ratio) wrong at interface faces, driving spurious currents)."""
+    import numpy as np
+
+    from ibamr_tpu.integrators.ins_vc import (INSVCStaggeredIntegrator,
+                                              advance_vc)
+
+    n = 32
+    g = StaggeredGrid(n=(n, n), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    y = (np.arange(n) + 0.5) / n
+    # heavy phase (phi > 0) fills the bottom half
+    phi0 = jnp.asarray(np.broadcast_to((0.5 - y)[None, :], (n, n)),
+                       dtype=jnp.float64)
+    integ = INSVCStaggeredIntegrator(
+        g, rho0=1.0, rho1=100.0, mu0=0.01, mu1=0.01,
+        gravity=(0.0, -1.0), sigma=0.0, convective_op_type="none",
+        reinit_interval=1000, cg_tol=1e-11, dtype=jnp.float64)
+    st = integ.initialize(phi0)
+    st = advance_vc(integ, st, 1e-3, 20)
+    # the density-anomaly gravity force injects zero net momentum and
+    # is a discrete y-gradient for a flat pool, so the projection
+    # absorbs it EXACTLY: full quiescence, no free-fall drift
+    umax = max(float(jnp.max(jnp.abs(c))) for c in st.u)
+    assert umax < 1e-10, umax
+
+
+def test_drop_buoyancy_relative_motion():
+    """A heavy drop under the anomaly-form gravity sinks RELATIVE to
+    the ambient while total momentum stays zero (periodic buoyancy)."""
+    import numpy as np
+
+    from ibamr_tpu.integrators.ins_vc import (INSVCStaggeredIntegrator,
+                                              advance_vc)
+
+    n = 32
+    g = StaggeredGrid(n=(n, n), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    x = (np.arange(n) + 0.5) / n
+    X, Y = np.meshgrid(x, x, indexing="ij")
+    phi0 = jnp.asarray(0.12 - np.sqrt((X - 0.5) ** 2 + (Y - 0.6) ** 2),
+                       dtype=jnp.float64)
+    integ = INSVCStaggeredIntegrator(
+        g, rho0=1.0, rho1=100.0, mu0=0.02, mu1=0.05,
+        gravity=(0.0, -1.0), cg_tol=1e-9, dtype=jnp.float64)
+    st = integ.initialize(phi0)
+    st = advance_vc(integ, st, 2e-4, 100)
+    v = np.asarray(st.u[1])
+    H = np.asarray(st.phi) > 0
+    vmean = v.mean()
+    # relative buoyancy: drop sinks, ambient recirculates up (the
+    # VELOCITY mean is not conserved by the non-conservative VC form —
+    # acceleration = force * 1/rho correlates sign with 1/rho — so the
+    # oracle is motion RELATIVE to the mean; the conservative-form
+    # variant is the documented trade, module docstring)
+    assert v[H].mean() - vmean < -1e-4      # drop sinks
+    assert v[~H].mean() - vmean > 1e-6      # ambient rises
