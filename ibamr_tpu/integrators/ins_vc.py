@@ -101,21 +101,30 @@ class INSVCStaggeredIntegrator:
 
     # -- variable-density projection -----------------------------------------
     def project_vc(self, u: Vel, rho_cc: jnp.ndarray,
-                   dt: float) -> Tuple[Vel, jnp.ndarray]:
+                   dt: float, face_rule: str = "harmonic"
+                   ) -> Tuple[Vel, jnp.ndarray]:
         """Solve div((dt/rho) grad p) = div u*, correct
         u <- u* - (dt/rho) grad p. CG with the configured
-        preconditioner (VC multigrid V-cycle or FFT)."""
+        preconditioner (VC multigrid V-cycle or FFT).
+
+        ``face_rule``: "harmonic" (arithmetic mean of 1/rho — the
+        standard choice for large density jumps, and exactly the rule
+        the MG preconditioner's coefficient coarsening uses) or
+        "arithmetic" (1 / mean(rho) — the conservative integrator's
+        rule, matching its face momentum density so the pressure
+        correction's TOTAL momentum telescopes to zero). The velocity
+        correction uses the SAME coefficient as the operator either
+        way, so div(u_new) = 0 holds discretely."""
         g = self.grid
         dx = g.dx
-        # harmonic-density face coefficients (arithmetic mean of 1/rho):
-        # the standard VC-projection choice for large density jumps, and
-        # EXACTLY the face rule the multigrid preconditioner's
-        # coefficient coarsening uses — so the "mg" V-cycle
-        # preconditions the true operator, keeping CG counts
-        # ratio-robust. The velocity correction uses the SAME
-        # coefficient so div(u_new) = 0 holds discretely.
-        inv_rho_face = tuple(_cc_to_face(1.0 / rho_cc, d)
-                             for d in range(g.dim))
+        if face_rule == "harmonic":
+            inv_rho_face = tuple(_cc_to_face(1.0 / rho_cc, d)
+                                 for d in range(g.dim))
+        elif face_rule == "arithmetic":
+            inv_rho_face = tuple(1.0 / _cc_to_face(rho_cc, d)
+                                 for d in range(g.dim))
+        else:
+            raise ValueError(f"unknown face_rule {face_rule!r}")
         div = stencils.divergence(u, dx)
         div = div - jnp.mean(div)
         rho_ref = min(self.rho)
@@ -278,14 +287,19 @@ class INSVCStaggeredIntegrator:
         p_new = p + dp
 
         # advect + periodically reinitialize the level set
-        phi_new = advect(phi, u_new, dx, dt)
-        phi_new = jax.lax.cond(
-            jnp.mod(state.k + 1, self.reinit_interval) == 0,
-            lambda q: ls.reinitialize(q, dx, iters=20),
-            lambda q: q, phi_new)
+        phi_new = self._transport_level_set(phi, u_new, dt, state.k)
 
         return VCINSState(u=u_new, p=p_new, phi=phi_new, n_prev=n_curr,
                           t=state.t + dt, k=state.k + 1)
+
+    def _transport_level_set(self, phi, u_new: Vel, dt, k):
+        """Godunov advection + cadenced reinitialization (shared by the
+        non-conservative and conservative steps)."""
+        phi_new = advect(phi, u_new, self.grid.dx, dt)
+        return jax.lax.cond(
+            jnp.mod(k + 1, self.reinit_interval) == 0,
+            lambda q: ls.reinitialize(q, self.grid.dx, iters=20),
+            lambda q: q, phi_new)
 
     # -- diagnostics ---------------------------------------------------------
     def max_divergence(self, state: VCINSState) -> jnp.ndarray:
@@ -302,6 +316,165 @@ class INSVCStaggeredIntegrator:
         if self.rho[1] >= self.rho[0]:
             return total - vol_neg
         return vol_neg
+
+
+class VCConsState(NamedTuple):
+    u: Vel
+    p: jnp.ndarray
+    phi: jnp.ndarray
+    rho: jnp.ndarray        # conservatively transported density
+    t: jnp.ndarray
+    k: jnp.ndarray
+
+
+class INSVCConservativeIntegrator(INSVCStaggeredIntegrator):
+    """Conservative-form variable-coefficient INS — the
+    ``INSVCStaggeredConservativeHierarchyIntegrator`` half of P22:
+    density is a conserved state transported by upwind mass fluxes, and
+    momentum is advected with the SAME mass fluxes interpolated to each
+    momentum control volume (consistent mass–momentum transport).
+
+    Discrete consistency: the face momentum density is the ARITHMETIC
+    mean of the cell densities. Arithmetic means are linear, so the
+    face density satisfies its own continuity equation with exactly the
+    face-interpolated fluxes the momentum advection uses — which makes
+    uniform translation of a density jump an EXACT discrete equilibrium
+    (no spurious interface accelerations; tested). The projection uses
+    the matching arithmetic face coefficient, so the pressure
+    correction's total momentum telescopes to zero and global momentum
+    is conserved to roundoff under net-force-free forcing — the
+    property the non-conservative velocity form cannot have (both
+    pinned by tests). Viscosity stays slaved to the level set;
+    ``rho_resync_interval`` optionally re-slaves rho to phi to bound
+    drift between the conserved density and the interface geometry."""
+
+    def __init__(self, *args, rho_resync_interval: int = 0, **kw):
+        super().__init__(*args, **kw)
+        self.rho_resync_interval = int(rho_resync_interval)
+        if self.convective_op_type not in ("upwind", "none"):
+            raise ValueError(
+                "the conservative form advects momentum with upwind "
+                "mass fluxes; convective_op_type must be 'upwind' "
+                f"(or 'none' for the Stokes limit), got "
+                f"{self.convective_op_type!r}")
+
+    # -- conservative transport ----------------------------------------
+    def _mass_fluxes(self, u: Vel, rho_cc: jnp.ndarray) -> Vel:
+        """Upwind mass flux rho*u through every (lower) cell face."""
+        out = []
+        for d in range(self.grid.dim):
+            rho_up = jnp.where(u[d] > 0, jnp.roll(rho_cc, 1, d), rho_cc)
+            out.append(u[d] * rho_up)
+        return tuple(out)
+
+    def _momentum_advection(self, u: Vel, F: Vel) -> Vel:
+        """div(F u) on each momentum control volume, upwinding u_d by
+        the sign of the interpolated mass flux — the consistent pairing
+        (same F as the density update)."""
+        g = self.grid
+        dim = g.dim
+        dx = g.dx
+        out = []
+        for d in range(dim):
+            acc = None
+            for j in range(dim):
+                if j == d:
+                    # CV faces at cell centers along d
+                    Fc = 0.5 * (F[d] + jnp.roll(F[d], -1, d))
+                    u_up = jnp.where(Fc > 0, u[d],
+                                     jnp.roll(u[d], -1, d))
+                    G = Fc * u_up
+                    term = (G - jnp.roll(G, 1, d)) / dx[d]
+                else:
+                    # CV faces at d-j edges
+                    Fe = 0.5 * (F[j] + jnp.roll(F[j], 1, d))
+                    u_up = jnp.where(Fe > 0, jnp.roll(u[d], 1, j),
+                                     u[d])
+                    G = Fe * u_up
+                    term = (jnp.roll(G, -1, j) - G) / dx[j]
+                acc = term if acc is None else acc + term
+            out.append(acc)
+        return tuple(out)
+
+    # -- state / stepping ----------------------------------------------
+    def initialize(self, phi0, u0_arrays: Optional[Vel] = None
+                   ) -> VCConsState:
+        base = super().initialize(phi0, u0_arrays=u0_arrays)
+        return VCConsState(u=base.u, p=base.p, phi=base.phi,
+                           rho=self.density(base.phi),
+                           t=base.t, k=base.k)
+
+    def step(self, state: VCConsState, dt: float,
+             f: Optional[Vel] = None) -> VCConsState:
+        g = self.grid
+        dx = g.dx
+        u, p, phi, rho = state.u, state.p, state.phi, state.rho
+        mu_cc = self.viscosity(phi)
+
+        # 1. mass transport (conservative)
+        F = self._mass_fluxes(u, rho)
+        div_F = None
+        for d in range(g.dim):
+            t_ = (jnp.roll(F[d], -1, d) - F[d]) / dx[d]
+            div_F = t_ if div_F is None else div_F + t_
+        rho_new = rho - dt * div_F
+
+        # 2. momentum update with the SAME fluxes. Arithmetic face
+        # densities: linear in the cells, so mean(rho_new) equals the
+        # face continuity update with the momentum CV's interpolated
+        # fluxes — uniform translation of a jump stays exact, and the
+        # arithmetic-rule projection keeps total momentum telescoping.
+        if self.convective_op_type == "none":
+            adv = tuple(jnp.zeros(g.n, dtype=u[0].dtype)
+                        for _ in range(g.dim))
+            rho_new = rho          # no transport in the Stokes limit
+        else:
+            adv = self._momentum_advection(u, F)
+        visc = self._viscous_force(u, mu_cc)
+        body = self._interface_forces(phi, rho)
+        gp = stencils.gradient(p, dx)
+        u_star = []
+        for d in range(g.dim):
+            m = _cc_to_face(rho, d) * u[d]
+            rhs = -adv[d] + visc[d] + body[d] - gp[d]
+            if f is not None:
+                rhs = rhs + f[d]
+            u_star.append((m + dt * rhs) / _cc_to_face(rho_new, d))
+
+        # 3. variable-density pressure-increment projection with the
+        # MATCHING arithmetic face coefficient
+        u_new, dp = self.project_vc(tuple(u_star), rho_new, dt,
+                                    face_rule="arithmetic")
+        p_new = p + dp
+
+        # 4. interface transport + optional density re-slaving
+        phi_new = self._transport_level_set(phi, u_new, dt, state.k)
+        if self.rho_resync_interval:
+            rho_new = jax.lax.cond(
+                jnp.mod(state.k + 1, self.rho_resync_interval) == 0,
+                lambda _: self.density(phi_new),
+                lambda r: r, rho_new)
+
+        return VCConsState(u=u_new, p=p_new, phi=phi_new, rho=rho_new,
+                           t=state.t + dt, k=state.k + 1)
+
+    # -- diagnostics ----------------------------------------------------
+    def total_mass(self, state: VCConsState) -> jnp.ndarray:
+        return jnp.sum(state.rho) * self.grid.cell_volume
+
+    def total_momentum(self, state: VCConsState) -> Vel:
+        """Arithmetic-face momentum density — the conserved quantity of
+        this discretization (matches the step's face rule)."""
+        return tuple(
+            jnp.sum(_cc_to_face(state.rho, d) * state.u[d])
+            * self.grid.cell_volume
+            for d in range(self.grid.dim))
+
+
+# one generic scan advance serves both VC forms (step resolves
+# dynamically); the alias keeps the conservative API explicit
+def advance_vc_conservative(integ, state, dt: float, num_steps: int):
+    return advance_vc(integ, state, dt, num_steps)
 
 
 def advance_vc(integ: INSVCStaggeredIntegrator, state: VCINSState,

@@ -1,0 +1,134 @@
+"""Conservative-form VC INS (the INSVCStaggeredConservative half of
+P22): consistent mass-momentum transport.
+
+Oracles: EXACT global mass conservation (telescoping upwind fluxes),
+EXACT global momentum conservation under net-force-free forcing (the
+property the non-conservative velocity form cannot have — compared
+head-to-head), uniform-flow preservation, hydrostatic quiescence, and
+relative drop buoyancy."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from ibamr_tpu.grid import StaggeredGrid
+from ibamr_tpu.integrators.ins_vc import (INSVCConservativeIntegrator,
+                                          INSVCStaggeredIntegrator,
+                                          advance_vc,
+                                          advance_vc_conservative)
+
+
+def _drop_phi(n, center=(0.5, 0.6), r0=0.12):
+    x = (np.arange(n) + 0.5) / n
+    X, Y = np.meshgrid(x, x, indexing="ij")
+    return jnp.asarray(
+        r0 - np.sqrt((X - center[0]) ** 2 + (Y - center[1]) ** 2),
+        dtype=jnp.float64)
+
+
+def _grid(n=32):
+    return StaggeredGrid(n=(n, n), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+
+
+def test_mass_and_momentum_conserved_exactly():
+    g = _grid()
+    integ = INSVCConservativeIntegrator(
+        g, rho0=1.0, rho1=100.0, mu0=0.02, mu1=0.05,
+        gravity=(0.0, -1.0), sigma=0.0, cg_tol=1e-11,
+        dtype=jnp.float64)
+    st = integ.initialize(_drop_phi(32))
+    m0 = float(integ.total_mass(st))
+    p0 = [float(c) for c in integ.total_momentum(st)]
+    st = advance_vc_conservative(integ, st, 2e-4, 60)
+    m1 = float(integ.total_mass(st))
+    p1 = [float(c) for c in integ.total_momentum(st)]
+    assert abs(m1 - m0) < 1e-12 * m0
+    for a, b in zip(p0, p1):
+        assert abs(b - a) < 1e-11          # roundoff-scale drift
+
+
+def test_momentum_conservation_beats_nonconservative():
+    """Head-to-head under identical physics: the conservative form's
+    momentum drift is orders of magnitude below the velocity form's."""
+    g = _grid()
+    phi0 = _drop_phi(32)
+    kw = dict(rho0=1.0, rho1=100.0, mu0=0.02, mu1=0.05,
+              gravity=(0.0, -1.0), sigma=0.0, cg_tol=1e-10,
+              dtype=jnp.float64)
+    cons = INSVCConservativeIntegrator(g, **kw)
+    nonc = INSVCStaggeredIntegrator(g, **kw)
+
+    st_c = cons.initialize(phi0)
+    st_c = advance_vc_conservative(cons, st_c, 2e-4, 60)
+    drift_c = abs(float(cons.total_momentum(st_c)[1]))
+
+    st_n = nonc.initialize(phi0)
+    st_n = advance_vc(nonc, st_n, 2e-4, 60)
+    rho_n = nonc.density(st_n.phi)
+    mom_n = float(jnp.sum(st_n.u[1]
+                          / (0.5 * (1.0 / rho_n
+                                    + jnp.roll(1.0 / rho_n, 1, 1))))
+                  * g.cell_volume)
+    assert drift_c < 1e-9
+    assert abs(mom_n) > 1e-4 * 1.0     # velocity form drifts visibly
+    assert drift_c < 1e-3 * abs(mom_n)
+
+
+def test_uniform_flow_preserved():
+    """Uniform rho + uniform u is an exact discrete equilibrium."""
+    g = _grid(16)
+    integ = INSVCConservativeIntegrator(
+        g, rho0=1.0, rho1=1.0, mu0=0.02, mu1=0.02, cg_tol=1e-12,
+        dtype=jnp.float64)
+    u0 = (jnp.full(g.n, 0.3), jnp.full(g.n, -0.2))
+    st = integ.initialize(jnp.full(g.n, -1.0), u0_arrays=u0)
+    st = advance_vc_conservative(integ, st, 1e-3, 10)
+    assert np.max(np.abs(np.asarray(st.u[0]) - 0.3)) < 1e-12
+    assert np.max(np.abs(np.asarray(st.u[1]) + 0.2)) < 1e-12
+
+
+def test_uniform_translation_of_density_jump_is_equilibrium():
+    """THE consistency property: a dense drop translating in uniform
+    flow (mu=0, sigma=0, no gravity) must stay in uniform flow — the
+    face momentum density is updated by the same interpolated mass
+    fluxes as the momentum advection, so no spurious interface
+    accelerations develop (regression: the harmonic face rule produced
+    ~17% spurious velocity in 20 steps at ratio 100)."""
+    g = _grid(32)
+    integ = INSVCConservativeIntegrator(
+        g, rho0=1.0, rho1=100.0, mu0=0.0, mu1=0.0, sigma=0.0,
+        reinit_interval=10 ** 9, cg_tol=1e-12, dtype=jnp.float64)
+    u0 = (jnp.full(g.n, 0.3), jnp.zeros(g.n))
+    st = integ.initialize(_drop_phi(32), u0_arrays=u0)
+    st = advance_vc_conservative(integ, st, 5e-4, 20)
+    assert np.max(np.abs(np.asarray(st.u[0]) - 0.3)) < 1e-10
+    assert np.max(np.abs(np.asarray(st.u[1]))) < 1e-10
+
+
+def test_hydrostatic_pool_quiescent_conservative():
+    g = _grid()
+    y = (np.arange(32) + 0.5) / 32
+    phi0 = jnp.asarray(np.broadcast_to((0.5 - y)[None, :], (32, 32)),
+                       dtype=jnp.float64)
+    integ = INSVCConservativeIntegrator(
+        g, rho0=1.0, rho1=100.0, mu0=0.01, mu1=0.01,
+        gravity=(0.0, -1.0), sigma=0.0, reinit_interval=1000,
+        cg_tol=1e-11, dtype=jnp.float64)
+    st = integ.initialize(phi0)
+    st = advance_vc_conservative(integ, st, 1e-3, 20)
+    umax = max(float(jnp.max(jnp.abs(c))) for c in st.u)
+    assert umax < 1e-9, umax
+
+
+def test_drop_buoyancy_conservative():
+    g = _grid()
+    integ = INSVCConservativeIntegrator(
+        g, rho0=1.0, rho1=100.0, mu0=0.02, mu1=0.05,
+        gravity=(0.0, -1.0), cg_tol=1e-9, dtype=jnp.float64)
+    st = integ.initialize(_drop_phi(32))
+    st = advance_vc_conservative(integ, st, 2e-4, 100)
+    v = np.asarray(st.u[1])
+    H = np.asarray(st.phi) > 0
+    assert v[H].mean() < -1e-4
+    assert v[~H].mean() > 1e-6
+    # and, unlike the velocity form, with ~zero mean drift
+    assert abs(float(integ.total_momentum(st)[1])) < 1e-8
