@@ -6,3 +6,15 @@ from ibamr_tpu.integrators.constraint_ib import (ConstraintIBMethod,
 
 __all__ = ["INSState", "INSStaggeredIntegrator", "CIBMethod", "RigidBodies",
            "IBFEMethod", "ConstraintIBMethod", "ConstraintIBState"]
+
+# Heavier integrator families import lazily (keep `import ibamr_tpu`
+# light); the module paths are the stable API:
+#   ibamr_tpu.integrators.ib           - explicit marker IB (P8/P9)
+#   ibamr_tpu.integrators.ib_implicit  - Newton-Krylov implicit IB (P8)
+#   ibamr_tpu.integrators.imp          - material points (P18)
+#   ibamr_tpu.integrators.ins_walls    - no-slip/moving-lid INS (P2)
+#   ibamr_tpu.integrators.ins_open     - inflow/outflow INS (P2/P3)
+#   ibamr_tpu.integrators.ins_vc       - two-phase VC INS, both forms (P22)
+#   ibamr_tpu.integrators.adv_diff     - transported quantities (P19)
+#   ibamr_tpu.integrators.gib          - generalized IB / rods (P12)
+#   ibamr_tpu.integrators.penalty_ib   - penalty IB (P14)
