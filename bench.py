@@ -33,6 +33,90 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def enable_compile_cache(jax) -> None:
+    """Persistent XLA compilation cache: reruns and the staged ramp skip
+    the 40-100 s flagship compiles (VERDICT round 2, weak #7)."""
+    try:
+        import os
+        d = os.environ.get(
+            "IBAMR_COMPILE_CACHE",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".jax_cache"))
+        os.makedirs(d, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", d)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    except Exception as e:
+        log(f"[bench] compile cache unavailable: {e}")
+
+
+def try_upgrade_to_tpu(probe_timeout: float = 45.0):
+    """Between stages, see if the relay came back; if so re-init the
+    accelerator in-process (VERDICT round 2, weak #1: a transient outage
+    at t=0 must not forfeit the whole round's perf artifact).
+    Returns (jax, platform, error); jax/platform are None when the
+    accelerator is still unavailable."""
+    import os
+
+    from ibamr_tpu.utils.backend_guard import (probe_accelerator,
+                                               restore_accelerator)
+
+    probe_timeout = float(os.environ.get("IBAMR_BENCH_REPROBE_TIMEOUT",
+                                         probe_timeout))
+    plat, err = probe_accelerator(probe_timeout)
+    if plat is None or plat == "cpu":
+        return None, None, err
+    jax, plat2 = restore_accelerator()
+    if plat2 is None:
+        return None, None, f"probe saw {plat} but in-process re-init failed"
+    return jax, plat2, None
+
+
+def phase_breakdown(jax, integ, state, dt: float, iters: int = 10) -> dict:
+    """Per-phase ms/step on the current device: bucket prep, interp,
+    force, spread, fluid solve — the TimerManager-style table SURVEY §6
+    asks for. Each phase is jitted standalone; the sum differs from the
+    fused step (XLA fuses across phases there), so the table names the
+    dominant phase rather than reconstructing the exact step time."""
+    import time as _t
+
+    grid = integ.ins.grid
+    ib = integ.ib
+    mask = state.mask
+    out = {}
+
+    def timeit(name, fn, *args):
+        res = fn(*args)
+        jax.block_until_ready(res)  # compile + warm
+        t0 = _t.perf_counter()
+        for _ in range(iters):
+            res = fn(*args)
+        jax.block_until_ready(res)
+        out[name] = round(1e3 * (_t.perf_counter() - t0) / iters, 3)
+        return res
+
+    ctx = None
+    if getattr(ib, "fast", None) is not None:
+        ctx = timeit("bucket_prep",
+                     jax.jit(lambda X: ib.prepare(X, mask)), state.X)
+    U = timeit("interp",
+               jax.jit(lambda u, X, c: ib.interpolate_velocity(
+                   u, grid, X, mask, ctx=c)),
+               state.ins.u, state.X, ctx)
+    F = timeit("force",
+               jax.jit(lambda X, U: ib.compute_force(X, U, 0.0)),
+               state.X, U)
+    f = timeit("spread",
+               jax.jit(lambda F, X, c: ib.spread_force(
+                   F, grid, X, mask, ctx=c)),
+               F, state.X, ctx)
+    timeit("fluid_solve",
+           jax.jit(lambda s, f: integ.ins.step(s, dt, f=f)),
+           state.ins, f)
+    out["dominant"] = max((k for k in out if k != "dominant"),
+                          key=lambda k: out[k])
+    return out
+
+
 def run_stage(jax, n: int, n_lat: int, n_lon: int, steps: int,
               warmup: int, dt: float, use_fast=None) -> dict:
     """Build the shell config at one grid size and time the jitted step."""
@@ -106,8 +190,10 @@ def main():
         "platform": None,
         "stages": [],
         "mxu_vs_scatter": None,
+        "phases": None,
         "error": None,
     }
+    orig_steps, orig_deadline = args.steps, args.deadline
 
     try:
         from ibamr_tpu.utils.backend_guard import init_backend_with_retry
@@ -118,6 +204,7 @@ def main():
         if backend_err is not None:
             result["error"] = f"accelerator init failed: {backend_err}"
         log(f"[bench] platform={platform}")
+        enable_compile_cache(jax)
         if platform == "cpu":
             # fallback exists to EMIT A LABELLED LINE, not to benchmark
             # the host: bound the wall clock well inside any driver
@@ -128,11 +215,37 @@ def main():
         sizes = [int(s) for s in args.stages.split(",") if s.strip()]
         sizes = sorted({s for s in sizes if s < args.n}) + [args.n]
         errors = []
+        # no upgrade attempts when the CONTAINER pinned cpu (the guard
+        # records the pre-force_cpu value; post-fallback env always
+        # says cpu)
+        from ibamr_tpu.utils.backend_guard import _ORIG_JAX_PLATFORMS
+        reprobes_left = 0 if (_ORIG_JAX_PLATFORMS or "").strip().lower() \
+            == "cpu" else 2
         for n in sizes:
             if time.perf_counter() - t_start > args.deadline:
                 log(f"[bench] deadline exceeded, skipping n={n}")
                 errors.append(f"n={n}: skipped (deadline)")
                 continue
+            if platform == "cpu" and reprobes_left > 0:
+                # a transient relay outage at t=0 must not forfeit the
+                # round's perf artifact: re-probe between stages and
+                # upgrade mid-run if the relay healed (VERDICT r2 weak
+                # #1). Bounded: the hang-wait costs up to 45 s against
+                # the clamped 420 s CPU budget, so at most 2 attempts,
+                # and none when CPU was explicitly requested.
+                reprobes_left -= 1
+                log("[bench] on cpu fallback: re-probing accelerator ...")
+                upj, uplat, uerr = try_upgrade_to_tpu()
+                if upj is not None:
+                    jax = upj
+                    platform = uplat
+                    result["platform"] = platform
+                    result["error"] = None
+                    args.steps, args.deadline = orig_steps, orig_deadline
+                    enable_compile_cache(jax)
+                    log(f"[bench] accelerator recovered: {platform}")
+                else:
+                    log(f"[bench] accelerator still down: {uerr}")
             if platform == "cpu" and n > 64:
                 # the CPU FALLBACK exists so a downed TPU relay still
                 # yields a labelled number — big CPU stages (128^3+)
@@ -156,6 +269,8 @@ def main():
                                       args.warmup, args.dt)
                 log(f"[bench] stage n={n}: {stage['steps_per_sec']} "
                     "steps/s")
+                stage["platform"] = platform  # stages can straddle a
+                # mid-run CPU->TPU upgrade; label each measurement
                 result["stages"].append(stage)
                 result["metric"] = (
                     f"IB/explicit/ex4 3D shell {n}^3, "
@@ -188,6 +303,28 @@ def main():
                     result["mxu_vs_scatter"] = cmp
                 except Exception as e:
                     errors.append(f"compare: {type(e).__name__}: {e}")
+
+        if (platform != "cpu" and result["stages"]
+                and time.perf_counter() - t_start <= args.deadline):
+            # per-phase TimerManager-style table at the largest completed
+            # size (SURVEY §6: name the dominant phase)
+            try:
+                bn = result["stages"][-1]["n"]
+                frac = bn / args.n
+                from ibamr_tpu.models.shell3d import build_shell_example
+
+                integ, st = build_shell_example(
+                    n_cells=bn,
+                    n_lat=max(16, int(round(args.n_lat * frac))),
+                    n_lon=max(16, int(round(args.n_lon * frac))),
+                    radius=0.25, aspect=1.2, stiffness=1.0,
+                    rest_length_factor=0.75, mu=0.05)
+                result["phases"] = {"n": bn,
+                                    **phase_breakdown(jax, integ, st,
+                                                      args.dt)}
+                log(f"[bench] phases@{bn}^3: {result['phases']}")
+            except Exception as e:
+                errors.append(f"phases: {type(e).__name__}: {e}")
 
         if errors:
             msg = "; ".join(errors)

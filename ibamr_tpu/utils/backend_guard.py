@@ -26,15 +26,32 @@ import os
 import time
 from typing import Optional, Tuple
 
+# JAX_PLATFORMS as the container set it, before any force_cpu() mutation —
+# needed to probe/restore the accelerator after a CPU fallback.
+_ORIG_JAX_PLATFORMS: Optional[str] = os.environ.get("JAX_PLATFORMS")
+
+# factories popped by _drop_axon_factory, kept so restore_accelerator()
+# can re-register them (a mid-run relay recovery is otherwise one-way)
+_stashed_factories: dict = {}
+
+# per-process probe memo: (platform, error) of the last subprocess probe.
+# Healthy example startups pay the probe subprocess exactly once
+# (ADVICE round 2); explicit re-probes bypass via probe_backend directly.
+_probe_memo: Optional[Tuple[Optional[str], Optional[str]]] = None
+
 
 def _drop_axon_factory() -> None:
     """Unregister the axon PJRT backend factory so no code path can
-    force-initialize the TPU relay. Private-API access is fully guarded:
-    if jax moves the symbol, we degrade to trusting JAX_PLATFORMS."""
+    force-initialize the TPU relay. The factory is stashed, not lost —
+    restore_accelerator() re-registers it. Private-API access is fully
+    guarded: if jax moves the symbol, we degrade to trusting
+    JAX_PLATFORMS."""
     try:
         from jax._src import xla_bridge as _xb
 
-        _xb._backend_factories.pop("axon", None)
+        fac = _xb._backend_factories.pop("axon", None)
+        if fac is not None:
+            _stashed_factories["axon"] = fac
     except Exception:
         pass
 
@@ -106,13 +123,16 @@ def auto_backend():
     return jax
 
 
-def probe_backend(timeout_s: float) -> Tuple[Optional[str], Optional[str]]:
+def probe_backend(timeout_s: float, env: Optional[dict] = None,
+                  ) -> Tuple[Optional[str], Optional[str]]:
     """Check IN A SUBPROCESS whether the default backend can initialize
     within ``timeout_s``. The TPU relay can HANG ``jax.devices()``
     indefinitely (not just error) — a hang in-process is unrecoverable
     because backend init holds the xla_bridge lock, so the probe must be
-    a child process we can kill. Returns (platform, None) on success or
-    (None, reason) on timeout/failure."""
+    a child process we can kill. ``env`` overrides the child environment
+    (default: parent env — the same env an in-process init would see).
+    Returns (platform, None) on success or (None, reason) on
+    timeout/failure."""
     import subprocess
     import sys
 
@@ -120,13 +140,69 @@ def probe_backend(timeout_s: float) -> Tuple[Optional[str], Optional[str]]:
     try:
         r = subprocess.run([sys.executable, "-c", code],
                            capture_output=True, text=True,
-                           timeout=timeout_s)
+                           timeout=timeout_s, env=env)
     except subprocess.TimeoutExpired:
         return None, f"backend init hung > {timeout_s:.0f}s (relay down?)"
     if r.returncode != 0:
         tail = (r.stderr or "").strip().splitlines()[-1:]
         return None, f"backend init failed: {' '.join(tail)}"
     return r.stdout.strip().splitlines()[-1], None
+
+
+def probe_accelerator(timeout_s: float) -> Tuple[Optional[str],
+                                                 Optional[str]]:
+    """Probe the ACCELERATOR backend specifically, even after a
+    force_cpu() fallback mutated this process's JAX_PLATFORMS: the child
+    gets the container's original JAX_PLATFORMS back (and no forced CPU
+    device-count flag, which is harmless but noisy)."""
+    env = dict(os.environ)
+    if _ORIG_JAX_PLATFORMS is None:
+        env.pop("JAX_PLATFORMS", None)
+    else:
+        env["JAX_PLATFORMS"] = _ORIG_JAX_PLATFORMS
+    return probe_backend(timeout_s, env=env)
+
+
+def restore_accelerator() -> Tuple[object, Optional[str]]:
+    """Undo a force_cpu()/CPU-fallback in this process and re-initialize
+    the accelerator backend. Call ONLY after probe_accelerator()
+    succeeded (the in-process init below can still hang if the relay
+    wedges in between — same residual race as init_backend_with_retry).
+
+    Returns (jax, platform) on success or (jax, None) if the accelerator
+    is still unavailable (process stays on CPU)."""
+    if _ORIG_JAX_PLATFORMS is None:
+        os.environ.pop("JAX_PLATFORMS", None)
+    else:
+        os.environ["JAX_PLATFORMS"] = _ORIG_JAX_PLATFORMS
+
+    import jax
+
+    try:
+        from jax._src import xla_bridge as _xb
+
+        for name, fac in list(_stashed_factories.items()):
+            _xb._backend_factories.setdefault(name, fac)
+        _stashed_factories.clear()
+    except Exception:
+        pass
+    _clear_backend_caches()
+    try:
+        jax.config.update("jax_platforms", _ORIG_JAX_PLATFORMS)
+    except Exception:
+        pass
+    try:
+        devs = jax.devices()
+        plat = devs[0].platform
+        if plat == "cpu":
+            force_cpu()
+            return jax, None
+        return jax, plat
+    except Exception:
+        # relay wedged between probe and init: re-pin CPU so the next
+        # in-process compute cannot hang on the half-restored relay
+        force_cpu()
+        return jax, None
 
 
 def init_backend_with_retry(retries: int = 3, delay: float = 10.0,
@@ -149,21 +225,33 @@ def init_backend_with_retry(retries: int = 3, delay: float = 10.0,
     ``"axon"``/``"tpu"``/``"cpu"`` and ``error`` is the last accelerator
     init failure message when we fell back (None on clean init).
     """
+    global _probe_memo
     probe_timeout = float(os.environ.get("IBAMR_BACKEND_PROBE_TIMEOUT",
                                          probe_timeout))
     last_err: Optional[str] = None
     platform = None
-    for attempt in range(max(retries, 1)):
-        platform, err = probe_backend(probe_timeout)
-        if platform is not None:
-            break
-        last_err = err
-        if err and "hung" in err:
-            # a hard hang will not heal in seconds: one full-timeout
-            # probe is the evidence; go straight to the CPU fallback
-            break
-        if attempt + 1 < retries:
-            time.sleep(delay * (attempt + 1))
+    if _probe_memo is not None:
+        # one probe subprocess per process (ADVICE round 2): healthy
+        # startups reuse the verdict; a re-probe after relay recovery
+        # goes through probe_accelerator()/restore_accelerator().
+        platform, last_err = _probe_memo
+    else:
+        # escalating timeouts: a healthy relay answers the short probe in
+        # seconds; only a hang pays the full timeout, exactly once
+        short = min(60.0, probe_timeout)
+        for attempt in range(max(retries, 1)):
+            platform, err = probe_backend(
+                short if attempt == 0 else probe_timeout)
+            if platform is not None:
+                break
+            last_err = err
+            if err and "hung" in err and attempt > 0:
+                # a hang that survived the escalated probe will not heal
+                # in seconds; go straight to the CPU fallback
+                break
+            if attempt + 1 < retries:
+                time.sleep(delay)
+        _probe_memo = (platform, last_err)
     if platform is None:
         jax = force_cpu()
         return jax, "cpu", last_err
