@@ -167,6 +167,35 @@ def is_composite(kernel: Kernel) -> bool:
     return isinstance(kernel, str) and kernel.upper() in _COMPOSITE
 
 
+# Kernels whose implementation has a trustworthy AD derivative for the
+# kernel-GRADIENT transfers (IMP, P18): C^1 closed forms. Excluded and
+# why: PIECEWISE_LINEAR / BSPLINE_2 are C^0 (distributional derivative);
+# IB_3 / IB_4 have subgradient kinks at the piece knots so vmap(grad)
+# silently returns one-sided values there; IB_6 is table-interpolated,
+# so AD yields a piecewise-CONSTANT (staircase) derivative;
+# COMPOSITE_BSPLINE_32's tangential axis is the C^0 hat.
+_C1_GRADIENT_KERNELS = frozenset({
+    "COSINE", "BSPLINE_3", "BSPLINE_4", "BSPLINE_5", "BSPLINE_6",
+    "COMPOSITE_BSPLINE_43", "COMPOSITE_BSPLINE_54",
+})
+
+
+def validate_gradient_kernel(kernel: Kernel) -> None:
+    """Reject kernels whose AD derivative is unreliable for
+    kernel-gradient transfers (ADVICE round 2: a user passing
+    kernel="IB_4" to IMPMethod must get an error, not silently degraded
+    kink-point gradients). User-defined ``(support, phi)`` pairs pass —
+    smoothness is the caller's contract (document C^1 there)."""
+    if isinstance(kernel, str) and \
+            kernel.upper() not in _C1_GRADIENT_KERNELS:
+        raise ValueError(
+            f"kernel {kernel!r} is not C^1 (or its implementation has "
+            "no trustworthy AD derivative) and cannot be used for "
+            "kernel-gradient transfers (IMP); choose one of "
+            f"{sorted(_C1_GRADIENT_KERNELS)} or pass a user-defined "
+            "(support, phi) pair that is C^1")
+
+
 def get_kernel_axes(kernel: Kernel, centering, dim: int
                     ) -> List[KernelSpec]:
     """Per-axis (support, phi) specs for a field of the given centering

@@ -174,6 +174,8 @@ def _stencil_with_grad(grid: StaggeredGrid, X: jnp.ndarray, centering,
     dW[..., j] = (phi_j'(r)/h_j) * prod_{d != j} phi_d(r_d)."""
     import jax
 
+    from ibamr_tpu.ops.delta import validate_gradient_kernel
+    validate_gradient_kernel(kernel)
     specs = get_kernel_axes(kernel, centering, grid.dim)
     offsets = _centering_offsets(grid, centering)
     dim = grid.dim

@@ -291,6 +291,66 @@ def make_sharded_ib_step(integ, mesh: Mesh, sharded_markers: bool = True,
     return jax.jit(step)
 
 
+def make_sharded_two_level_ib_step(integ, mesh: Mesh):
+    """Jitted composite two-level INS/IB step (S4 for the FLAGSHIP
+    path) with the COARSE level sharded over ``mesh`` and the fine
+    window replicated, with explicit pins at every level crossing.
+
+    Cost model (why window-replication, not window-sharding): the fine
+    window is the SMALL level by construction — it tracks the immersed
+    structure (box_from_markers), so its cell count is O(structure
+    volume), typically 5-25% of the coarse level's and often far less;
+    its per-step work is stencils + a fast-diagonalization solve whose
+    dense axis matmuls saturate a single chip's MXU at window sizes
+    (<= ~128^3) without needing the mesh. Sharding it would put a
+    collective inside EVERY CF crossing (ghost fill, restriction,
+    interface flux sync, and each FGMRES iteration's operator+precond
+    application — ~m*restarts per projection), i.e. O(100) extra
+    latency-bound collectives per step to distribute the minority of
+    the FLOPs. The coarse level — the majority of cells and of the
+    FFT-preconditioner work — IS sharded; the replicated window rides
+    along like the marker arrays do in make_sharded_ib_step. The pins
+    (CompositeProjection._pin_c/_pin_f) keep the SPMD partitioner from
+    mis-propagating through the mixed scatter/gather level crossings
+    (the round-2 wrong-values miscompile this replaces; same fix
+    pattern as make_sharded_multilevel_step's sync pins). Equality with
+    the single-device path is pinned by tests/test_parallel.py."""
+    import copy
+
+    grid = integ.grid
+    dim = grid.dim
+    spatial = NamedSharding(mesh, grid_pspec(mesh, dim))
+    replicated = NamedSharding(mesh, P())
+
+    integ = copy.copy(integ)
+    integ.core = copy.copy(integ.core)
+    proj = copy.copy(integ.core.proj)
+    proj.level_sharding = spatial
+    proj.window_sharding = replicated
+    proj.build_dense_coarse_solver()   # host-side: not legal mid-trace
+    integ.core.proj = proj
+
+    def pin_state(st):
+        # STRUCTURAL classification (coarse level vs everything else):
+        # a shape heuristic would misclassify fine-window arrays
+        # whenever ratio * box.shape == grid.n
+        def pin(a, sh):
+            return jax.lax.with_sharding_constraint(a, sh)
+
+        fluid = st.fluid._replace(
+            uc=tuple(pin(c, spatial) for c in st.fluid.uc),
+            uf=tuple(pin(f, replicated) for f in st.fluid.uf))
+        return st._replace(fluid=fluid,
+                           X=pin(st.X, replicated),
+                           U=pin(st.U, replicated),
+                           mask=pin(st.mask, replicated))
+
+    def step(state, dt):
+        return pin_state(integ.step(pin_state(state), dt))
+
+    return jax.jit(step)
+
+
 def place_state(state, grid: StaggeredGrid, mesh: Mesh):
     """Device-put the initial state under the spatial sharding (so the
     first step doesn't start from a single-device layout)."""

@@ -87,19 +87,38 @@ def _periodic_symbol(n: int, h: float) -> np.ndarray:
     return (2.0 * np.cos(2.0 * math.pi * k) - 2.0) / (h * h)
 
 
+def laplacian_1d_periodic(n: int, h: float) -> np.ndarray:
+    """Circulant 1D Laplacian (symmetric; its eigh basis is a real
+    orthogonal Fourier basis — the dense-transform alternative to the
+    FFT plan)."""
+    eye = np.eye(n)
+    return (-2.0 * eye + np.roll(eye, 1, axis=1)
+            + np.roll(eye, -1, axis=1)) / (h * h)
+
+
 class FastDiagSolver:
     """Separable Helmholtz solve (alpha + beta lap) Q = rhs on one grid,
     for one combination of per-axis (BC, centering)."""
 
     def __init__(self, grid: StaggeredGrid, bc: DomainBC,
-                 centerings: Sequence[str]):
+                 centerings: Sequence[str], dense_periodic: bool = False):
+        """``dense_periodic``: apply periodic axes as dense real-Fourier
+        eigenbasis MATMULS instead of FFTs. Two reasons to choose it:
+        (a) the MXU runs same-size dense transforms at full throughput
+        and the SPMD partitioner distributes axis matmuls cleanly, and
+        (b) XLA's fft thunk rejects the partitioned layouts a sharded
+        composite solve produces (CPU "IsMonotonicWithDim0Major"
+        RET_CHECK) — matmul transforms have no such restriction."""
         self.grid = grid
         self.bc = bc
         self.centerings = tuple(centerings)
         self.plans = []            # per axis: ("fft", lam) | ("eig", V, lam)
         for d, (axbc, cent) in enumerate(zip(bc.axes, self.centerings)):
             n, h = grid.n[d], grid.dx[d]
-            if axbc.periodic:
+            if axbc.periodic and dense_periodic:
+                lam, V = np.linalg.eigh(laplacian_1d_periodic(n, h))
+                self.plans.append(("eig", jnp.asarray(V), jnp.asarray(lam)))
+            elif axbc.periodic:
                 self.plans.append(("fft", jnp.asarray(_periodic_symbol(n, h))))
             elif cent == "cc":
                 lam, V = np.linalg.eigh(laplacian_1d_cc(n, h, axbc))

@@ -69,6 +69,39 @@ def test_regrid_noop_when_window_fits():
     assert integ2 is integ and st2 is st
 
 
+def test_regrid_carries_projection_config():
+    """A moved window must keep the full projection configuration —
+    custom m/restarts AND the external preconditioner, rebuilt at the
+    NEW box by its factory (ADVICE round 2: a FAC-preconditioned run
+    must not silently revert to the default preconditioner mid-run)."""
+    built = []
+
+    def factory(grid, box):
+        def precond(r):
+            return r
+        built.append(box.lo)
+        return precond
+
+    grid = StaggeredGrid(n=(64, 64), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    struct = make_circle_membrane(64, 0.06, (0.3, 0.5), stiffness=0.5)
+    ib = IBMethod(struct.force_specs(dtype=jnp.float64), kernel="IB_4")
+    box = FineBox(lo=(9, 22), shape=(20, 20))
+    integ = TwoLevelIBINS(grid, box, ib, mu=0.02, proj_tol=1e-10,
+                          proj_m=17, proj_restarts=3,
+                          precond_factory=factory)
+    st = integ.initialize(jnp.asarray(struct.vertices, jnp.float64))
+    assert built == [(9, 22)]
+    st2 = TwoLevelIBState(fluid=st.fluid,
+                          X=st.X + jnp.asarray([0.1, 0.0]),
+                          U=st.U, mask=st.mask)
+    integ2, _ = regrid_two_level_ib(integ, st2)
+    assert integ2.box.lo != integ.box.lo
+    assert integ2.core.proj.m == 17
+    assert integ2.core.proj.restarts == 3
+    assert integ2.core.proj._external_precond is not None
+    assert built[-1] == integ2.box.lo     # rebuilt at the NEW box
+
+
 def test_window_tracks_advected_membrane():
     U0 = 0.5
     grid, integ, st = _setup(U0=U0)

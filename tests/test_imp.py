@@ -113,3 +113,25 @@ def test_imp_step_jits():
     step = jax.jit(lambda s: integ.step(s, 1e-3))
     st = step(step(st))
     assert np.all(np.isfinite(np.asarray(st.X)))
+
+
+def test_nonsmooth_kernel_rejected_for_gradient_transfers():
+    """ADVICE round 2: IMP accepts any Kernel, but kink-point (IB_4),
+    table-interpolated (IB_6), and C^0 kernels must raise rather than
+    silently degrade the kernel-gradient transfers."""
+    import pytest
+
+    grid = StaggeredGrid(n=(16, 16), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    u = tuple(jnp.zeros(grid.n) for _ in range(2))
+    X = jnp.asarray([[0.5, 0.5]])
+    for bad in ("IB_4", "IB_6", "PIECEWISE_LINEAR", "BSPLINE_2",
+                "COMPOSITE_BSPLINE_32"):
+        with pytest.raises(ValueError, match="C\\^1"):
+            interaction.interpolate_vel_and_gradient(u, grid, X,
+                                                     kernel=bad)
+    # the C^1 families and user-defined pairs still work
+    interaction.interpolate_vel_and_gradient(u, grid, X,
+                                             kernel="BSPLINE_3")
+    from ibamr_tpu.ops.delta import get_kernel
+    interaction.interpolate_vel_and_gradient(
+        u, grid, X, kernel=get_kernel("BSPLINE_3"))

@@ -203,3 +203,41 @@ def test_wall_bounded_adv_diff_sharded_matches_single(mesh8):
         st_sh = step(st_sh, 1e-3)
     assert np.max(np.abs(np.asarray(st_ref.Q[0])
                          - np.asarray(st_sh.Q[0]))) < 1e-13
+
+
+@pytest.mark.parametrize("mesh_axes", [1, 2])
+def test_two_level_ib_sharded_matches_single(mesh_axes):
+    """The composite two-level INS/IB step — coarse level sharded over
+    the mesh, fine window replicated, explicit pins at every level
+    crossing — must match the unsharded step (VERDICT round 2 item 2:
+    this replaces the fully-replicated workaround for the SPMD
+    mixed scatter/gather miscompile)."""
+    from ibamr_tpu.amr import FineBox
+    from ibamr_tpu.amr_ins import TwoLevelIBINS
+    from ibamr_tpu.integrators.ib import IBMethod
+    from ibamr_tpu.models.membrane2d import make_circle_membrane
+    from ibamr_tpu.parallel.mesh import make_sharded_two_level_ib_step
+
+    n = 32
+    from ibamr_tpu.grid import StaggeredGrid
+    grid = StaggeredGrid(n=(n, n), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    struct = make_circle_membrane(48, 0.08, (0.5, 0.5), stiffness=0.5)
+    ib = IBMethod(struct.force_specs(dtype=jnp.float64), kernel="IB_4")
+    box = FineBox(lo=(8, 8), shape=(16, 16))
+    integ = TwoLevelIBINS(grid, box, ib, mu=0.02, proj_tol=1e-10)
+    st0 = integ.initialize(jnp.asarray(struct.vertices, jnp.float64))
+
+    dt = 2e-4
+    ref = st0
+    for _ in range(3):
+        ref = integ.step(ref, dt)
+
+    mesh = make_mesh(8, max_axes=mesh_axes)
+    step = make_sharded_two_level_ib_step(integ, mesh)
+    sh = st0
+    for _ in range(3):
+        sh = step(sh, dt)
+
+    _tree_allclose(ref, sh, rtol=1e-12, atol=1e-12)
+    # the coarse level really is distributed
+    assert len(sh.fluid.uc[0].sharding.device_set) == 8
