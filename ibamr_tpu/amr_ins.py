@@ -201,6 +201,86 @@ def _box_cc_laplacian(phi_ext: jnp.ndarray, dx_f, fine_n) -> jnp.ndarray:
     return acc
 
 
+def box_mac_gradient_correct(u_box: Vel, phi_ext: jnp.ndarray,
+                             dx_f) -> Vel:
+    """``u - grad(phi)`` on box MAC faces (complete-face layout) with
+    gradients from the 1-ghost-extended cell array. Shared by the
+    two-level and L-level composite projections."""
+    dim = len(u_box)
+    nf = tuple(s - 2 for s in phi_ext.shape)
+    out = []
+    for d in range(dim):
+        lo = [slice(1, 1 + n) for n in nf]
+        hi = [slice(1, 1 + n) for n in nf]
+        lo[d] = slice(0, nf[d] + 1)
+        hi[d] = slice(1, nf[d] + 2)
+        g = (phi_ext[tuple(hi)] - phi_ext[tuple(lo)]) / dx_f[d]
+        out.append(u_box[d] - g)
+    return tuple(out)
+
+
+def interface_flux_correction(lap_c, phi_eff, phi_ext, box: FineBox,
+                              dx_c, dx_f):
+    """Replace the parent flux through each CF interface face of ``box``
+    by the restricted fine flux, adjusting the Laplacian of the OUTSIDE
+    neighbor cells (the flux-sync rows). Works for any parent/child
+    level pair of a nested hierarchy: ``lap_c``/``phi_eff`` are
+    parent-level cell arrays (box coordinates index the parent),
+    ``phi_ext`` is the 1-ghost-extended child array."""
+    dim = lap_c.ndim
+    r = box.ratio
+    for d in range(dim):
+        for side in (0, 1):
+            # fine flux through the interface plane (outward = +-d)
+            # fine cells: first interior layer vs ghost layer
+            if side == 0:
+                inner = 1
+                ghostl = 0
+                cout = box.lo[d] - 1      # outside parent cell
+                cin = box.lo[d]
+            else:
+                inner = box.fine_n[d]
+                ghostl = box.fine_n[d] + 1
+                cout = box.hi[d]
+                cin = box.hi[d] - 1
+            sl_in = [slice(1, 1 + n) for n in box.fine_n]
+            sl_gh = [slice(1, 1 + n) for n in box.fine_n]
+            sl_in[d] = slice(inner, inner + 1)
+            sl_gh[d] = slice(ghostl, ghostl + 1)
+            # gradient at the interface: fine spacing between ghost
+            # center and first interior center
+            gf = (phi_ext[tuple(sl_gh)] - phi_ext[tuple(sl_in)]) \
+                / dx_f[d]
+            if side == 0:
+                gf = -gf                  # make it the +d-face flux
+            # transverse restriction: mean over fine face pairs
+            gf = jnp.squeeze(gf, axis=d)
+            tshape = []
+            for a in range(dim):
+                if a == d:
+                    continue
+                tshape += [box.shape[a], r]
+            gf = gf.reshape(tshape)
+            gf = gf.mean(axis=tuple(range(1, 2 * (dim - 1), 2)))
+            gf = jnp.expand_dims(gf, axis=d)
+            # parent flux lap_c already used through that face
+            sl_out = [slice(box.lo[a], box.hi[a]) for a in range(dim)]
+            sl_inn = [slice(box.lo[a], box.hi[a]) for a in range(dim)]
+            sl_out[d] = slice(cout, cout + 1)
+            sl_inn[d] = slice(cin, cin + 1)
+            gc = (phi_eff[tuple(sl_inn)] - phi_eff[tuple(sl_out)]) \
+                / dx_c[d]
+            if side == 1:
+                gc = -gc          # make gc the +d gradient (gf is
+                #                   already +d-directed on both sides)
+            # outside cell: the shared face is its UPPER face on the
+            # lo side (+1/h) and its LOWER face on the hi side (-1/h)
+            sgn = 1.0 if side == 0 else -1.0
+            lap_c = lap_c.at[tuple(sl_out)].add(
+                sgn * (gf - gc) / dx_c[d])
+    return lap_c
+
+
 # --------------------------------------------------------------------------
 # composite projection
 # --------------------------------------------------------------------------
@@ -277,62 +357,8 @@ class CompositeProjection:
         return self._pin_c(phi_c.at[self.box_sl].set(restrict_cc(phi_f)))
 
     def _interface_flux_correction(self, lap_c, phi_eff, phi_ext):
-        """Replace the coarse flux through each CF interface face by the
-        restricted fine flux, adjusting the Laplacian of the OUTSIDE
-        neighbor cells (the flux-sync rows)."""
-        box = self.box
-        dim = self.grid.dim
-        r = box.ratio
-        for d in range(dim):
-            for side in (0, 1):
-                # fine flux through the interface plane (outward = +-d)
-                # fine cells: first interior layer vs ghost layer
-                if side == 0:
-                    inner = 1
-                    ghostl = 0
-                    cout = box.lo[d] - 1      # outside coarse cell
-                    cin = box.lo[d]
-                else:
-                    inner = box.fine_n[d]
-                    ghostl = box.fine_n[d] + 1
-                    cout = box.hi[d]
-                    cin = box.hi[d] - 1
-                sl_in = [slice(1, 1 + n) for n in box.fine_n]
-                sl_gh = [slice(1, 1 + n) for n in box.fine_n]
-                sl_in[d] = slice(inner, inner + 1)
-                sl_gh[d] = slice(ghostl, ghostl + 1)
-                # gradient at the interface: fine spacing between ghost
-                # center and first interior center
-                gf = (phi_ext[tuple(sl_gh)] - phi_ext[tuple(sl_in)]) \
-                    / self.dx_f[d]
-                if side == 0:
-                    gf = -gf                  # make it the +d-face flux
-                # transverse restriction: mean over fine face pairs
-                gf = jnp.squeeze(gf, axis=d)
-                tshape = []
-                for a in range(dim):
-                    if a == d:
-                        continue
-                    tshape += [box.shape[a], r]
-                gf = gf.reshape(tshape)
-                gf = gf.mean(axis=tuple(range(1, 2 * (dim - 1), 2)))
-                gf = jnp.expand_dims(gf, axis=d)
-                # coarse flux lap_c already used through that face
-                sl_out = [slice(box.lo[a], box.hi[a]) for a in range(dim)]
-                sl_inn = [slice(box.lo[a], box.hi[a]) for a in range(dim)]
-                sl_out[d] = slice(cout, cout + 1)
-                sl_inn[d] = slice(cin, cin + 1)
-                gc = (phi_eff[tuple(sl_inn)] - phi_eff[tuple(sl_out)]) \
-                    / self.dx[d]
-                if side == 1:
-                    gc = -gc          # make gc the +d gradient (gf is
-                    #                   already +d-directed on both sides)
-                # outside cell: the shared face is its UPPER face on the
-                # lo side (+1/h) and its LOWER face on the hi side (-1/h)
-                sgn = 1.0 if side == 0 else -1.0
-                lap_c = lap_c.at[tuple(sl_out)].add(
-                    sgn * (gf - gc) / self.dx[d])
-        return lap_c
+        return interface_flux_correction(lap_c, phi_eff, phi_ext,
+                                         self.box, self.dx, self.dx_f)
 
     def operator(self, phi):
         """Composite Poisson operator. The covered coarse DOFs are
@@ -402,17 +428,8 @@ class CompositeProjection:
         # fine correction (gradients from the ghost-extended phi)
         phi_ext = self._pin_f(fill_fine_ghosts(phi_f, phi_eff, box,
                                                ghost=1))
-        uf_new = []
-        dim = grid.dim
-        for d in range(dim):
-            nf = box.fine_n
-            lo = [slice(1, 1 + n) for n in nf]
-            hi = [slice(1, 1 + n) for n in nf]
-            lo[d] = slice(0, nf[d] + 1)
-            hi[d] = slice(1, nf[d] + 2)
-            g = (phi_ext[tuple(hi)] - phi_ext[tuple(lo)]) / self.dx_f[d]
-            uf_new.append(self._pin_f(uf[d] - g))
-        uf_new = tuple(uf_new)
+        uf_new = tuple(self._pin_f(c) for c in
+                       box_mac_gradient_correct(uf, phi_ext, self.dx_f))
 
         uc_new = tuple(
             self._pin_c(c) for c in scatter_box_mac_to_coarse(

@@ -33,6 +33,30 @@ from ibamr_tpu.solvers.multigrid import (PoissonMultigrid,
 Array = jnp.ndarray
 
 
+def _smooth_patch(box: FineBox, dx_f, diag_f, masks, box_sl,
+                  e: Array, r: Array, e_parent: Optional[Array],
+                  sweeps: int) -> Array:
+    """Masked red-black relaxation of lap e = r on one patch level.
+    ``e_parent`` supplies CF ghosts (None = homogeneous zero ghosts).
+    Shared by the two-level and L-level FAC classes."""
+    fine_n = box.fine_n
+
+    def ghosted(e):
+        if e_parent is None:
+            pad = [(1, 1)] * e.ndim
+            return jnp.pad(e, pad)
+        e_eff = e_parent.at[box_sl].set(restrict_cc(e))
+        return fill_fine_ghosts(e, e_eff, box, ghost=1)
+
+    def sweep(_, e):
+        for mask in masks:
+            lap = _box_lap(ghosted(e), dx_f, fine_n)
+            e = e + jnp.where(mask, (r - lap) / diag_f, 0.0)
+        return e
+
+    return jax.lax.fori_loop(0, sweeps, sweep, e)
+
+
 class FACCompositePoisson:
     """FAC preconditioner for the two-level composite Poisson system of
     :class:`ibamr_tpu.amr_ins.CompositeProjection` (residual pytree
@@ -72,25 +96,9 @@ class FACCompositePoisson:
 
     def _smooth_fine(self, e_f: Array, r_f: Array,
                      e_c: Optional[Array], sweeps: int) -> Array:
-        """Masked red-black relaxation of lap_f e_f = r_f on the patch.
-        ``e_c`` supplies CF ghosts (None = homogeneous zero ghosts)."""
-        fine_n = self.box.fine_n
-
-        def ghosted(e_f):
-            if e_c is None:
-                pad = [(1, 1)] * e_f.ndim
-                return jnp.pad(e_f, pad)
-            e_eff = e_c.at[self.box_sl].set(restrict_cc(e_f))
-            return fill_fine_ghosts(e_f, e_eff, self.box, ghost=1)
-
-        def sweep(_, e_f):
-            for mask in self._masks:
-                lap = _box_lap(ghosted(e_f), self.dx_f, fine_n)
-                e_f = e_f + jnp.where(mask, (r_f - lap) / self._diag_f,
-                                      0.0)
-            return e_f
-
-        return jax.lax.fori_loop(0, sweeps, sweep, e_f)
+        return _smooth_patch(self.box, self.dx_f, self._diag_f,
+                             self._masks, self.box_sl, e_f, r_f, e_c,
+                             sweeps)
 
     def precondition(self, r: Tuple[Array, Array]
                      ) -> Tuple[Array, Array]:
@@ -115,3 +123,100 @@ class FACCompositePoisson:
         # covered coarse rows are decoupled -diag*phi identity rows
         e_c_out = jnp.where(self._covered, -r_c / self._diag_c, e_c)
         return (e_c_out, e_f)
+
+
+class FACMultilevelPoisson:
+    """L-level FAC V-cycle for the composite Poisson system of
+    :class:`ibamr_tpu.amr_ins_multilevel.MultiLevelCompositeProjection`
+    (residual pytree ``(r_0, ..., r_{L-1})``, one nested box per level)
+    — the arbitrary-depth generalization of the two-level
+    :class:`FACCompositePoisson` (reference FACPreconditioner over a
+    full hierarchy, SURVEY.md T8).
+
+    One V(nu,nu)-cycle:
+
+    - DOWN, finest to level 1: red-black pre-smoothing of each patch
+      correction (zero CF ghosts), then the defining FAC move — the
+      parent's rhs carries the RESTRICTED child residual underneath the
+      patch;
+    - BOTTOM: full-domain multigrid V-cycle on level 0's composite
+      residual;
+    - UP, level 1 to finest: CF-interpolate the parent correction onto
+      the patch, post-smooth with live CF ghosts.
+
+    ``levels`` come from ``build_hierarchy`` (level 0 periodic root).
+    """
+
+    def __init__(self, levels, nu: int = 2,
+                 mg: Optional[PoissonMultigrid] = None,
+                 dtype=jnp.float64):
+        self.levels = list(levels)
+        self.L = len(self.levels)
+        self.nu = int(nu)
+        root = self.levels[0].grid
+        dim = root.dim
+        self.mg_c = mg if mg is not None else PoissonMultigrid(
+            tuple(root.n), DomainBC.periodic(dim), root.dx,
+            dtype=jax.dtypes.canonicalize_dtype(dtype))
+        self.dx = [spec.grid.dx for spec in self.levels]
+        self.diag = [sum(-2.0 / h ** 2 for h in spec.grid.dx)
+                     for spec in self.levels]
+        self.box_sl = []
+        self.masks = []
+        self.covered = []     # per level l < L-1: child-box mask
+        for l in range(1, self.L):
+            box = self.levels[l].box
+            self.box_sl.append(tuple(slice(box.lo[a], box.hi[a])
+                                     for a in range(dim)))
+            self.masks.append(checkerboard_masks(box.fine_n))
+            cov = np.zeros(self.levels[l - 1].grid.n, dtype=bool)
+            cov[self.box_sl[-1]] = True
+            self.covered.append(jnp.asarray(cov))
+
+    def _smooth(self, l: int, e: Array, r: Array,
+                e_parent: Optional[Array], sweeps: int) -> Array:
+        return _smooth_patch(self.levels[l].box, self.dx[l],
+                             self.diag[l], self.masks[l - 1],
+                             self.box_sl[l - 1], e, r, e_parent, sweeps)
+
+    def precondition(self, rs):
+        orig = tuple(rs)   # identity rows echo the ORIGINAL residual;
+        # the down pass overwrites covered regions with child residuals
+        rs = list(rs)
+        es = [None] * self.L
+
+        # DOWN: smooth each patch, push its residual under the parent
+        for l in range(self.L - 1, 0, -1):
+            e = self._smooth(l, jnp.zeros_like(rs[l]), rs[l], None,
+                             self.nu)
+            pad = [(1, 1)] * e.ndim
+            res = rs[l] - _box_lap(jnp.pad(e, pad), self.dx[l],
+                                   self.levels[l].box.fine_n)
+            rs[l - 1] = rs[l - 1].at[self.box_sl[l - 1]].set(
+                restrict_cc(res))
+            es[l] = e
+
+        # BOTTOM: full-domain MG on the root composite residual
+        rr = rs[0]
+        if self.mg_c.has_nullspace:
+            rr = rr - jnp.mean(rr)
+        e0 = self.mg_c.vcycle(jnp.zeros_like(rr), rr)
+        if self.mg_c.has_nullspace:
+            e0 = e0 - jnp.mean(e0)
+        es[0] = e0
+
+        # UP: prolong the parent correction, post-smooth w/ live ghosts
+        for l in range(1, self.L):
+            es[l] = es[l] + prolong_cc(es[l - 1], self.levels[l].box)
+            es[l] = self._smooth(l, es[l], rs[l], es[l - 1], self.nu)
+
+        # covered parent rows are decoupled -diag*phi identity rows in
+        # the composite operator
+        out = []
+        for l in range(self.L):
+            if l + 1 < self.L:
+                out.append(jnp.where(self.covered[l],
+                                     orig[l] / self.diag[l], es[l]))
+            else:
+                out.append(es[l])
+        return tuple(out)

@@ -1,0 +1,231 @@
+"""L-level composite INS + IB (VERDICT round 2 item 3): the two-level
+composite fluid machinery generalized to arbitrary-depth hierarchies.
+
+Oracles:
+- the L-level composite projection drives the composite divergence to
+  solver tolerance on random data (3 levels);
+- with a single box the L-level integrator reproduces the two-level
+  integrator (same scheme, independent implementations);
+- a compact vortex doubly refined at the center: the finest region
+  tracks a uniform run at the finest resolution far better than the
+  coarse run does;
+- FGMRES iteration counts stay level-count independent (2 vs 3 levels);
+- a membrane inside the FINEST box of a 3-level hierarchy conserves
+  area and keeps the composite field div-free.
+"""
+
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ibamr_tpu.amr import FineBox
+from ibamr_tpu.amr_ins import TwoLevelINS, advance_two_level
+from ibamr_tpu.amr_ins_multilevel import (MultiLevelCompositeProjection,
+                                          MultiLevelIBINS, MultiLevelINS,
+                                          advance_multilevel,
+                                          advance_multilevel_ib,
+                                          build_hierarchy)
+from ibamr_tpu.grid import StaggeredGrid
+from ibamr_tpu.integrators.ib import IBMethod, polygon_area
+from ibamr_tpu.models.membrane2d import make_circle_membrane
+from ibamr_tpu.ops import stencils
+from ibamr_tpu.ops.convection import convective_rate
+from ibamr_tpu.solvers import fft
+
+
+def _grid(n):
+    return StaggeredGrid(n=(n, n), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+
+
+# analytic compact vortex: psi = A exp(-((x-.5)^2+(y-.5)^2)/s^2)
+_A, _S = 0.05, 0.08
+
+
+def _psi(x, y):
+    return _A * np.exp(-((x - 0.5) ** 2 + (y - 0.5) ** 2) / _S ** 2)
+
+
+def _vel(d, mesh):
+    x, y = mesh
+    if d == 0:     # u = dpsi/dy
+        return _psi(x, y) * (-2.0 * (y - 0.5) / _S ** 2)
+    return _psi(x, y) * (2.0 * (x - 0.5) / _S ** 2)   # v = -dpsi/dx
+
+
+def _uniform_run(n, T, steps, mu):
+    """Uniform-grid run with the same explicit scheme, analytic init."""
+    g = _grid(n)
+    comps = []
+    for d in range(2):
+        coords = []
+        for e in range(2):
+            if e == d:
+                c = np.arange(g.n[e]) * g.dx[e]
+            else:
+                c = (np.arange(g.n[e]) + 0.5) * g.dx[e]
+            coords.append(c)
+        mesh = np.meshgrid(*coords, indexing="ij")
+        comps.append(jnp.asarray(_vel(d, mesh)))
+    u, _ = fft.project_divergence_free(tuple(comps), g.dx)
+    dt = T / steps
+
+    def step(u, _):
+        lap = stencils.laplacian_vel(u, g.dx)
+        nc = convective_rate(u, g.dx, "centered")
+        us = tuple(c + dt * (-a + mu * l)
+                   for c, a, l in zip(u, nc, lap))
+        un, _ = fft.project_divergence_free(us, g.dx)
+        return un, None
+
+    u, _ = jax.lax.scan(step, u, None, length=steps)
+    return u
+
+
+_BOXES3 = [FineBox(lo=(8, 8), shape=(16, 16)),
+           FineBox(lo=(8, 8), shape=(16, 16))]
+
+
+def _random_slaved_field(levels, seed=0):
+    """Random per-level MAC field with covered parent faces slaved to
+    the child restriction — the projection's input contract (matches
+    the two-level exact test; the predictor slaves bottom-up too)."""
+    from ibamr_tpu.amr import restrict_mac
+    from ibamr_tpu.amr_ins import scatter_box_mac_to_coarse
+
+    rng = np.random.default_rng(seed)
+    us = []
+    for l, spec in enumerate(levels):
+        g = spec.grid
+        comps = []
+        for d in range(2):
+            shape = tuple(g.n[e] + (1 if (l > 0 and e == d) else 0)
+                          for e in range(2))
+            comps.append(jnp.asarray(rng.standard_normal(shape)) * 0.1)
+        us.append(tuple(comps))
+    for l in range(len(levels) - 2, -1, -1):
+        us[l] = scatter_box_mac_to_coarse(us[l], restrict_mac(us[l + 1]),
+                                          levels[l + 1].box)
+    return us
+
+
+def test_multilevel_projection_exact():
+    levels = build_hierarchy(_grid(32), _BOXES3)
+    proj = MultiLevelCompositeProjection(levels, tol=1e-12, m=30,
+                                         restarts=20)
+    us = _random_slaved_field(levels)
+    out, iters = proj.project(us)
+    assert float(proj.max_divergence(out)) < 1e-9
+    assert int(iters) < 30 * 20
+
+
+def test_single_box_matches_two_level():
+    """L=2 instance vs TwoLevelINS: same scheme, two implementations —
+    fields must agree to solver tolerance."""
+    mu, dt, steps = 0.002, 6.25e-4, 40
+    g = _grid(32)
+    box = FineBox(lo=(8, 8), shape=(16, 16))
+
+    ml = MultiLevelINS(g, [box], mu=mu, proj_tol=1e-11)
+    st0_ml = ml.initialize(_vel)
+    st_ml = advance_multilevel(ml, st0_ml, dt, steps)
+
+    # start TwoLevelINS from the multilevel's own projected initial
+    # state so the comparison isolates the step implementations
+    tl = TwoLevelINS(g, box, mu=mu, proj_tol=1e-11)
+    from ibamr_tpu.amr_ins import TwoLevelINSState
+    st_tl = TwoLevelINSState(uc=st0_ml.us[0], uf=st0_ml.us[1],
+                             t=jnp.zeros(()), k=jnp.zeros((), jnp.int32))
+    st_tl = advance_two_level(tl, st_tl, dt, steps)
+
+    for a, b in zip(st_ml.us[0] + st_ml.us[1], st_tl.uc + st_tl.uf):
+        assert float(jnp.max(jnp.abs(a - b))) < 1e-8
+
+
+def test_vortex_3level_matches_uniform_finest():
+    """Doubly-refined center: the finest region must be far closer to
+    uniform-128 than uniform-32 is."""
+    T, steps, mu = 0.125, 200, 0.002
+    u128 = _uniform_run(128, T, steps, mu)
+    u32 = _uniform_run(32, T, steps, mu)
+
+    ml = MultiLevelINS(_grid(32), _BOXES3, mu=mu, proj_tol=1e-11)
+    st = ml.initialize(_vel)
+    st = advance_multilevel(ml, st, T / steps, steps)
+    assert float(ml.max_divergence(st)) < 1e-9
+
+    # finest level covers coarse cells [12, 20) = fine-128 cells
+    # [48, 80); u-faces of that region on the uniform-128 grid
+    uf = st.us[2][0]
+    err_3lev = float(jnp.max(jnp.abs(uf - u128[0][48:81, 48:80])))
+
+    # coarse u-face value ~ mean of the 4 coincident fine faces
+    # (faces at 4k along x, cell pairs 4k..4k+3 along y)
+    sub = u128[0][48:81:4, 48:80]
+    u_ref_avg = 0.25 * (sub[:, 0::4] + sub[:, 1::4] + sub[:, 2::4]
+                        + sub[:, 3::4])
+    err_c32 = float(jnp.max(jnp.abs(u32[0][12:21, 12:20] - u_ref_avg)))
+    umax = float(jnp.max(jnp.abs(u128[0])))
+    assert err_3lev < 0.35 * err_c32, (err_3lev, err_c32)
+    assert err_3lev < 0.03 * umax, (err_3lev, umax)
+
+
+def test_fgmres_iterations_level_count_independent():
+    """The per-level exact-inverse preconditioner must keep FGMRES
+    iteration counts flat as depth grows (T8's grid-independence
+    property, hierarchy-wide)."""
+
+    def iters_for(boxes):
+        levels = build_hierarchy(_grid(32), boxes)
+        proj = MultiLevelCompositeProjection(levels, tol=1e-10, m=40,
+                                             restarts=10)
+        us = _random_slaved_field(levels, seed=1)
+        _, iters = proj.project(us)
+        return int(iters)
+
+    i2 = iters_for(_BOXES3[:1])
+    i3 = iters_for(_BOXES3)
+    assert i3 <= max(int(1.6 * i2), i2 + 8), (i2, i3)
+
+
+def test_membrane_ib_3level():
+    """Membrane inside the FINEST box of a 3-level hierarchy: area
+    conserved, composite field div-free, markers finite."""
+    struct = make_circle_membrane(64, 0.08, (0.5, 0.5), stiffness=2.0,
+                                  aspect=1.2, rest_length_factor=0.7)
+    ib = IBMethod(struct.force_specs(dtype=jnp.float64), kernel="IB_4")
+    integ = MultiLevelIBINS(_grid(32), _BOXES3, ib, rho=1.0, mu=0.02,
+                            proj_tol=1e-10)
+    st = integ.initialize(jnp.asarray(struct.vertices, jnp.float64))
+    a0 = float(polygon_area(st.X))
+    st = advance_multilevel_ib(integ, st, 2.5e-4, 200)
+    assert float(integ.core.max_divergence(st.fluid)) < 1e-8
+    assert abs(float(polygon_area(st.X)) - a0) / a0 < 5e-4
+    assert np.all(np.isfinite(np.asarray(st.X)))
+
+
+def test_fac_multilevel_preconditioner():
+    """The L-level FAC V-cycle (solvers.fac.FACMultilevelPoisson) as
+    the external preconditioner for the 3-level composite projection:
+    converges to the same answer as the exact-inverse default within a
+    bounded iteration budget."""
+    from ibamr_tpu.solvers.fac import FACMultilevelPoisson
+
+    levels = build_hierarchy(_grid(32), _BOXES3)
+    us = _random_slaved_field(levels, seed=2)
+
+    proj_ref = MultiLevelCompositeProjection(levels, tol=1e-10, m=40,
+                                             restarts=10)
+    out_ref, _ = proj_ref.project(us)
+
+    fac = FACMultilevelPoisson(levels, nu=2)
+    proj_fac = MultiLevelCompositeProjection(
+        levels, tol=1e-10, m=40, restarts=10,
+        preconditioner=fac.precondition)
+    out_fac, iters = proj_fac.project(us)
+
+    assert float(proj_fac.max_divergence(out_fac)) < 1e-8
+    assert int(iters) < 120, int(iters)
+    for a, b in zip(out_ref, out_fac):
+        for ca, cb in zip(a, b):
+            assert float(jnp.max(jnp.abs(ca - cb))) < 1e-7
