@@ -380,21 +380,14 @@ class DynamicTwoLevelAdvDiff:
         return tuple(out)
 
     # -- one composite step (traced origin) ----------------------------------
-    def step(self, state: AMRState, dt: float) -> AMRState:
-        grid = self.grid
-        dim = grid.dim
+    def _fine_substeps(self, Qc, Qc_new, Qf, lo, dt):
+        """Advance one window's fine data r substeps against the coarse
+        predictor; returns (Qf_new, acc_lo, acc_hi) boundary-flux sums
+        for the reflux."""
+        dim = self.grid.dim
         r = self.ratio
-        dx, dx_f = grid.dx, self.dx_f
+        dx_f = self.dx_f
         dt_f = dt / r
-        Qc, Qf, lo = state
-
-        Fc = self._coarse_fluxes(Qc)
-        div = None
-        for d in range(dim):
-            t = (jnp.roll(Fc[d], -1, d) - Fc[d]) / dx[d]
-            div = t if div is None else div + t
-        Qc_new = Qc - dt * div
-
         acc_lo = [None] * dim
         acc_hi = [None] * dim
         for m in range(r):
@@ -418,6 +411,16 @@ class DynamicTwoLevelAdvDiff:
                 acc_lo[d] = f_lo if acc_lo[d] is None else acc_lo[d] + f_lo
                 acc_hi[d] = f_hi if acc_hi[d] is None else acc_hi[d] + f_hi
             Qf = Qf - dt_f * divf
+        return Qf, acc_lo, acc_hi
+
+    def _restrict_and_reflux(self, Qc_new, Qf, lo, Fc, acc_lo, acc_hi,
+                             dt):
+        """Restrict one window onto the coarse level and reflux its CF
+        interface neighbors (dynamic origin)."""
+        grid = self.grid
+        dim = grid.dim
+        r = self.ratio
+        dx = grid.dx
 
         # restriction onto covered coarse cells (dynamic origin)
         Qc_new = restrict_into_coarse(Qc_new, Qf, lo, r)
@@ -459,7 +462,24 @@ class DynamicTwoLevelAdvDiff:
             cell = cell + (dt / dx[d]) * (favg_hi - fc_hi[exp]
                                           ).reshape(slab_shape)
             Qc_new = lax.dynamic_update_slice(Qc_new, cell, tuple(nb_hi))
+        return Qc_new
 
+    def _coarse_advance(self, Qc, dt):
+        """Coarse-level flux divergence advance; returns (Fc, Qc_new)."""
+        dx = self.grid.dx
+        Fc = self._coarse_fluxes(Qc)
+        div = None
+        for d in range(self.grid.dim):
+            t = (jnp.roll(Fc[d], -1, d) - Fc[d]) / dx[d]
+            div = t if div is None else div + t
+        return Fc, Qc - dt * div
+
+    def step(self, state: AMRState, dt: float) -> AMRState:
+        Qc, Qf, lo = state
+        Fc, Qc_new = self._coarse_advance(Qc, dt)
+        Qf, acc_lo, acc_hi = self._fine_substeps(Qc, Qc_new, Qf, lo, dt)
+        Qc_new = self._restrict_and_reflux(Qc_new, Qf, lo, Fc, acc_lo,
+                                           acc_hi, dt)
         return AMRState(Qc=Qc_new, Qf=Qf, lo=lo)
 
     # -- tag / fit / regrid ---------------------------------------------------
