@@ -110,9 +110,11 @@ def build_shell_example(
         input_db=None) -> Tuple[IBExplicitIntegrator, IBState]:
     """Assemble the ex4-equivalent simulation (3D periodic unit box).
 
-    ``use_fast_interaction``: use the bucketed-MXU spread/interp engine
-    (ops.interaction_fast). None = auto: on when the grid is
-    tile-divisible and the marker count is large enough to matter.
+    ``use_fast_interaction``: True = bucketed-MXU spread/interp engine
+    (ops.interaction_fast); ``"pallas"`` = the Pallas tile-kernel
+    engine (ops.pallas_interaction); False = XLA scatter/gather.
+    None = auto: MXU when the grid is tile-divisible and the marker
+    count is large enough to matter.
     """
     import jax.numpy as jnp
 
@@ -173,8 +175,14 @@ def build_shell_example(
         # pole-clustered tiles overflow into the compact scatter path;
         # keep the dense capacity bounded so padding FLOPs stay sane
         cap = min(cap, 1024)
-        fast = FastInteraction(grid, kernel=kernel, tile=8, cap=cap,
-                               overflow_cap=max(2048, n_markers // 4))
+        if use_fast_interaction == "pallas":
+            from ibamr_tpu.ops.pallas_interaction import PallasInteraction
+            fast = PallasInteraction(
+                grid, kernel=kernel, tile=8, cap=cap,
+                overflow_cap=max(2048, n_markers // 4))
+        else:
+            fast = FastInteraction(grid, kernel=kernel, tile=8, cap=cap,
+                                   overflow_cap=max(2048, n_markers // 4))
     ib = IBMethod(structure.force_specs(dtype=dtype), kernel=kernel,
                   fast=fast)
     integ = IBExplicitIntegrator(ins, ib, scheme="midpoint")

@@ -365,17 +365,14 @@ def spread_bucketed(geom: BucketGeometry, grid: StaggeredGrid,
                                      kernel)
 
 
-def interpolate_bucketed(geom: BucketGeometry, grid: StaggeredGrid,
-                         b: Buckets, f: jnp.ndarray, X: jnp.ndarray,
-                         centering, kernel: Kernel) -> jnp.ndarray:
-    """Interpolate grid field at markers -> (N,) (adjoint of spread).
-    Marker weights come from ``b`` only — see spread_bucketed."""
-    T = _extract_tiles(geom, grid, f)                 # (B, P, n_last)
-    A, Wlast = _tile_weights(geom, grid, b, centering, kernel)
-    D = jnp.einsum("bpz,bmz->bmp", T, Wlast,
-                   precision=jax.lax.Precision.HIGHEST)
-    # wb already carries the caller's marker weights (bucket_markers)
-    Ub = jnp.sum(A * D, axis=-1) * b.wb               # (B, cap)
+def unbucket_with_overflow(Ub: jnp.ndarray, b: Buckets, f: jnp.ndarray,
+                           X: jnp.ndarray, grid: StaggeredGrid,
+                           centering, kernel: Kernel) -> jnp.ndarray:
+    """Scatter per-slot interpolants Ub (B, cap) back to marker order
+    and add the overflow markers' contribution (compact gather for the
+    buffered overflow, exact full gather when the buffer itself
+    overflowed) — the interp twin of spread_overflow_fallbacks, shared
+    by the MXU and Pallas engines."""
     U = jnp.take(Ub.reshape(-1), jnp.minimum(
         b.slot_of_marker, Ub.size - 1), axis=0)
     U = jnp.where(b.slot_of_marker < Ub.size, U, 0.0)
@@ -395,6 +392,20 @@ def interpolate_bucketed(geom: BucketGeometry, grid: StaggeredGrid,
         b.exceeded, full,
         lambda u: jax.lax.cond(b.any_overflow, compact,
                                lambda uu: uu, u), U)
+
+
+def interpolate_bucketed(geom: BucketGeometry, grid: StaggeredGrid,
+                         b: Buckets, f: jnp.ndarray, X: jnp.ndarray,
+                         centering, kernel: Kernel) -> jnp.ndarray:
+    """Interpolate grid field at markers -> (N,) (adjoint of spread).
+    Marker weights come from ``b`` only — see spread_bucketed."""
+    T = _extract_tiles(geom, grid, f)                 # (B, P, n_last)
+    A, Wlast = _tile_weights(geom, grid, b, centering, kernel)
+    D = jnp.einsum("bpz,bmz->bmp", T, Wlast,
+                   precision=jax.lax.Precision.HIGHEST)
+    # wb already carries the caller's marker weights (bucket_markers)
+    Ub = jnp.sum(A * D, axis=-1) * b.wb               # (B, cap)
+    return unbucket_with_overflow(Ub, b, f, X, grid, centering, kernel)
 
 
 class FastInteraction:

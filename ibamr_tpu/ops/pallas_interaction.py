@@ -20,14 +20,17 @@ needed inside the kernel.
 Correctness oracle: bitwise-level agreement with ops.interaction.spread
 (tested in interpret mode on CPU).
 
-Hardware status (2026-07-30): this container's TPU relay routes Pallas
-through a remote-compile service that stalls on this kernel (plain XLA
-programs compile fine), so compiled-TPU timings could not be captured
-this round; the kernel stays OFF the default paths (scatter and the
-MXU bucketed formulation remain the production spread engines) until a
-environment with local Pallas compilation can profile it. The intended
-schedule advantage over the MXU path: identical FLOPs but no
-(B, 169, NZ) HBM intermediate and no overlap-add traffic.
+Wiring status (round 3): BOTH transfers now exist as Pallas programs
+(:class:`PallasSpread3D` + the interp twin in
+:class:`PallasInteraction`), selectable from the flagship model via
+``build_shell_example(use_fast_interaction="pallas")`` and compared
+three-way (scatter / MXU / pallas) by ``bench.py`` — with the pallas
+leg in a TERMINABLE child process because this container's TPU relay
+routes Pallas through a remote-compile service that stalled on this
+kernel in round 2 (plain XLA compiles fine). The default production
+engine remains the MXU bucketed formulation until a compiled-TPU
+timing shows the Pallas schedule winning; its intended advantage is
+identical FLOPs with no (B, cap, P) weight intermediates in HBM.
 """
 
 from __future__ import annotations
@@ -163,3 +166,136 @@ class PallasSpread3D:
                    b: Buckets) -> tuple:
         return tuple(self.spread(F[:, d], X, d, b)
                      for d in range(self.grid.dim))
+
+
+def _interp_kernel_3d(geom: BucketGeometry, grid: StaggeredGrid,
+                      offs, phi, interpret: bool):
+    """Per-tile interp program: contract the extracted tile with ALL
+    cap markers' tensor-product weights in one fused VMEM computation —
+    the gather twin of _spread_kernel_3d. The (P, cap) contraction is a
+    dense dot (MXU); no (B, cap, P) weight intermediate ever reaches
+    HBM (the MXU einsum path materializes two of those)."""
+    W0, W1 = geom.width
+    nz = grid.n[2]
+    nb1 = geom.nblk[1]
+    t0, t1 = geom.tile
+    cap = geom.cap
+    lo = grid.x_lo
+    dx = grid.dx
+
+    def kernel(Xb_ref, T_ref, out_ref):
+        b = pl.program_id(0)
+        bx = b // nb1
+        by = b % nb1
+        x0 = bx * t0 - 1
+        y0 = by * t1 - 1
+
+        X = Xb_ref[0]                                  # (cap, 3)
+        ox = jax.lax.broadcasted_iota(jnp.float32, (1, W0), 1)
+        oy = jax.lax.broadcasted_iota(jnp.float32, (1, W1), 1)
+        kz = jax.lax.broadcasted_iota(jnp.float32, (1, nz), 1)
+
+        xi = (X[:, 0:1] - lo[0]) / dx[0] - offs[0]     # (cap, 1)
+        yi = (X[:, 1:2] - lo[1]) / dx[1] - offs[1]
+        zi = (X[:, 2:3] - lo[2]) / dx[2] - offs[2]
+        tx = xi - (x0 + ox)
+        tx = tx - jnp.round(tx / grid.n[0]) * grid.n[0]
+        ty = yi - (y0 + oy)
+        ty = ty - jnp.round(ty / grid.n[1]) * grid.n[1]
+        tz = zi - kz
+        tz = tz - jnp.round(tz / nz) * nz
+        wx = phi(tx)                                   # (cap, W0)
+        wy = phi(ty)                                   # (cap, W1)
+        wz = phi(tz)                                   # (cap, nz)
+        wxy = (wx[:, :, None] * wy[:, None, :]).reshape(cap, W0 * W1)
+
+        T = T_ref[0]                                   # (P, nz)
+        # accumulate in the caller's dtype: f64 callers keep full
+        # precision end to end, like the spread twin
+        tmp = jnp.dot(T, wz.T.astype(T.dtype),
+                      preferred_element_type=T.dtype)  # (P, cap)
+        out_ref[0] = jnp.sum(wxy.T.astype(T.dtype) * tmp,
+                             axis=0)[:, None]
+
+    def call(Xb, T):
+        B = Xb.shape[0]
+        return pl.pallas_call(
+            kernel,
+            grid=(B,),
+            in_specs=[
+                pl.BlockSpec((1, cap, 3), lambda b: (b, 0, 0)),
+                pl.BlockSpec((1, W0 * W1, nz), lambda b: (b, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, cap, 1), lambda b: (b, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((B, cap, 1), Xb.dtype),
+            interpret=interpret,
+        )(Xb, T)
+
+    return call
+
+
+class PallasInteraction:
+    """Drop-in FastInteraction-shaped engine with BOTH transfers as
+    Pallas tile kernels (3D only): spread via :class:`PallasSpread3D`'s
+    program, interp via its gather twin. Selectable from the flagship
+    model with ``use_fast_interaction="pallas"`` and benchmarked
+    three-way (scatter / MXU / Pallas) by bench.py (VERDICT round 2
+    item 5)."""
+
+    def __init__(self, grid: StaggeredGrid, kernel: Kernel = "IB_4",
+                 tile: int = 8, cap: int = 256,
+                 overflow_cap: Optional[int] = None,
+                 interpret: Optional[bool] = None):
+        from ibamr_tpu.ops.interaction_fast import make_geometry
+
+        if grid.dim != 3:
+            raise ValueError("PallasInteraction is 3D-only")
+        self.grid = grid
+        self.kernel: Kernel = kernel
+        self.geom = make_geometry(grid, kernel, tile=tile, cap=cap)
+        self.overflow_cap = overflow_cap
+        if interpret is None:
+            interpret = jax.default_backend() == "cpu"
+        self.interpret = bool(interpret)
+        support, phi0 = get_kernel(kernel)
+        self._phi = _phi_safe(phi0, support)
+        self._spread = PallasSpread3D(grid, kernel=kernel, tile=tile,
+                                      cap=cap, interpret=interpret)
+
+    def buckets(self, X: jnp.ndarray,
+                weights: Optional[jnp.ndarray] = None) -> Buckets:
+        from ibamr_tpu.ops.interaction_fast import bucket_markers
+
+        return bucket_markers(self.geom, self.grid, X, weights=weights,
+                              overflow_cap=self.overflow_cap)
+
+    def interpolate(self, f: jnp.ndarray, X: jnp.ndarray, centering,
+                    b: Buckets) -> jnp.ndarray:
+        from ibamr_tpu.ops.interaction_fast import (
+            _extract_tiles, unbucket_with_overflow)
+
+        geom = self.geom
+        grid = self.grid
+        offs = _centering_offsets(grid, centering)
+        T = _extract_tiles(geom, grid, f)             # (B, P, nz)
+        call = _interp_kernel_3d(geom, grid, offs, self._phi,
+                                 self.interpret)
+        Ub = call(b.Xb.astype(f.dtype), T.astype(f.dtype))[..., 0]
+        Ub = Ub * b.wb                                # (B, cap)
+        return unbucket_with_overflow(Ub, b, f, X, grid, centering,
+                                      self.kernel)
+
+    def interpolate_vel(self, u, X: jnp.ndarray,
+                        weights: Optional[jnp.ndarray] = None,
+                        b: Optional[Buckets] = None) -> jnp.ndarray:
+        if b is None:
+            b = self.buckets(X, weights=weights)
+        return jnp.stack([self.interpolate(u[d], X, d, b)
+                          for d in range(self.grid.dim)], axis=-1)
+
+    def spread_vel(self, F: jnp.ndarray, X: jnp.ndarray,
+                   weights: Optional[jnp.ndarray] = None,
+                   b: Optional[Buckets] = None):
+        if b is None:
+            b = self.buckets(X, weights=weights)
+        return self._spread.spread_vel(F, X, b)

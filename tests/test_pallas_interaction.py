@@ -87,3 +87,63 @@ def test_pallas_rejects_2d():
     g = StaggeredGrid(n=(16, 16), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
     with pytest.raises(ValueError, match="3D"):
         PallasSpread3D(g)
+
+
+def test_pallas_interp_matches_gather():
+    """The interp twin (VERDICT round 2 item 5): PallasInteraction
+    gathers grid velocity at markers identically to the XLA gather."""
+    from ibamr_tpu.ops.pallas_interaction import PallasInteraction
+
+    rng = np.random.default_rng(3)
+    g = StaggeredGrid(n=(16, 16, 32), x_lo=(0.0,) * 3, x_up=(1.0,) * 3)
+    X = jnp.asarray(rng.uniform(0, 1, (300, 3)), dtype=jnp.float32)
+    u = tuple(jnp.asarray(rng.standard_normal(g.n), dtype=jnp.float32)
+              for _ in range(3))
+    eng = PallasInteraction(g, kernel="IB_4", tile=8, cap=64,
+                            interpret=True)
+    U_pl = eng.interpolate_vel(u, X)
+    U_ref = interaction.interpolate_vel(u, g, X, kernel="IB_4")
+    scale = float(jnp.max(jnp.abs(U_ref)))
+    np.testing.assert_allclose(np.asarray(U_pl), np.asarray(U_ref),
+                               atol=2e-6 * scale)
+
+
+def test_pallas_interp_overflow_and_mask():
+    """Undersized capacity: overflow markers flow through the compact
+    gather fallback; masked markers contribute zero."""
+    from ibamr_tpu.ops.pallas_interaction import PallasInteraction
+
+    rng = np.random.default_rng(4)
+    g = StaggeredGrid(n=(16, 16, 16), x_lo=(0.0,) * 3, x_up=(1.0,) * 3)
+    # cluster markers into one tile so cap=4 overflows
+    X = jnp.asarray(0.2 + 0.05 * rng.uniform(0, 1, (64, 3)),
+                    dtype=jnp.float32)
+    u = tuple(jnp.asarray(rng.standard_normal(g.n), dtype=jnp.float32)
+              for _ in range(3))
+    w = jnp.asarray((np.arange(64) % 2), dtype=jnp.float32)
+    eng = PallasInteraction(g, kernel="IB_4", tile=8, cap=4,
+                            overflow_cap=64, interpret=True)
+    U_pl = eng.interpolate_vel(u, X, weights=w)
+    U_ref = interaction.interpolate_vel(u, g, X, kernel="IB_4",
+                                        weights=w)
+    scale = float(jnp.max(jnp.abs(U_ref)))
+    np.testing.assert_allclose(np.asarray(U_pl), np.asarray(U_ref),
+                               atol=2e-6 * scale)
+
+
+def test_pallas_engine_coupled_step_matches_scatter():
+    """Flagship selection path: build_shell_example(use_fast_interaction
+    ="pallas") steps identically to the scatter engine."""
+    from ibamr_tpu.models.shell3d import build_shell_example
+
+    integ_pl, st_pl = build_shell_example(
+        n_cells=16, n_lat=12, n_lon=12, mu=0.05,
+        use_fast_interaction="pallas")
+    integ_sc, st_sc = build_shell_example(
+        n_cells=16, n_lat=12, n_lon=12, mu=0.05,
+        use_fast_interaction=False)
+    for _ in range(3):
+        st_pl = integ_pl.step(st_pl, 1e-3)
+        st_sc = integ_sc.step(st_sc, 1e-3)
+    np.testing.assert_allclose(np.asarray(st_pl.X), np.asarray(st_sc.X),
+                               atol=5e-6)
