@@ -182,17 +182,34 @@ def run_stage(jax, n: int, n_lat: int, n_lon: int, steps: int,
     # of HBM traffic at 256^3)
     step = jax.jit(lambda s, dt: integ.step(s, dt), donate_argnums=0)
 
-    t_c0 = time.perf_counter()
-    for _ in range(max(warmup, 1)):
-        state = step(state, dt)
-    jax.block_until_ready(state)
-    compile_s = time.perf_counter() - t_c0
+    def hard_sync(s):
+        # block_until_ready proved unreliable over the axon relay after
+        # a compile-helper restart (round 3: a 256^3 stage "measured"
+        # 12055 steps/s); a device_get round-trip of a state leaf is a
+        # true barrier.
+        jax.device_get(s.X[0])
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state = step(state, dt)
-    jax.block_until_ready(state)
-    elapsed = time.perf_counter() - t0
+    def timed_run():
+        nonlocal state
+        t_c0 = time.perf_counter()
+        for _ in range(max(warmup, 1)):
+            state = step(state, dt)
+        hard_sync(state)
+        compile_s = time.perf_counter() - t_c0
+
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state = step(state, dt)
+        hard_sync(state)
+        return compile_s, time.perf_counter() - t0
+
+    compile_s, elapsed = timed_run()
+    # plausibility floor: one 256^3 step streams >1 GB of HBM; anything
+    # under 1 ms/step at n>=128 is a relay timing artifact -> remeasure
+    if n >= 128 and (elapsed / steps) * 1e3 < 1.0:
+        log(f"[bench] n={n}: implausible {elapsed / steps * 1e3:.3f} "
+            "ms/step; remeasuring once")
+        _, elapsed = timed_run()
 
     import numpy as np
     if not bool(np.isfinite(np.asarray(jax.device_get(state.X))).all()):

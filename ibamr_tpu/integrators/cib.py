@@ -206,6 +206,33 @@ class CIBMethod:
         Xdot = rigid_velocity(X, self.bodies, U)
         return X + dt * Xdot, U, info
 
+    # -- Krylov free-body menu (the KrylovFreeBodyMobilitySolver analog) -----
+    def free_body_solver(self, X: jnp.ndarray, radius: float,
+                         inner_tol: Optional[float] = None,
+                         outer_tol: float = 1e-7):
+        """Build a ``KrylovFreeBodyMobilitySolver`` over THIS method's
+        exact mobility (P15 menu: outer body-space FGMRES, inner
+        preconditioned CG, dense regularized-Stokeslet preconditioners).
+        ``radius`` is the marker hydrodynamic radius for the dense
+        approximate tensors — the marker spacing (~grid dx) is the
+        standard choice."""
+        from ibamr_tpu.solvers.mobility import KrylovFreeBodyMobilitySolver
+        return KrylovFreeBodyMobilitySolver(
+            lambda lam: self.mobility_apply(X, lam), self.bodies, X,
+            radius, self.mu,
+            inner_tol=self.cg_tol if inner_tol is None else inner_tol,
+            inner_maxiter=self.cg_maxiter, outer_tol=outer_tol)
+
+    def step_krylov(self, X: jnp.ndarray, FT: jnp.ndarray, dt: float,
+                    radius: float):
+        """Forward-Euler free-body step through the Krylov menu: one
+        outer body-mobility solve instead of ``n_bodies * n_rigid_modes``
+        resistance-column solves — the scalable path for many bodies."""
+        solver = self.free_body_solver(X, radius)
+        res = solver.solve(FT)
+        Xdot = rigid_velocity(X, self.bodies, res.U)
+        return X + dt * Xdot, res.U, res
+
 
 def make_disc(center: Sequence[float], radius: float, n_markers: int,
               dtype=jnp.float64) -> jnp.ndarray:

@@ -49,68 +49,92 @@ from ibamr_tpu.ops.interaction_fast import (BucketGeometry, Buckets,
                                             _overlap_add, _phi_safe)
 
 
+def _marker_weight_preamble(geom: BucketGeometry, grid: StaggeredGrid,
+                            offs, phi):
+    """Shared per-tile weight computation for BOTH Pallas programs
+    (spread and its interp adjoint must evaluate bit-identical weights):
+    markers on the LANE axis, per-axis kernel-weight matrices
+    ``wx (W0, cap), wy (W1, cap), wz (nz, cap)`` with periodic wrap.
+
+    Mosaic-friendly by construction (round 3: the per-marker rank-1
+    fori_loop form died in infer-vector-layout on a reshape): iota +
+    broadcast arithmetic only — no reshape or transpose in-kernel.
+    """
+    W0, W1 = geom.width
+    nz = grid.n[2]
+    t0, t1 = geom.tile
+    lo = grid.x_lo
+    dx = grid.dx
+
+    def weights(Xt, bx, by):
+        x0 = bx * t0 - 1          # tile footprint origin (cells)
+        y0 = by * t1 - 1
+        ox = jax.lax.broadcasted_iota(jnp.int32, (W0, 1), 0).astype(
+            Xt.dtype)
+        oy = jax.lax.broadcasted_iota(jnp.int32, (W1, 1), 0).astype(
+            Xt.dtype)
+        kz = jax.lax.broadcasted_iota(jnp.int32, (nz, 1), 0).astype(
+            Xt.dtype)
+
+        xi = (Xt[0:1, :] - lo[0]) / dx[0] - offs[0]    # (1, cap)
+        yi = (Xt[1:2, :] - lo[1]) / dx[1] - offs[1]
+        zi = (Xt[2:3, :] - lo[2]) / dx[2] - offs[2]
+        # wrapped distances (periodic) at every tile/axis offset
+        tx = xi - (x0 + ox)                            # (W0, cap)
+        tx = tx - jnp.round(tx / grid.n[0]) * grid.n[0]
+        ty = yi - (y0 + oy)                            # (W1, cap)
+        ty = ty - jnp.round(ty / grid.n[1]) * grid.n[1]
+        tz = zi - kz                                   # (nz, cap)
+        tz = tz - jnp.round(tz / nz) * nz
+        return phi(tx), phi(ty), phi(tz)
+
+    return weights
+
+
 def _spread_kernel_3d(geom: BucketGeometry, grid: StaggeredGrid,
                       offs, phi, interpret: bool):
     """Build the per-tile Pallas program (static closure)."""
     W0, W1 = geom.width
     nz = grid.n[2]
     nb0, nb1 = geom.nblk
-    t0, t1 = geom.tile
     cap = geom.cap
-    lo = grid.x_lo
-    dx = grid.dx
+    weights = _marker_weight_preamble(geom, grid, offs, phi)
 
-    def kernel(Xb_ref, coef_ref, out_ref):
+    def kernel(XbT_ref, coef_ref, out_ref):
         b = pl.program_id(0)
         bx = b // nb1
         by = b % nb1
-        x0 = bx * t0 - 1          # tile footprint origin (cells)
-        y0 = by * t1 - 1
+        Xt = XbT_ref[0]                                # (3, cap)
+        c = coef_ref[0]                                # (1, cap)
+        wx, wy, wz = weights(Xt, bx, by)
+        wzc = wz * c                                   # (nz, cap)
 
-        ox = jax.lax.broadcasted_iota(jnp.float32, (W0, 1), 0)
-        oy = jax.lax.broadcasted_iota(jnp.float32, (W1, 1), 0)
-        kz = jax.lax.broadcasted_iota(jnp.float32, (1, nz), 1)
-
-        def body(i, acc):
-            x = Xb_ref[0, i, 0]
-            y = Xb_ref[0, i, 1]
-            z = Xb_ref[0, i, 2]
-            c = coef_ref[0, i, 0]
-            xi = (x - lo[0]) / dx[0] - offs[0]
-            yi = (y - lo[1]) / dx[1] - offs[1]
-            zi = (z - lo[2]) / dx[2] - offs[2]
-            # wrapped distances (periodic) at every tile/axis offset
-            tx = xi - (x0 + ox)
-            tx = tx - jnp.round(tx / grid.n[0]) * grid.n[0]
-            ty = yi - (y0 + oy)
-            ty = ty - jnp.round(ty / grid.n[1]) * grid.n[1]
-            tz = zi - kz
-            tz = tz - jnp.round(tz / nz) * nz
-            wx = phi(tx)                      # (W0, 1)
-            wy = phi(ty)                      # (W1, 1)
-            wz = phi(tz)                      # (1, nz)
-            wxy = (wx * wy.T).reshape(W0 * W1, 1)
-            return acc + wxy * (c * wz)       # rank-1 VPU update
-
-        acc = jnp.zeros((W0 * W1, nz), dtype=out_ref.dtype)
-        out_ref[0] = jax.lax.fori_loop(0, cap, body, acc)
+        # out[a*W1 + b, z] = sum_m wx[a,m] wy[b,m] c[m] wz[z,m]
+        for a in range(W0):                            # static unroll
+            rows = jax.lax.dot_general(
+                wy * wx[a:a + 1, :], wzc,
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=out_ref.dtype,
+                precision=jax.lax.Precision.HIGHEST)   # (W1, nz)
+            out_ref[0, a * W1:(a + 1) * W1, :] = rows
 
     def call(Xb, coef):
         B = Xb.shape[0]
-        # trailing singleton keeps the TPU block-shape rule happy (last
-        # two dims must divide (8, 128) or equal the array dims)
-        coef = coef[:, :, None]
+        # markers on the lane axis: transpose OUTSIDE the kernel (XLA
+        # handles layout changes; Mosaic must not see them)
+        XbT = jnp.swapaxes(Xb, 1, 2)                   # (B, 3, cap)
+        coefT = coef[:, None, :]                       # (B, 1, cap)
         return pl.pallas_call(
             kernel,
             grid=(B,),
             in_specs=[
-                pl.BlockSpec((1, cap, 3), lambda b: (b, 0, 0)),
-                pl.BlockSpec((1, cap, 1), lambda b: (b, 0, 0)),
+                pl.BlockSpec((1, 3, cap), lambda b: (b, 0, 0)),
+                pl.BlockSpec((1, 1, cap), lambda b: (b, 0, 0)),
             ],
             out_specs=pl.BlockSpec((1, W0 * W1, nz), lambda b: (b, 0, 0)),
             out_shape=jax.ShapeDtypeStruct((B, W0 * W1, nz), Xb.dtype),
             interpret=interpret,
-        )(Xb, coef)
+        )(XbT, coefT)
 
     return call
 
@@ -178,58 +202,47 @@ def _interp_kernel_3d(geom: BucketGeometry, grid: StaggeredGrid,
     W0, W1 = geom.width
     nz = grid.n[2]
     nb1 = geom.nblk[1]
-    t0, t1 = geom.tile
     cap = geom.cap
-    lo = grid.x_lo
-    dx = grid.dx
+    weights = _marker_weight_preamble(geom, grid, offs, phi)
 
-    def kernel(Xb_ref, T_ref, out_ref):
+    def kernel(XbT_ref, T_ref, out_ref):
+        # the gather twin of _spread_kernel_3d, same shared weight
+        # preamble: the z-contraction as ONE dot_general, the (a, b)
+        # contraction as a static W0-unroll of sublane reductions.
         b = pl.program_id(0)
         bx = b // nb1
         by = b % nb1
-        x0 = bx * t0 - 1
-        y0 = by * t1 - 1
-
-        X = Xb_ref[0]                                  # (cap, 3)
-        ox = jax.lax.broadcasted_iota(jnp.float32, (1, W0), 1)
-        oy = jax.lax.broadcasted_iota(jnp.float32, (1, W1), 1)
-        kz = jax.lax.broadcasted_iota(jnp.float32, (1, nz), 1)
-
-        xi = (X[:, 0:1] - lo[0]) / dx[0] - offs[0]     # (cap, 1)
-        yi = (X[:, 1:2] - lo[1]) / dx[1] - offs[1]
-        zi = (X[:, 2:3] - lo[2]) / dx[2] - offs[2]
-        tx = xi - (x0 + ox)
-        tx = tx - jnp.round(tx / grid.n[0]) * grid.n[0]
-        ty = yi - (y0 + oy)
-        ty = ty - jnp.round(ty / grid.n[1]) * grid.n[1]
-        tz = zi - kz
-        tz = tz - jnp.round(tz / nz) * nz
-        wx = phi(tx)                                   # (cap, W0)
-        wy = phi(ty)                                   # (cap, W1)
-        wz = phi(tz)                                   # (cap, nz)
-        wxy = (wx[:, :, None] * wy[:, None, :]).reshape(cap, W0 * W1)
+        Xt = XbT_ref[0]                                # (3, cap)
+        wx, wy, wz = weights(Xt, bx, by)               # (nz, cap) wz
 
         T = T_ref[0]                                   # (P, nz)
         # accumulate in the caller's dtype: f64 callers keep full
         # precision end to end, like the spread twin
-        tmp = jnp.dot(T, wz.T.astype(T.dtype),
-                      preferred_element_type=T.dtype)  # (P, cap)
-        out_ref[0] = jnp.sum(wxy.T.astype(T.dtype) * tmp,
-                             axis=0)[:, None]
+        tmp = jnp.dot(T, wz.astype(T.dtype),
+                      preferred_element_type=T.dtype,
+                      precision=jax.lax.Precision.HIGHEST)  # (P, cap)
+        out = jnp.zeros((1, cap), dtype=T.dtype)
+        for a in range(W0):                            # static unroll
+            blk = tmp[a * W1:(a + 1) * W1, :]          # (W1, cap)
+            inner = jnp.sum(wy.astype(T.dtype) * blk, axis=0,
+                            keepdims=True)             # (1, cap)
+            out = out + wx[a:a + 1, :].astype(T.dtype) * inner
+        out_ref[0] = out
 
     def call(Xb, T):
         B = Xb.shape[0]
+        XbT = jnp.swapaxes(Xb, 1, 2)                   # (B, 3, cap)
         return pl.pallas_call(
             kernel,
             grid=(B,),
             in_specs=[
-                pl.BlockSpec((1, cap, 3), lambda b: (b, 0, 0)),
+                pl.BlockSpec((1, 3, cap), lambda b: (b, 0, 0)),
                 pl.BlockSpec((1, W0 * W1, nz), lambda b: (b, 0, 0)),
             ],
-            out_specs=pl.BlockSpec((1, cap, 1), lambda b: (b, 0, 0)),
-            out_shape=jax.ShapeDtypeStruct((B, cap, 1), Xb.dtype),
+            out_specs=pl.BlockSpec((1, 1, cap), lambda b: (b, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((B, 1, cap), Xb.dtype),
             interpret=interpret,
-        )(Xb, T)
+        )(XbT, T)
 
     return call
 
@@ -280,7 +293,7 @@ class PallasInteraction:
         T = _extract_tiles(geom, grid, f)             # (B, P, nz)
         call = _interp_kernel_3d(geom, grid, offs, self._phi,
                                  self.interpret)
-        Ub = call(b.Xb.astype(f.dtype), T.astype(f.dtype))[..., 0]
+        Ub = call(b.Xb.astype(f.dtype), T.astype(f.dtype))[:, 0, :]
         Ub = Ub * b.wb                                # (B, cap)
         return unbucket_with_overflow(Ub, b, f, X, grid, centering,
                                       self.kernel)

@@ -1,3 +1,12 @@
 from ibamr_tpu.solvers import fft, krylov
 
-__all__ = ["fft", "krylov"]
+__all__ = ["fft", "krylov", "mobility"]
+
+
+def __getattr__(name):
+    # mobility imports integrators.cib which imports solvers.fft; lazy
+    # load keeps the package import acyclic.
+    if name == "mobility":
+        import importlib
+        return importlib.import_module("ibamr_tpu.solvers.mobility")
+    raise AttributeError(name)

@@ -132,6 +132,8 @@ SLOW_TESTS = {
     "test_membrane_ib_3level",
     "test_single_box_matches_two_level",
     "test_fac_multilevel_preconditioner",
+    "test_cib_terminal_velocity_matches_constraint_ib",
+    "test_preconditioner_cuts_iterations",
 }
 
 

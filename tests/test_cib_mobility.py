@@ -1,0 +1,252 @@
+"""CIB mobility-solver menu (P15, SURVEY.md §2.2): Direct / Krylov /
+KrylovFreeBody solvers.
+
+Oracles: the dense approximate tensors (RPY 3D, regularized blob 2D) are
+SPD for overlapping and separated configurations; Direct solve is an
+exact inverse of its own matrix; the dense preconditioner strictly cuts
+exact-mobility CG iterations; the matrix-free free-body solve agrees
+with the dense resistance-column path; and the Krylov free-body terminal
+velocity of a heavy disc agrees with the inertial ConstraintIB
+sedimentation dynamics in the overlapping (quasi-steady, back-flow
+frame) regime.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ibamr_tpu.grid import StaggeredGrid
+from ibamr_tpu.integrators import cib
+from ibamr_tpu.solvers import mobility
+
+
+def _grid2d(n=64):
+    return StaggeredGrid(n=(n, n), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+
+
+def _disc(n_markers=40, radius=0.12, center=(0.5, 0.5)):
+    X = cib.make_disc(center, radius, n_markers)
+    bodies = cib.RigidBodies(
+        body_id=jnp.zeros(n_markers, dtype=jnp.int32), n_bodies=1)
+    return X, bodies
+
+
+# -- dense approximate tensors ---------------------------------------------
+
+def test_blob_mobility_spd_2d():
+    rng = np.random.default_rng(0)
+    # random cloud including overlapping pairs
+    X = jnp.asarray(rng.uniform(0.3, 0.7, size=(25, 2)))
+    M = mobility.blob_mobility_matrix(X, radius=0.02, mu=0.7)
+    assert np.allclose(np.asarray(M), np.asarray(M).T, atol=1e-12)
+    w = np.linalg.eigvalsh(np.asarray(M))
+    assert w.min() > 0.0, f"blob mobility not PD: min eig {w.min()}"
+
+
+def test_rpy_mobility_spd_3d_overlapping():
+    rng = np.random.default_rng(1)
+    X = jnp.asarray(rng.uniform(0.4, 0.6, size=(18, 3)))  # dense, overlaps
+    M = mobility.rpy_mobility_matrix(X, radius=0.05, mu=1.3)
+    assert np.allclose(np.asarray(M), np.asarray(M).T, atol=1e-12)
+    w = np.linalg.eigvalsh(np.asarray(M))
+    assert w.min() > 0.0, f"RPY not PD with overlaps: min eig {w.min()}"
+
+
+def test_rpy_isolated_particle_stokes_drag():
+    """A lone RPY particle has exactly the Stokes mobility 1/(6 pi mu a)."""
+    X = jnp.asarray([[0.5, 0.5, 0.5]])
+    a, mu = 0.03, 2.0
+    M = mobility.rpy_mobility_matrix(X, radius=a, mu=mu)
+    expect = 1.0 / (6.0 * np.pi * mu * a)
+    assert np.allclose(np.asarray(M), expect * np.eye(3), rtol=1e-12)
+
+
+def test_direct_solver_exact_inverse():
+    X, _ = _disc()
+    ds = mobility.DirectMobilitySolver(X, radius=0.01, mu=1.0)
+    rng = np.random.default_rng(2)
+    rhs = jnp.asarray(rng.standard_normal(X.shape))
+    lam = ds.solve(rhs)
+    assert np.allclose(np.asarray(ds.apply(lam)), np.asarray(rhs),
+                       atol=1e-8)
+
+
+# -- Krylov mobility solver -------------------------------------------------
+
+def test_preconditioner_cuts_iterations():
+    """The dense blob preconditioner must strictly reduce CG iterations
+    on the exact grid mobility (the reference's reason for nesting
+    DirectMobilitySolver inside KrylovMobilitySolver)."""
+    g = _grid2d(64)
+    X, bodies = _disc(n_markers=60)
+    m = cib.CIBMethod(g, bodies, mu=1.0)
+    rng = np.random.default_rng(3)
+    apply_m = lambda lam: m.mobility_apply(X, lam)
+    # in-range RHS (a marker velocity the kernel-regularized mobility can
+    # actually produce): random forces pushed through M
+    rhs = apply_m(jnp.asarray(rng.standard_normal(X.shape)))
+    plain = mobility.KrylovMobilitySolver(apply_m, precond=None,
+                                          tol=1e-5,
+                                          maxiter=2000).solve(rhs)
+    # hydrodynamic radius ~ marker spacing
+    ds = mobility.DirectMobilitySolver(X, radius=float(g.dx[0]), mu=1.0)
+    pcg = mobility.KrylovMobilitySolver(apply_m, precond=ds,
+                                        tol=1e-5,
+                                        maxiter=2000).solve(rhs)
+    assert bool(plain.converged) and bool(pcg.converged)
+    assert int(pcg.iters) < int(plain.iters), \
+        f"precond {int(pcg.iters)} !< plain {int(plain.iters)}"
+    # both realize the requested marker velocities (lambda itself is
+    # non-unique in the kernel-regularized near-nullspace)
+    rn = float(jnp.linalg.norm(rhs))
+    for sol in (plain, pcg):
+        resid = float(jnp.linalg.norm(apply_m(sol.x) - rhs))
+        assert resid < 2e-5 * max(rn, 1.0), resid
+
+
+# -- Krylov free-body solver ------------------------------------------------
+
+def test_free_body_matches_direct_resistance_path():
+    """KrylovFreeBodyMobilitySolver and the dense resistance-column
+    path (CIBMethod.solve_mobility) are two routes to the same U."""
+    g = _grid2d(64)
+    X, bodies = _disc(n_markers=48)
+    m = cib.CIBMethod(g, bodies, mu=1.0, cg_tol=1e-10)
+    FT = jnp.asarray([[0.3, -1.0, 0.05]])  # force + torque
+    U_direct, _, info = m.solve_mobility(X, FT)
+    assert bool(info.converged)
+
+    solver = m.free_body_solver(X, radius=float(g.dx[0]))
+    res = solver.solve(FT)
+    assert bool(res.converged)
+    assert np.allclose(np.asarray(res.U), np.asarray(U_direct),
+                       rtol=1e-5, atol=1e-8), (res.U, U_direct)
+
+
+def test_free_body_two_bodies_interact():
+    """Two side-by-side discs driven by equal forces in a periodic box:
+    mirror symmetry forces equal settling speeds and opposite spins
+    (each disc rotates in the other's shear field), and settling is
+    HINDERED relative to an isolated disc — the doubled net force
+    doubles the periodic back-flow (classic hindered settling of a
+    periodic suspension; the zero-mean frame the mobility solve uses)."""
+    g = _grid2d(64)
+    n_mk = 32
+    X1 = cib.make_disc((0.35, 0.5), 0.08, n_mk)
+    X2 = cib.make_disc((0.65, 0.5), 0.08, n_mk)
+    X = jnp.concatenate([X1, X2])
+    bodies = cib.RigidBodies(
+        body_id=jnp.concatenate([jnp.zeros(n_mk, dtype=jnp.int32),
+                                 jnp.ones(n_mk, dtype=jnp.int32)]),
+        n_bodies=2)
+    m = cib.CIBMethod(g, bodies, mu=1.0)
+    FT = jnp.asarray([[0.0, -1.0, 0.0], [0.0, -1.0, 0.0]])
+    res = m.free_body_solver(X, radius=float(g.dx[0])).solve(FT)
+    assert bool(res.converged)
+
+    Xs, bs = _disc(n_markers=n_mk, radius=0.08)
+    ms = cib.CIBMethod(g, bs, mu=1.0)
+    res_single = ms.free_body_solver(Xs, radius=float(g.dx[0])).solve(
+        jnp.asarray([[0.0, -1.0, 0.0]]))
+    v1, v2 = float(res.U[0, 1]), float(res.U[1, 1])
+    w1, w2 = float(res.U[0, 2]), float(res.U[1, 2])
+    v_single = float(res_single.U[0, 1])
+    assert np.isclose(v1, v2, rtol=1e-6), (v1, v2)       # mirror symmetry
+    assert np.isclose(w1, -w2, rtol=1e-6), (w1, w2)      # counter-spin
+    assert abs(w1) > 1e-3                                 # real rotation
+    assert v_single < v1 < 0.0, (v1, v_single)            # hindered
+
+
+def test_free_body_step_advances():
+    g = _grid2d(32)
+    X, bodies = _disc(n_markers=24, radius=0.1)
+    m = cib.CIBMethod(g, bodies, mu=1.0)
+    FT = jnp.asarray([[0.0, -1.0, 0.0]])
+    Xn, U, res = m.step_krylov(X, FT, dt=1e-2, radius=float(g.dx[0]))
+    assert bool(res.converged)
+    assert float(jnp.mean(Xn[:, 1] - X[:, 1])) < 0.0  # moved down
+
+
+# -- overlap with ConstraintIB dynamics ------------------------------------
+
+def _terminal_ratio(n):
+    """ConstraintIB long-time sedimentation velocity (back-flow frame)
+    over the quasi-static CIB free-body velocity for the same disc."""
+    from ibamr_tpu.integrators.constraint_ib import (ConstraintIBMethod,
+                                                     advance_constraint_ib,
+                                                     fill_disc)
+    from ibamr_tpu.integrators.ins import INSStaggeredIntegrator
+
+    mu, rho, r_disc, s = 0.5, 1.0, 0.08, 4.0
+    g = StaggeredGrid(n=(n, n), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+
+    # inertial run to the viscous steady state (mu=0.5: box viscous
+    # time L^2/nu = 2 s; t = 1.2 s with the wake scale ~0.1 s)
+    ins = INSStaggeredIntegrator(g, mu=mu, rho=rho)
+    X0 = fill_disc((0.5, 0.6), r_disc, 1.0 / n / 2, dtype=ins.dtype)
+    bodies = cib.RigidBodies(
+        body_id=jnp.zeros(X0.shape[0], dtype=jnp.int32), n_bodies=1)
+    method = ConstraintIBMethod(ins, bodies, density_ratio=[s],
+                                gravity=[0.0, -1.0])
+    st = method.initialize(X0)
+    st = advance_constraint_ib(method, st, 1e-3, 1000)
+    v_a = float(st.U_body[0, 1]) - float(jnp.mean(st.ins.u[1]))
+    st = advance_constraint_ib(method, st, 1e-3, 200)
+    v_b = float(st.U_body[0, 1]) - float(jnp.mean(st.ins.u[1]))
+    assert v_b < 0.0
+    # settled: drift over the last 0.2 s is small
+    assert abs(v_b - v_a) < 0.1 * abs(v_b), (v_a, v_b)
+
+    # quasi-static CIB: rigid boundary ring at the settled centroid,
+    # excess weight F = (s-1) rho A g
+    cent = np.asarray(st.X).mean(axis=0)
+    n_ring = max(12, int(2 * np.pi * r_disc * n))
+    Xr = cib.make_disc(tuple(cent), r_disc, n_ring)
+    ring = cib.RigidBodies(
+        body_id=jnp.zeros(n_ring, dtype=jnp.int32), n_bodies=1)
+    m = cib.CIBMethod(g, ring, mu=mu, cg_tol=1e-8)
+    F_excess = (s - 1.0) * rho * np.pi * r_disc ** 2
+    res = m.free_body_solver(Xr, radius=float(g.dx[0])).solve(
+        jnp.asarray([[0.0, -F_excess, 0.0]]))
+    assert bool(res.converged)
+    v_cib = float(res.U[0, 1])
+    assert v_cib < 0.0
+    return v_b / v_cib
+
+
+def test_cib_terminal_velocity_matches_constraint_ib():
+    """A heavy disc's quasi-static CIB velocity under its excess weight
+    agrees with the long-time ConstraintIB sedimentation velocity
+    (measured in the back-flow frame: body velocity relative to the mean
+    fluid velocity — the zero-mean convention of the periodic Stokes
+    mobility solve), and the residual gap SHRINKS under refinement: the
+    momentum-projection constraint under-resolves drag at coarse dx
+    (calibrated: ratio 1.64 at 32^2 -> 1.22 at 64^2). The two
+    formulations share only the spread/interp kernels — this pins the
+    mobility menu against the independently-tested inertial integrator
+    (VERDICT round 2, item 6)."""
+    r32 = _terminal_ratio(32)
+    r64 = _terminal_ratio(64)
+    assert 0.9 < r64 < 1.45, (r32, r64)
+    assert abs(r64 - 1.0) < 0.75 * abs(r32 - 1.0), (r32, r64)
+
+
+def test_rpy_coincident_markers_finite():
+    """Two DISTINCT markers at the same position (touching body
+    discretizations) must take the near-field limit c0*I, not NaN
+    (round-3 review finding: the far branch divided by r2=0)."""
+    X = jnp.asarray([[0.5, 0.5, 0.5], [0.5, 0.5, 0.5],
+                     [0.7, 0.5, 0.5]])
+    a, mu = 0.05, 1.0
+    M = mobility.rpy_mobility_matrix(X, radius=a, mu=mu)
+    assert np.isfinite(np.asarray(M)).all()
+    c0 = 1.0 / (6.0 * np.pi * mu * a)
+    assert np.allclose(np.asarray(M[0:3, 3:6]), c0 * np.eye(3),
+                       rtol=1e-12)
+    # coincident blobs are indistinguishable -> exactly PSD (one zero
+    # eigenvalue), never negative; the Direct solver's jitter covers it
+    w = np.linalg.eigvalsh(np.asarray(M))
+    assert w.min() > -1e-12 * w.max()
+    ds = mobility.DirectMobilitySolver(X, radius=a, mu=mu, jitter=1e-8)
+    assert np.isfinite(np.asarray(ds.solve(jnp.ones_like(X)))).all()
