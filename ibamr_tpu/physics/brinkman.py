@@ -1,0 +1,219 @@
+"""Brinkman penalization: porous/rigid obstacles as a volume penalty.
+
+Reference parity: the Brinkman penalization half of P22 (SURVEY.md §2.2
+"newer physics" — ``BrinkmanPenalizationRigidBodyDynamics``,
+``BrinkmanAdvDiffBcHelper``): solid bodies are represented by an
+indicator field chi on the FLUID grid and a permeability eta; inside the
+body the momentum equation gains -(chi/eta)(u - u_b), driving the fluid
+velocity to the body velocity u_b without any boundary-conforming mesh
+or Lagrangian markers.
+
+TPU-first redesign: instead of assembling the penalty into a
+variable-coefficient implicit solve (the reference's PETSc path — which
+would forfeit our exact spectral Helmholtz/projection solvers), the
+penalty is a pointwise DIAGONAL implicit split step:
+
+    u  <-  (u + (dt chi/eta) u_b) / (1 + dt chi/eta)
+
+followed by one extra exact projection to restore div u = 0. The update
+is unconditionally stable for ANY eta (the stiff limit eta -> 0 just
+clamps u -> u_b), costs one fused elementwise pass plus one FFT round
+trip, and keeps every solver seam stock. Free bodies advance by
+Newton--Euler with the hydrodynamic force measured as the momentum the
+penalty removes from the fluid — discretely exact, no surface
+quadrature.
+
+Bodies are analytic signed-distance functions evaluated fresh each step
+at the body's current center/orientation (functional state, jit-native;
+no stored masks to regrid).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ibamr_tpu.grid import StaggeredGrid
+from ibamr_tpu.physics.level_set import heaviside
+
+Vel = Tuple[jnp.ndarray, ...]
+
+
+def face_coords(grid: StaggeredGrid, d: int) -> Tuple[jnp.ndarray, ...]:
+    """Broadcastable coordinates of component-d face centers — thin
+    wrapper over ``StaggeredGrid.face_centers`` so the staggering
+    convention lives in exactly one place (grid.py)."""
+    return grid.face_centers(d)
+
+
+class RigidBodyState(NamedTuple):
+    """Dynamic state of one penalized rigid body."""
+    center: jnp.ndarray   # (dim,)
+    U: jnp.ndarray        # (dim,) translational velocity
+    theta: jnp.ndarray    # scalar orientation (2D) — 0.0 if unused
+    omega: jnp.ndarray    # scalar angular velocity (2D) — 0.0 if unused
+
+
+class BrinkmanBody:
+    """One penalized body: an analytic SDF (negative inside) evaluated
+    in BODY frame, plus permeability and (for free bodies) inertia.
+
+    ``sdf(x_body) -> phi`` gets coordinates already translated (and, in
+    2D, rotated) into the body frame, so one lambda describes the shape
+    for every position/orientation.
+    """
+
+    def __init__(self, sdf: Callable[[Sequence[jnp.ndarray]], jnp.ndarray],
+                 eta: float = 1e-3, smear_cells: float = 1.0,
+                 density: Optional[float] = None,
+                 volume: Optional[float] = None,
+                 moment: Optional[float] = None):
+        self.sdf = sdf
+        self.eta = float(eta)
+        self.smear_cells = float(smear_cells)
+        self.density = density      # None -> prescribed-motion body
+        self.volume = volume        # needed for free-body gravity
+        self.moment = moment
+
+    def chi(self, grid: StaggeredGrid, d: int,
+            st: RigidBodyState) -> jnp.ndarray:
+        """Indicator (smoothed Heaviside of -sdf) on the d-faces."""
+        xs = face_coords(grid, d)
+        xb = [x - st.center[a] for a, x in enumerate(xs)]
+        if grid.dim == 2:
+            c, s = jnp.cos(-st.theta), jnp.sin(-st.theta)
+            xb = [c * xb[0] - s * xb[1], s * xb[0] + c * xb[1]]
+        phi = self.sdf(xb)
+        eps = self.smear_cells * max(grid.dx)
+        return 1.0 - heaviside(phi, eps)   # 1 inside the body
+
+    def body_velocity(self, grid: StaggeredGrid, d: int,
+                      st: RigidBodyState) -> jnp.ndarray:
+        """Rigid velocity of the body material at the d-faces."""
+        xs = face_coords(grid, d)
+        v = jnp.full_like(xs[0], st.U[d])
+        if grid.dim == 2:
+            r = (xs[0] - st.center[0], xs[1] - st.center[1])
+            v = v + (-st.omega * r[1] if d == 0 else st.omega * r[0])
+        return v
+
+
+def penalize(u: Vel, grid: StaggeredGrid, dt: float,
+             bodies: Sequence[BrinkmanBody],
+             states: Sequence[RigidBodyState]) -> Tuple[Vel, list]:
+    """Diagonal implicit penalty update; returns the new velocity and,
+    per body, the momentum/angular impulse the fluid LOST to it (the
+    hydrodynamic force/torque on the body is +impulse/dt)."""
+    dim = grid.dim
+    unew = list(u)
+    impulses = []
+    vol = math.prod(grid.dx)
+    for body, st in zip(bodies, states):
+        dP = []
+        torque_impulse = jnp.zeros((), dtype=u[0].dtype)
+        for d in range(dim):
+            chi = body.chi(grid, d, st)
+            ub = body.body_velocity(grid, d, st)
+            a = dt * chi / body.eta
+            before = unew[d]
+            after = (before + a * ub) / (1.0 + a)
+            unew[d] = after
+            dP.append(jnp.sum(before - after) * vol)
+            if dim == 2:
+                xs = face_coords(grid, d)
+                r = (xs[0] - st.center[0], xs[1] - st.center[1])
+                arm = -r[1] if d == 0 else r[0]
+                # angular momentum the fluid LOST, same convention as
+                # dP (round-3 review: a double negation here inverted
+                # the torque and anti-damped free rotation)
+                torque_impulse = torque_impulse + jnp.sum(
+                    arm * (before - after)) * vol
+        impulses.append((jnp.stack(dP), torque_impulse))
+    return tuple(unew), impulses
+
+
+class BrinkmanPenalization:
+    """Penalization operator bound to one INS integrator: wraps its step
+    with penalty + re-projection, and advances FREE bodies by
+    Newton--Euler using the measured penalty impulse (the analog of the
+    reference's ``BrinkmanPenalizationRigidBodyDynamics``).
+
+    Prescribed bodies (``density=None``) keep whatever ``U``/``omega``
+    their state carries; free bodies integrate
+
+        m dV/dt = F_hydro + (m - m_displaced) g,
+        I domega/dt = T_hydro.
+    """
+
+    def __init__(self, ins, bodies: Sequence[BrinkmanBody],
+                 gravity: Optional[Sequence[float]] = None):
+        self.ins = ins
+        self.bodies = list(bodies)
+        self.gravity = (None if gravity is None
+                        else jnp.asarray(gravity, dtype=ins.dtype))
+
+    def step(self, ins_state, body_states: Sequence[RigidBodyState],
+             dt: float, f: Optional[Vel] = None):
+        """One coupled step: INS advance -> implicit penalty ->
+        re-projection -> Newton--Euler body update."""
+        g = self.ins.grid
+        st1 = self.ins.step(ins_state, dt, f=f)
+        u_pen, impulses = penalize(st1.u, g, dt, self.bodies, body_states)
+        # restore incompressibility (chi varies in space, so the
+        # pointwise clamp injects divergence near the body surface)
+        u_div0, _ = self.ins.project(u_pen, g.dx)
+        st1 = st1._replace(u=u_div0)
+
+        new_states = []
+        rho_f = float(self.ins.rho)
+        for body, bst, (dP, dL) in zip(self.bodies, body_states,
+                                       impulses):
+            if body.density is None:
+                new_states.append(bst._replace(
+                    center=bst.center + dt * bst.U,
+                    theta=bst.theta + dt * bst.omega))
+                continue
+            m_body = body.density * body.volume
+            m_disp = rho_f * body.volume
+            F = rho_f * dP / dt
+            U_new = bst.U + dt / m_body * F
+            if self.gravity is not None:
+                U_new = U_new + dt * (m_body - m_disp) / m_body \
+                    * self.gravity
+            if body.moment is not None:
+                # angular impulse dL is already time-integrated:
+                # delta_omega = rho_f dL / I
+                om_new = bst.omega + rho_f * dL / body.moment
+            else:
+                om_new = bst.omega
+            new_states.append(RigidBodyState(
+                center=bst.center + dt * U_new, U=U_new,
+                theta=bst.theta + dt * om_new, omega=om_new))
+        return st1, new_states, impulses
+
+
+def make_cylinder_sdf(radius: float):
+    """SDF of a circle/cylinder of given radius about the body origin
+    (2D: disc; 3D: sphere)."""
+    def sdf(xb):
+        r2 = sum(x * x for x in xb)
+        return jnp.sqrt(r2) - radius
+    return sdf
+
+
+def make_box_sdf(half_widths: Sequence[float]):
+    """SDF of an axis-aligned box with the given half-widths."""
+    hw = tuple(float(h) for h in half_widths)
+
+    def sdf(xb):
+        q = [jnp.abs(x) - h for x, h in zip(xb, hw)]
+        outside = jnp.sqrt(sum(jnp.maximum(c, 0.0) ** 2 for c in q))
+        m = q[0]
+        for c in q[1:]:
+            m = jnp.maximum(m, c)          # broadcasting max (coords
+        inside = jnp.minimum(0.0, m)       # may be (n,1)/(1,n) shaped)
+        return outside + inside
+    return sdf

@@ -134,6 +134,8 @@ SLOW_TESTS = {
     "test_fac_multilevel_preconditioner",
     "test_cib_terminal_velocity_matches_constraint_ib",
     "test_preconditioner_cuts_iterations",
+    "test_wave_generated_then_damped",
+    "test_porous_obstacle_drag_balances_driving_force",
 }
 
 
