@@ -42,11 +42,12 @@ from ibamr_tpu.physics.level_set import heaviside
 Vel = Tuple[jnp.ndarray, ...]
 
 
-def face_coords(grid: StaggeredGrid, d: int) -> Tuple[jnp.ndarray, ...]:
+def face_coords(grid: StaggeredGrid, d: int,
+                dtype=jnp.float32) -> Tuple[jnp.ndarray, ...]:
     """Broadcastable coordinates of component-d face centers — thin
     wrapper over ``StaggeredGrid.face_centers`` so the staggering
     convention lives in exactly one place (grid.py)."""
-    return grid.face_centers(d)
+    return grid.face_centers(d, dtype)
 
 
 class RigidBodyState(NamedTuple):
@@ -81,7 +82,7 @@ class BrinkmanBody:
     def chi(self, grid: StaggeredGrid, d: int,
             st: RigidBodyState) -> jnp.ndarray:
         """Indicator (smoothed Heaviside of -sdf) on the d-faces."""
-        xs = face_coords(grid, d)
+        xs = face_coords(grid, d, st.center.dtype)
         xb = [x - st.center[a] for a, x in enumerate(xs)]
         if grid.dim == 2:
             c, s = jnp.cos(-st.theta), jnp.sin(-st.theta)
@@ -93,7 +94,7 @@ class BrinkmanBody:
     def body_velocity(self, grid: StaggeredGrid, d: int,
                       st: RigidBodyState) -> jnp.ndarray:
         """Rigid velocity of the body material at the d-faces."""
-        xs = face_coords(grid, d)
+        xs = face_coords(grid, d, st.center.dtype)
         v = jnp.full_like(xs[0], st.U[d])
         if grid.dim == 2:
             r = (xs[0] - st.center[0], xs[1] - st.center[1])
@@ -123,7 +124,7 @@ def penalize(u: Vel, grid: StaggeredGrid, dt: float,
             unew[d] = after
             dP.append(jnp.sum(before - after) * vol)
             if dim == 2:
-                xs = face_coords(grid, d)
+                xs = face_coords(grid, d, st.center.dtype)
                 r = (xs[0] - st.center[0], xs[1] - st.center[1])
                 arm = -r[1] if d == 0 else r[0]
                 # angular momentum the fluid LOST, same convention as

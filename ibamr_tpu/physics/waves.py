@@ -210,17 +210,15 @@ def make_zone(grid: StaggeredGrid, x_start: float, x_end: float,
             sigma = 1.0 - sigma
         return strength * _ramp(sigma) * ((x >= x_start) & (x <= x_end))
 
-    # cell centers
-    xc = grid.x_lo[0] + (jnp.arange(grid.n[0], dtype=dtype) + 0.5) \
-        * grid.dx[0]
+    # staggering convention delegated to grid.py's 1-D helpers
     shape = (grid.n[0],) + (1,) * (grid.dim - 1)
+    xc = grid.cell_coords_1d(0, dtype)
     w_cc = weight_at(xc).reshape(shape).astype(dtype) \
         * jnp.ones(grid.n, dtype=dtype)
     w_face = []
     for d in range(grid.dim):
-        off = 0.0 if d == 0 else 0.5
-        xf = grid.x_lo[0] + (jnp.arange(grid.n[0], dtype=dtype) + off) \
-            * grid.dx[0]
+        xf = (grid.face_coords_1d(0, dtype) if d == 0
+              else grid.cell_coords_1d(0, dtype))
         w_face.append(weight_at(xf).reshape(shape).astype(dtype)
                       * jnp.ones(grid.n, dtype=dtype))
     return RelaxationZone(w_cc=w_cc, w_face=tuple(w_face), kind=kind)
@@ -389,7 +387,12 @@ class WaveTank:
             u = tuple(ud / (1.0 + dt * chi / self.eta_solid)
                       for ud, chi in zip(u, self._solid))
             rho_cc = self.integ.density(phi) if rho is None else rho
-            u, _ = self.integ.project_vc(u, rho_cc, dt)
+            # match the integrator's own projection convention: the
+            # conservative form projects with ARITHMETIC face densities
+            # (its momentum telescoping identity needs it), the plain
+            # form with harmonic (ins_vc.project_vc docstring)
+            rule = "arithmetic" if rho is not None else "harmonic"
+            u, _ = self.integ.project_vc(u, rho_cc, dt, face_rule=rule)
         st = st._replace(phi=phi, u=u)
         if rho is not None:
             st = st._replace(rho=rho)
