@@ -26,6 +26,8 @@ from __future__ import annotations
 
 from typing import Callable, NamedTuple
 
+import math
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -45,18 +47,112 @@ _TET4_QP = np.array([[_TET4_B, _TET4_B, _TET4_B],
 _TET4_QW = np.array([1 / 24] * 4)                   # ref-tet volume 1/6
 
 
+def _tri6_shapes(qp):
+    """Quadratic triangle (libMesh TRI6 edge order 3:(0,1) 4:(1,2)
+    5:(2,0)); barycentric L = (1-xi-eta, xi, eta)."""
+    xi, eta = qp[:, 0], qp[:, 1]
+    L = np.stack([1.0 - xi - eta, xi, eta], axis=1)          # (nq, 3)
+    N = np.concatenate([L * (2.0 * L - 1.0),
+                        np.stack([4 * L[:, 0] * L[:, 1],
+                                  4 * L[:, 1] * L[:, 2],
+                                  4 * L[:, 2] * L[:, 0]], axis=1)],
+                       axis=1)                               # (nq, 6)
+    dL = np.array([[-1.0, -1.0], [1.0, 0.0], [0.0, 1.0]])   # (3, 2)
+    dN = np.zeros((qp.shape[0], 6, 2))
+    for a in range(3):
+        dN[:, a, :] = (4.0 * L[:, a, None] - 1.0) * dL[a]
+    edges = [(0, 1), (1, 2), (2, 0)]
+    for m, (i, j) in enumerate(edges):
+        dN[:, 3 + m, :] = 4.0 * (L[:, i, None] * dL[j]
+                                 + L[:, j, None] * dL[i])
+    return N, dN
+
+
+def _tet10_shapes(qp):
+    """Quadratic tetrahedron (libMesh TET10 edge order 4:(0,1) 5:(1,2)
+    6:(0,2) 7:(0,3) 8:(1,3) 9:(2,3))."""
+    xi, eta, ze = qp[:, 0], qp[:, 1], qp[:, 2]
+    L = np.stack([1.0 - xi - eta - ze, xi, eta, ze], axis=1)
+    edges = [(0, 1), (1, 2), (0, 2), (0, 3), (1, 3), (2, 3)]
+    N = np.concatenate(
+        [L * (2.0 * L - 1.0),
+         np.stack([4 * L[:, i] * L[:, j] for i, j in edges], axis=1)],
+        axis=1)                                              # (nq, 10)
+    dL = np.array([[-1.0, -1.0, -1.0], [1.0, 0.0, 0.0],
+                   [0.0, 1.0, 0.0], [0.0, 0.0, 1.0]])
+    dN = np.zeros((qp.shape[0], 10, 3))
+    for a in range(4):
+        dN[:, a, :] = (4.0 * L[:, a, None] - 1.0) * dL[a]
+    for m, (i, j) in enumerate(edges):
+        dN[:, 4 + m, :] = 4.0 * (L[:, i, None] * dL[j]
+                                 + L[:, j, None] * dL[i])
+    return N, dN
+
+
+def _tensor_shapes(qp, dim):
+    """Bi/tri-linear tensor element (QUAD4 / HEX8), nodes in the
+    standard counterclockwise / bottom-then-top order on [-1, 1]^dim."""
+    if dim == 2:
+        corners = np.array([[-1, -1], [1, -1], [1, 1], [-1, 1]])
+    else:
+        corners = np.array([[-1, -1, -1], [1, -1, -1], [1, 1, -1],
+                            [-1, 1, -1], [-1, -1, 1], [1, -1, 1],
+                            [1, 1, 1], [-1, 1, 1]])
+    nq, nen = qp.shape[0], corners.shape[0]
+    N = np.ones((nq, nen))
+    dN = np.zeros((nq, nen, dim))
+    for a in range(nen):
+        facs = [(1.0 + corners[a, d] * qp[:, d]) / 2.0
+                for d in range(dim)]
+        for d in range(dim):
+            N[:, a] *= facs[d]
+            dfac = corners[a, d] / 2.0 * np.ones(nq)
+            dN[:, a, d] = dfac
+            for d2 in range(dim):
+                if d2 != d:
+                    dN[:, a, d] *= facs[d2]
+    return N, dN
+
+
+def _gauss_1d():
+    g = 1.0 / math.sqrt(3.0)
+    return np.array([-g, g]), np.array([1.0, 1.0])
+
+
 def _shape_table(elem_type: str):
-    """(N(q,a), dN/dxi(a,d), qp weights) for the reference element."""
+    """(N (nq, nen), dN/dxi (nq, nen, dim), qp weights (nq,)) for the
+    reference element. Per-quadrature-point gradients support the full
+    family menu (linear + quadratic simplices, bi/tri-linear tensor
+    elements) — the FEDataManager generality of T16/P17."""
     if elem_type == "TRI3":
         qp, qw = _TRI3_QP, _TRI3_QW
-        N = np.stack([1.0 - qp[:, 0] - qp[:, 1], qp[:, 0], qp[:, 1]], axis=1)
-        dN = np.array([[-1.0, -1.0], [1.0, 0.0], [0.0, 1.0]])
+        N = np.stack([1.0 - qp[:, 0] - qp[:, 1], qp[:, 0], qp[:, 1]],
+                     axis=1)
+        dN1 = np.array([[-1.0, -1.0], [1.0, 0.0], [0.0, 1.0]])
+        dN = np.broadcast_to(dN1, (qp.shape[0],) + dN1.shape).copy()
     elif elem_type == "TET4":
         qp, qw = _TET4_QP, _TET4_QW
-        N = np.stack([1.0 - qp.sum(axis=1), qp[:, 0], qp[:, 1], qp[:, 2]],
-                     axis=1)
-        dN = np.array([[-1.0, -1.0, -1.0], [1.0, 0.0, 0.0],
-                       [0.0, 1.0, 0.0], [0.0, 0.0, 1.0]])
+        N = np.stack([1.0 - qp.sum(axis=1), qp[:, 0], qp[:, 1],
+                      qp[:, 2]], axis=1)
+        dN1 = np.array([[-1.0, -1.0, -1.0], [1.0, 0.0, 0.0],
+                        [0.0, 1.0, 0.0], [0.0, 0.0, 1.0]])
+        dN = np.broadcast_to(dN1, (qp.shape[0],) + dN1.shape).copy()
+    elif elem_type == "TRI6":
+        qp, qw = _TRI3_QP, _TRI3_QW        # degree-2 exact
+        N, dN = _tri6_shapes(qp)
+    elif elem_type == "TET10":
+        qp, qw = _TET4_QP, _TET4_QW        # degree-2 exact
+        N, dN = _tet10_shapes(qp)
+    elif elem_type in ("QUAD4", "HEX8"):
+        dim = 2 if elem_type == "QUAD4" else 3
+        g, w = _gauss_1d()
+        grids = np.meshgrid(*([g] * dim), indexing="ij")
+        qp = np.stack([c.reshape(-1) for c in grids], axis=1)
+        wgrids = np.meshgrid(*([w] * dim), indexing="ij")
+        qw = np.ones(qp.shape[0])
+        for c in wgrids:
+            qw = qw * c.reshape(-1)
+        N, dN = _tensor_shapes(qp, dim)
     else:
         raise ValueError(f"unknown element type {elem_type!r}")
     return N, dN, qw
@@ -66,7 +162,7 @@ class FEAssembly(NamedTuple):
     """Device-resident reference-configuration tables for one mesh."""
     elems: jnp.ndarray     # (E, nen) int32 connectivity
     shape: jnp.ndarray     # (nq, nen) shape values at quad points
-    dNdX: jnp.ndarray      # (E, nen, dim) reference shape gradients
+    dNdX: jnp.ndarray      # (E, nq, nen, dim) reference shape gradients
     wdV: jnp.ndarray       # (E, nq) quadrature weight * |detJ|
     lumped_mass: jnp.ndarray  # (n_nodes,) sum_q wdV * N_a  (unit density)
     n_nodes: int
@@ -76,16 +172,22 @@ class FEAssembly(NamedTuple):
 def build_assembly(mesh: FEMesh, dtype=jnp.float32) -> FEAssembly:
     N, dN, qw = _shape_table(mesh.elem_type)
     Xe = mesh.nodes[mesh.elems]                      # (E, nen, dim)
-    # J_ij = dX_i/dxi_j  (constant per linear simplex)
-    J = np.einsum("ad,eai->eid", dN, Xe)             # (E, dim, dim)
-    detJ = np.linalg.det(J)
+    # per-quadrature-point Jacobian J_ij = dX_i/dxi_j (varies within
+    # quadratic/tensor elements)
+    J = np.einsum("qad,eai->eqid", dN, Xe)           # (E, nq, dim, dim)
+    detJ = np.linalg.det(J)                          # (E, nq)
     Jinv = np.linalg.inv(J)
-    dNdX = np.einsum("ad,edi->eai", dN, Jinv)        # (E, nen, dim)
-    wdV = np.abs(detJ)[:, None] * qw[None, :]        # (E, nq)
+    dNdX = np.einsum("qad,eqdi->eqai", dN, Jinv)     # (E, nq, nen, dim)
+    wdV = np.abs(detJ) * qw[None, :]                 # (E, nq)
 
     n_nodes = mesh.n_nodes
     mass = np.zeros(n_nodes)
-    contrib = np.einsum("eq,qa->ea", wdV, N)         # (E, nen)
+    # HRZ diagonal scaling: m_a ~ integral N_a^2, normalized per element
+    # to the element mass — positive for EVERY family (plain row-sum
+    # lumping goes negative at quadratic-simplex vertices)
+    n2 = np.einsum("eq,qa->ea", wdV, N * N)          # (E, nen)
+    emass = wdV.sum(axis=1)                          # (E,)
+    contrib = n2 * (emass / np.maximum(n2.sum(axis=1), 1e-300))[:, None]
     np.add.at(mass, mesh.elems, contrib)
 
     return FEAssembly(
@@ -100,9 +202,9 @@ def build_assembly(mesh: FEMesh, dtype=jnp.float32) -> FEAssembly:
 # -- kinematics --------------------------------------------------------------
 
 def deformation_gradients(asm: FEAssembly, x: jnp.ndarray) -> jnp.ndarray:
-    """FF_e = dx/dX per element (constant for linear simplices) -> (E, dim, dim)."""
+    """FF = dx/dX at every quadrature point -> (E, nq, dim, dim)."""
     xe = x[asm.elems]                                # (E, nen, dim)
-    return jnp.einsum("eai,eaj->eij", xe, asm.dNdX)
+    return jnp.einsum("eai,eqaj->eqij", xe, asm.dNdX)
 
 
 # -- strain-energy densities (W: FF -> scalar) -------------------------------
@@ -147,10 +249,9 @@ def pk1(W: Callable) -> Callable:
 # -- force assembly ----------------------------------------------------------
 
 def elastic_energy(asm: FEAssembly, W: Callable, x: jnp.ndarray):
-    """E(x) = sum_e sum_q wdV * W(FF_e). Linear simplices: FF constant per
-    element, so per-element energy is W(FF_e) * sum_q wdV."""
-    FF = deformation_gradients(asm, x)
-    return jnp.sum(W(FF) * jnp.sum(asm.wdV, axis=1))
+    """E(x) = sum_e sum_q wdV_eq * W(FF_eq)."""
+    FF = deformation_gradients(asm, x)               # (E, nq, d, d)
+    return jnp.sum(W(FF) * asm.wdV)
 
 
 def nodal_forces(asm: FEAssembly, W: Callable, x: jnp.ndarray) -> jnp.ndarray:
@@ -163,9 +264,9 @@ def nodal_forces_pk1(asm: FEAssembly, W: Callable,
     """Explicit PK1 assembly F_a = -sum_e sum_q wdV P(FF) dN_a/dX — the
     reference's element-loop form; must equal :func:`nodal_forces`."""
     FF = deformation_gradients(asm, x)
-    P = pk1(W)(FF)                                   # (E, dim, dim)
-    vol = jnp.sum(asm.wdV, axis=1)                   # (E,)
-    Fe = -jnp.einsum("e,eij,eaj->eai", vol, P, asm.dNdX)  # (E, nen, dim)
+    P = pk1(W)(FF)                                   # (E, nq, dim, dim)
+    Fe = -jnp.einsum("eq,eqij,eqaj->eai", asm.wdV, P,
+                     asm.dNdX)                       # (E, nen, dim)
     out = jnp.zeros((asm.n_nodes, asm.dim), dtype=x.dtype)
     return out.at[asm.elems.reshape(-1)].add(
         Fe.reshape(-1, asm.dim))
@@ -186,18 +287,54 @@ def project_to_quads(asm: FEAssembly, nodal: jnp.ndarray) -> jnp.ndarray:
     return nq.reshape((-1,) + nodal.shape[1:])
 
 
-def l2_project_from_quads(asm: FEAssembly, vals: jnp.ndarray) -> jnp.ndarray:
-    """Lumped-mass L2 projection of quad-point values to nodes:
-    N_a-weighted quadrature sum divided by the lumped mass — the rebuild's
-    ``FEDataManager::buildL2ProjectionSolver`` (T16) with mass lumping."""
-    E, nq = asm.wdV.shape
+def _node_qp_weights(elems, shape, w, n_nodes):
+    """Positive node<->quad-point transfer weights omega_eqa = w_eq *
+    N_a(q)^2 and their per-node totals. N^2 keeps every weight
+    POSITIVE for every element family (plain N goes negative at
+    quadratic-simplex vertices, where sum_q w N_a is exactly zero —
+    round-3 review finding: the old N-weighted projection returned 0 at
+    TRI6/TET10 vertices)."""
+    ww = w[:, :, None] * (shape ** 2)[None, :, :]    # (E, nq, nen)
+    den = jnp.zeros(n_nodes, dtype=w.dtype)
+    den = den.at[elems.reshape(-1)].add(
+        jnp.sum(ww, axis=1).reshape(-1))
+    den = jnp.where(den > 0, den, jnp.ones_like(den))
+    return ww, den
+
+
+def nodal_average_from_quads(elems, shape, w, n_nodes,
+                             vals: jnp.ndarray) -> jnp.ndarray:
+    """Node-normalized weighted average of quad-point values: exact for
+    constants on EVERY family (numerator and denominator carry the same
+    weights). The rebuild's FEDataManager L2-projection role (T16),
+    shared by the volumetric and surface paths."""
+    E, nq = w.shape
     v = vals.reshape((E, nq) + vals.shape[1:])
-    contrib = jnp.einsum("eq,qa,eq...->ea...", asm.wdV, asm.shape, v)
-    out = jnp.zeros((asm.n_nodes,) + vals.shape[1:], dtype=vals.dtype)
-    out = out.at[asm.elems.reshape(-1)].add(
+    ww, den = _node_qp_weights(elems, shape, w, n_nodes)
+    contrib = jnp.einsum("eqa,eq...->ea...", ww, v)
+    out = jnp.zeros((n_nodes,) + vals.shape[1:], dtype=vals.dtype)
+    out = out.at[elems.reshape(-1)].add(
         contrib.reshape((-1,) + vals.shape[1:]))
-    shape = (asm.n_nodes,) + (1,) * (vals.ndim - 1)
-    return out / safe_lumped_mass(asm).reshape(shape)
+    shp = (n_nodes,) + (1,) * (vals.ndim - 1)
+    return out / den.reshape(shp)
+
+
+def distribute_to_quads(elems, shape, w, n_nodes,
+                        F: jnp.ndarray) -> jnp.ndarray:
+    """Adjoint transfer: split each NODAL value over its quadrature
+    points with per-node-normalized shares, so sum_q out_q == sum_a F_a
+    EXACTLY (the force-conservation contract of the unified coupling),
+    for every element family."""
+    ww, den = _node_qp_weights(elems, shape, w, n_nodes)
+    Fa = (F / den.reshape((n_nodes,) + (1,) * (F.ndim - 1)))[elems]
+    out = jnp.einsum("eqa,ea...->eq...", ww, Fa)
+    return out.reshape((-1,) + F.shape[1:])
+
+
+def l2_project_from_quads(asm: FEAssembly, vals: jnp.ndarray) -> jnp.ndarray:
+    """Quad-point values -> nodes (see nodal_average_from_quads)."""
+    return nodal_average_from_quads(asm.elems, asm.shape, asm.wdV,
+                                    asm.n_nodes, vals)
 
 
 def safe_lumped_mass(asm: FEAssembly) -> jnp.ndarray:

@@ -27,7 +27,7 @@ class FEMesh:
 
     nodes: (n_nodes, dim) float reference coordinates
     elems: (n_elems, nen) int connectivity (nen = dim + 1)
-    elem_type: "TRI3" | "TET4"
+    elem_type: "TRI3" | "TET4" | "TRI6" | "TET10" | "QUAD4" | "HEX8"
     """
     nodes: np.ndarray
     elems: np.ndarray
@@ -46,12 +46,14 @@ class FEMesh:
         return self.elems.shape[0]
 
     def volume(self) -> float:
-        """Total reference measure (area in 2D, volume in 3D)."""
-        X = self.nodes[self.elems]          # (E, nen, dim)
-        edges = X[:, 1:, :] - X[:, :1, :]   # (E, dim, dim)
-        det = np.linalg.det(edges)
-        fact = 2.0 if self.elem_type == "TRI3" else 6.0
-        return float(np.sum(np.abs(det)) / fact)
+        """Total reference measure (area in 2D, volume in 3D), by the
+        element family's own quadrature — exact for every type in the
+        menu."""
+        from ibamr_tpu.fe.fem import _shape_table
+        _, dN, qw = _shape_table(self.elem_type)
+        X = self.nodes[self.elems]                    # (E, nen, dim)
+        J = np.einsum("qad,eai->eqid", dN, X)
+        return float(np.sum(np.abs(np.linalg.det(J)) * qw[None, :]))
 
 
 def disc_mesh(radius: float = 0.25,
@@ -175,3 +177,70 @@ def read_triangle(node_path: str, ele_path: str) -> FEMesh:
          for r in range(n_elems)], dtype=np.int32)
     etype = "TRI3" if nen == 3 else "TET4"
     return FEMesh(nodes=nodes, elems=elems, elem_type=etype)
+
+
+def to_quadratic(mesh: FEMesh) -> FEMesh:
+    """Convert a linear simplex mesh to its quadratic family member
+    (TRI3 -> TRI6, TET4 -> TET10) by inserting midside nodes — the
+    higher-order path of the reference's general element support
+    (T16/P17). Shared edges share one midside node."""
+    if mesh.elem_type == "TRI3":
+        edges = [(0, 1), (1, 2), (2, 0)]
+        new_type = "TRI6"
+    elif mesh.elem_type == "TET4":
+        edges = [(0, 1), (1, 2), (0, 2), (0, 3), (1, 3), (2, 3)]
+        new_type = "TET10"
+    else:
+        raise ValueError(f"to_quadratic: {mesh.elem_type} is not a "
+                         "linear simplex type")
+    edge_id = {}
+    E = mesh.n_elems
+    mids = np.zeros((E, len(edges)), dtype=mesh.elems.dtype)
+    next_id = mesh.n_nodes
+    new_pts = []
+    for e in range(E):
+        conn = mesh.elems[e]
+        for m, (i, j) in enumerate(edges):
+            key = (min(conn[i], conn[j]), max(conn[i], conn[j]))
+            if key not in edge_id:
+                edge_id[key] = next_id
+                new_pts.append(0.5 * (mesh.nodes[conn[i]]
+                                      + mesh.nodes[conn[j]]))
+                next_id += 1
+            mids[e, m] = edge_id[key]
+    all_nodes = np.concatenate([mesh.nodes, np.asarray(new_pts)], axis=0)
+    elems = np.concatenate([mesh.elems, mids], axis=1)
+    return FEMesh(nodes=all_nodes, elems=elems, elem_type=new_type)
+
+
+def rect_quad_mesh(nx: int, ny: int,
+                   x_lo=(0.0, 0.0), x_up=(1.0, 1.0)) -> FEMesh:
+    """Structured QUAD4 mesh of a rectangle."""
+    xs = np.linspace(x_lo[0], x_up[0], nx + 1)
+    ys = np.linspace(x_lo[1], x_up[1], ny + 1)
+    X, Y = np.meshgrid(xs, ys, indexing="ij")
+    nodes = np.stack([X.reshape(-1), Y.reshape(-1)], axis=1)
+    nid = np.arange((nx + 1) * (ny + 1)).reshape(nx + 1, ny + 1)
+    elems = np.stack([nid[:-1, :-1], nid[1:, :-1],
+                      nid[1:, 1:], nid[:-1, 1:]],
+                     axis=-1).reshape(-1, 4)
+    return FEMesh(nodes=nodes, elems=elems.astype(np.int64),
+                  elem_type="QUAD4")
+
+
+def box_hex_mesh(nx: int, ny: int, nz: int,
+                 x_lo=(0.0, 0.0, 0.0), x_up=(1.0, 1.0, 1.0)) -> FEMesh:
+    """Structured HEX8 mesh of a box."""
+    axes = [np.linspace(x_lo[d], x_up[d], n + 1)
+            for d, n in enumerate((nx, ny, nz))]
+    X, Y, Z = np.meshgrid(*axes, indexing="ij")
+    nodes = np.stack([X.reshape(-1), Y.reshape(-1), Z.reshape(-1)],
+                     axis=1)
+    nid = np.arange(nodes.shape[0]).reshape(nx + 1, ny + 1, nz + 1)
+    c = nid[:-1, :-1, :-1]
+    elems = np.stack([
+        c, nid[1:, :-1, :-1], nid[1:, 1:, :-1], nid[:-1, 1:, :-1],
+        nid[:-1, :-1, 1:], nid[1:, :-1, 1:], nid[1:, 1:, 1:],
+        nid[:-1, 1:, 1:]], axis=-1).reshape(-1, 8)
+    return FEMesh(nodes=nodes, elems=elems.astype(np.int64),
+                  elem_type="HEX8")

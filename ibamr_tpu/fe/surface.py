@@ -1,0 +1,208 @@
+"""Codim-1 FE structures: membranes/shells (the IBFESurfaceMethod half
+of P17, SURVEY.md §2.2).
+
+Reference parity: the reference's ``IBFESurfaceMethod`` couples a
+surface (codimension-1) finite-element mesh to the fluid: EDGE2 curves
+in 2D, TRI3 facets in 3D, with in-plane membrane elasticity evaluated
+from the surface deformation gradient and forces spread from surface
+quadrature points with AREA weights.
+
+TPU-first redesign mirrors ``fe/fem.py``: all reference tables (shape
+values, parametric gradients, reference metric and area measure) are
+host-precomputed; the total membrane energy
+
+    E(x) = sum_e sum_q wdA_eq * W_s(M_eq),   M = G_ref^{-1} C(x),
+    C_ij = t_i . t_j,  t_i = sum_a dN_a/dxi_i x_a   (current tangents)
+
+is a pure jitted function of nodal positions and the nodal force is
+``-jax.grad(E)`` — the weak form falls out of the chain rule, for any
+invariant-based membrane energy. ``M`` (the mixed Cauchy--Green strain)
+is frame-indifferent by construction: rigid motions give C == G_ref,
+M == I, zero force.
+
+``neo_hookean_membrane``: W_s = mu/2 (tr M - rdim - ln det M)
++ kappa/2 (sqrt(det M) - 1)^2 — shear stiffness mu, area-dilatation
+stiffness kappa (kappa with mu=0 is a surface-tension-like area
+penalty).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Vel = Tuple[jnp.ndarray, ...]
+
+
+class SurfaceMesh(NamedTuple):
+    """Codim-1 mesh: EDGE2 (2D ambient) or TRI3 facets (3D ambient)."""
+    nodes: np.ndarray      # (n_nodes, dim)
+    elems: np.ndarray      # (E, nen): nen=2 (EDGE2) or 3 (TRI3)
+    elem_type: str         # "EDGE2" | "TRI3S"
+
+    @property
+    def dim(self) -> int:
+        return self.nodes.shape[1]
+
+    @property
+    def n_nodes(self) -> int:
+        return self.nodes.shape[0]
+
+
+def _surf_shape_table(elem_type: str):
+    if elem_type == "EDGE2":
+        g = 1.0 / math.sqrt(3.0)
+        qp = np.array([[(1.0 - g) / 2.0], [(1.0 + g) / 2.0]])
+        qw = np.array([0.5, 0.5])
+        N = np.stack([1.0 - qp[:, 0], qp[:, 0]], axis=1)
+        dN = np.broadcast_to(np.array([[-1.0], [1.0]]),
+                             (2, 2, 1)).copy()
+    elif elem_type == "TRI3S":
+        qp = np.array([[1 / 6, 1 / 6], [2 / 3, 1 / 6], [1 / 6, 2 / 3]])
+        qw = np.array([1 / 6, 1 / 6, 1 / 6])
+        N = np.stack([1.0 - qp[:, 0] - qp[:, 1], qp[:, 0], qp[:, 1]],
+                     axis=1)
+        dN = np.broadcast_to(
+            np.array([[-1.0, -1.0], [1.0, 0.0], [0.0, 1.0]]),
+            (3, 3, 2)).copy()
+    else:
+        raise ValueError(f"unknown surface element {elem_type!r}")
+    return N, dN, qw
+
+
+class SurfaceAssembly(NamedTuple):
+    elems: jnp.ndarray       # (E, nen)
+    shape: jnp.ndarray       # (nq, nen)
+    dN: jnp.ndarray          # (nq, nen, rdim) parametric gradients
+    Ginv: jnp.ndarray        # (E, nq, rdim, rdim) reference metric inv
+    wdA: jnp.ndarray         # (E, nq) reference area measure * weight
+    lumped_mass: jnp.ndarray  # (n_nodes,) HRZ-lumped surface mass
+    n_nodes: int
+    dim: int                 # ambient dimension
+    rdim: int                # reference (surface) dimension
+
+
+def build_surface_assembly(mesh: SurfaceMesh,
+                           dtype=jnp.float32) -> SurfaceAssembly:
+    N, dN, qw = _surf_shape_table(mesh.elem_type)
+    rdim = dN.shape[2]
+    Xe = mesh.nodes[mesh.elems]                      # (E, nen, dim)
+    T = np.einsum("qar,eai->eqir", dN, Xe)           # (E, nq, dim, rdim)
+    G = np.einsum("eqir,eqis->eqrs", T, T)           # reference metric
+    detG = np.linalg.det(G)
+    wdA = np.sqrt(np.abs(detG)) * qw[None, :]
+    Ginv = np.linalg.inv(G)
+
+    mass = np.zeros(mesh.n_nodes)
+    n2 = np.einsum("eq,qa->ea", wdA, N * N)
+    emass = wdA.sum(axis=1)
+    contrib = n2 * (emass / np.maximum(n2.sum(axis=1), 1e-300))[:, None]
+    np.add.at(mass, mesh.elems, contrib)
+
+    return SurfaceAssembly(
+        elems=jnp.asarray(mesh.elems, dtype=jnp.int32),
+        shape=jnp.asarray(N, dtype=dtype),
+        dN=jnp.asarray(dN, dtype=dtype),
+        Ginv=jnp.asarray(Ginv, dtype=dtype),
+        wdA=jnp.asarray(wdA, dtype=dtype),
+        lumped_mass=jnp.asarray(mass, dtype=dtype),
+        n_nodes=mesh.n_nodes, dim=mesh.dim, rdim=rdim)
+
+
+def surface_strain(asm: SurfaceAssembly, x: jnp.ndarray) -> jnp.ndarray:
+    """Mixed Cauchy--Green strain M = G_ref^{-1} C(x) at every surface
+    quadrature point -> (E, nq, rdim, rdim); M == I under rigid motion."""
+    xe = x[asm.elems]                                # (E, nen, dim)
+    T = jnp.einsum("qar,eai->eqir", asm.dN, xe)      # current tangents
+    C = jnp.einsum("eqir,eqis->eqrs", T, T)
+    return jnp.einsum("eqrt,eqts->eqrs", asm.Ginv, C)
+
+
+def neo_hookean_membrane(mu: float, kappa: float) -> Callable:
+    """W_s(M) = mu/2 (tr M - rdim - ln det M) + kappa/2 (J_s - 1)^2,
+    J_s = sqrt(det M) (relative area/length change)."""
+    def W(M):
+        rdim = M.shape[-1]
+        detM = jnp.linalg.det(M) if rdim > 1 else M[..., 0, 0]
+        trM = jnp.trace(M, axis1=-2, axis2=-1) if rdim > 1 \
+            else M[..., 0, 0]
+        Js = jnp.sqrt(jnp.maximum(detM, 1e-12))
+        return (0.5 * mu * (trM - rdim - jnp.log(
+            jnp.maximum(detM, 1e-12)))
+            + 0.5 * kappa * (Js - 1.0) ** 2)
+    return W
+
+
+def membrane_energy(asm: SurfaceAssembly, W: Callable, x: jnp.ndarray):
+    M = surface_strain(asm, x)
+    return jnp.sum(W(M) * asm.wdA)
+
+
+def membrane_forces(asm: SurfaceAssembly, W: Callable,
+                    x: jnp.ndarray) -> jnp.ndarray:
+    """Weak-form nodal membrane force -dE/dx -> (n_nodes, dim)."""
+    return -jax.grad(lambda xx: membrane_energy(asm, W, xx))(x)
+
+
+def surface_quad_positions(asm: SurfaceAssembly,
+                           x: jnp.ndarray) -> jnp.ndarray:
+    xe = x[asm.elems]
+    return jnp.einsum("qa,eai->eqi", asm.shape, xe).reshape(-1, asm.dim)
+
+
+def current_area(asm: SurfaceAssembly, x: jnp.ndarray):
+    """Deformed surface measure (perimeter in 2D, area in 3D)."""
+    M = surface_strain(asm, x)
+    rdim = asm.rdim
+    detM = jnp.linalg.det(M) if rdim > 1 else M[..., 0, 0]
+    return jnp.sum(jnp.sqrt(jnp.maximum(detM, 0.0)) * asm.wdA)
+
+
+# -- mesh builders -----------------------------------------------------------
+
+def ring_mesh(center=(0.5, 0.5), radius: float = 0.25, n: int = 64,
+              aspect: float = 1.0) -> SurfaceMesh:
+    """Closed EDGE2 ring (optionally elliptic: semi-axes r*aspect, r)."""
+    th = 2.0 * np.pi * np.arange(n) / n
+    nodes = np.stack([center[0] + radius * aspect * np.cos(th),
+                      center[1] + radius * np.sin(th)], axis=1)
+    elems = np.stack([np.arange(n), (np.arange(n) + 1) % n], axis=1)
+    return SurfaceMesh(nodes=nodes, elems=elems.astype(np.int64),
+                       elem_type="EDGE2")
+
+
+def sphere_surface_mesh(center=(0.5, 0.5, 0.5), radius: float = 0.25,
+                        n_subdiv: int = 2) -> SurfaceMesh:
+    """Geodesic TRI3 sphere: subdivided octahedron projected to the
+    sphere (watertight, near-uniform facets)."""
+    verts = np.array([[1, 0, 0], [-1, 0, 0], [0, 1, 0],
+                      [0, -1, 0], [0, 0, 1], [0, 0, -1]], dtype=float)
+    faces = [(0, 2, 4), (2, 1, 4), (1, 3, 4), (3, 0, 4),
+             (2, 0, 5), (1, 2, 5), (3, 1, 5), (0, 3, 5)]
+    verts = [v for v in verts]
+    for _ in range(n_subdiv):
+        new_faces = []
+        midcache = {}
+
+        def mid(i, j):
+            key = (min(i, j), max(i, j))
+            if key not in midcache:
+                m = verts[i] + verts[j]
+                m = m / np.linalg.norm(m)
+                midcache[key] = len(verts)
+                verts.append(m)
+            return midcache[key]
+
+        for (a, b, c) in faces:
+            ab, bc, ca = mid(a, b), mid(b, c), mid(c, a)
+            new_faces += [(a, ab, ca), (b, bc, ab), (c, ca, bc),
+                          (ab, bc, ca)]
+        faces = new_faces
+    nodes = np.asarray(verts) * radius + np.asarray(center)
+    return SurfaceMesh(nodes=nodes,
+                       elems=np.asarray(faces, dtype=np.int64),
+                       elem_type="TRI3S")

@@ -96,16 +96,16 @@ class IBFEMethod:
         if self.coupling == "nodal":
             return interaction.spread_vel(F, grid, X, kernel=self.kernel,
                                           weights=mask)
-        # force density G = M_lumped^{-1} F at nodes -> quad points,
-        # each quad point spreads G(X_q) * (w_q dV); nodal mask zeroes
-        # inactive slots' contribution, matching the nodal path
-        from ibamr_tpu.fe.fem import safe_lumped_mass
-        G = F * mask[:, None] / safe_lumped_mass(self.asm)[:, None]
-        Gq = project_to_quads(self.asm, G)
-        wq = self.asm.wdV.reshape(-1)
+        # distribute each nodal force over its quadrature points with
+        # per-node-normalized positive shares (exact total-force
+        # conservation on every element family; see fem.
+        # distribute_to_quads); nodal mask zeroes inactive slots
+        from ibamr_tpu.fe.fem import distribute_to_quads
+        Fq = distribute_to_quads(self.asm.elems, self.asm.shape,
+                                 self.asm.wdV, self.asm.n_nodes,
+                                 F * mask[:, None])
         xq = quad_positions(self.asm, X)
-        return interaction.spread_vel(Gq * wq[:, None], grid, xq,
-                                      kernel=self.kernel)
+        return interaction.spread_vel(Fq, grid, xq, kernel=self.kernel)
 
     # -- diagnostics ---------------------------------------------------------
     def energy(self, X: jnp.ndarray):
@@ -114,6 +114,126 @@ class IBFEMethod:
     def current_volume(self, X: jnp.ndarray):
         """Deformed measure: sum_e |det FF_e| * refvol_e."""
         from ibamr_tpu.fe.fem import deformation_gradients
-        FF = deformation_gradients(self.asm, X)
-        return jnp.sum(jnp.abs(jnp.linalg.det(FF))
-                       * jnp.sum(self.asm.wdV, axis=1))
+        FF = deformation_gradients(self.asm, X)      # (E, nq, d, d)
+        return jnp.sum(jnp.abs(jnp.linalg.det(FF)) * self.asm.wdV)
+
+
+class IBFESurfaceMethod:
+    """Codim-1 FE strategy (the reference's ``IBFESurfaceMethod``, P17):
+    membranes/shells carry in-plane elasticity from ``fe/surface.py``
+    and couple at surface quadrature points with AREA weights (or
+    nodally) — same IBStrategy seam, so ``IBExplicitIntegrator`` drives
+    it unchanged."""
+
+    def __init__(self, mesh, W: Callable, kernel: Kernel = "IB_4",
+                 coupling: str = "unified", damping: float = 0.0,
+                 body_force: Optional[Callable] = None,
+                 dtype=jnp.float32):
+        from ibamr_tpu.fe.surface import (SurfaceMesh,
+                                          build_surface_assembly)
+
+        if coupling not in ("nodal", "unified"):
+            raise ValueError(f"unknown IBFE coupling scheme {coupling!r}")
+        assert isinstance(mesh, SurfaceMesh)
+        self.mesh = mesh
+        self.asm = build_surface_assembly(mesh, dtype=dtype)
+        self.W = W
+        self.kernel = kernel
+        self.coupling = coupling
+        self.damping = damping
+        self.body_force = body_force
+
+    # -- IBStrategy surface --------------------------------------------------
+    def compute_force(self, X: jnp.ndarray, U: jnp.ndarray,
+                      t) -> jnp.ndarray:
+        from ibamr_tpu.fe.surface import membrane_forces
+
+        F = membrane_forces(self.asm, self.W, X)
+        if self.damping:
+            F = F - self.damping * U
+        if self.body_force is not None:
+            F = F + self.body_force(X, t)
+        return F
+
+    def interpolate_velocity(self, u: Vel, grid: StaggeredGrid,
+                             X: jnp.ndarray, mask: jnp.ndarray,
+                             ctx=None) -> jnp.ndarray:
+        from ibamr_tpu.fe.fem import nodal_average_from_quads
+        from ibamr_tpu.fe.surface import surface_quad_positions
+
+        if self.coupling == "nodal":
+            return interaction.interpolate_vel(u, grid, X,
+                                               kernel=self.kernel,
+                                               weights=mask)
+        xq = surface_quad_positions(self.asm, X)
+        Uq = interaction.interpolate_vel(u, grid, xq, kernel=self.kernel)
+        out = nodal_average_from_quads(self.asm.elems, self.asm.shape,
+                                       self.asm.wdA, self.asm.n_nodes,
+                                       Uq)
+        return out * mask[:, None]
+
+    def spread_force(self, F: jnp.ndarray, grid: StaggeredGrid,
+                     X: jnp.ndarray, mask: jnp.ndarray,
+                     ctx=None) -> Vel:
+        from ibamr_tpu.fe.fem import distribute_to_quads
+        from ibamr_tpu.fe.surface import surface_quad_positions
+
+        if self.coupling == "nodal":
+            return interaction.spread_vel(F, grid, X, kernel=self.kernel,
+                                          weights=mask)
+        Fq = distribute_to_quads(self.asm.elems, self.asm.shape,
+                                 self.asm.wdA, self.asm.n_nodes,
+                                 F * mask[:, None])
+        xq = surface_quad_positions(self.asm, X)
+        return interaction.spread_vel(Fq, grid, xq, kernel=self.kernel)
+
+    # -- diagnostics ---------------------------------------------------------
+    def energy(self, X: jnp.ndarray):
+        from ibamr_tpu.fe.surface import membrane_energy
+        return membrane_energy(self.asm, self.W, X)
+
+    def current_area(self, X: jnp.ndarray):
+        from ibamr_tpu.fe.surface import current_area
+        return current_area(self.asm, X)
+
+
+class DirectForcingKinematics:
+    """Prescribed-kinematics wrapper (the reference's
+    ``IBFEDirectForcingKinematics``, P17): drives any FE strategy's
+    structure toward a prescribed trajectory with a stiff
+    penalty/damping pair
+
+        F_df = kappa (X_target(t) - X) - eta (U - U_target(t)),
+
+    added on top of the wrapped strategy's elastic force. All other
+    IBStrategy calls delegate, so the integrator sees one strategy."""
+
+    def __init__(self, base, target_fn: Callable, kappa: float,
+                 eta: float = 0.0, target_vel_fn: Optional[Callable] = None):
+        self.base = base
+        self.target_fn = target_fn
+        self.target_vel_fn = target_vel_fn
+        self.kappa = float(kappa)
+        self.eta = float(eta)
+
+    def compute_force(self, X: jnp.ndarray, U: jnp.ndarray,
+                      t) -> jnp.ndarray:
+        F = self.base.compute_force(X, U, t)
+        Xt = self.target_fn(t)
+        F = F + self.kappa * (Xt - X)
+        if self.eta:
+            Ut = (self.target_vel_fn(t) if self.target_vel_fn is not None
+                  else jnp.zeros_like(U))
+            F = F - self.eta * (U - Ut)
+        # user target functions easily promote dtype (x64 constants);
+        # the coupled scan carry must stay in the state's dtype
+        return F.astype(X.dtype)
+
+    def interpolate_velocity(self, *a, **kw):
+        return self.base.interpolate_velocity(*a, **kw)
+
+    def spread_force(self, *a, **kw):
+        return self.base.spread_force(*a, **kw)
+
+    def __getattr__(self, name):
+        return getattr(self.base, name)
