@@ -181,14 +181,7 @@ def build_assembly(mesh: FEMesh, dtype=jnp.float32) -> FEAssembly:
     wdV = np.abs(detJ) * qw[None, :]                 # (E, nq)
 
     n_nodes = mesh.n_nodes
-    mass = np.zeros(n_nodes)
-    # HRZ diagonal scaling: m_a ~ integral N_a^2, normalized per element
-    # to the element mass — positive for EVERY family (plain row-sum
-    # lumping goes negative at quadratic-simplex vertices)
-    n2 = np.einsum("eq,qa->ea", wdV, N * N)          # (E, nen)
-    emass = wdV.sum(axis=1)                          # (E,)
-    contrib = n2 * (emass / np.maximum(n2.sum(axis=1), 1e-300))[:, None]
-    np.add.at(mass, mesh.elems, contrib)
+    mass = hrz_lumped_mass(mesh.elems, N, wdV, n_nodes)
 
     return FEAssembly(
         elems=jnp.asarray(mesh.elems, dtype=jnp.int32),
@@ -287,6 +280,20 @@ def project_to_quads(asm: FEAssembly, nodal: jnp.ndarray) -> jnp.ndarray:
     return nq.reshape((-1,) + nodal.shape[1:])
 
 
+def hrz_lumped_mass(elems, N, w, n_nodes) -> "np.ndarray":
+    """HRZ diagonal mass lumping (host-side, numpy): m_a ~ integral
+    N_a^2, normalized per element to the element mass (weights ``w`` =
+    wdV volumetric or wdA surface) — positive for EVERY family (plain
+    row-sum lumping goes negative at quadratic-simplex vertices).
+    Shared by the volumetric and codim-1 assemblies."""
+    mass = np.zeros(n_nodes)
+    n2 = np.einsum("eq,qa->ea", w, N * N)            # (E, nen)
+    emass = w.sum(axis=1)                            # (E,)
+    contrib = n2 * (emass / np.maximum(n2.sum(axis=1), 1e-300))[:, None]
+    np.add.at(mass, elems, contrib)
+    return mass
+
+
 def _node_qp_weights(elems, shape, w, n_nodes):
     """Positive node<->quad-point transfer weights omega_eqa = w_eq *
     N_a(q)^2 and their per-node totals. N^2 keeps every weight
@@ -303,14 +310,19 @@ def _node_qp_weights(elems, shape, w, n_nodes):
 
 
 def nodal_average_from_quads(elems, shape, w, n_nodes,
-                             vals: jnp.ndarray) -> jnp.ndarray:
+                             vals: jnp.ndarray,
+                             ww_den=None) -> jnp.ndarray:
     """Node-normalized weighted average of quad-point values: exact for
     constants on EVERY family (numerator and denominator carry the same
     weights). The rebuild's FEDataManager L2-projection role (T16),
-    shared by the volumetric and surface paths."""
+    shared by the volumetric and surface paths. ``ww_den`` takes a
+    precomputed ``_node_qp_weights`` pair (it depends only on the
+    static assembly, so per-step callers hoist it out of the hot
+    loop — round-3 review finding)."""
     E, nq = w.shape
     v = vals.reshape((E, nq) + vals.shape[1:])
-    ww, den = _node_qp_weights(elems, shape, w, n_nodes)
+    ww, den = (ww_den if ww_den is not None
+               else _node_qp_weights(elems, shape, w, n_nodes))
     contrib = jnp.einsum("eqa,eq...->ea...", ww, v)
     out = jnp.zeros((n_nodes,) + vals.shape[1:], dtype=vals.dtype)
     out = out.at[elems.reshape(-1)].add(
@@ -320,12 +332,13 @@ def nodal_average_from_quads(elems, shape, w, n_nodes,
 
 
 def distribute_to_quads(elems, shape, w, n_nodes,
-                        F: jnp.ndarray) -> jnp.ndarray:
+                        F: jnp.ndarray, ww_den=None) -> jnp.ndarray:
     """Adjoint transfer: split each NODAL value over its quadrature
     points with per-node-normalized shares, so sum_q out_q == sum_a F_a
     EXACTLY (the force-conservation contract of the unified coupling),
-    for every element family."""
-    ww, den = _node_qp_weights(elems, shape, w, n_nodes)
+    for every element family. ``ww_den``: see nodal_average_from_quads."""
+    ww, den = (ww_den if ww_den is not None
+               else _node_qp_weights(elems, shape, w, n_nodes))
     Fa = (F / den.reshape((n_nodes,) + (1,) * (F.ndim - 1)))[elems]
     out = jnp.einsum("eqa,ea...->eq...", ww, Fa)
     return out.reshape((-1,) + F.shape[1:])

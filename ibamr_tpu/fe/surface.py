@@ -97,11 +97,8 @@ def build_surface_assembly(mesh: SurfaceMesh,
     wdA = np.sqrt(np.abs(detG)) * qw[None, :]
     Ginv = np.linalg.inv(G)
 
-    mass = np.zeros(mesh.n_nodes)
-    n2 = np.einsum("eq,qa->ea", wdA, N * N)
-    emass = wdA.sum(axis=1)
-    contrib = n2 * (emass / np.maximum(n2.sum(axis=1), 1e-300))[:, None]
-    np.add.at(mass, mesh.elems, contrib)
+    from ibamr_tpu.fe.fem import hrz_lumped_mass
+    mass = hrz_lumped_mass(mesh.elems, N, wdA, mesh.n_nodes)
 
     return SurfaceAssembly(
         elems=jnp.asarray(mesh.elems, dtype=jnp.int32),

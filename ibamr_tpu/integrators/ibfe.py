@@ -34,8 +34,8 @@ from typing import Callable, Optional, Tuple
 import jax.numpy as jnp
 
 from ibamr_tpu.fe.fem import (FEAssembly, build_assembly, elastic_energy,
-                              l2_project_from_quads, nodal_forces,
-                              project_to_quads, quad_positions)
+                              nodal_average_from_quads, nodal_forces,
+                              quad_positions)
 from ibamr_tpu.fe.mesh import FEMesh
 from ibamr_tpu.grid import StaggeredGrid
 from ibamr_tpu.ops import interaction
@@ -66,6 +66,11 @@ class IBFEMethod:
         self.coupling = coupling
         self.damping = damping
         self.body_force = body_force  # optional (x, t) -> nodal force
+        # static node<->quad transfer weights, hoisted out of the
+        # per-step calls (they depend only on the assembly)
+        from ibamr_tpu.fe.fem import _node_qp_weights
+        self._wwden = _node_qp_weights(self.asm.elems, self.asm.shape,
+                                       self.asm.wdV, self.asm.n_nodes)
 
     # -- IBStrategy surface --------------------------------------------------
     def compute_force(self, X: jnp.ndarray, U: jnp.ndarray,
@@ -88,7 +93,10 @@ class IBFEMethod:
         Uq = interaction.interpolate_vel(u, grid, xq, kernel=self.kernel)
         # nodal mask honored the same way the nodal path does: inactive
         # slots interpolate to zero (and so do not move)
-        return l2_project_from_quads(self.asm, Uq) * mask[:, None]
+        out = nodal_average_from_quads(self.asm.elems, self.asm.shape,
+                                       self.asm.wdV, self.asm.n_nodes,
+                                       Uq, ww_den=self._wwden)
+        return out * mask[:, None]
 
     def spread_force(self, F: jnp.ndarray, grid: StaggeredGrid,
                      X: jnp.ndarray, mask: jnp.ndarray,
@@ -103,7 +111,7 @@ class IBFEMethod:
         from ibamr_tpu.fe.fem import distribute_to_quads
         Fq = distribute_to_quads(self.asm.elems, self.asm.shape,
                                  self.asm.wdV, self.asm.n_nodes,
-                                 F * mask[:, None])
+                                 F * mask[:, None], ww_den=self._wwden)
         xq = quad_positions(self.asm, X)
         return interaction.spread_vel(Fq, grid, xq, kernel=self.kernel)
 
@@ -142,6 +150,9 @@ class IBFESurfaceMethod:
         self.coupling = coupling
         self.damping = damping
         self.body_force = body_force
+        from ibamr_tpu.fe.fem import _node_qp_weights
+        self._wwden = _node_qp_weights(self.asm.elems, self.asm.shape,
+                                       self.asm.wdA, self.asm.n_nodes)
 
     # -- IBStrategy surface --------------------------------------------------
     def compute_force(self, X: jnp.ndarray, U: jnp.ndarray,
@@ -169,7 +180,7 @@ class IBFESurfaceMethod:
         Uq = interaction.interpolate_vel(u, grid, xq, kernel=self.kernel)
         out = nodal_average_from_quads(self.asm.elems, self.asm.shape,
                                        self.asm.wdA, self.asm.n_nodes,
-                                       Uq)
+                                       Uq, ww_den=self._wwden)
         return out * mask[:, None]
 
     def spread_force(self, F: jnp.ndarray, grid: StaggeredGrid,
@@ -183,7 +194,7 @@ class IBFESurfaceMethod:
                                           weights=mask)
         Fq = distribute_to_quads(self.asm.elems, self.asm.shape,
                                  self.asm.wdA, self.asm.n_nodes,
-                                 F * mask[:, None])
+                                 F * mask[:, None], ww_den=self._wwden)
         xq = surface_quad_positions(self.asm, X)
         return interaction.spread_vel(Fq, grid, xq, kernel=self.kernel)
 

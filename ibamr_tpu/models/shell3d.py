@@ -111,10 +111,13 @@ def build_shell_example(
     """Assemble the ex4-equivalent simulation (3D periodic unit box).
 
     ``use_fast_interaction``: True = bucketed-MXU spread/interp engine
-    (ops.interaction_fast); ``"pallas"`` = the Pallas tile-kernel
-    engine (ops.pallas_interaction); False = XLA scatter/gather.
-    None = auto: MXU when the grid is tile-divisible and the marker
-    count is large enough to matter.
+    (ops.interaction_fast); ``"packed"`` = the occupancy-packed chunk
+    engine (ops.interaction_packed — best for surface structures whose
+    tile occupancy is silhouette-clustered); ``"pallas"`` = the Pallas
+    tile-kernel engine (ops.pallas_interaction); False = XLA
+    scatter/gather. None = auto: the bucketed-MXU engine when the grid
+    is tile-divisible and the marker count is large enough to matter
+    (auto will move to "packed" once the on-chip bench confirms it).
     """
     import jax.numpy as jnp
 
@@ -179,6 +182,14 @@ def build_shell_example(
             from ibamr_tpu.ops.pallas_interaction import PallasInteraction
             fast = PallasInteraction(
                 grid, kernel=kernel, tile=8, cap=cap,
+                overflow_cap=max(2048, n_markers // 4))
+        elif use_fast_interaction == "packed":
+            from ibamr_tpu.ops.interaction_packed import (
+                PackedInteraction, suggest_chunks)
+            Q = suggest_chunks(grid, structure.vertices, kernel=kernel,
+                               tile=8, chunk=128, slack=1.3)
+            fast = PackedInteraction(
+                grid, kernel=kernel, tile=8, chunk=128, nchunks=Q,
                 overflow_cap=max(2048, n_markers // 4))
         else:
             fast = FastInteraction(grid, kernel=kernel, tile=8, cap=cap,
