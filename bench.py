@@ -71,8 +71,9 @@ def try_upgrade_to_tpu(probe_timeout: float = 45.0):
     return jax, plat2, None
 
 
-def _pallas_stage_child(q, n, n_lat, n_lon, steps, warmup, dt):
-    """Child-process body for the pallas compare leg."""
+def _pallas_stage_child(q, n, n_lat, n_lon, steps, warmup, dt,
+                        engine="pallas"):
+    """Child-process body for a pallas compare leg."""
     try:
         from ibamr_tpu.utils.backend_guard import init_backend_with_retry
 
@@ -80,7 +81,7 @@ def _pallas_stage_child(q, n, n_lat, n_lon, steps, warmup, dt):
                                                      delay=2.0)
         enable_compile_cache(jax)
         st = run_stage(jax, n, n_lat, n_lon, steps, warmup, dt,
-                       use_fast="pallas")
+                       use_fast=engine)
         st["platform"] = platform
         q.put(st)
     except Exception as e:  # noqa: BLE001 - report, parent decides
@@ -88,8 +89,8 @@ def _pallas_stage_child(q, n, n_lat, n_lon, steps, warmup, dt):
 
 
 def run_pallas_stage_guarded(n, n_lat, n_lon, steps, warmup, dt,
-                             timeout_s: float):
-    """Run the pallas stage in a TERMINABLE child: the relay's
+                             timeout_s: float, engine="pallas"):
+    """Run a pallas stage in a TERMINABLE child: the relay's
     remote-compile service stalled on this kernel in round 2, and an
     in-process hang would forfeit the whole bench artifact. Returns the
     stage dict or {'error': ...}."""
@@ -98,7 +99,7 @@ def run_pallas_stage_guarded(n, n_lat, n_lon, steps, warmup, dt,
     ctx = mp.get_context("spawn")
     q = ctx.Queue()
     p = ctx.Process(target=_pallas_stage_child,
-                    args=(q, n, n_lat, n_lon, steps, warmup, dt))
+                    args=(q, n, n_lat, n_lon, steps, warmup, dt, engine))
     p.start()
     p.join(timeout_s)
     if p.is_alive():
@@ -361,15 +362,24 @@ def main():
                     n_lat = max(16, int(round(args.n_lat * frac)))
                     n_lon = max(16, int(round(args.n_lon * frac)))
                     cmp = {}
-                    # three-way: scatter / MXU-bucketed / Pallas tile
-                    # kernel (VERDICT round 2 item 5). A Pallas compile
-                    # stall (the relay's remote-compile service choked
-                    # on it in round 2) only loses the pallas entry.
+                    # five-way transfer-engine compare: scatter /
+                    # MXU-bucketed / occupancy-packed / Pallas tile
+                    # kernel / Pallas-packed (VERDICT round 2 item 5 +
+                    # round 3 packed engines). A Pallas compile stall
+                    # (the relay's remote-compile service choked on it
+                    # in round 2) only loses that engine's entry.
                     for label, fast in (("mxu", True),
                                         ("scatter", False),
-                                        ("pallas", "pallas")):
+                                        ("packed", "packed"),
+                                        ("pallas", "pallas"),
+                                        ("pallas_packed",
+                                         "pallas_packed")):
+                        if time.perf_counter() - t_start > args.deadline:
+                            errors.append(f"compare[{label}]: skipped "
+                                          "(deadline)")
+                            continue
                         try:
-                            if label == "pallas":
+                            if label.startswith("pallas"):
                                 budget = max(
                                     60.0, min(
                                         600.0,
@@ -378,7 +388,8 @@ def main():
                                            - t_start)))
                                 st = run_pallas_stage_guarded(
                                     cn, n_lat, n_lon, args.steps,
-                                    args.warmup, args.dt, budget)
+                                    args.warmup, args.dt, budget,
+                                    engine=fast)
                                 if "error" in st:
                                     raise RuntimeError(st["error"])
                                 if st.get("platform") != platform:
@@ -386,7 +397,7 @@ def main():
                                     # record a CPU-interpreter number
                                     # beside compiled-TPU entries
                                     raise RuntimeError(
-                                        "pallas leg ran on "
+                                        f"{label} leg ran on "
                                         f"{st.get('platform')!r}, "
                                         f"parent on {platform!r}")
                             else:

@@ -94,6 +94,20 @@ class MultiLevelCompositeProjection:
         self.dx = [spec.grid.dx for spec in self.levels]
         self.diag = [sum(2.0 / h ** 2 for h in spec.grid.dx)
                      for spec in self.levels]
+        # GSPMD pins (parallel.mesh.make_sharded_multilevel_ib_step):
+        # root-level arrays pinned to the spatial sharding, box arrays
+        # pinned replicated, at every level crossing — the explicit-pin
+        # pattern of the two-level CompositeProjection (wrong values
+        # were observed when the partitioner propagated through mixed
+        # scatter/gather composites unconstrained). None = unsharded
+        # no-ops.
+        self.root_sharding = None
+        self.box_sharding = None
+        # dense-transform twin of the root FFT inverse for the sharded
+        # preconditioner path; built host-side by
+        # build_dense_root_solver (eigenbasis constants must not be
+        # created mid-trace)
+        self._root_dense_solver = None
 
         # per level l < L-1: the region covered by the child box, and
         # the child-box slice in this level's index space
@@ -117,6 +131,29 @@ class MultiLevelCompositeProjection:
                            ("cc",) * spec.grid.dim)
             for spec in self.levels[1:]]
 
+    # -- sharding pins ---------------------------------------------------
+    def _pin(self, x, l: int):
+        """Pin a level-``l`` array: the root to the spatial sharding,
+        box levels replicated (boxes are the SMALL levels by design —
+        see make_sharded_two_level_ib_step's cost model)."""
+        sh = self.root_sharding if l == 0 else self.box_sharding
+        if sh is None:
+            return x
+        return jax.lax.with_sharding_constraint(x, sh)
+
+    def _pin_all(self, xs):
+        return tuple(self._pin(x, l) for l, x in enumerate(xs))
+
+    def build_dense_root_solver(self) -> None:
+        """Build the dense-periodic root inverse for the sharded
+        preconditioner path (XLA's fft thunk rejects the partitioned
+        layouts this solve produces). Host-side only."""
+        if self._root_dense_solver is None:
+            g = self.levels[0].grid
+            self._root_dense_solver = FastDiagSolver(
+                g, DomainBC.periodic(g.dim), ("cc",) * g.dim,
+                dense_periodic=True)
+
     # -- composite operator ---------------------------------------------
     def _effective(self, phis: Sequence[Array]) -> List[Array]:
         """Top-down effective arrays: each level's covered region holds
@@ -124,8 +161,8 @@ class MultiLevelCompositeProjection:
         eff = [None] * self.L
         eff[self.L - 1] = phis[self.L - 1]
         for l in range(self.L - 2, -1, -1):
-            eff[l] = phis[l].at[self.box_sl[l]].set(
-                restrict_cc(eff[l + 1]))
+            eff[l] = self._pin(phis[l].at[self.box_sl[l]].set(
+                restrict_cc(eff[l + 1])), l)
         return eff
 
     def _extended(self, eff: Sequence[Array]) -> List[Optional[Array]]:
@@ -133,8 +170,9 @@ class MultiLevelCompositeProjection:
         effective array (None at the root)."""
         exts: List[Optional[Array]] = [None]
         for l in range(1, self.L):
-            exts.append(fill_fine_ghosts(eff[l], eff[l - 1],
-                                         self.levels[l].box, ghost=1))
+            exts.append(self._pin(
+                fill_fine_ghosts(eff[l], eff[l - 1],
+                                 self.levels[l].box, ghost=1), l))
         return exts
 
     def operator(self, phis):
@@ -158,19 +196,28 @@ class MultiLevelCompositeProjection:
                 # rank-one shift removes the composite constant
                 # nullspace (as in the two-level operator)
                 lap = lap + self.diag[0] * jnp.mean(eff[0])
-            out.append(lap)
+            out.append(self._pin(lap, l))
         return tuple(out)
 
     def _precondition(self, rs):
         if self._external_precond is not None:
             return self._external_precond(rs)
-        out = [fft.solve_poisson_periodic(rs[0], self.dx[0])]
+        if self.root_sharding is not None:
+            # sharded solve: the root exact inverse runs as dense
+            # real-Fourier axis MATMULS (fastdiag dense_periodic) that
+            # the SPMD partitioner distributes; XLA's fft thunk rejects
+            # the partitioned layouts
+            p0 = self._root_dense_solver.solve(rs[0], 0.0, 1.0,
+                                               zero_nullspace=True)
+        else:
+            p0 = fft.solve_poisson_periodic(rs[0], self.dx[0])
+        out = [p0]
         for l in range(1, self.L):
             out.append(self.box_solvers[l - 1].solve(rs[l], 0.0, 1.0))
         for l in range(self.L - 1):
             out[l] = jnp.where(self.covered[l],
                                -rs[l] / self.diag[l], out[l])
-        return tuple(out)
+        return self._pin_all(out)
 
     # -- projection ------------------------------------------------------
     def project(self, us: Sequence[Vel]) -> Tuple[Tuple[Vel, ...],
@@ -189,11 +236,11 @@ class MultiLevelCompositeProjection:
                 d = _box_mac_divergence(us[l], g.dx)
             if l + 1 < self.L:
                 d = jnp.where(self.covered[l], 0.0, d)
-            divs.append(d)
+            divs.append(self._pin(d, l))
 
         sol = fgmres(self.operator, tuple(divs), M=self._precondition,
                      m=self.m, tol=self.tol, restarts=self.restarts)
-        phis = sol.x
+        phis = self._pin_all(sol.x)
         eff = self._effective(phis)
         exts = self._extended(eff)
 
@@ -202,16 +249,19 @@ class MultiLevelCompositeProjection:
             g = self.levels[l].grid
             if l == 0:
                 gc = stencils.gradient(eff[0], g.dx)
-                out.append(tuple(c - gr for c, gr in zip(us[0], gc)))
+                out.append(tuple(self._pin(c - gr, l)
+                                 for c, gr in zip(us[0], gc)))
             else:
-                out.append(box_mac_gradient_correct(us[l], exts[l],
-                                                    g.dx))
+                out.append(tuple(self._pin(c, l) for c in
+                                 box_mac_gradient_correct(us[l], exts[l],
+                                                          g.dx)))
 
         # synchronize bottom-up: covered parent faces := restriction
         for l in range(self.L - 2, -1, -1):
-            out[l] = scatter_box_mac_to_coarse(
-                out[l], restrict_mac(out[l + 1]),
-                self.levels[l + 1].box)
+            out[l] = tuple(self._pin(c, l) for c in
+                           scatter_box_mac_to_coarse(
+                               out[l], restrict_mac(out[l + 1]),
+                               self.levels[l + 1].box))
         return tuple(out), sol.iters
 
     def max_divergence(self, us: Sequence[Vel]) -> Array:

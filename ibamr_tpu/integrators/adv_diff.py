@@ -54,7 +54,7 @@ class TransportedQuantity(NamedTuple):
     kappa: float = 0.0
     # source(coords, t, Q) -> array, or None
     source: Optional[Callable] = None
-    convective_op_type: str = "upwind"   # "centered" | "upwind" | "none"
+    convective_op_type: str = "upwind"   # "centered"|"upwind"|"cui"|"none"
     init: Optional[Callable] = None      # Q0(coords) -> array
     bc: Optional[object] = None          # bc.DomainBC or None
     # spatially-varying boundary data {(axis, side): array} overriding
@@ -73,7 +73,12 @@ def convective_flux_divergence(Q: jnp.ndarray, u: Vel,
     out = jnp.zeros_like(Q)
     for d in range(dim):
         Qm = jnp.roll(Q, 1, d)            # Q[i-1] at lower face i
-        qf = advective_face_value(Qm, Q, u[d], scheme)
+        if scheme == "cui":
+            qf = advective_face_value(Qm, Q, u[d], scheme,
+                                      Qmm=jnp.roll(Q, 2, d),
+                                      Qpp=jnp.roll(Q, -1, d))
+        else:
+            qf = advective_face_value(Qm, Q, u[d], scheme)
         flux = u[d] * qf                   # at lower faces of axis d
         out = out + (jnp.roll(flux, -1, d) - flux) / dx[d]
     return out

@@ -52,8 +52,9 @@ class INSStaggeredIntegrator:
 
     Parameters mirror the reference's input-file vocabulary where sensible:
     ``rho`` (mass density), ``mu`` (dynamic viscosity), and
-    ``convective_op_type`` in {"centered", "upwind", "ppm", "none"}
-    (case-insensitive; "ppm" is the reference's default operator).
+    ``convective_op_type`` in {"centered", "upwind", "ppm", "cui",
+    "none"} (case-insensitive; "ppm" is the reference's default
+    operator, "cui" the CBC-limited cubic upwind of the newer menu).
     ``wall_axes`` puts homogeneous no-slip walls on both sides of the
     marked axes; ``wall_tangential[(d, e, side)]`` prescribes component
     d's tangential velocity on the side(0=lo,1=hi) wall of axis e (a
@@ -67,7 +68,8 @@ class INSStaggeredIntegrator:
                  wall_tangential=None):
         # reference input files spell these uppercase ("PPM", "CENTERED")
         convective_op_type = convective_op_type.lower()
-        if convective_op_type not in ("centered", "upwind", "ppm", "none"):
+        if convective_op_type not in ("centered", "upwind", "ppm", "cui",
+                                      "none"):
             raise ValueError(f"unknown convective_op_type {convective_op_type!r}")
         self.grid = grid
         self.rho = float(rho)
@@ -127,7 +129,7 @@ class INSStaggeredIntegrator:
         from ibamr_tpu.ops.convection import convective_rate_bc
         if convective_op_type == "none":
             self._convective = None
-        elif any(self.wall_axes) or convective_op_type == "ppm":
+        elif any(self.wall_axes) or convective_op_type in ("ppm", "cui"):
             self._convective = partial(
                 convective_rate_bc, scheme=convective_op_type,
                 wall_axes=self.wall_axes,

@@ -114,10 +114,12 @@ def build_shell_example(
     (ops.interaction_fast); ``"packed"`` = the occupancy-packed chunk
     engine (ops.interaction_packed — best for surface structures whose
     tile occupancy is silhouette-clustered); ``"pallas"`` = the Pallas
-    tile-kernel engine (ops.pallas_interaction); False = XLA
-    scatter/gather. None = auto: the bucketed-MXU engine when the grid
-    is tile-divisible and the marker count is large enough to matter
-    (auto will move to "packed" once the on-chip bench confirms it).
+    tile-kernel engine (ops.pallas_interaction); ``"pallas_packed"`` =
+    occupancy-packed chunks driven by Pallas programs (no HBM weight
+    intermediates); False = XLA scatter/gather. None = auto: the
+    bucketed-MXU engine when the grid is tile-divisible and the marker
+    count is large enough to matter (auto will move to a packed engine
+    once the on-chip bench confirms it).
     """
     import jax.numpy as jnp
 
@@ -183,14 +185,21 @@ def build_shell_example(
             fast = PallasInteraction(
                 grid, kernel=kernel, tile=8, cap=cap,
                 overflow_cap=max(2048, n_markers // 4))
-        elif use_fast_interaction == "packed":
+        elif use_fast_interaction in ("packed", "pallas_packed"):
             from ibamr_tpu.ops.interaction_packed import (
                 PackedInteraction, suggest_chunks)
             Q = suggest_chunks(grid, structure.vertices, kernel=kernel,
                                tile=8, chunk=128, slack=1.3)
-            fast = PackedInteraction(
-                grid, kernel=kernel, tile=8, chunk=128, nchunks=Q,
-                overflow_cap=max(2048, n_markers // 4))
+            if use_fast_interaction == "pallas_packed":
+                from ibamr_tpu.ops.pallas_interaction import (
+                    PallasPackedInteraction)
+                fast = PallasPackedInteraction(
+                    grid, kernel=kernel, tile=8, chunk=128, nchunks=Q,
+                    overflow_cap=max(2048, n_markers // 4))
+            else:
+                fast = PackedInteraction(
+                    grid, kernel=kernel, tile=8, chunk=128, nchunks=Q,
+                    overflow_cap=max(2048, n_markers // 4))
         else:
             fast = FastInteraction(grid, kernel=kernel, tile=8, cap=cap,
                                    overflow_cap=max(2048, n_markers // 4))

@@ -69,16 +69,55 @@ def _upwind_p(f: jnp.ndarray, vel: jnp.ndarray, axis: int) -> jnp.ndarray:
     return jnp.where(vel >= 0, f, jnp.roll(f, -1, axis))
 
 
+def _cui_face(U: jnp.ndarray, C: jnp.ndarray,
+              D: jnp.ndarray) -> jnp.ndarray:
+    """CUI face value from (far-upwind, upwind, downwind) cell states:
+    cubic upwind interpolation limited by the convective-boundedness
+    criterion in normalized-variable form (Waterson & Deconinck, JCP
+    224 (2007); the reference's AdvDiffCUIConvectiveOperator /
+    INSVCStaggeredConservative CUI menu entry, SURVEY.md P4/P19 [U]).
+
+    NVD: phi_hat = (C-U)/(D-U); the limited face value is
+      3*phi_hat           on (0, 1/6]
+      3/4*phi_hat + 3/8   on (1/6, 5/6)   (the cubic-upwind segment)
+      1                   on [5/6, 1)
+      phi_hat (upwind)    outside (0, 1)  (non-smooth: donor cell)
+    """
+    den = D - U
+    # guard the normalized variable where D == U (uniform data: face
+    # value reduces to C regardless of the branch taken)
+    safe = jnp.where(jnp.abs(den) > 0.0, den, 1.0)
+    ph = (C - U) / safe
+    f_hat = jnp.where(
+        ph < 1.0 / 6.0, 3.0 * ph,
+        jnp.where(ph <= 5.0 / 6.0, 0.75 * ph + 0.375,
+                  jnp.ones_like(ph)))
+    f_hat = jnp.where((ph > 0.0) & (ph < 1.0), f_hat, ph)
+    return jnp.where(jnp.abs(den) > 0.0, U + f_hat * den, C)
+
+
 def advective_face_value(Qm: jnp.ndarray, Qp: jnp.ndarray,
-                         vel: jnp.ndarray, scheme: str) -> jnp.ndarray:
+                         vel: jnp.ndarray, scheme: str,
+                         Qmm: Optional[jnp.ndarray] = None,
+                         Qpp: Optional[jnp.ndarray] = None
+                         ) -> jnp.ndarray:
     """Face value of an advected scalar from its two neighbor cells
     (Qm below the face, Qp above) and the face-normal velocity — the one
     shared scheme-selection point for the cell-centered transport paths
-    (adv_diff and the two-level AMR fluxes)."""
+    (adv_diff and the two-level AMR fluxes). ``"cui"`` additionally
+    needs the far neighbors Qmm (below Qm) and Qpp (above Qp)."""
     if scheme == "centered":
         return 0.5 * (Qm + Qp)
     if scheme == "upwind":
         return jnp.where(vel > 0, Qm, Qp)
+    if scheme == "cui":
+        if Qmm is None or Qpp is None:
+            raise ValueError("cui needs the far-neighbor states "
+                             "Qmm/Qpp")
+        up = _cui_face(Qmm, Qm, Qp)    # vel >= 0: C = Qm, U = Qmm
+        dn = _cui_face(Qpp, Qp, Qm)    # vel <  0: C = Qp, U = Qpp
+        return jnp.where(vel > 0, up,
+                         jnp.where(vel < 0, dn, 0.5 * (up + dn)))
     raise ValueError(f"unknown convective scheme {scheme!r}")
 
 
@@ -210,6 +249,13 @@ def _face_value_padded(ap: jnp.ndarray, adv: jnp.ndarray, axis: int,
         aL, aR = _ppm_states(ap, axis, n, g)
         up = _take(aR, axis, shift, shift + n)        # aR of cell i+shift-1
         dn = _take(aL, axis, shift + 1, shift + 1 + n)  # aL of cell i+shift
+        return jnp.where(adv > 0.0, up,
+                         jnp.where(adv < 0.0, dn, 0.5 * (up + dn)))
+    if scheme == "cui":
+        qmm = _sh(ap, axis, shift - 2, n, g)
+        qpp = _sh(ap, axis, shift + 1, n, g)
+        up = _cui_face(qmm, qm, qp)
+        dn = _cui_face(qpp, qp, qm)
         return jnp.where(adv > 0.0, up,
                          jnp.where(adv < 0.0, dn, 0.5 * (up + dn)))
     raise ValueError(f"unknown convective scheme {scheme!r}")

@@ -351,6 +351,84 @@ def make_sharded_two_level_ib_step(integ, mesh: Mesh):
     return jax.jit(step)
 
 
+def _shard_multilevel_proj(core, mesh: Mesh):
+    """Copy an L-level core integrator with its composite projection
+    pinned for GSPMD: root level spatially sharded, box levels
+    replicated (same cost model as make_sharded_two_level_ib_step —
+    the boxes are the small levels; the root holds the majority of
+    cells and of the preconditioner work)."""
+    import copy
+
+    core = copy.copy(core)
+    proj = copy.copy(core.proj)
+    proj.root_sharding = NamedSharding(mesh,
+                                       grid_pspec(mesh, core.grid.dim))
+    proj.box_sharding = NamedSharding(mesh, P())
+    proj.build_dense_root_solver()    # host-side: not legal mid-trace
+    core.proj = proj
+    return core
+
+
+def _pin_multilevel_us(us, spatial, replicated):
+    pin = jax.lax.with_sharding_constraint
+    return tuple(
+        tuple(pin(c, spatial if l == 0 else replicated) for c in lev)
+        for l, lev in enumerate(us))
+
+
+def make_sharded_multilevel_ins_step(integ, mesh: Mesh):
+    """Jitted L-level composite INS step
+    (:class:`~ibamr_tpu.amr_ins_multilevel.MultiLevelINS`) with the
+    root level sharded over ``mesh`` and every box level replicated,
+    with explicit pins at every level crossing (S4 for the L-level
+    FLUID hierarchy — the arbitrary-depth extension of
+    make_sharded_two_level_ib_step)."""
+    integ = _shard_multilevel_proj(integ, mesh)
+    spatial = NamedSharding(mesh, grid_pspec(mesh, integ.grid.dim))
+    replicated = NamedSharding(mesh, P())
+
+    def pin_state(st):
+        return st._replace(us=_pin_multilevel_us(st.us, spatial,
+                                                 replicated))
+
+    def step(state, dt):
+        return pin_state(integ.step(pin_state(state), dt))
+
+    return jax.jit(step)
+
+
+def make_sharded_multilevel_ib_step(integ, mesh: Mesh):
+    """Jitted L-level composite INS/IB step
+    (:class:`~ibamr_tpu.amr_ins_multilevel.MultiLevelIBINS`): root
+    level sharded, box levels + markers replicated, pins at every
+    level crossing. Removes the round-3 scope line "the L-level
+    composite INS/IB runs replicated under sharding": the majority of
+    cells (the root) now distributes over the mesh while the
+    structure-tracking boxes ride along replicated, exactly like the
+    two-level flagship path. Equality with the single-device step is
+    pinned by tests/test_parallel.py."""
+    import copy
+
+    integ = copy.copy(integ)
+    integ.core = _shard_multilevel_proj(integ.core, mesh)
+    spatial = NamedSharding(mesh, grid_pspec(mesh, integ.grid.dim))
+    replicated = NamedSharding(mesh, P())
+    pin = jax.lax.with_sharding_constraint
+
+    def pin_state(st):
+        fluid = st.fluid._replace(
+            us=_pin_multilevel_us(st.fluid.us, spatial, replicated))
+        return st._replace(fluid=fluid,
+                           X=pin(st.X, replicated),
+                           U=pin(st.U, replicated),
+                           mask=pin(st.mask, replicated))
+
+    def step(state, dt):
+        return pin_state(integ.step(pin_state(state), dt))
+
+    return jax.jit(step)
+
+
 def place_state(state, grid: StaggeredGrid, mesh: Mesh):
     """Device-put the initial state under the spatial sharding (so the
     first step doesn't start from a single-device layout)."""

@@ -136,6 +136,8 @@ SLOW_TESTS = {
     "test_preconditioner_cuts_iterations",
     "test_wave_generated_then_damped",
     "test_porous_obstacle_drag_balances_driving_force",
+    "test_multilevel_ins_sharded_matches_single",
+    "test_multilevel_ib_sharded_matches_single",
 }
 
 

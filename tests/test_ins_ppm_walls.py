@@ -73,10 +73,10 @@ def _tg_exact(g, t, nu, dtype=jnp.float64):
     return u, v
 
 
-def _run_tg_ppm(n, steps, T, nu):
+def _run_tg_ppm(n, steps, T, nu, scheme="ppm"):
     g = StaggeredGrid(n=(n, n), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
     integ = INSStaggeredIntegrator(g, rho=1.0, mu=nu,
-                                   convective_op_type="ppm",
+                                   convective_op_type=scheme,
                                    dtype=jnp.float64)
     u0, v0 = _tg_exact(g, 0.0, nu)
     st = integ.initialize(u0_arrays=(u0, v0))
@@ -95,6 +95,17 @@ def test_taylor_green_ppm_convergence():
     assert order > 1.6, (e16, e32, order)
 
 
+def test_taylor_green_cui_convergence():
+    """CUI on the staggered momentum fluxes (SURVEY.md P4 newer menu):
+    2nd-order on the smooth Taylor-Green field, like PPM."""
+    nu, T = 0.01, 0.25
+    e16 = _run_tg_ppm(16, 32, T, nu, scheme="cui")
+    e32 = _run_tg_ppm(32, 64, T, nu, scheme="cui")
+    order = math.log2(e16 / e32)
+    assert e32 < 3e-3, (e16, e32)
+    assert order > 1.6, (e16, e32, order)
+
+
 def test_uppercase_scheme_names_accepted():
     g = StaggeredGrid(n=(8, 8), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
     integ = INSStaggeredIntegrator(g, convective_op_type="PPM")
@@ -105,7 +116,7 @@ def test_uppercase_scheme_names_accepted():
 # wall-bounded Navier-Stokes
 # --------------------------------------------------------------------------
 
-@pytest.mark.parametrize("scheme", ["ppm", "centered", "upwind"])
+@pytest.mark.parametrize("scheme", ["ppm", "centered", "upwind", "cui"])
 def test_poiseuille_with_convection(scheme):
     """Channel flow driven by a body force: convection is analytically
     zero for the unidirectional profile, so the convecting integrator

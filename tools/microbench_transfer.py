@@ -35,6 +35,9 @@ def main():
     ap.add_argument("--cap", type=int, default=0)
     ap.add_argument("--tile", type=int, default=8)
     ap.add_argument("--reps", type=int, default=10)
+    ap.add_argument("--no-pallas", action="store_true",
+                    help="skip the pallas-packed legs (remote-compile "
+                         "stall risk)")
     args = ap.parse_args()
 
     import os
@@ -118,6 +121,20 @@ def main():
     t_pinterp3 = timeit(jax.jit(
         lambda: peng.interpolate_vel(u, X, b=pb)), r)
 
+    # pallas-packed: same chunk layout, Pallas tile programs
+    t_ppspread3 = t_ppinterp3 = None
+    if not args.no_pallas:
+        from ibamr_tpu.ops.pallas_interaction import PallasPackedInteraction
+
+        ppeng = PallasPackedInteraction(grid, tile=args.tile, chunk=128,
+                                        nchunks=Q,
+                                        overflow_cap=max(2048, N // 4))
+        ppb = jax.jit(ppeng.buckets)(X)
+        t_ppspread3 = timeit(jax.jit(
+            lambda: ppeng.spread_vel(F, X, b=ppb)), r)
+        t_ppinterp3 = timeit(jax.jit(
+            lambda: ppeng.interpolate_vel(u, X, b=ppb)), r)
+
     gb = (A.nbytes + Wlast.nbytes + T.nbytes) / 1e9
     print(f"bucket_build      {t_bucket:8.2f} ms")
     print(f"weights (1 ch)    {t_weights:8.2f} ms   "
@@ -134,6 +151,9 @@ def main():
     print(f"packed bucket     {t_pbucket:8.2f} ms")
     print(f"packed spread 3ch {t_pspread3:8.2f} ms")
     print(f"packed interp 3ch {t_pinterp3:8.2f} ms")
+    if t_ppspread3 is not None:
+        print(f"pallas-pk sprd 3c {t_ppspread3:8.2f} ms")
+        print(f"pallas-pk intp 3c {t_ppinterp3:8.2f} ms")
 
 
 if __name__ == "__main__":
