@@ -382,7 +382,10 @@ class CompositeProjection:
 
     def _precondition(self, r):
         if self._external_precond is not None:
-            return self._external_precond(r)
+            # pin the external preconditioner's output like every other
+            # level crossing (the sharded path's partitioner invariant)
+            p_c, p_f = self._external_precond(r)
+            return (self._pin_c(p_c), self._pin_f(p_f))
         r_c, r_f = r
         diag = sum(2.0 / h ** 2 for h in self.dx)
         if self.level_sharding is not None:
@@ -779,18 +782,16 @@ def regrid_two_level_ib(integ: TwoLevelIBINS, state: TwoLevelIBState,
                                    mask=state.mask)
 
 
-def advance_two_level_ib_regridding(integ: TwoLevelIBINS,
-                                    state: TwoLevelIBState, dt: float,
-                                    num_steps: int,
-                                    regrid_interval: int = 20
-                                    ) -> Tuple[TwoLevelIBINS,
-                                               TwoLevelIBState]:
-    """Advance with the window tracking the structure: jitted chunks of
-    ``regrid_interval`` steps with host-side marker-tagged regrids in
-    between (the reference's regrid cadence)."""
-    # cache the jitted chunk per (integrator, length): a static window
-    # re-traces nothing; only a MOVED window (new integrator, new
-    # static origin) compiles anew — the documented cost model
+def advance_with_regrids(integ, state, dt: float, num_steps: int,
+                         regrid_interval: int, advance_fn, regrid_fn):
+    """Shared regrid-cadence driver (the reference's regrid loop shape,
+    SURVEY.md §3.4): jitted chunks of ``regrid_interval`` steps with
+    host-side ``regrid_fn(integ, state)`` between them.
+
+    The jitted chunk is cached per (integrator, length): a static
+    window re-traces nothing; only a MOVED window (new integrator, new
+    static origins) compiles anew — the documented cost model. Used by
+    both the two-level and the L-level moving-window paths."""
     chunks = {}
 
     def chunk(n):
@@ -799,7 +800,7 @@ def advance_two_level_ib_regridding(integ: TwoLevelIBINS,
             local_integ = integ
 
             def run(s, dt):
-                return advance_two_level_ib(local_integ, s, dt, n)
+                return advance_fn(local_integ, s, dt, n)
 
             chunks[key] = jax.jit(run)
         return chunks[key]
@@ -810,7 +811,7 @@ def advance_two_level_ib_regridding(integ: TwoLevelIBINS,
         state = chunk(n)(state, dt)
         done += n
         if done < num_steps:
-            integ2, state = regrid_two_level_ib(integ, state)
+            integ2, state = regrid_fn(integ, state)
             if integ2 is not integ:
                 # the moved window's old executables are unreachable
                 # (cache keys are id-based); drop them so a long run
@@ -818,6 +819,20 @@ def advance_two_level_ib_regridding(integ: TwoLevelIBINS,
                 chunks.clear()
                 integ = integ2
     return integ, state
+
+
+def advance_two_level_ib_regridding(integ: TwoLevelIBINS,
+                                    state: TwoLevelIBState, dt: float,
+                                    num_steps: int,
+                                    regrid_interval: int = 20
+                                    ) -> Tuple[TwoLevelIBINS,
+                                               TwoLevelIBState]:
+    """Advance with the window tracking the structure: jitted chunks of
+    ``regrid_interval`` steps with host-side marker-tagged regrids in
+    between (the reference's regrid cadence)."""
+    return advance_with_regrids(integ, state, dt, num_steps,
+                                regrid_interval, advance_two_level_ib,
+                                regrid_two_level_ib)
 
 
 def box_from_markers(grid: StaggeredGrid, X, pad: int = 4,

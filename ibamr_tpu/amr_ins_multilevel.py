@@ -201,7 +201,11 @@ class MultiLevelCompositeProjection:
 
     def _precondition(self, rs):
         if self._external_precond is not None:
-            return self._external_precond(rs)
+            # pin the external (e.g. FAC V-cycle) output too: the
+            # sharded path's invariant is that every level crossing
+            # re-constrains the partitioner, external preconditioners
+            # included
+            return self._pin_all(self._external_precond(rs))
         if self.root_sharding is not None:
             # sharded solve: the root exact inverse runs as dense
             # real-Fourier axis MATMULS (fastdiag dense_periodic) that
@@ -307,6 +311,10 @@ class MultiLevelINS:
         self.rho = float(rho)
         self.mu = float(mu)
         self.convective = bool(convective)
+        # kept so a moving-window regrid can rebuild the preconditioner
+        # at the new boxes instead of silently reverting to the default
+        # (the ADVICE-round-2 regrid-config-carry contract)
+        self.precond_factory = precond_factory
         precond = (precond_factory(self.levels)
                    if precond_factory is not None else None)
         self.proj = MultiLevelCompositeProjection(
@@ -429,11 +437,13 @@ class MultiLevelIBINS:
     def __init__(self, grid: StaggeredGrid, boxes: Sequence[FineBox], ib,
                  rho: float = 1.0, mu: float = 0.01,
                  convective: bool = True, proj_tol: float = 1e-9,
-                 proj_m: int = 24, proj_restarts: int = 8):
+                 proj_m: int = 24, proj_restarts: int = 8,
+                 precond_factory=None):
         self.core = MultiLevelINS(grid, boxes, rho=rho, mu=mu,
                                   convective=convective,
                                   proj_tol=proj_tol, proj_m=proj_m,
-                                  proj_restarts=proj_restarts)
+                                  proj_restarts=proj_restarts,
+                                  precond_factory=precond_factory)
         self.levels = self.core.levels
         self.L = self.core.L
         self.grid = grid
@@ -505,3 +515,127 @@ def advance_multilevel_ib(integ: MultiLevelIBINS,
 
     out, _ = jax.lax.scan(body, state, None, length=num_steps)
     return out
+
+
+# --------------------------------------------------------------------------
+# moving-window regrid at arbitrary depth (SURVEY.md §3.4 for L levels)
+# --------------------------------------------------------------------------
+
+def regrid_multilevel_ib(integ: MultiLevelIBINS, state: MultiLevelIBState,
+                         move_threshold: int = 2
+                         ) -> Tuple[MultiLevelIBINS, MultiLevelIBState]:
+    """Host-side marker-tagged regrid of the WHOLE box chain: every
+    level's fixed-shape window is re-centered on the current markers
+    (in its own parent's index space, nesting clearance enforced
+    level by level — the depth-L generalization of
+    :func:`ibamr_tpu.amr_ins.regrid_two_level_ib`). When any window
+    moves, the state transfers:
+
+    1. each new window's velocity = divergence-preserving MAC
+       prolongation of its (already transferred) parent field (T10);
+    2. surviving same-level data copied across the old/new overlap —
+       the overlap is computed in PHYSICAL coordinates because a moved
+       parent shifts the child's index frame;
+    3. covered parent faces re-slaved bottom-up and ONE composite
+       projection cleans the prolongation/copy seams.
+
+    Returns (integ, state); both unchanged when no window moved."""
+    from ibamr_tpu.amr import prolong_mac_div_preserving
+    from ibamr_tpu.amr_ins import _window_lo_from_markers
+
+    old_levels = integ.levels
+    L = integ.L
+    grid = integ.grid
+
+    new_boxes: List[FineBox] = []
+    parent_grid = grid
+    moved = False
+    for l in range(1, L):
+        old = old_levels[l].box
+        lo = _window_lo_from_markers(parent_grid, state.X, old.shape)
+        if max(abs(a - b) for a, b in zip(lo, old.lo)) < move_threshold \
+                and not moved:
+            # a moved ANCESTOR forces recomputation below it even if
+            # this window's origin is unchanged in the parent frame
+            lo = old.lo
+        else:
+            moved = moved or tuple(lo) != tuple(old.lo)
+        new_boxes.append(FineBox(lo=tuple(lo), shape=old.shape,
+                                 ratio=old.ratio))
+        parent_grid = new_boxes[-1].fine_grid(parent_grid)
+    if not moved:
+        return integ, state
+
+    core = integ.core
+    integ2 = MultiLevelIBINS(grid, new_boxes, integ.ib, rho=core.rho,
+                             mu=core.mu, convective=core.convective,
+                             proj_tol=core.proj.tol, proj_m=core.proj.m,
+                             proj_restarts=core.proj.restarts,
+                             precond_factory=core.precond_factory)
+    new_levels = integ2.levels
+
+    us_new: List[Vel] = [state.fluid.us[0]]       # root rides along
+    for l in range(1, L):
+        pg = new_levels[l - 1].grid
+        box = new_levels[l].box
+        parent = us_new[l - 1]
+        if l >= 2:
+            # box layout -> periodic layout of the parent window; the
+            # wrap images never reach the prolonged region (>= 2-cell
+            # nesting clearance vs the 1-cell prolongation stencil)
+            from ibamr_tpu.amr_ins import _periodic_from_box_mac
+            parent = _periodic_from_box_mac(parent, pg.n)
+        uf = list(prolong_mac_div_preserving(parent, pg, box))
+
+        # overlap copy in physical coordinates (integer at this level's
+        # resolution: window origins live on the parent lattice)
+        og = old_levels[l].grid
+        ng = new_levels[l].grid
+        dxl = ng.dx
+        ov_lo = [max(a, b) for a, b in zip(og.x_lo, ng.x_lo)]
+        ov_hi = [min(a, b) for a, b in zip(og.x_up, ng.x_up)]
+        if all(h > lo_ + 0.5 * dd
+               for lo_, h, dd in zip(ov_lo, ov_hi, dxl)):
+            src0 = [int(round((ov_lo[d] - og.x_lo[d]) / dxl[d]))
+                    for d in range(grid.dim)]
+            dst0 = [int(round((ov_lo[d] - ng.x_lo[d]) / dxl[d]))
+                    for d in range(grid.dim)]
+            cnt = [int(round((ov_hi[d] - ov_lo[d]) / dxl[d]))
+                   for d in range(grid.dim)]
+            for d in range(grid.dim):
+                src = tuple(slice(src0[e], src0[e] + cnt[e]
+                                  + (1 if e == d else 0))
+                            for e in range(grid.dim))
+                dst = tuple(slice(dst0[e], dst0[e] + cnt[e]
+                                  + (1 if e == d else 0))
+                            for e in range(grid.dim))
+                uf[d] = uf[d].at[dst].set(state.fluid.us[l][d][src])
+        us_new.append(tuple(uf))
+
+    # re-slave covered parent faces bottom-up, then clean the seams
+    for l in range(L - 2, -1, -1):
+        us_new[l] = scatter_box_mac_to_coarse(
+            us_new[l], restrict_mac(us_new[l + 1]),
+            new_levels[l + 1].box)
+    us_p, _ = integ2.core.proj.project(us_new)
+    fluid = MultiLevelINSState(us=tuple(us_p), t=state.fluid.t,
+                               k=state.fluid.k)
+    return integ2, MultiLevelIBState(fluid=fluid, X=state.X, U=state.U,
+                                     mask=state.mask)
+
+
+def advance_multilevel_ib_regridding(integ: MultiLevelIBINS,
+                                     state: MultiLevelIBState, dt: float,
+                                     num_steps: int,
+                                     regrid_interval: int = 20
+                                     ) -> Tuple[MultiLevelIBINS,
+                                                MultiLevelIBState]:
+    """Advance with the whole window chain tracking the structure:
+    jitted chunks with host-side regrids between them (the reference's
+    regrid cadence, §3.4). A static chain re-traces nothing; a moved
+    chain compiles anew at its new static origins."""
+    from ibamr_tpu.amr_ins import advance_with_regrids
+
+    return advance_with_regrids(integ, state, dt, num_steps,
+                                regrid_interval, advance_multilevel_ib,
+                                regrid_multilevel_ib)

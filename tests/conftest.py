@@ -137,6 +137,8 @@ SLOW_TESTS = {
     "test_wave_generated_then_damped",
     "test_porous_obstacle_drag_balances_driving_force",
     "test_multilevel_ins_sharded_matches_single",
+    "test_multilevel_regrid_tracks_drifting_structure",
+    "test_hydrodynamic_force_measures_body_drag",
     "test_multilevel_ib_sharded_matches_single",
 }
 
