@@ -64,19 +64,43 @@ class TransportedQuantity(NamedTuple):
 
 def convective_flux_divergence(Q: jnp.ndarray, u: Vel,
                                dx: Sequence[float],
-                               scheme: str) -> jnp.ndarray:
+                               scheme: str, bc=None,
+                               bdry_data=None) -> jnp.ndarray:
     """div(u Q) at cell centers from face fluxes. ``scheme`` selects the
-    face value of Q: centered average or upwind donor cell."""
+    face value of Q: centered average, upwind donor cell, or CUI.
+
+    With ``bc`` (a :class:`ibamr_tpu.bc.DomainBC`), the face states come
+    from a BC-honoring ghost fill (T5) instead of the periodic wrap —
+    required for CUI's two-cell reach near walls; the flux DIVERGENCE
+    stays the roll form because the advecting normal velocity vanishes
+    on wall faces (pinned MAC layout), so the wrapped flux there is the
+    exact zero both sides need."""
     from ibamr_tpu.ops.convection import advective_face_value
 
     dim = Q.ndim
+    need_ghosts = bc is not None and not bc.all_periodic
+    if need_ghosts:
+        from ibamr_tpu import bc as bc_mod
+
+        g = 2
+        Qg = bc_mod.fill_ghosts_cc(Q, bc, dx, bdry_data=bdry_data,
+                                   width=g)
+        interior = [slice(g, g + Q.shape[e]) for e in range(dim)]
+
+        def at(d, s):
+            sl = list(interior)
+            sl[d] = slice(g + s, g + s + Q.shape[d])
+            return Qg[tuple(sl)]
+    else:
+        def at(d, s):
+            return jnp.roll(Q, -s, d) if s else Q
+
     out = jnp.zeros_like(Q)
     for d in range(dim):
-        Qm = jnp.roll(Q, 1, d)            # Q[i-1] at lower face i
+        Qm = at(d, -1)                    # Q[i-1] at lower face i
         if scheme == "cui":
             qf = advective_face_value(Qm, Q, u[d], scheme,
-                                      Qmm=jnp.roll(Q, 2, d),
-                                      Qpp=jnp.roll(Q, -1, d))
+                                      Qmm=at(d, -2), Qpp=at(d, 1))
         else:
             qf = advective_face_value(Qm, Q, u[d], scheme)
         flux = u[d] * qf                   # at lower faces of axis d
@@ -148,7 +172,8 @@ class AdvDiffSemiImplicitIntegrator:
                 n_star = n_curr
             else:
                 n_curr = convective_flux_divergence(
-                    Q, u, dx, q.convective_op_type)
+                    Q, u, dx, q.convective_op_type, bc=q.bc,
+                    bdry_data=q.bdry_data)
                 c1 = jnp.where(state.k == 0, 1.0, 1.5).astype(self.dtype)
                 c2 = jnp.where(state.k == 0, 0.0, -0.5).astype(self.dtype)
                 n_star = c1 * n_curr + c2 * state.n_prev[i]

@@ -52,11 +52,19 @@ class INSOpenIntegrator:
 
     def __init__(self, n, dx, bc: StokesBC, mu: float, dt: float,
                  bdry: Optional[Dict] = None, rho: float = 1.0,
-                 tol: float = 1e-8, dtype=jnp.float64):
+                 tol: float = 1e-8, dtype=jnp.float64,
+                 convective_op_type: str = "upwind",
+                 stab_band: int = 4):
         self.mu = float(mu)
         self.rho = float(rho)
         self.dt = float(dt)
         self.alpha = self.rho / self.dt
+        convective_op_type = convective_op_type.lower()
+        if convective_op_type not in ("upwind", "stabilized_ppm"):
+            raise ValueError(
+                f"unknown convective_op_type {convective_op_type!r}")
+        self.convective_op_type = convective_op_type
+        self.stab_band = int(stab_band)
         self.solver = StaggeredStokesSolver(
             n, dx, bc, alpha=self.alpha, mu=self.mu, tol=tol,
             dtype=dtype)
@@ -75,36 +83,13 @@ class INSOpenIntegrator:
 
     # -- advection helpers ---------------------------------------------
     def _ghost_with_data(self, c: Array, d: int) -> Array:
-        """One ghost layer per axis honoring the ACTUAL boundary data
-        (unlike the solver's homogeneous pad): prescribed tangential
-        sides reflect around the data value; open sides copy; periodic
-        wraps; own-axis boundary faces already carry their data (the
-        saddle solve's identity rows keep them exact)."""
-        s = self.solver
+        """One ghost layer on EVERY axis honoring the actual boundary
+        data (unlike the solver's homogeneous pad) — sequential
+        applications of :meth:`_ghost_axis`, the one reflection
+        implementation both advection paths share."""
         out = c
         for e in range(c.ndim):
-            lo_idx = [slice(None)] * out.ndim
-            hi_idx = [slice(None)] * out.ndim
-            if s.bc.periodic(e):
-                lo_idx[e] = slice(-1, None)
-                hi_idx[e] = slice(0, 1)
-                lo_g, hi_g = out[tuple(lo_idx)], out[tuple(hi_idx)]
-            else:
-                lo_idx[e] = slice(0, 1)
-                hi_idx[e] = slice(-1, None)
-                lo_g, hi_g = out[tuple(lo_idx)], out[tuple(hi_idx)]
-                if e != d:
-                    if s.bc.side(e, 0).prescribed:
-                        v = pad_boundary_data(jnp.asarray(
-                            self.bdry.get((d, e, 0), 0.0), c.dtype),
-                            out, e)
-                        lo_g = 2.0 * v - lo_g
-                    if s.bc.side(e, 1).prescribed:
-                        v = pad_boundary_data(jnp.asarray(
-                            self.bdry.get((d, e, 1), 0.0), c.dtype),
-                            out, e)
-                        hi_g = 2.0 * v - hi_g
-            out = jnp.concatenate([lo_g, out, hi_g], axis=e)
+            out = self._ghost_axis(out, d, e, width=1)
         return out
 
     def _to_cells(self, u: Vel) -> Vel:
@@ -145,6 +130,122 @@ class INSOpenIntegrator:
             out.append(N)
         return tuple(out)
 
+    def _ghost_axis(self, c: Array, d: int, e: int, width: int) -> Array:
+        """``width`` ghost layers along axis ``e`` only, honoring the
+        boundary data (the wide-stencil fill the stabilized-PPM path
+        needs; the one-layer all-axes fill above serves upwind)."""
+        s = self.solver
+
+        def take(lo, hi):
+            sl = [slice(None)] * c.ndim
+            sl[e] = slice(lo, hi)
+            return c[tuple(sl)]
+
+        n_e = c.shape[e]
+        if s.bc.periodic(e):
+            return jnp.concatenate(
+                [take(n_e - width, n_e), c, take(0, width)], axis=e)
+        if e != d:
+            # cell-centered along e: odd reflection about prescribed
+            # data, constant extrapolation past open sides
+            lo_int = jnp.flip(take(0, width), axis=e)
+            hi_int = jnp.flip(take(n_e - width, n_e), axis=e)
+            if s.bc.side(e, 0).prescribed:
+                v = pad_boundary_data(jnp.asarray(
+                    self.bdry.get((d, e, 0), 0.0), c.dtype), c, e)
+                lo_g = 2.0 * v - lo_int
+            else:
+                lo_g = jnp.repeat(take(0, 1), width, axis=e)
+            if s.bc.side(e, 1).prescribed:
+                v = pad_boundary_data(jnp.asarray(
+                    self.bdry.get((d, e, 1), 0.0), c.dtype), c, e)
+                hi_g = 2.0 * v - hi_int
+            else:
+                hi_g = jnp.repeat(take(n_e - 1, n_e), width, axis=e)
+        else:
+            # face-centered along its own axis: the boundary faces ARE
+            # slots 0 / -1 (the saddle solve keeps them exact); odd
+            # reflection through the boundary node for prescribed
+            # sides, constant extrapolation for open ones
+            if s.bc.side(e, 0).prescribed:
+                lo_g = 2.0 * take(0, 1) - jnp.flip(take(1, width + 1),
+                                                   axis=e)
+            else:
+                lo_g = jnp.repeat(take(0, 1), width, axis=e)
+            if s.bc.side(e, 1).prescribed:
+                hi_g = 2.0 * take(n_e - 1, n_e) - jnp.flip(
+                    take(n_e - 1 - width, n_e - 1), axis=e)
+            else:
+                hi_g = jnp.repeat(take(n_e - 1, n_e), width, axis=e)
+        return jnp.concatenate([lo_g, c, hi_g], axis=e)
+
+    def _stab_mask(self, shape, e: int) -> Array:
+        """Upwind-blend weight along flux axis ``e``: 1 at a
+        non-periodic boundary, linear ramp to 0 over ``stab_band``
+        cells (the reference's stabilized-PPM boundary band)."""
+        s = self.solver
+        n_e = shape[e]
+        idx = jnp.arange(n_e, dtype=jnp.float64)
+        chi = jnp.zeros((n_e,), dtype=jnp.float64)
+        band = float(max(self.stab_band, 1))
+        if not s.bc.periodic(e):
+            chi = jnp.maximum(chi, jnp.clip(1.0 - idx / band, 0.0, 1.0))
+            chi = jnp.maximum(chi, jnp.clip(
+                1.0 - (n_e - 1 - idx) / band, 0.0, 1.0))
+        sh = [1] * len(shape)
+        sh[e] = n_e
+        return chi.reshape(sh)
+
+    def _advect_stabilized(self, u: Vel) -> Vel:
+        """PPM-reconstructed advective derivatives in the interior,
+        blended to first-order upwind within ``stab_band`` cells of the
+        physical boundaries — the
+        ``INSStaggeredStabilizedPPMConvectiveOperator`` contract
+        (SURVEY.md P4 [U]): high-order transport where the flow is
+        smooth, damping at open/inflow boundaries where PPM's wide
+        stencil would ring against the boundary model."""
+        from ibamr_tpu.ops.convection import _face_value_padded, _sh
+
+        s = self.solver
+        g = 3
+        uc = self._to_cells(u)
+        out = []
+        for d, c in enumerate(u):
+            N = jnp.zeros_like(c)
+            for e in range(c.ndim):
+                a = self._advecting(uc, u, d, e)
+                # midpoint advecting values between c's sample points
+                # (wrap on periodic axes: an edge pad would pick the
+                # wrong upwind donor at the seam)
+                pad = [(0, 0)] * c.ndim
+                pad[e] = (1, 1)
+                ap = jnp.pad(a, pad,
+                             mode="wrap" if s.bc.periodic(e) else "edge")
+                lo_sl = [slice(None)] * c.ndim
+                hi_sl = [slice(None)] * c.ndim
+                lo_sl[e] = slice(0, -2)
+                hi_sl[e] = slice(2, None)
+                a_lo = 0.5 * (a + ap[tuple(lo_sl)])
+                a_hi = 0.5 * (a + ap[tuple(hi_sl)])
+
+                G = self._ghost_axis(c, d, e, width=g)
+                n_e = c.shape[e]
+                q_lo = _face_value_padded(G, a_lo, e, n_e, g, "ppm",
+                                          shift=0)
+                q_hi = _face_value_padded(G, a_hi, e, n_e, g, "ppm",
+                                          shift=1)
+                ppm_term = a * (q_hi - q_lo) / s.dx[e]
+
+                c_m = _sh(G, e, -1, n_e, g)
+                c_p = _sh(G, e, 1, n_e, g)
+                up_term = jnp.where(
+                    a > 0, a * (c - c_m) / s.dx[e],
+                    a * (c_p - c) / s.dx[e])
+                chi = self._stab_mask(c.shape, e).astype(c.dtype)
+                N = N + chi * up_term + (1.0 - chi) * ppm_term
+            out.append(N)
+        return tuple(out)
+
     def _advecting(self, uc: Vel, u: Vel, d: int, e: int) -> Array:
         """Velocity component e evaluated at component d's faces."""
         s = self.solver
@@ -167,7 +268,10 @@ class INSOpenIntegrator:
     def step(self, state: OpenINSState,
              f: Optional[Vel] = None) -> OpenINSState:
         s = self.solver
-        N = self._advect(state.u)
+        if self.convective_op_type == "stabilized_ppm":
+            N = self._advect_stabilized(state.u)
+        else:
+            N = self._advect(state.u)
         f_u = []
         for d in range(len(s.n)):
             r = self.alpha * state.u[d] - self.rho * N[d]

@@ -138,6 +138,7 @@ SLOW_TESTS = {
     "test_porous_obstacle_drag_balances_driving_force",
     "test_multilevel_ins_sharded_matches_single",
     "test_multilevel_regrid_tracks_drifting_structure",
+    "test_channel_develops_to_poiseuille_stabilized_ppm",
     "test_hydrodynamic_force_measures_body_drag",
     "test_multilevel_ib_sharded_matches_single",
 }
