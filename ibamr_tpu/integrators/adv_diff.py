@@ -97,13 +97,25 @@ def convective_flux_divergence(Q: jnp.ndarray, u: Vel,
 
     out = jnp.zeros_like(Q)
     for d in range(dim):
+        ud = u[d]
+        if need_ghosts and not bc.axes[d].periodic:
+            # ENFORCE the pinned-wall layout contract on non-periodic
+            # axes: face 0 is the physical boundary face AND (via the
+            # roll) the image of the opposite boundary face, so a
+            # nonzero boundary-normal velocity there would re-inject
+            # the outflow at the inflow end. The BC menu served here is
+            # walls (u.n = 0); pin it so a through-flow velocity fails
+            # visibly (no boundary transport) instead of wrapping.
+            sl = [slice(None)] * dim
+            sl[d] = slice(0, 1)
+            ud = ud.at[tuple(sl)].set(0.0)
         Qm = at(d, -1)                    # Q[i-1] at lower face i
         if scheme == "cui":
-            qf = advective_face_value(Qm, Q, u[d], scheme,
+            qf = advective_face_value(Qm, Q, ud, scheme,
                                       Qmm=at(d, -2), Qpp=at(d, 1))
         else:
-            qf = advective_face_value(Qm, Q, u[d], scheme)
-        flux = u[d] * qf                   # at lower faces of axis d
+            qf = advective_face_value(Qm, Q, ud, scheme)
+        flux = ud * qf                     # at lower faces of axis d
         out = out + (jnp.roll(flux, -1, d) - flux) / dx[d]
     return out
 

@@ -168,3 +168,30 @@ def test_box_from_markers_tags_structure():
         assert box.lo[d] <= c.min() - 3
         assert box.hi[d] >= c.max() + 3
     assert all(s % 2 == 0 for s in box.shape)
+
+
+def test_two_level_ib_3d_shell():
+    """3D composite two-level INS/IB (the production adaptive-shell
+    shape): divergence at solver tolerance, shell volume conserved,
+    markers finite."""
+    from ibamr_tpu.models.shell3d import make_spherical_shell, shell_volume
+
+    g = StaggeredGrid(n=(32, 32, 32), x_lo=(0.0,) * 3, x_up=(1.0,) * 3)
+    # a SPHERE under taut springs: it stays spherical (radial
+    # symmetry), so the radial-sample volume proxy is shape-exact and
+    # incompressibility pins it; an aspect!=1 shell changes mean(r^3)
+    # at fixed true volume while relaxing
+    s = make_spherical_shell(16, 16, 0.12, (0.5, 0.5, 0.5), 1.0,
+                             rest_length_factor=0.75)
+    ib = IBMethod(s.force_specs(dtype=jnp.float64), kernel="IB_4")
+    box = FineBox(lo=(8, 8, 8), shape=(16, 16, 16))
+    integ = TwoLevelIBINS(g, box, ib, mu=0.05, proj_tol=1e-10)
+    st = integ.initialize(jnp.asarray(s.vertices, jnp.float64))
+    v0 = float(shell_volume(st.X, (0.5, 0.5, 0.5)))
+    st = advance_two_level_ib(integ, st, 5e-4, 60)
+    assert float(integ.core.max_divergence(st.fluid)) < 1e-8
+    assert np.all(np.isfinite(np.asarray(st.X)))
+    # shell_volume is a radial-sample PROXY (diagnostic only — see its
+    # docstring; exact conservation is pinned in 2D): pole-weighted
+    # sampling drifts ~2% as the taut shell settles
+    assert abs(float(shell_volume(st.X, (0.5, 0.5, 0.5))) - v0) / abs(v0) < 3e-2
