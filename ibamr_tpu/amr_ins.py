@@ -783,7 +783,8 @@ def regrid_two_level_ib(integ: TwoLevelIBINS, state: TwoLevelIBState,
 
 
 def advance_with_regrids(integ, state, dt: float, num_steps: int,
-                         regrid_interval: int, advance_fn, regrid_fn):
+                         regrid_interval: int, advance_fn, regrid_fn,
+                         on_chunk=None):
     """Shared regrid-cadence driver (the reference's regrid loop shape,
     SURVEY.md §3.4): jitted chunks of ``regrid_interval`` steps with
     host-side ``regrid_fn(integ, state)`` between them.
@@ -791,7 +792,12 @@ def advance_with_regrids(integ, state, dt: float, num_steps: int,
     The jitted chunk is cached per (integrator, length): a static
     window re-traces nothing; only a MOVED window (new integrator, new
     static origins) compiles anew — the documented cost model. Used by
-    both the two-level and the L-level moving-window paths."""
+    both the two-level and the L-level moving-window paths.
+
+    ``on_chunk(integ, state, steps_done)``: optional host-side hook
+    after every chunk (metrics/viz/restart) — drivers should use it
+    rather than calling this function repeatedly, which would discard
+    the chunk cache (and recompile) at every call."""
     chunks = {}
 
     def chunk(n):
@@ -810,6 +816,8 @@ def advance_with_regrids(integ, state, dt: float, num_steps: int,
         n = min(regrid_interval, num_steps - done)
         state = chunk(n)(state, dt)
         done += n
+        if on_chunk is not None:
+            on_chunk(integ, state, done)
         if done < num_steps:
             integ2, state = regrid_fn(integ, state)
             if integ2 is not integ:
@@ -824,7 +832,8 @@ def advance_with_regrids(integ, state, dt: float, num_steps: int,
 def advance_two_level_ib_regridding(integ: TwoLevelIBINS,
                                     state: TwoLevelIBState, dt: float,
                                     num_steps: int,
-                                    regrid_interval: int = 20
+                                    regrid_interval: int = 20,
+                                    on_chunk=None
                                     ) -> Tuple[TwoLevelIBINS,
                                                TwoLevelIBState]:
     """Advance with the window tracking the structure: jitted chunks of
@@ -832,7 +841,7 @@ def advance_two_level_ib_regridding(integ: TwoLevelIBINS,
     between (the reference's regrid cadence)."""
     return advance_with_regrids(integ, state, dt, num_steps,
                                 regrid_interval, advance_two_level_ib,
-                                regrid_two_level_ib)
+                                regrid_two_level_ib, on_chunk=on_chunk)
 
 
 def box_from_markers(grid: StaggeredGrid, X, pad: int = 4,

@@ -627,7 +627,8 @@ def regrid_multilevel_ib(integ: MultiLevelIBINS, state: MultiLevelIBState,
 def advance_multilevel_ib_regridding(integ: MultiLevelIBINS,
                                      state: MultiLevelIBState, dt: float,
                                      num_steps: int,
-                                     regrid_interval: int = 20
+                                     regrid_interval: int = 20,
+                                     on_chunk=None
                                      ) -> Tuple[MultiLevelIBINS,
                                                 MultiLevelIBState]:
     """Advance with the whole window chain tracking the structure:
@@ -638,4 +639,5 @@ def advance_multilevel_ib_regridding(integ: MultiLevelIBINS,
 
     return advance_with_regrids(integ, state, dt, num_steps,
                                 regrid_interval, advance_multilevel_ib,
-                                regrid_multilevel_ib)
+                                regrid_multilevel_ib,
+                                on_chunk=on_chunk)

@@ -157,6 +157,7 @@ SLOW_TESTS = {
     "test_stokes_box_energy_decay",
     "test_free_body_step_advances",
     "test_conservative_3d_smoke",
+    "test_multilevel_ib_3d_shell",
     "test_hydrodynamic_force_measures_body_drag",
     "test_multilevel_ib_sharded_matches_single",
 }
