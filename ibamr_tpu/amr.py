@@ -114,6 +114,21 @@ def restrict_cc(fine: jnp.ndarray, ratio: int = 2) -> jnp.ndarray:
     return arr
 
 
+def box_mac_to_cc(uf):
+    """Each box MAC component (complete faces: shape n + e_d) to cell
+    centers — the box-layout twin of :func:`ibamr_tpu.ops.stencils.fc_to_cc`
+    (dimension-generic; viz/diagnostic use)."""
+    dim = len(uf)
+    out = []
+    for d, c in enumerate(uf):
+        lo = tuple(slice(0, -1) if e == d else slice(None)
+                   for e in range(dim))
+        hi = tuple(slice(1, None) if e == d else slice(None)
+                   for e in range(dim))
+        out.append(0.5 * (c[lo] + c[hi]))
+    return tuple(out)
+
+
 def restrict_mac(u_fine: Sequence[jnp.ndarray], ratio: int = 2) -> Vel:
     """Coarsen box MAC data (component d has shape fine_n + e_d): coarse
     face value = mean of the 2^(dim-1) coincident fine faces (even normal

@@ -188,6 +188,7 @@ class VizWriter:
         os.makedirs(viz_dir, exist_ok=True)
         self._eul: list = []
         self._lag: list = []
+        self._amr: list = []
 
     def dump(self, step: int, t: float,
              cell_fields: Optional[Dict] = None,
@@ -211,9 +212,20 @@ class VizWriter:
             self._lag.append((t, fname))
         self._write_pvd()
 
+    def dump_hierarchy(self, step: int, t: float, level_grids,
+                       level_fields, fmt: str = "ascii") -> None:
+        """AMR time-series dump: a .vtm multiblock (one ImageData per
+        level) per step, indexed by hierarchy.pvd."""
+        fname = f"amr_{step:06d}.vtm"
+        write_vtm_hierarchy(os.path.join(self.viz_dir, fname),
+                            level_grids, level_fields, fmt=fmt)
+        self._amr.append((t, fname))
+        self._write_pvd()
+
     def _write_pvd(self) -> None:
         for series, name in ((self._eul, "eulerian.pvd"),
-                             (self._lag, "lagrangian.pvd")):
+                             (self._lag, "lagrangian.pvd"),
+                             (self._amr, "hierarchy.pvd")):
             if not series:
                 continue
             rows = "\n".join(
@@ -224,3 +236,42 @@ class VizWriter:
                     + rows + '\n  </Collection>\n</VTKFile>\n')
             with open(os.path.join(self.viz_dir, name), "w") as f:
                 f.write(body)
+
+
+def write_vtm_hierarchy(path: str, level_grids, level_fields,
+                        fmt: str = "ascii") -> str:
+    """AMR hierarchy dump: one ``.vti`` ImageData per level (each with
+    its own origin/spacing — the refined boxes are their own uniform
+    grids) referenced from a ``.vtm`` vtkMultiBlockDataSet index that
+    ParaView/VisIt open directly. The reference dumps its patch
+    hierarchy through VisItDataWriter the same one-file-per-level way
+    (SURVEY.md §5.5 [U]).
+
+    ``level_grids``: sequence of :class:`StaggeredGrid` (level 0 the
+    root; finer levels e.g. ``box.fine_grid(parent)`` /
+    ``LevelSpec.grid``). ``level_fields``: per-level dict for
+    :func:`write_vti`.
+    """
+    if len(level_grids) != len(level_fields):
+        raise ValueError(
+            f"{len(level_grids)} level grids vs {len(level_fields)} "
+            "field dicts — a level would be silently dropped")
+    base = os.path.splitext(os.path.basename(path))[0]
+    d = os.path.dirname(path) or "."
+    os.makedirs(d, exist_ok=True)
+    rows = []
+    for l, (g, fields) in enumerate(zip(level_grids, level_fields)):
+        fname = f"{base}_L{l}.vti"
+        write_vti(os.path.join(d, fname), g, fields, fmt=fmt)
+        rows.append(f'    <Block index="{l}" name="level_{l}">\n'
+                    f'      <DataSet index="0" file="{fname}"/>\n'
+                    f'    </Block>')
+    body = ('<?xml version="1.0"?>\n'
+            '<VTKFile type="vtkMultiBlockDataSet" version="1.0" '
+            'byte_order="LittleEndian">\n'
+            '  <vtkMultiBlockDataSet>\n'
+            + "\n".join(rows)
+            + '\n  </vtkMultiBlockDataSet>\n</VTKFile>\n')
+    with open(path, "w") as f:
+        f.write(body)
+    return path
