@@ -116,7 +116,10 @@ def build_shell_example(
     tile occupancy is silhouette-clustered); ``"pallas"`` = the Pallas
     tile-kernel engine (ops.pallas_interaction); ``"pallas_packed"`` =
     occupancy-packed chunks driven by Pallas programs (no HBM weight
-    intermediates); False = XLA scatter/gather. None = auto: the
+    intermediates); ``"mxu_bf16"`` / ``"packed_bf16"`` = the MXU /
+    packed engines with bf16-compressed contraction operands (halves
+    the dominant HBM traffic; ~3 decimal digits of delta-weight
+    precision); False = XLA scatter/gather. None = auto: the
     bucketed-MXU engine when the grid is tile-divisible and the marker
     count is large enough to matter (auto will move to a packed engine
     once the on-chip bench confirms it).
@@ -171,6 +174,12 @@ def build_shell_example(
             n_markers >= 4096
             and all(v % 8 == 0 for v in n[:-1])
             and all(v >= 8 + support + 1 for v in n[:-1]))
+    _ENGINES = (True, False, None, "pallas", "packed", "pallas_packed",
+                "mxu_bf16", "packed_bf16")
+    if use_fast_interaction not in _ENGINES:
+        raise ValueError(
+            f"unknown use_fast_interaction {use_fast_interaction!r}; "
+            f"one of {_ENGINES}")
     fast = None
     if use_fast_interaction:
         from ibamr_tpu.ops.interaction_fast import (FastInteraction,
@@ -185,7 +194,8 @@ def build_shell_example(
             fast = PallasInteraction(
                 grid, kernel=kernel, tile=8, cap=cap,
                 overflow_cap=max(2048, n_markers // 4))
-        elif use_fast_interaction in ("packed", "pallas_packed"):
+        elif use_fast_interaction in ("packed", "pallas_packed",
+                                      "packed_bf16"):
             from ibamr_tpu.ops.interaction_packed import (
                 PackedInteraction, suggest_chunks)
             Q = suggest_chunks(grid, structure.vertices, kernel=kernel,
@@ -199,10 +209,17 @@ def build_shell_example(
             else:
                 fast = PackedInteraction(
                     grid, kernel=kernel, tile=8, chunk=128, nchunks=Q,
-                    overflow_cap=max(2048, n_markers // 4))
+                    overflow_cap=max(2048, n_markers // 4),
+                    compute_dtype=(jnp.bfloat16
+                                   if use_fast_interaction
+                                   == "packed_bf16" else None))
         else:
-            fast = FastInteraction(grid, kernel=kernel, tile=8, cap=cap,
-                                   overflow_cap=max(2048, n_markers // 4))
+            fast = FastInteraction(
+                grid, kernel=kernel, tile=8, cap=cap,
+                overflow_cap=max(2048, n_markers // 4),
+                compute_dtype=(jnp.bfloat16
+                               if use_fast_interaction == "mxu_bf16"
+                               else None))
     ib = IBMethod(structure.force_specs(dtype=dtype), kernel=kernel,
                   fast=fast)
     integ = IBExplicitIntegrator(ins, ib, scheme="midpoint")

@@ -158,6 +158,7 @@ SLOW_TESTS = {
     "test_free_body_step_advances",
     "test_conservative_3d_smoke",
     "test_multilevel_ib_3d_shell",
+    "test_bf16_compute_matches_f32_within_tolerance",
     "test_hydrodynamic_force_measures_body_drag",
     "test_multilevel_ib_sharded_matches_single",
 }

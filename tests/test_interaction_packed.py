@@ -153,3 +153,44 @@ def test_jit_stability_and_position_reuse():
     f2, U2 = go(F, X + 0.002)   # same shapes -> cached compile
     assert np.isfinite(np.asarray(f1[0])).all()
     assert np.isfinite(np.asarray(U2)).all()
+
+
+def test_bf16_compute_matches_f32_within_tolerance():
+    """bf16-compressed contraction operands (the HBM-halving opt-in):
+    spread and interp agree with the exact-f32 engines to bf16 weight
+    precision (~4e-3 relative), and adjointness survives at that
+    tolerance."""
+    g = StaggeredGrid(n=(32, 32, 32), x_lo=(0.0,) * 3, x_up=(1.0,) * 3)
+    rng = np.random.default_rng(5)
+    N = 3000
+    X = jnp.asarray(0.15 + 0.7 * rng.random((N, 3)), jnp.float32)
+    F = jnp.asarray(rng.standard_normal((N, 3)), jnp.float32)
+    u = tuple(jnp.asarray(rng.standard_normal(g.n), jnp.float32)
+              for _ in range(3))
+
+    from ibamr_tpu.ops.interaction_fast import FastInteraction
+    for mk in (lambda **kw: FastInteraction(g, tile=8, cap=256, **kw),
+               lambda **kw: PackedInteraction(g, tile=8, chunk=128,
+                                              nchunks=64, **kw)):
+        exact = mk()
+        comp = mk(compute_dtype=jnp.bfloat16)
+        f0 = exact.spread_vel(F, X)
+        f1 = comp.spread_vel(F, X)
+        scale = max(float(jnp.max(jnp.abs(c))) for c in f0)
+        err = max(float(jnp.max(jnp.abs(a - b)))
+                  for a, b in zip(f0, f1))
+        assert err < 8e-3 * scale, (type(exact).__name__, err, scale)
+
+        U0 = exact.interpolate_vel(u, X)
+        U1 = comp.interpolate_vel(u, X)
+        uscale = float(jnp.max(jnp.abs(U0)))
+        uerr = float(jnp.max(jnp.abs(U0 - U1)))
+        assert uerr < 8e-3 * uscale, (type(exact).__name__, uerr)
+
+        # adjointness at bf16 tolerance: <spread(F), u> == <F, interp(u)>
+        lhs = sum(float(jnp.sum(a * b)) for a, b in
+                  zip(comp.spread_vel(F, X), u))
+        rhs = float(jnp.sum(F * comp.interpolate_vel(u, X))) \
+            / float(np.prod(g.dx))
+        assert abs(lhs - rhs) < 2e-2 * max(abs(lhs), abs(rhs), 1e-6), \
+            (lhs, rhs)
