@@ -121,6 +121,22 @@ def main():
     t_pinterp3 = timeit(jax.jit(
         lambda: peng.interpolate_vel(u, X, b=pb)), r)
 
+    # bf16-compressed twins (operand HBM traffic halved)
+    engb = fast.FastInteraction(grid, tile=args.tile, cap=cap,
+                                overflow_cap=max(2048, N // 4),
+                                compute_dtype=jnp.bfloat16)
+    t_bspread3 = timeit(jax.jit(lambda: engb.spread_vel(F, X, b=b)), r)
+    t_binterp3 = timeit(jax.jit(
+        lambda: engb.interpolate_vel(u, X, b=b)), r)
+    pengb = packed.PackedInteraction(grid, tile=args.tile, chunk=128,
+                                     nchunks=Q,
+                                     overflow_cap=max(2048, N // 4),
+                                     compute_dtype=jnp.bfloat16)
+    t_pbspread3 = timeit(jax.jit(lambda: pengb.spread_vel(F, X, b=pb)),
+                         r)
+    t_pbinterp3 = timeit(jax.jit(
+        lambda: pengb.interpolate_vel(u, X, b=pb)), r)
+
     # pallas-packed: same chunk layout, Pallas tile programs
     t_ppspread3 = t_ppinterp3 = None
     if not args.no_pallas:
@@ -151,6 +167,10 @@ def main():
     print(f"packed bucket     {t_pbucket:8.2f} ms")
     print(f"packed spread 3ch {t_pspread3:8.2f} ms")
     print(f"packed interp 3ch {t_pinterp3:8.2f} ms")
+    print(f"mxu-bf16 sprd 3ch {t_bspread3:8.2f} ms")
+    print(f"mxu-bf16 intp 3ch {t_binterp3:8.2f} ms")
+    print(f"pk-bf16 sprd 3ch  {t_pbspread3:8.2f} ms")
+    print(f"pk-bf16 intp 3ch  {t_pbinterp3:8.2f} ms")
     if t_ppspread3 is not None:
         print(f"pallas-pk sprd 3c {t_ppspread3:8.2f} ms")
         print(f"pallas-pk intp 3c {t_ppinterp3:8.2f} ms")
