@@ -44,6 +44,27 @@ from ibamr_tpu.ops.delta import Kernel
 Vel = Tuple[jnp.ndarray, ...]
 
 
+def _check_fast_engine(fast, kernel) -> None:
+    """The engine bakes in its kernel at construction; a mismatch with
+    the method's kernel would silently transfer with the wrong delta."""
+    if fast is not None and getattr(fast, "kernel", kernel) != kernel:
+        raise ValueError(
+            f"fast engine kernel {fast.kernel!r} != method kernel "
+            f"{kernel!r}")
+
+
+def _check_fast_grid(fast, grid) -> None:
+    """The engine bakes in its grid too; calling with a different grid
+    (e.g. after a regrid) must fail loudly, not transfer on the stale
+    geometry."""
+    eg = getattr(fast, "grid", None)
+    if eg is not None and (tuple(eg.n) != tuple(grid.n)
+                           or eg.x_lo != grid.x_lo or eg.x_up != grid.x_up):
+        raise ValueError(
+            f"fast engine grid {tuple(eg.n)} != call grid "
+            f"{tuple(grid.n)}; rebuild the engine after regridding")
+
+
 class IBFEMethod:
     """FE-structure strategy for the explicit IB coupling integrator.
 
@@ -56,9 +77,18 @@ class IBFEMethod:
                  coupling: str = "unified",
                  damping: float = 0.0,
                  body_force: Optional[Callable] = None,
-                 dtype=jnp.float32):
+                 dtype=jnp.float32,
+                 fast=None):
         if coupling not in ("nodal", "unified"):
             raise ValueError(f"unknown IBFE coupling scheme {coupling!r}")
+        # optional transfer engine (FastInteraction / PackedInteraction
+        # / Pallas twins): IBFE quadrature/node clouds are ordinary
+        # marker clouds to the engines, so the FE coupling rides the
+        # same MXU/packed fast paths as the classic IB method; None =
+        # XLA scatter/gather (exact for either choice — the engines are
+        # roundoff-equal to the scatter oracle, tests pin it)
+        _check_fast_engine(fast, kernel)
+        self.fast = fast
         self.mesh = mesh
         self.asm: FEAssembly = build_assembly(mesh, dtype=dtype)
         self.W = W
@@ -86,11 +116,19 @@ class IBFEMethod:
                              X: jnp.ndarray, mask: jnp.ndarray,
                              ctx=None) -> jnp.ndarray:
         if self.coupling == "nodal":
+            if self.fast is not None:
+                _check_fast_grid(self.fast, grid)
+                return self.fast.interpolate_vel(u, X, weights=mask)
             return interaction.interpolate_vel(u, grid, X,
                                                kernel=self.kernel,
                                                weights=mask)
         xq = quad_positions(self.asm, X)
-        Uq = interaction.interpolate_vel(u, grid, xq, kernel=self.kernel)
+        if self.fast is not None:
+            _check_fast_grid(self.fast, grid)
+            Uq = self.fast.interpolate_vel(u, xq)
+        else:
+            Uq = interaction.interpolate_vel(u, grid, xq,
+                                             kernel=self.kernel)
         # nodal mask honored the same way the nodal path does: inactive
         # slots interpolate to zero (and so do not move)
         out = nodal_average_from_quads(self.asm.elems, self.asm.shape,
@@ -102,6 +140,9 @@ class IBFEMethod:
                      X: jnp.ndarray, mask: jnp.ndarray,
                      ctx=None) -> Vel:
         if self.coupling == "nodal":
+            if self.fast is not None:
+                _check_fast_grid(self.fast, grid)
+                return self.fast.spread_vel(F, X, weights=mask)
             return interaction.spread_vel(F, grid, X, kernel=self.kernel,
                                           weights=mask)
         # distribute each nodal force over its quadrature points with
@@ -113,6 +154,9 @@ class IBFEMethod:
                                  self.asm.wdV, self.asm.n_nodes,
                                  F * mask[:, None], ww_den=self._wwden)
         xq = quad_positions(self.asm, X)
+        if self.fast is not None:
+            _check_fast_grid(self.fast, grid)
+            return self.fast.spread_vel(Fq, xq)
         return interaction.spread_vel(Fq, grid, xq, kernel=self.kernel)
 
     # -- diagnostics ---------------------------------------------------------
@@ -136,13 +180,15 @@ class IBFESurfaceMethod:
     def __init__(self, mesh, W: Callable, kernel: Kernel = "IB_4",
                  coupling: str = "unified", damping: float = 0.0,
                  body_force: Optional[Callable] = None,
-                 dtype=jnp.float32):
+                 dtype=jnp.float32, fast=None):
         from ibamr_tpu.fe.surface import (SurfaceMesh,
                                           build_surface_assembly)
 
         if coupling not in ("nodal", "unified"):
             raise ValueError(f"unknown IBFE coupling scheme {coupling!r}")
         assert isinstance(mesh, SurfaceMesh)
+        _check_fast_engine(fast, kernel)
+        self.fast = fast
         self.mesh = mesh
         self.asm = build_surface_assembly(mesh, dtype=dtype)
         self.W = W
@@ -173,11 +219,19 @@ class IBFESurfaceMethod:
         from ibamr_tpu.fe.surface import surface_quad_positions
 
         if self.coupling == "nodal":
+            if self.fast is not None:
+                _check_fast_grid(self.fast, grid)
+                return self.fast.interpolate_vel(u, X, weights=mask)
             return interaction.interpolate_vel(u, grid, X,
                                                kernel=self.kernel,
                                                weights=mask)
         xq = surface_quad_positions(self.asm, X)
-        Uq = interaction.interpolate_vel(u, grid, xq, kernel=self.kernel)
+        if self.fast is not None:
+            _check_fast_grid(self.fast, grid)
+            Uq = self.fast.interpolate_vel(u, xq)
+        else:
+            Uq = interaction.interpolate_vel(u, grid, xq,
+                                            kernel=self.kernel)
         out = nodal_average_from_quads(self.asm.elems, self.asm.shape,
                                        self.asm.wdA, self.asm.n_nodes,
                                        Uq, ww_den=self._wwden)
@@ -190,12 +244,18 @@ class IBFESurfaceMethod:
         from ibamr_tpu.fe.surface import surface_quad_positions
 
         if self.coupling == "nodal":
+            if self.fast is not None:
+                _check_fast_grid(self.fast, grid)
+                return self.fast.spread_vel(F, X, weights=mask)
             return interaction.spread_vel(F, grid, X, kernel=self.kernel,
                                           weights=mask)
         Fq = distribute_to_quads(self.asm.elems, self.asm.shape,
                                  self.asm.wdA, self.asm.n_nodes,
                                  F * mask[:, None], ww_den=self._wwden)
         xq = surface_quad_positions(self.asm, X)
+        if self.fast is not None:
+            _check_fast_grid(self.fast, grid)
+            return self.fast.spread_vel(Fq, xq)
         return interaction.spread_vel(Fq, grid, xq, kernel=self.kernel)
 
     # -- diagnostics ---------------------------------------------------------
