@@ -1,10 +1,8 @@
-"""ex0 with adaptive refinement: a 2D elastic membrane advected by a
-background stream, tracked by a marker-tagged refined window on a
-2-level composite hierarchy (the flagship AMR-IB user path:
-TwoLevelIBINS + the host-side regrid cadence — the reference's
-GriddingAlgorithm/StandardTagAndInitialize loop, SURVEY.md par.3.4).
+"""ex4 with adaptive refinement: the 3D elastic shell in a background
+stream, tracked by a marker-tagged refined window on the composite
+two-level hierarchy (the reference's production adaptive-IB shape).
 
-Run:  python examples/IB/explicit/ex0_amr/main.py [input2d]
+Run:  python examples/IB/explicit/ex4_amr/main.py [input3d]
 """
 
 import os
@@ -23,47 +21,45 @@ from ibamr_tpu.amr import box_mac_to_cc  # noqa: E402
 from ibamr_tpu.amr_ins import (TwoLevelIBINS,  # noqa: E402
                                advance_two_level_ib_regridding,
                                box_from_markers)
-from ibamr_tpu.ops import stencils  # noqa: E402
 from ibamr_tpu.grid import StaggeredGrid  # noqa: E402
-from ibamr_tpu.integrators.ib import IBMethod, polygon_area  # noqa: E402
-from ibamr_tpu.models.membrane2d import make_circle_membrane  # noqa: E402
+from ibamr_tpu.integrators.ib import IBMethod  # noqa: E402
+from ibamr_tpu.io.vtk import VizWriter  # noqa: E402
+from ibamr_tpu.models.shell3d import (make_spherical_shell,  # noqa: E402
+                                      shell_volume)
+from ibamr_tpu.ops import stencils  # noqa: E402
 from ibamr_tpu.utils import (MetricsLogger, TimerManager,  # noqa: E402
                              parse_input_file)
 
 
 def main(argv):
     input_path = argv[1] if len(argv) > 1 else \
-        os.path.join(os.path.dirname(__file__), "input2d")
+        os.path.join(os.path.dirname(__file__), "input3d")
     db = parse_input_file(input_path)
     main_db = db.get_database("Main")
     ins_db = db.get_database("INSStaggeredHierarchyIntegrator")
     grid_db = db.get_database_with_default("GriddingAlgorithm")
-    mem_db = db.get_database("Membrane")
+    sh = db.get_database("Shell")
     geo = db.get_database("CartesianGeometry")
 
-    n = tuple(int(v) for v in geo.get_int_array("n_cells"))
     grid = StaggeredGrid(
-        n=n,
+        n=tuple(int(v) for v in geo.get_int_array("n_cells")),
         x_lo=tuple(float(v) for v in geo.get_array("x_lo")),
         x_up=tuple(float(v) for v in geo.get_array("x_up")))
 
-    struct = make_circle_membrane(
-        mem_db.get_int("num_markers"), mem_db.get_float("radius"),
-        tuple(float(v) for v in mem_db.get_array("center")),
-        stiffness=mem_db.get_float("stiffness"),
-        rest_length_factor=mem_db.get_float("rest_length_factor", 1.0),
-        aspect=mem_db.get_float("aspect", 1.0))
-    # f32 on the accelerator like ex0 (enable jax x64 for an f64 run);
-    # proj_tol sits above f32 roundoff so FGMRES terminates on the
-    # tolerance, not the iteration cap
+    center = tuple(float(v) for v in sh.get_array("center"))
+    struct = make_spherical_shell(
+        sh.get_int("n_lat"), sh.get_int("n_lon"), sh.get_float("radius"),
+        center=center, stiffness=sh.get_float("stiffness"),
+        rest_length_factor=sh.get_float("rest_length_factor", 1.0),
+        aspect=sh.get_float("aspect", 1.0))
     dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
     ib = IBMethod(struct.force_specs(dtype=dtype),
                   kernel=db.get_database_with_default("IBMethod")
                   .get_string("delta_fcn", "IB_4"))
 
     X0 = jnp.asarray(struct.vertices, dtype)
-    pad = grid_db.get_int("tag_buffer", 4)
-    box = box_from_markers(grid, X0, pad=pad)
+    box = box_from_markers(grid, X0,
+                           pad=grid_db.get_int("tag_buffer", 3))
     integ = TwoLevelIBINS(grid, box, ib,
                           rho=ins_db.get_float("rho", 1.0),
                           mu=ins_db.get_float("mu"),
@@ -71,12 +67,10 @@ def main(argv):
                           else 3e-6)
     u0 = db.get_database_with_default("Stream").get_float("u0", 0.0)
     state = integ.initialize(X0)
-    # background stream: a uniform (div-free) flow survives the
-    # composite projection and advects the membrane
     fluid = state.fluid
     state = state._replace(fluid=fluid._replace(
-        uc=(fluid.uc[0] + u0, fluid.uc[1]),
-        uf=(fluid.uf[0] + u0, fluid.uf[1])))
+        uc=(fluid.uc[0] + u0,) + fluid.uc[1:],
+        uf=(fluid.uf[0] + u0,) + fluid.uf[1:]))
 
     dt = ins_db.get_float("dt")
     lim = float(integ.core.stable_dt(state.fluid))
@@ -85,28 +79,23 @@ def main(argv):
               f"stability advisory {lim:g} (finest-level viscous/CFL "
               "limit); expect blow-up")
     num_steps = ins_db.get_int("num_steps")
-    regrid_int = grid_db.get_int("regrid_interval", 20)
+    regrid_int = grid_db.get_int("regrid_interval", 10)
     viz_int = main_db.get_int("viz_dump_interval", 0)
-    viz_dir = main_db.get_string("viz_dirname", "viz_ex0_amr")
-    os.makedirs(viz_dir, exist_ok=True)
-    metrics = MetricsLogger(main_db.get_string("log_file", "")
-                            or None)
-    from ibamr_tpu.io.vtk import VizWriter
+    viz_dir = main_db.get_string("viz_dirname", "viz_ex4_amr")
+    metrics = MetricsLogger(main_db.get_string("log_file", "") or None)
     viz = VizWriter(viz_dir, grid)
     tm = TimerManager()
 
-    a0 = float(polygon_area(state.X))
+    v0 = float(shell_volume(state.X, center))
     last_viz = [0]
 
     def on_chunk(ci, cs, done):
-        # host-side cadence hook: the regrid driver keeps its jit-chunk
-        # cache alive across the whole run (a static window never
-        # recompiles), and we observe/log between chunks. Viz/metrics
-        # time is scoped separately from the advance scope.
         metrics.log({
             "step": done,
             "t": float(cs.fluid.t),
-            "area_drift": float(polygon_area(cs.X)) / a0 - 1.0,
+            "volume_drift": float(shell_volume(
+                cs.X, tuple(np.mean(np.asarray(cs.X), axis=0)))) / v0
+            - 1.0,
             "window_lo": list(ci.box.lo),
             "max_div": float(ci.core.max_divergence(cs.fluid)),
             "x_center": float(jnp.mean(cs.X[:, 0])),
@@ -114,16 +103,16 @@ def main(argv):
         if viz_int and done // viz_int > last_viz[0]:
             last_viz[0] = done // viz_int
             with tm.scope("Main::viz"):
-                np.savetxt(os.path.join(viz_dir,
-                                        f"markers.{done:06d}.csv"),
-                           np.asarray(cs.X), delimiter=",")
-                # hierarchy dump: coarse + window velocity at centers
                 fg = ci.box.fine_grid(grid)
-                viz.dump_hierarchy(done, float(cs.fluid.t), [grid, fg], [
-                    {"u": tuple(np.asarray(c) for c in
-                                stencils.fc_to_cc(cs.fluid.uc))},
-                    {"u": tuple(np.asarray(c) for c in
-                                box_mac_to_cc(cs.fluid.uf))}])
+                viz.dump_hierarchy(
+                    done, float(cs.fluid.t), [grid, fg],
+                    [{"u": tuple(np.asarray(c) for c in
+                                 stencils.fc_to_cc(cs.fluid.uc))},
+                     {"u": tuple(np.asarray(c) for c in
+                                 box_mac_to_cc(cs.fluid.uf))}],
+                    fmt="binary")
+                viz.dump(done, float(cs.fluid.t),
+                         markers=np.asarray(cs.X))
 
     with tm.scope("IB::advanceHierarchy"):
         integ, state = advance_two_level_ib_regridding(

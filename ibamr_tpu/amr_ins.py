@@ -553,6 +553,19 @@ class TwoLevelINS:
                                 t=state.t + dt, k=state.k + 1)
 
     # -- diagnostics ---------------------------------------------------------
+    def stable_dt(self, state: TwoLevelINSState, cfl: float = 0.5):
+        """Advisory dt bound for the EXPLICIT predictor (host-side
+        diagnostic, the reference's getMaximumTimeStepSize analog):
+        min over levels of the advective CFL and the explicit viscous
+        limit rho dx^2 / (2 dim mu) at that level's spacing — the fine
+        level binds. Exceeding the viscous bound is the classic
+        silent-NaN failure of composite explicit stepping."""
+        out = jnp.asarray(jnp.inf, dtype=state.uc[0].dtype)
+        for us, dx in ((state.uc, self.grid.dx), (state.uf, self.dx_f)):
+            out = jnp.minimum(out, level_dt_limit(
+                us, dx, self.grid.dim, self.rho, self.mu, cfl))
+        return out
+
     def max_divergence(self, state: TwoLevelINSState):
         """(uncovered coarse incl. interface ring, fine interior)."""
         div_c = stencils.divergence(state.uc, self.grid.dx)
@@ -780,6 +793,22 @@ def regrid_two_level_ib(integ: TwoLevelIBINS, state: TwoLevelIBState,
                              k=state.fluid.k)
     return integ2, TwoLevelIBState(fluid=fluid, X=state.X, U=state.U,
                                    mask=state.mask)
+
+
+def level_dt_limit(us, dx, dim: int, rho: float, mu: float,
+                   cfl: float = 0.5):
+    """One level's explicit-predictor dt bound: advective CFL against
+    the level's max speed, and the explicit viscous limit
+    rho dx^2/(2 dim mu). Shared by the two-level and L-level advisory
+    diagnostics so the convention cannot diverge."""
+    dt0 = us[0].dtype
+    umax = jnp.maximum(jnp.asarray(1e-12, dtype=dt0),
+                       jnp.max(jnp.stack([jnp.max(jnp.abs(c))
+                                          for c in us])))
+    out = cfl * min(dx) / umax
+    if mu > 0.0:
+        out = jnp.minimum(out, rho * min(dx) ** 2 / (2.0 * dim * mu))
+    return out
 
 
 def advance_with_regrids(integ, state, dt: float, num_steps: int,

@@ -407,6 +407,20 @@ class MultiLevelINS:
     def max_divergence(self, state: MultiLevelINSState) -> Array:
         return self.proj.max_divergence(state.us)
 
+    def stable_dt(self, state: MultiLevelINSState, cfl: float = 0.5
+                  ) -> Array:
+        """Advisory explicit-predictor dt bound (see
+        TwoLevelINS.stable_dt): the FINEST level's advective CFL and
+        viscous limits bind."""
+        from ibamr_tpu.amr_ins import level_dt_limit
+
+        out = jnp.asarray(jnp.inf, dtype=state.us[0][0].dtype)
+        for spec, us in zip(self.levels, state.us):
+            out = jnp.minimum(out, level_dt_limit(
+                us, spec.grid.dx, spec.grid.dim, self.rho, self.mu,
+                cfl))
+        return out
+
 
 def advance_multilevel(integ: MultiLevelINS, state: MultiLevelINSState,
                        dt: float, num_steps: int) -> MultiLevelINSState:

@@ -103,6 +103,17 @@ class IBFEMethod:
                                        self.asm.wdV, self.asm.n_nodes)
 
     # -- IBStrategy surface --------------------------------------------------
+    def prepare(self, X: jnp.ndarray, mask: jnp.ndarray):
+        """Per-position transfer context for the fast engines: bucket
+        ONCE per structural position (nodal cloud, or the quad cloud it
+        determines) and reuse across the step's spread+interp calls —
+        the same ctx protocol IBMethod exposes."""
+        if self.fast is None:
+            return None
+        if self.coupling == "nodal":
+            return self.fast.buckets(X, mask)
+        return self.fast.buckets(quad_positions(self.asm, X))
+
     def compute_force(self, X: jnp.ndarray, U: jnp.ndarray,
                       t) -> jnp.ndarray:
         F = nodal_forces(self.asm, self.W, X)
@@ -118,14 +129,15 @@ class IBFEMethod:
         if self.coupling == "nodal":
             if self.fast is not None:
                 _check_fast_grid(self.fast, grid)
-                return self.fast.interpolate_vel(u, X, weights=mask)
+                return self.fast.interpolate_vel(u, X, weights=mask,
+                                                 b=ctx)
             return interaction.interpolate_vel(u, grid, X,
                                                kernel=self.kernel,
                                                weights=mask)
         xq = quad_positions(self.asm, X)
         if self.fast is not None:
             _check_fast_grid(self.fast, grid)
-            Uq = self.fast.interpolate_vel(u, xq)
+            Uq = self.fast.interpolate_vel(u, xq, b=ctx)
         else:
             Uq = interaction.interpolate_vel(u, grid, xq,
                                              kernel=self.kernel)
@@ -142,7 +154,7 @@ class IBFEMethod:
         if self.coupling == "nodal":
             if self.fast is not None:
                 _check_fast_grid(self.fast, grid)
-                return self.fast.spread_vel(F, X, weights=mask)
+                return self.fast.spread_vel(F, X, weights=mask, b=ctx)
             return interaction.spread_vel(F, grid, X, kernel=self.kernel,
                                           weights=mask)
         # distribute each nodal force over its quadrature points with
@@ -156,7 +168,7 @@ class IBFEMethod:
         xq = quad_positions(self.asm, X)
         if self.fast is not None:
             _check_fast_grid(self.fast, grid)
-            return self.fast.spread_vel(Fq, xq)
+            return self.fast.spread_vel(Fq, xq, b=ctx)
         return interaction.spread_vel(Fq, grid, xq, kernel=self.kernel)
 
     # -- diagnostics ---------------------------------------------------------
@@ -201,6 +213,16 @@ class IBFESurfaceMethod:
                                        self.asm.wdA, self.asm.n_nodes)
 
     # -- IBStrategy surface --------------------------------------------------
+    def prepare(self, X: jnp.ndarray, mask: jnp.ndarray):
+        """Per-position transfer context (see IBFEMethod.prepare)."""
+        from ibamr_tpu.fe.surface import surface_quad_positions
+
+        if self.fast is None:
+            return None
+        if self.coupling == "nodal":
+            return self.fast.buckets(X, mask)
+        return self.fast.buckets(surface_quad_positions(self.asm, X))
+
     def compute_force(self, X: jnp.ndarray, U: jnp.ndarray,
                       t) -> jnp.ndarray:
         from ibamr_tpu.fe.surface import membrane_forces
@@ -221,14 +243,15 @@ class IBFESurfaceMethod:
         if self.coupling == "nodal":
             if self.fast is not None:
                 _check_fast_grid(self.fast, grid)
-                return self.fast.interpolate_vel(u, X, weights=mask)
+                return self.fast.interpolate_vel(u, X, weights=mask,
+                                                 b=ctx)
             return interaction.interpolate_vel(u, grid, X,
                                                kernel=self.kernel,
                                                weights=mask)
         xq = surface_quad_positions(self.asm, X)
         if self.fast is not None:
             _check_fast_grid(self.fast, grid)
-            Uq = self.fast.interpolate_vel(u, xq)
+            Uq = self.fast.interpolate_vel(u, xq, b=ctx)
         else:
             Uq = interaction.interpolate_vel(u, grid, xq,
                                             kernel=self.kernel)
@@ -246,7 +269,7 @@ class IBFESurfaceMethod:
         if self.coupling == "nodal":
             if self.fast is not None:
                 _check_fast_grid(self.fast, grid)
-                return self.fast.spread_vel(F, X, weights=mask)
+                return self.fast.spread_vel(F, X, weights=mask, b=ctx)
             return interaction.spread_vel(F, grid, X, kernel=self.kernel,
                                           weights=mask)
         Fq = distribute_to_quads(self.asm.elems, self.asm.shape,
@@ -255,7 +278,7 @@ class IBFESurfaceMethod:
         xq = surface_quad_positions(self.asm, X)
         if self.fast is not None:
             _check_fast_grid(self.fast, grid)
-            return self.fast.spread_vel(Fq, xq)
+            return self.fast.spread_vel(Fq, xq, b=ctx)
         return interaction.spread_vel(Fq, grid, xq, kernel=self.kernel)
 
     # -- diagnostics ---------------------------------------------------------

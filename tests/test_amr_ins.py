@@ -195,3 +195,25 @@ def test_two_level_ib_3d_shell():
     # docstring; exact conservation is pinned in 2D): pole-weighted
     # sampling drifts ~2% as the taut shell settles
     assert abs(float(shell_volume(st.X, (0.5, 0.5, 0.5))) - v0) / abs(v0) < 3e-2
+
+
+def test_stable_dt_advisory():
+    """stable_dt flags the fine-level explicit viscous limit (the
+    silent-NaN failure mode the 3D adaptive example hit at mu=0.05,
+    dt=5e-4) and scales with the finest spacing."""
+    g = StaggeredGrid(n=(32, 32), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    box = FineBox(lo=(8, 8), shape=(16, 16))
+    tl = TwoLevelINS(g, box, mu=0.05, proj_tol=1e-8)
+    st = tl.initialize(tuple(jnp.zeros(g.n) for _ in range(2)))
+    lim = float(tl.stable_dt(st))
+    # viscous bound at dx_f = 1/64: rho dx^2/(2*2*mu) = (1/4096)/0.2
+    expect = (1.0 / 64.0) ** 2 / (4.0 * 0.05)
+    assert abs(lim - expect) / expect < 1e-6, (lim, expect)
+
+    from ibamr_tpu.amr_ins_multilevel import MultiLevelINS
+    ml = MultiLevelINS(g, [box, FineBox(lo=(8, 8), shape=(16, 16))],
+                       mu=0.05, proj_tol=1e-8)
+    sml = ml.initialize()
+    lim3 = float(ml.stable_dt(sml))
+    # finest level dx = 1/128: 4x tighter than the 2-level bound
+    assert abs(lim3 - expect / 4.0) / (expect / 4.0) < 1e-6

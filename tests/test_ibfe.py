@@ -193,20 +193,32 @@ def test_read_triangle_roundtrip(tmp_path):
 
 
 @pytest.mark.parametrize("coupling", ["nodal", "unified"])
-def test_fast_engine_matches_scatter(coupling):
+@pytest.mark.parametrize("family", ["volume", "surface"])
+def test_fast_engine_matches_scatter(coupling, family):
     """IBFE transfers through the MXU bucketed engine equal the XLA
     scatter path to roundoff — the FE quadrature/node clouds are
     ordinary marker clouds to the engines (same contract the classic
-    IB flagship pins)."""
+    IB flagship pins). Covers the volumetric AND codim-1 surface
+    strategies, with the prepare/ctx bucket-reuse protocol."""
     from ibamr_tpu.ops.interaction_fast import FastInteraction
 
     grid = StaggeredGrid(n=(32, 32), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
-    m = disc_mesh(radius=0.15, center=(0.5, 0.5), n_rings=4)
     eng = FastInteraction(grid, kernel="IB_4", tile=8, cap=64)
-    fe0 = IBFEMethod(m, neo_hookean(1.0, 4.0), coupling=coupling,
-                     dtype=F64)
-    fe1 = IBFEMethod(m, neo_hookean(1.0, 4.0), coupling=coupling,
-                     dtype=F64, fast=eng)
+    if family == "volume":
+        m = disc_mesh(radius=0.15, center=(0.5, 0.5), n_rings=4)
+        fe0 = IBFEMethod(m, neo_hookean(1.0, 4.0), coupling=coupling,
+                         dtype=F64)
+        fe1 = IBFEMethod(m, neo_hookean(1.0, 4.0), coupling=coupling,
+                         dtype=F64, fast=eng)
+    else:
+        from ibamr_tpu.fe import surface
+        from ibamr_tpu.integrators.ibfe import IBFESurfaceMethod
+
+        m = surface.ring_mesh(center=(0.5, 0.5), radius=0.15, n=48)
+        W = surface.neo_hookean_membrane(1.0, 2.0)
+        fe0 = IBFESurfaceMethod(m, W, coupling=coupling, dtype=F64)
+        fe1 = IBFESurfaceMethod(m, W, coupling=coupling, dtype=F64,
+                                fast=eng)
     rng = np.random.RandomState(3)
     X = jnp.asarray(m.nodes * 1.1 - 0.05, dtype=F64)
     F = jnp.asarray(rng.randn(m.n_nodes, 2), dtype=F64)
@@ -214,12 +226,13 @@ def test_fast_engine_matches_scatter(coupling):
     u = (jnp.asarray(rng.randn(*grid.n), dtype=F64),
          jnp.asarray(rng.randn(*grid.n), dtype=F64))
 
+    ctx = fe1.prepare(X, mask)
     f0 = fe0.spread_force(F, grid, X, mask)
-    f1 = fe1.spread_force(F, grid, X, mask)
+    f1 = fe1.spread_force(F, grid, X, mask, ctx=ctx)
     for a, b in zip(f0, f1):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-10, atol=1e-10)
     U0 = fe0.interpolate_velocity(u, grid, X, mask)
-    U1 = fe1.interpolate_velocity(u, grid, X, mask)
+    U1 = fe1.interpolate_velocity(u, grid, X, mask, ctx=ctx)
     np.testing.assert_allclose(np.asarray(U0), np.asarray(U1),
                                rtol=1e-10, atol=1e-10)
