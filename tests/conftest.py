@@ -129,7 +129,6 @@ SLOW_TESTS = {
     "test_hydrostatic_balance_no_spurious_currents",
     "test_three_level_tracks_uniform_fine_and_converges",
     "test_early_time_added_mass_free_fall",
-    "test_sedimentation_velocity_independent_of_virtual_mass",
     "test_vortex_3level_matches_uniform_finest",
     "test_membrane_ib_3level",
     "test_single_box_matches_two_level",
