@@ -386,7 +386,7 @@ def main():
             # and report the best configuration as the headline value.
             # Each leg is deadline-guarded; the pallas leg runs in a
             # terminable child (remote-compile stall history).
-            for label in ("packed", "pallas_packed", "packed_bf16"):
+            for label in ("packed", "packed_bf16", "pallas_packed"):
                 if time.perf_counter() - t_start > args.deadline:
                     errors.append(f"flagship[{label}]: skipped "
                                   "(deadline)")
