@@ -144,6 +144,20 @@ def build_shell_example(
                                                convective_op_type)
         ib_db = input_db.get_database_with_default("IBMethod")
         kernel = ib_db.get_string("delta_fcn", kernel)
+        # reference-style engine knob: IBMethod { transfer_engine =
+        # "auto"|"scatter"|"mxu"|"packed"|"pallas"|"pallas_packed"|
+        # "mxu_bf16"|"packed_bf16" }
+        if use_fast_interaction is None:
+            _KNOB = ("auto", "scatter", "mxu", "packed", "pallas",
+                     "pallas_packed", "mxu_bf16", "packed_bf16")
+            eng = ib_db.get_string("transfer_engine", "auto").lower()
+            if eng not in _KNOB:
+                raise ValueError(
+                    f"IBMethod.transfer_engine = {eng!r}: expected one "
+                    f"of {_KNOB}")
+            use_fast_interaction = {
+                "auto": None, "scatter": False, "mxu": True,
+            }.get(eng, eng)
         sh = input_db.get_database_with_default("Shell")
         n_lat = sh.get_int("n_lat", n_lat)
         n_lon = sh.get_int("n_lon", n_lon)

@@ -194,3 +194,28 @@ def test_bf16_compute_matches_f32_within_tolerance():
             / float(np.prod(g.dx))
         assert abs(lhs - rhs) < 2e-2 * max(abs(lhs), abs(rhs), 1e-6), \
             (lhs, rhs)
+
+
+def test_transfer_engine_input_key():
+    """The reference-style input knob IBMethod{transfer_engine=...}
+    selects the engine in build_shell_example; unknown names raise."""
+    import pytest
+
+    from ibamr_tpu.models.shell3d import build_shell_example
+    from ibamr_tpu.utils.input_db import parse_input_string
+
+    def db_for(eng):
+        return parse_input_string(f'''
+CartesianGeometry {{ n_cells = 16, 16, 16 }}
+Shell {{ n_lat = 24 n_lon = 24 }}
+IBMethod {{ transfer_engine = "{eng}" }}
+''')
+
+    for eng, cls in (("packed", "PackedInteraction"),
+                     ("scatter", "NoneType"),
+                     ("mxu", "FastInteraction"),
+                     ("mxu_bf16", "FastInteraction")):
+        integ, _ = build_shell_example(input_db=db_for(eng))
+        assert type(integ.ib.fast).__name__ == cls, eng
+    with pytest.raises(ValueError, match="transfer_engine"):
+        build_shell_example(input_db=db_for("bf16"))
