@@ -65,3 +65,31 @@ def test_profile_trace_noop_without_dir():
         pass
     with profile_trace(None):
         pass
+
+
+def test_packed_engine_bitwise_deterministic():
+    """The occupancy-packed engine's sort/segment pipeline is bitwise
+    repeatable: two independent bucket+spread+interp evaluations of the
+    same inputs are identical (sorted segment reductions, no atomics —
+    the determinism the reference's MPI reductions cannot promise)."""
+    import jax.numpy as jnp
+
+    from ibamr_tpu.models.shell3d import make_spherical_shell
+    from ibamr_tpu.ops.interaction_packed import PackedInteraction
+
+    g = StaggeredGrid(n=(32, 32, 32), x_lo=(0.0,) * 3, x_up=(1.0,) * 3)
+    s = make_spherical_shell(16, 16, 0.12, (0.5,) * 3, 1.0)
+    X = jnp.asarray(s.vertices, jnp.float32)
+    rng = np.random.default_rng(7)
+    F = jnp.asarray(rng.standard_normal((X.shape[0], 3)), jnp.float32)
+    u = tuple(jnp.asarray(rng.standard_normal(g.n), jnp.float32)
+              for _ in range(3))
+    eng = PackedInteraction(g, tile=8, chunk=128, nchunks=64)
+
+    f1 = eng.spread_vel(F, X)
+    f2 = eng.spread_vel(F, X)
+    for a, b in zip(f1, f2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    U1 = eng.interpolate_vel(u, X)
+    U2 = eng.interpolate_vel(u, X)
+    np.testing.assert_array_equal(np.asarray(U1), np.asarray(U2))
