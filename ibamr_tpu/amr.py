@@ -289,14 +289,23 @@ def fill_fine_ghosts(fine: jnp.ndarray, coarse: jnp.ndarray, box: FineBox,
     """Pad the fine interior with ghost layers interpolated from coarse
     (quadratic — T10's CF interpolation), keeping interior values exact.
     Only the O(surface) ghost shell is interpolated, from precomputed
-    static slab geometry."""
-    g = ghost
-    out = jnp.zeros(tuple(n + 2 * g for n in box.fine_n),
-                    dtype=fine.dtype)
-    inner = tuple(slice(g, g + n) for n in box.fine_n)
-    out = out.at[inner].set(fine)
-    for sl, pts in _ghost_slab_geometry(box, ghost, coarse.dtype.name):
-        out = out.at[sl].set(interp_periodic(coarse, pts, order=2))
+    static slab geometry.
+
+    Assembly is CONCATENATION in reverse-axis onion order (each axis's
+    slab pair spans the interior of earlier axes and the full extent of
+    later ones), not scatter-into-zeros: the SPMD partitioner
+    miscompiles the repeated static-slab ``.at[sl].set`` chain when the
+    result is pinned to a spatial sharding (wrong values, observed on
+    the 8-device CPU mesh in the sharded-window S4 path), while
+    gather + concatenate partitions correctly. Values are identical."""
+    out = fine
+    slabs = _ghost_slab_geometry(box, ghost, coarse.dtype.name)
+    for d in reversed(range(box.dim)):
+        _, lo_pts = slabs[2 * d]
+        _, hi_pts = slabs[2 * d + 1]
+        lo = interp_periodic(coarse, lo_pts, order=2)
+        hi = interp_periodic(coarse, hi_pts, order=2)
+        out = jnp.concatenate([lo, out, hi], axis=d)
     return out
 
 

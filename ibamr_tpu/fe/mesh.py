@@ -244,3 +244,131 @@ def box_hex_mesh(nx: int, ny: int, nz: int,
         nid[:-1, 1:, 1:]], axis=-1).reshape(-1, 8)
     return FEMesh(nodes=nodes, elems=elems.astype(np.int64),
                   elem_type="HEX8")
+
+
+# --------------------------------------------------------------------------
+# Gmsh MSH v2 ASCII import/export (T16 external-geometry path)
+# --------------------------------------------------------------------------
+
+# Gmsh element-type id -> (elem_type, nodes-per-element, topological dim).
+# Node orderings (Gmsh reference manual §9.3) match this module's
+# conventions directly for TRI3/TRI6/TET4/QUAD4/HEX8; TET10 differs in
+# the last two midside nodes — Gmsh stores e(2,3) at slot 8 and e(1,3)
+# at slot 9, while fem._shape_table's TET10 (libMesh order) wants
+# e(1,3) then e(2,3) — so slots 8 and 9 are swapped on read/write.
+_GMSH_TYPES = {
+    2: ("TRI3", 3, 2),
+    3: ("QUAD4", 4, 2),
+    4: ("TET4", 4, 3),
+    5: ("HEX8", 8, 3),
+    9: ("TRI6", 6, 2),
+    11: ("TET10", 10, 3),
+}
+_GMSH_IDS = {v[0]: (k, v[1], v[2]) for k, v in _GMSH_TYPES.items()}
+_TET10_GMSH_TO_LIBMESH = [0, 1, 2, 3, 4, 5, 6, 7, 9, 8]
+
+
+def read_gmsh(path: str, elem_type: str = None) -> FEMesh:
+    """Read a Gmsh ``.msh`` v2 ASCII file into an :class:`FEMesh` —
+    the rebuild's analog of the reference's libMesh mesh readers
+    (``FEDataManager`` geometry input via ``libMesh::ExodusII_IO`` /
+    ``GmshIO``, SURVEY.md T16 [U]): user geometries enter the IBFE
+    path from a file instead of the programmatic generators.
+
+    Supports the full element menu of :mod:`ibamr_tpu.fe.fem`
+    (TRI3/TRI6/QUAD4/TET4/TET10/HEX8). A file may carry several
+    element types (boundary lines/faces alongside the solid): the
+    reader keeps ``elem_type`` if given, else the highest-dimension
+    supported type present (the solid body). Node ids may be
+    non-contiguous (Gmsh never guarantees contiguity); they are
+    remapped densely and unreferenced nodes are dropped. For 2D
+    element types the z column is discarded (Gmsh always writes xyz).
+    """
+    with open(path) as f:
+        lines = [ln.strip() for ln in f]
+
+    def section(name):
+        try:
+            a = lines.index(f"${name}") + 1
+            b = lines.index(f"$End{name}")
+        except ValueError:
+            raise ValueError(f"{path}: missing ${name} section "
+                             "(is this MSH v2 ASCII?)")
+        return lines[a:b]
+
+    fmt = section("MeshFormat")[0].split()
+    if not fmt[0].startswith("2"):
+        raise ValueError(
+            f"{path}: MSH version {fmt[0]} unsupported (need v2 ASCII; "
+            "export with `gmsh -format msh2`)")
+    if int(fmt[1]) != 0:
+        raise ValueError(f"{path}: binary MSH unsupported")
+
+    node_lines = section("Nodes")
+    n_nodes = int(node_lines[0])
+    ids = np.empty(n_nodes, dtype=np.int64)
+    xyz = np.empty((n_nodes, 3), dtype=np.float64)
+    for r, ln in enumerate(node_lines[1:1 + n_nodes]):
+        t = ln.split()
+        ids[r] = int(t[0])
+        xyz[r] = [float(t[1]), float(t[2]), float(t[3])]
+    id2row = {int(i): r for r, i in enumerate(ids)}
+
+    elem_lines = section("Elements")
+    n_elems = int(elem_lines[0])
+    by_type = {}
+    for ln in elem_lines[1:1 + n_elems]:
+        t = ln.split()
+        gtype = int(t[1])
+        if gtype not in _GMSH_TYPES:
+            continue                      # points/lines/unsupported
+        name, nen, _ = _GMSH_TYPES[gtype]
+        ntags = int(t[2])
+        conn = [id2row[int(v)] for v in t[3 + ntags:3 + ntags + nen]]
+        by_type.setdefault(name, []).append(conn)
+    if not by_type:
+        raise ValueError(f"{path}: no supported volume/surface elements")
+
+    if elem_type is None:
+        elem_type = max(by_type, key=lambda k: (_GMSH_IDS[k][2],
+                                                len(by_type[k])))
+    if elem_type not in by_type:
+        raise ValueError(f"{path}: no {elem_type} elements "
+                         f"(found {sorted(by_type)})")
+    elems = np.asarray(by_type[elem_type], dtype=np.int64)
+    if elem_type == "TET10":
+        elems = elems[:, _TET10_GMSH_TO_LIBMESH]
+
+    dim = _GMSH_IDS[elem_type][2]
+    nodes = xyz[:, :dim]
+    # drop nodes not referenced by the kept element block (the file may
+    # carry boundary-only nodes); remap connectivity densely
+    used = np.unique(elems)
+    remap = -np.ones(nodes.shape[0], dtype=np.int64)
+    remap[used] = np.arange(used.size)
+    return FEMesh(nodes=nodes[used], elems=remap[elems],
+                  elem_type=elem_type)
+
+
+def write_gmsh(mesh: FEMesh, path: str) -> None:
+    """Write an :class:`FEMesh` as Gmsh ``.msh`` v2 ASCII (round-trip
+    partner of :func:`read_gmsh`; also lets generated meshes feed any
+    external Gmsh-reading tool)."""
+    gtype, nen, _ = _GMSH_IDS[mesh.elem_type]
+    elems = mesh.elems
+    if mesh.elem_type == "TET10":
+        inv = np.argsort(_TET10_GMSH_TO_LIBMESH)
+        elems = elems[:, inv]
+    with open(path, "w") as f:
+        f.write("$MeshFormat\n2.2 0 8\n$EndMeshFormat\n")
+        f.write(f"$Nodes\n{mesh.n_nodes}\n")
+        for i, p in enumerate(mesh.nodes):
+            x, y = p[0], p[1]
+            z = p[2] if mesh.dim == 3 else 0.0
+            f.write(f"{i + 1} {x:.17g} {y:.17g} {z:.17g}\n")
+        f.write("$EndNodes\n")
+        f.write(f"$Elements\n{mesh.n_elems}\n")
+        for e, conn in enumerate(elems):
+            nodes = " ".join(str(int(v) + 1) for v in conn)
+            f.write(f"{e + 1} {gtype} 2 0 0 {nodes}\n")
+        f.write("$EndElements\n")

@@ -68,6 +68,8 @@ class INSVCStaggeredIntegrator:
                  reinit_interval: int = 10,
                  cg_tol: float = 1e-8, cg_maxiter: int = 200,
                  precond: str = "mg",
+                 wall_axes: Optional[Sequence[bool]] = None,
+                 tangential=None,
                  dtype=jnp.float32):
         self.grid = grid
         self.rho = (float(rho0), float(rho1))
@@ -81,14 +83,45 @@ class INSVCStaggeredIntegrator:
         self.reinit_interval = int(reinit_interval)
         self.cg_tol = float(cg_tol)
         self.cg_maxiter = int(cg_maxiter)
+        # wall_axes[d] puts NO-SLIP physical walls on both sides of
+        # axis d (pinned-face storage of integrators.ins_walls: the
+        # wall-NORMAL component's slot 0 along d is the lo wall face,
+        # pinned to 0; the hi wall face is its periodic-wrap image), the
+        # non-periodic half of P22 the reference runs its tanks with
+        # (INSVCStaggeredHierarchyIntegrator wall BCs, SURVEY.md §2.2).
+        # tangential[(d, e, side)] prescribes component d's tangential
+        # velocity on the side (0=lo/1=hi) wall of axis e (moving lid).
+        self.wall_axes = (tuple(bool(w) for w in wall_axes)
+                          if wall_axes is not None
+                          else (False,) * grid.dim)
+        self.tangential = dict(tangential or {})
         if precond not in ("fft", "mg"):
             raise ValueError(f"unknown preconditioner {precond!r}")
+        if any(self.wall_axes) and precond == "fft":
+            raise ValueError(
+                "wall-bounded VC-INS requires the 'mg' preconditioner "
+                "(the FFT inverse assumes a fully periodic domain)")
         # "fft": exact constant-coefficient inverse (iterations grow
         # with the density ratio); "mg": one V-cycle of the TRUE
         # variable-coefficient operator (ratio-robust — the reference's
         # FAC-preconditioned VC Poisson, SURVEY.md T8/P22)
         self.precond = precond
         self.dtype = dtype
+
+    # -- wall helpers --------------------------------------------------------
+    def _pin_normal(self, c: jnp.ndarray, d: int) -> jnp.ndarray:
+        """Zero the pinned wall-face slot of component d (wall axes)."""
+        from ibamr_tpu.integrators.ins_walls import pin_normal
+
+        return pin_normal(c, d, self.wall_axes)
+
+    def _proj_bc(self):
+        """Pressure-Poisson BCs: Neumann at walls, periodic elsewhere
+        (the discrete counterpart of the masked wall-face gradient)."""
+        from ibamr_tpu.bc import AxisBC, DomainBC, neumann_axis
+
+        return DomainBC(axes=tuple(
+            neumann_axis() if w else AxisBC() for w in self.wall_axes))
 
     # -- material fields -----------------------------------------------------
     def density(self, phi: jnp.ndarray) -> jnp.ndarray:
@@ -125,6 +158,12 @@ class INSVCStaggeredIntegrator:
                                  for d in range(g.dim))
         else:
             raise ValueError(f"unknown face_rule {face_rule!r}")
+        # masking the wall-face coefficient makes the operator's wall
+        # rows homogeneous-Neumann AND keeps the velocity correction
+        # from touching the pinned faces — one mask, both halves of the
+        # discrete-exactness argument (see ins_walls module docstring)
+        inv_rho_face = tuple(self._pin_normal(c, d)
+                             for d, c in enumerate(inv_rho_face))
         div = stencils.divergence(u, dx)
         div = div - jnp.mean(div)
         rho_ref = min(self.rho)
@@ -147,7 +186,9 @@ class INSVCStaggeredIntegrator:
             # — the level hierarchy (coefficient coarsening, diagonals)
             # traces into the step; shapes are static so this compiles
             # once. Note A is the NEGATED operator, so M negates too.
-            mg = PoissonMultigrid(g.n, DomainBC.periodic(g.dim), dx,
+            bc = (self._proj_bc() if any(self.wall_axes)
+                  else DomainBC.periodic(g.dim))
+            mg = PoissonMultigrid(g.n, bc, dx,
                                   D=dt / rho_cc, dtype=rho_cc.dtype)
 
             def M(r):
@@ -160,22 +201,46 @@ class INSVCStaggeredIntegrator:
                 return -fft.solve_poisson_periodic(r / (dt / rho_ref),
                                                    dx)
 
-        res = krylov.cg(A, -div, M=M, tol=self.cg_tol,
+        # clamp the tolerance to the dtype's reachable floor: an f32
+        # production run configured with the f64 default (1e-8) must
+        # iterate to ITS roundoff floor and stop, not chase an
+        # unreachable residual past the divergence guard
+        eps = float(jnp.finfo(rho_cc.dtype).eps)
+        tol_eff = max(self.cg_tol, 20.0 * eps)
+        res = krylov.cg(A, -div, M=M, tol=tol_eff,
                         maxiter=self.cg_maxiter)
         p = res.x - jnp.mean(res.x)
         gp = stencils.gradient(p, dx)
-        u_new = tuple(c - dt * rf * gc
-                      for c, rf, gc in zip(u, inv_rho_face, gp))
+        u_new = tuple(self._pin_normal(c - dt * rf * gc, d)
+                      for d, (c, rf, gc)
+                      in enumerate(zip(u, inv_rho_face, gp)))
         return u_new, p
 
     # -- variable-viscosity stress -------------------------------------------
     def _viscous_force(self, u: Vel, mu_cc: jnp.ndarray) -> Vel:
         """div(2 mu D(u)) on the MAC grid (explicit). Diagonal terms use
         cell-centered mu; off-diagonal terms use mu averaged to the
-        transverse-face (edge-like) locations."""
+        transverse-face (edge-like) locations.
+
+        Wall axes (pinned-face storage): the DIAGONAL term's rolls stay
+        exact (both wall faces carry 0 for the normal component, and
+        the wall-face output rows are pinned anyway). The OFF-DIAGONAL
+        term for component d across wall axis j needs the true wall
+        shear: tau_dj at the wall edge = mu_wall * 2 (u_d - V_wall)/dx_j
+        (half-cell one-sided gradient against the prescribed tangential
+        velocity; du_j/dx_d vanishes on the wall since u_j = 0 along
+        it), with mu_wall the even-reflection (adjacent-cell) viscosity
+        — assembled by CONCATENATING [lo-wall edge, interior edges,
+        hi-wall edge] along j (n+1 edge planes) and differencing."""
         g = self.grid
         dim = g.dim
         dx = g.dx
+
+        def take(a, axis, lo, hi):
+            idx = [slice(None)] * a.ndim
+            idx[axis] = slice(lo, hi)
+            return a[tuple(idx)]
+
         out = []
         for d in range(dim):
             acc = None
@@ -193,9 +258,26 @@ class INSVCStaggeredIntegrator:
                                    + jnp.roll(mu_cc, 1, j)
                                    + jnp.roll(jnp.roll(mu_cc, 1, d), 1, j))
                     tau = mu_e * (dudj + dujd)
-                    term = (jnp.roll(tau, -1, j) - tau) / dx[j]
+                    if self.wall_axes[j]:
+                        nj = u[d].shape[j]
+                        # mu averaged along d to the face, one-sided in j
+                        mu_d = 0.5 * (mu_cc + jnp.roll(mu_cc, 1, d))
+                        v_lo = self.tangential.get((d, j, 0), 0.0)
+                        v_hi = self.tangential.get((d, j, 1), 0.0)
+                        t_lo = (take(mu_d, j, 0, 1)
+                                * 2.0 * (take(u[d], j, 0, 1) - v_lo)
+                                / dx[j])
+                        t_hi = (take(mu_d, j, nj - 1, nj)
+                                * 2.0 * (v_hi - take(u[d], j, nj - 1, nj))
+                                / dx[j])
+                        tau_full = jnp.concatenate(
+                            [t_lo, take(tau, j, 1, nj), t_hi], axis=j)
+                        term = (take(tau_full, j, 1, nj + 1)
+                                - take(tau_full, j, 0, nj)) / dx[j]
+                    else:
+                        term = (jnp.roll(tau, -1, j) - tau) / dx[j]
                 acc = term if acc is None else acc + term
-            out.append(acc)
+            out.append(self._pin_normal(acc, d))
         return tuple(out)
 
     # -- surface tension + gravity -------------------------------------------
@@ -215,7 +297,8 @@ class INSVCStaggeredIntegrator:
         g = self.grid
         dx = g.dx
         out = []
-        kap = ls.curvature(phi, dx) if self.sigma else None
+        kap = (ls.curvature(phi, dx, wall_axes=self.wall_axes)
+               if self.sigma else None)
         dlt = ls.delta(phi, self.eps) if self.sigma else None
         drho = rho_cc - jnp.mean(rho_cc)
         for d in range(g.dim):
@@ -223,7 +306,7 @@ class INSVCStaggeredIntegrator:
             if self.sigma:
                 gphi = (phi - jnp.roll(phi, 1, d)) / dx[d]
                 f = f + self.sigma * _cc_to_face(kap * dlt, d) * gphi
-            out.append(f)
+            out.append(self._pin_normal(f, d))
         return tuple(out)
 
     # -- state / stepping ----------------------------------------------------
@@ -264,7 +347,7 @@ class INSVCStaggeredIntegrator:
             n_curr = tuple(jnp.zeros_like(c) for c in u)
             n_star = n_curr
         else:
-            n_curr = convective_rate(u, dx, self.convective_op_type)
+            n_curr = self._convective(u)
             c1 = jnp.where(state.k == 0, 1.0, 1.5).astype(self.dtype)
             c2 = jnp.where(state.k == 0, 0.0, -0.5).astype(self.dtype)
             n_star = tuple(c1 * a + c2 * b
@@ -280,7 +363,7 @@ class INSVCStaggeredIntegrator:
                    + (visc[d] + body[d] - gp[d]) * inv_rho_face[d])
             if f is not None:
                 rhs = rhs + f[d] * inv_rho_face[d]
-            u_star.append(u[d] + dt * rhs)
+            u_star.append(self._pin_normal(u[d] + dt * rhs, d))
 
         # variable-density pressure-increment projection
         u_new, dp = self.project_vc(tuple(u_star), rho_cc, dt)
@@ -292,13 +375,30 @@ class INSVCStaggeredIntegrator:
         return VCINSState(u=u_new, p=p_new, phi=phi_new, n_prev=n_curr,
                           t=state.t + dt, k=state.k + 1)
 
+    def _convective(self, u: Vel) -> Vel:
+        """N(u) — BC-aware ghost-padded path when any axis is walled
+        (the wall-edge momentum fluxes vanish and tangential lids enter
+        through the Dirichlet ghosts), periodic rolls otherwise."""
+        if any(self.wall_axes):
+            from ibamr_tpu.ops.convection import convective_rate_bc
+
+            return convective_rate_bc(
+                u, self.grid.dx, scheme=self.convective_op_type,
+                wall_axes=self.wall_axes,
+                wall_tangential=self.tangential)
+        return convective_rate(u, self.grid.dx, self.convective_op_type)
+
     def _transport_level_set(self, phi, u_new: Vel, dt, k):
         """Godunov advection + cadenced reinitialization (shared by the
-        non-conservative and conservative steps)."""
-        phi_new = advect(phi, u_new, self.grid.dx, dt)
+        non-conservative and conservative steps). Wall axes ride the
+        pinned-face convention: wall-face fluxes vanish identically, so
+        the advection conserves mass in the walled box too."""
+        wa = self.wall_axes if any(self.wall_axes) else None
+        phi_new = advect(phi, u_new, self.grid.dx, dt, wall_axes=wa)
         return jax.lax.cond(
             jnp.mod(k + 1, self.reinit_interval) == 0,
-            lambda q: ls.reinitialize(q, self.grid.dx, iters=20),
+            lambda q: ls.reinitialize(q, self.grid.dx, iters=20,
+                                      wall_axes=wa),
             lambda q: q, phi_new)
 
     # -- diagnostics ---------------------------------------------------------
@@ -439,7 +539,8 @@ class INSVCConservativeIntegrator(INSVCStaggeredIntegrator):
             rhs = -adv[d] + visc[d] + body[d] - gp[d]
             if f is not None:
                 rhs = rhs + f[d]
-            u_star.append((m + dt * rhs) / _cc_to_face(rho_new, d))
+            u_star.append(self._pin_normal(
+                (m + dt * rhs) / _cc_to_face(rho_new, d), d))
 
         # 3. variable-density pressure-increment projection with the
         # MATCHING arithmetic face coefficient

@@ -39,6 +39,18 @@ def _axis_bc(wall: bool, kind_builder) -> AxisBC:
     return kind_builder() if wall else AxisBC()
 
 
+def pin_normal(c: jnp.ndarray, d: int, wall_axes) -> jnp.ndarray:
+    """Zero the pinned wall-face slot of MAC component d (the storage
+    convention of this module: slot 0 along a wall axis is the lo wall
+    face; the hi wall face is its periodic-wrap image). Shared by every
+    wall-bounded integrator so the convention is single-sourced."""
+    if not wall_axes[d]:
+        return c
+    idx = [slice(None)] * c.ndim
+    idx[d] = slice(0, 1)
+    return c.at[tuple(idx)].set(0.0)
+
+
 class WallOps:
     """Per-grid wall-aware operators + solvers, built once per config.
 
@@ -117,11 +129,7 @@ class WallOps:
     # -- masks ---------------------------------------------------------------
     def _pin_normal(self, c: jnp.ndarray, d: int) -> jnp.ndarray:
         """Zero the pinned wall-face slot of component d (wall axes only)."""
-        if not self.wall_axes[d]:
-            return c
-        idx = [slice(None)] * c.ndim
-        idx[d] = slice(0, 1)
-        return c.at[tuple(idx)].set(0.0)
+        return pin_normal(c, d, self.wall_axes)
 
     # -- operators -----------------------------------------------------------
     def laplacian_vel(self, u: Sequence[jnp.ndarray],

@@ -17,17 +17,30 @@ per axis under jit.
 
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 
 Vel = Tuple[jnp.ndarray, ...]
 
 
-def mc_limited_slope(Q: jnp.ndarray, axis: int) -> jnp.ndarray:
-    """Monotonized-central limited undivided slope (van Leer MC)."""
+def mc_limited_slope(Q: jnp.ndarray, axis: int,
+                     wall: bool = False) -> jnp.ndarray:
+    """Monotonized-central limited undivided slope (van Leer MC).
+
+    ``wall`` treats both ends of ``axis`` as domain walls: the
+    cross-wall (periodic-wrap) differences are zeroed — the
+    even-reflection ghost — which limits the boundary cells' slopes to
+    0 instead of polluting them with the opposite wall's values."""
     dp = jnp.roll(Q, -1, axis) - Q
     dm = Q - jnp.roll(Q, 1, axis)
+    if wall:
+        from ibamr_tpu.ops.stencils import wall_boundary_masks
+
+        is_lo, is_hi = wall_boundary_masks(Q.shape, axis)
+        dm = jnp.where(is_lo, 0.0, dm)
+        dp = jnp.where(is_hi, 0.0, dp)
     dc = 0.5 * (dp + dm)
     s = jnp.sign(dc)
     mag = jnp.minimum(jnp.abs(dc),
@@ -36,10 +49,11 @@ def mc_limited_slope(Q: jnp.ndarray, axis: int) -> jnp.ndarray:
 
 
 def _face_states(Q: jnp.ndarray, u: jnp.ndarray, d: int, dx: float,
-                 dt: float) -> Tuple[jnp.ndarray, jnp.ndarray]:
+                 dt: float, wall: bool = False
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Left/right predicted states at the lower d-faces (PLM in space +
     half-dt characteristic tracing along d)."""
-    slope = mc_limited_slope(Q, d)
+    slope = mc_limited_slope(Q, d, wall=wall)
     nu = u * dt / dx           # face CFL number
     # left state: from cell i-1, traced toward the face over dt/2
     qL = jnp.roll(Q, 1, d) + 0.5 * (1.0 - jnp.maximum(nu, 0.0)) \
@@ -51,15 +65,26 @@ def _face_states(Q: jnp.ndarray, u: jnp.ndarray, d: int, dx: float,
 
 def godunov_face_values(Q: jnp.ndarray, u: Vel,
                         dx: Sequence[float], dt: float,
-                        ctu: bool = True) -> Vel:
+                        ctu: bool = True,
+                        wall_axes: Optional[Sequence[bool]] = None) -> Vel:
     """Time-centered face values q^{n+1/2} at the lower faces of each
     axis; ``u`` is the advecting MAC velocity. With ``ctu``, transverse
     derivative corrections (corner transport upwind) lift the stability
-    limit to the full multidimensional CFL."""
+    limit to the full multidimensional CFL.
+
+    ``wall_axes[d]`` marks axis d as wall-bounded under the pinned-face
+    storage convention (ins_walls): the advecting normal velocity
+    carries 0 at both wall faces, so every wall-face flux vanishes and
+    the flux-divergence rolls stay EXACT; the only wall correction
+    needed is the even-reflection slope limit at boundary cells
+    (mc_limited_slope ``wall``)."""
     dim = Q.ndim
+    if wall_axes is None:
+        wall_axes = (False,) * dim
     faces = []
     for d in range(dim):
-        qL, qR = _face_states(Q, u[d], d, dx[d], dt)
+        qL, qR = _face_states(Q, u[d], d, dx[d], dt,
+                              wall=wall_axes[d])
         if ctu:
             corr = jnp.zeros_like(Q)
             for a in range(dim):
@@ -77,10 +102,14 @@ def godunov_face_values(Q: jnp.ndarray, u: Vel,
 
 
 def advect(Q: jnp.ndarray, u: Vel, dx: Sequence[float], dt: float,
-           ctu: bool = True) -> jnp.ndarray:
+           ctu: bool = True,
+           wall_axes: Optional[Sequence[bool]] = None) -> jnp.ndarray:
     """One conservative Godunov advection step:
-    Q - dt div(u q^{n+1/2}) (flux form -> exact mass conservation)."""
-    qf = godunov_face_values(Q, u, dx, dt, ctu=ctu)
+    Q - dt div(u q^{n+1/2}) (flux form -> exact mass conservation).
+    ``wall_axes`` — see godunov_face_values (wall-face fluxes vanish
+    under the pinned-face convention, so conservation holds in the
+    walled box too)."""
+    qf = godunov_face_values(Q, u, dx, dt, ctu=ctu, wall_axes=wall_axes)
     out = Q
     for d in range(Q.ndim):
         F = u[d] * qf[d]

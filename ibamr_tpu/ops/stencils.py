@@ -177,3 +177,15 @@ def strain_rate_magnitude_cc(u: Sequence[jnp.ndarray],
             t = e * e
             acc = t if acc is None else acc + t
     return jnp.sqrt(2.0 * acc)
+
+
+def wall_boundary_masks(shape, axis: int):
+    """(is_lo, is_hi) boolean masks of the first/last cell layer along
+    ``axis`` — THE helper for zeroing/replacing cross-wall periodic-wrap
+    differences under the even-reflection ghost convention (shared by
+    the Godunov slope limiter and the level-set wall machinery so the
+    convention is single-sourced)."""
+    import jax
+
+    i = jax.lax.broadcasted_iota(jnp.int32, tuple(shape), axis)
+    return i == 0, i == shape[axis] - 1

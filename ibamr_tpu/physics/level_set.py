@@ -53,39 +53,72 @@ def phase_volume(phi: jnp.ndarray, grid: StaggeredGrid,
     return jnp.sum(1.0 - heaviside(phi, eps)) * grid.cell_volume
 
 
-def gradient_norm(phi: jnp.ndarray, dx: Sequence[float]) -> jnp.ndarray:
-    """|grad phi| with central differences (diagnostic)."""
+def _central_grad(phi: jnp.ndarray, d: int, dx_d: float,
+                  wall: bool) -> jnp.ndarray:
+    """Central difference along d; with ``wall``, one-sided at the
+    boundary cells instead of the periodic wrap."""
+    g = (jnp.roll(phi, -1, d) - jnp.roll(phi, 1, d)) / (2.0 * dx_d)
+    if wall:
+        from ibamr_tpu.ops.stencils import wall_boundary_masks
+
+        is_lo, is_hi = wall_boundary_masks(phi.shape, d)
+        one_lo = (jnp.roll(phi, -1, d) - phi) / dx_d
+        one_hi = (phi - jnp.roll(phi, 1, d)) / dx_d
+        g = jnp.where(is_lo, one_lo, jnp.where(is_hi, one_hi, g))
+    return g
+
+
+def gradient_norm(phi: jnp.ndarray, dx: Sequence[float],
+                  wall_axes=None) -> jnp.ndarray:
+    """|grad phi| with central differences (diagnostic); one-sided at
+    walls when ``wall_axes`` marks an axis wall-bounded."""
+    if wall_axes is None:
+        wall_axes = (False,) * phi.ndim
     out = jnp.zeros_like(phi)
     for d in range(phi.ndim):
-        g = (jnp.roll(phi, -1, d) - jnp.roll(phi, 1, d)) / (2.0 * dx[d])
+        g = _central_grad(phi, d, dx[d], wall_axes[d])
         out = out + g * g
     return jnp.sqrt(out)
 
 
-def curvature(phi: jnp.ndarray, dx: Sequence[float]) -> jnp.ndarray:
-    """Interface curvature kappa = div(grad phi / |grad phi|)."""
+def curvature(phi: jnp.ndarray, dx: Sequence[float],
+              wall_axes=None) -> jnp.ndarray:
+    """Interface curvature kappa = div(grad phi / |grad phi|);
+    one-sided wall differences when ``wall_axes`` is given."""
     dim = phi.ndim
-    grads = [(jnp.roll(phi, -1, d) - jnp.roll(phi, 1, d)) / (2.0 * dx[d])
+    if wall_axes is None:
+        wall_axes = (False,) * dim
+    grads = [_central_grad(phi, d, dx[d], wall_axes[d])
              for d in range(dim)]
     mag = jnp.sqrt(sum(g * g for g in grads) + 1e-12)
     kap = jnp.zeros_like(phi)
     for d in range(dim):
         nd = grads[d] / mag
-        kap = kap + (jnp.roll(nd, -1, d) - jnp.roll(nd, 1, d)) \
-            / (2.0 * dx[d])
+        kap = kap + _central_grad(nd, d, dx[d], wall_axes[d])
     return kap
 
 
 # -- Godunov Hamiltonian -----------------------------------------------------
 
 def _godunov_grad_mag(phi: jnp.ndarray, dx: Sequence[float],
-                      sgn: jnp.ndarray) -> jnp.ndarray:
-    """Godunov-upwinded |grad phi| for the reinitialization equation."""
+                      sgn: jnp.ndarray,
+                      wall_axes=None) -> jnp.ndarray:
+    """Godunov-upwinded |grad phi| for the reinitialization equation.
+    ``wall_axes[d]`` zeroes the cross-wall (wrap) one-sided differences
+    of axis d — the even-reflection ghost for a walled domain."""
     dim = phi.ndim
+    if wall_axes is None:
+        wall_axes = (False,) * dim
     acc = jnp.zeros_like(phi)
     for d in range(dim):
         dm = (phi - jnp.roll(phi, 1, d)) / dx[d]     # backward
         dp = (jnp.roll(phi, -1, d) - phi) / dx[d]    # forward
+        if wall_axes[d]:
+            from ibamr_tpu.ops.stencils import wall_boundary_masks
+
+            is_lo, is_hi = wall_boundary_masks(phi.shape, d)
+            dm = jnp.where(is_lo, 0.0, dm)
+            dp = jnp.where(is_hi, 0.0, dp)
         # moving outward from the interface: use the upwind choice
         a = jnp.where(sgn >= 0,
                       jnp.maximum(jnp.maximum(dm, 0.0) ** 2,
@@ -96,24 +129,39 @@ def _godunov_grad_mag(phi: jnp.ndarray, dx: Sequence[float],
     return jnp.sqrt(acc)
 
 
-def _interface_cells(phi: jnp.ndarray) -> jnp.ndarray:
-    """Mask of cells whose stencil straddles the zero level."""
+def _interface_cells(phi: jnp.ndarray, wall_axes=None) -> jnp.ndarray:
+    """Mask of cells whose stencil straddles the zero level. With
+    ``wall_axes``, cross-wall (wrap) sign changes are NOT interface
+    cells — e.g. a pool's floor row against the air above the domain
+    top must not be relaxed by the subcell fix."""
+    if wall_axes is None:
+        wall_axes = (False,) * phi.ndim
     near = jnp.zeros_like(phi, dtype=bool)
     for d in range(phi.ndim):
-        near = near | (phi * jnp.roll(phi, 1, d) < 0.0) \
-            | (phi * jnp.roll(phi, -1, d) < 0.0)
+        lo = phi * jnp.roll(phi, 1, d) < 0.0
+        hi = phi * jnp.roll(phi, -1, d) < 0.0
+        if wall_axes[d]:
+            from ibamr_tpu.ops.stencils import wall_boundary_masks
+
+            is_lo, is_hi = wall_boundary_masks(phi.shape, d)
+            lo = lo & ~is_lo
+            hi = hi & ~is_hi
+        near = near | lo | hi
     return near
 
 
 def reinitialize(phi: jnp.ndarray, dx: Sequence[float],
                  iters: int = 40,
-                 dtau: float = None) -> jnp.ndarray:
+                 dtau: float = None,
+                 wall_axes=None) -> jnp.ndarray:
     """Relaxation reinitialization toward a signed-distance function.
 
     d phi / d tau = S(phi_0) (1 - |grad phi|), Godunov upwinding, with
     the Russo-Smereka subcell fix in interface cells: there the update
     drives phi toward (D * sgn) where D is the subcell distance estimate
     phi_0 / |grad phi_0|, so the zero level set does not drift.
+    ``wall_axes`` marks wall-bounded axes (even-reflection differences
+    at the walls instead of the periodic wrap).
     """
     h = min(dx)
     if dtau is None:
@@ -121,12 +169,12 @@ def reinitialize(phi: jnp.ndarray, dx: Sequence[float],
     phi0 = phi
     sgn = phi0 / jnp.sqrt(phi0 * phi0 + h * h)      # smoothed (far field)
     sgn_hard = jnp.where(phi0 >= 0.0, 1.0, -1.0)    # true sign (subcell fix)
-    near = _interface_cells(phi0)
-    g0 = jnp.maximum(gradient_norm(phi0, dx), 1e-8)
+    near = _interface_cells(phi0, wall_axes=wall_axes)
+    g0 = jnp.maximum(gradient_norm(phi0, dx, wall_axes=wall_axes), 1e-8)
     D = phi0 / g0                                   # subcell distance
 
     def body(_, p):
-        gm = _godunov_grad_mag(p, dx, sgn)
+        gm = _godunov_grad_mag(p, dx, sgn, wall_axes=wall_axes)
         upd_far = p + dtau * sgn * (1.0 - gm)
         # Russo-Smereka: relax interface cells to the frozen subcell
         # distance. The TRUE sign is essential here — the smoothed sgn

@@ -393,6 +393,13 @@ class WaveTank:
             # form with harmonic (ins_vc.project_vc docstring)
             rule = "arithmetic" if rho is not None else "harmonic"
             u, _ = self.integ.project_vc(u, rho_cc, dt, face_rule=rule)
+        wall_axes = getattr(self.integ, "wall_axes", None)
+        if wall_axes is not None and any(wall_axes):
+            # a wall-bounded integrator (the PHYSICAL floor/end-wall
+            # alternative to the Brinkman slabs): re-pin the wall-normal
+            # faces the zone blending may have touched
+            u = tuple(self.integ._pin_normal(c, d)
+                      for d, c in enumerate(u))
         st = st._replace(phi=phi, u=u)
         if rho is not None:
             st = st._replace(rho=rho)

@@ -65,14 +65,24 @@ def cg(A: Operator, b: Pytree, x0: Optional[Pytree] = None,
     z0 = M(r0)
     p0 = z0
     rz0 = tree_dot(r0, z0)
+    rn0 = jnp.sqrt(tree_dot(r0, r0))
 
+    # Finite-precision divergence guard: when ``tol`` is below the
+    # dtype's reachable floor (an f32 solve asked for 1e-9), the
+    # recurred residual bottoms out at roundoff and further iterations
+    # LOSE conjugacy — the iterate can then wander arbitrarily far
+    # (observed: div 1e10 from the VC projection in f32). Track the
+    # best iterate seen; stop once the residual has grown far past the
+    # best (the run is diverging, not converging); return the BEST
+    # iterate when the solve did not converge. Converged solves return
+    # the final iterate exactly as before (bitwise-identical path).
     def cond(st):
-        x, r, z, p, rz, k = st
-        rn = jnp.sqrt(tree_dot(r, r))
-        return jnp.logical_and(k < maxiter, rn > stop)
+        x, r, z, p, rz, k, rn, xb, rb = st
+        ok = jnp.logical_and(k < maxiter, rn > stop)
+        return jnp.logical_and(ok, rn <= 1e4 * rb)
 
     def body(st):
-        x, r, z, p, rz, k = st
+        x, r, z, p, rz, k, _, xb, rb = st
         Ap = A(p)
         pAp = tree_dot(p, Ap)
         # guard against breakdown (pAp ~ 0 when r ~ 0)
@@ -83,12 +93,21 @@ def cg(A: Operator, b: Pytree, x0: Optional[Pytree] = None,
         rz_new = tree_dot(r, z)
         beta = jnp.where(rz > 0, rz_new / jnp.where(rz == 0, 1.0, rz), 0.0)
         p = tree_axpy(beta, p, z)
-        return (x, r, z, p, rz_new, k + 1)
+        rn = jnp.sqrt(tree_dot(r, r))    # carried: cond reuses it
+        better = rn < rb
+        xb = jax.tree_util.tree_map(
+            lambda a_, b_: jnp.where(better, a_, b_), x, xb)
+        rb = jnp.minimum(rb, rn)
+        return (x, r, z, p, rz_new, k + 1, rn, xb, rb)
 
-    x, r, _, _, _, k = jax.lax.while_loop(
-        cond, body, (x0, r0, z0, p0, rz0, jnp.asarray(0)))
-    rn = jnp.sqrt(tree_dot(r, r))
-    return SolveResult(x=x, iters=k, resnorm=rn, converged=rn <= stop)
+    x, r, _, _, _, k, rn, xb, rb = jax.lax.while_loop(
+        cond, body, (x0, r0, z0, p0, rz0, jnp.asarray(0), rn0, x0, rn0))
+    converged = rn <= stop
+    use_best = jnp.logical_and(~converged, rb < rn)
+    x = jax.tree_util.tree_map(
+        lambda a_, b_: jnp.where(use_best, a_, b_), xb, x)
+    rn = jnp.where(use_best, rb, rn)
+    return SolveResult(x=x, iters=k, resnorm=rn, converged=converged)
 
 
 def bicgstab(A: Operator, b: Pytree, x0: Optional[Pytree] = None,

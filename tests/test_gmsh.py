@@ -1,0 +1,151 @@
+"""Gmsh MSH v2 ASCII mesh import for the IBFE path (VERDICT round 3,
+missing #4 / next-round item 5): external user geometries enter
+``fe/mesh.py`` from a file — the rebuild's analog of the reference's
+libMesh readers (``FEDataManager`` via ``GmshIO``, SURVEY.md T16 [U]).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from ibamr_tpu.fe.mesh import (FEMesh, block_mesh_tet, block_mesh_tri,
+                               box_hex_mesh, disc_mesh, read_gmsh,
+                               rect_quad_mesh, to_quadratic, write_gmsh)
+
+F64 = jnp.float64
+
+
+ALL_MESHES = [
+    ("TRI3", lambda: block_mesh_tri(3, 2)),
+    ("TRI6", lambda: to_quadratic(block_mesh_tri(2, 2))),
+    ("QUAD4", lambda: rect_quad_mesh(3, 2)),
+    ("TET4", lambda: block_mesh_tet(2, 2, 2)),
+    ("TET10", lambda: to_quadratic(block_mesh_tet(2, 1, 1))),
+    ("HEX8", lambda: box_hex_mesh(2, 2, 2)),
+]
+
+
+@pytest.mark.parametrize("etype,maker", ALL_MESHES,
+                         ids=[m[0] for m in ALL_MESHES])
+def test_gmsh_roundtrip_full_menu(etype, maker, tmp_path):
+    """write_gmsh -> read_gmsh is the identity (nodes, connectivity,
+    type) for EVERY element family of the menu — including the TET10
+    midside reorder between Gmsh and libMesh conventions."""
+    m = maker()
+    p = str(tmp_path / f"{etype}.msh")
+    write_gmsh(m, p)
+    m2 = read_gmsh(p)
+    assert m2.elem_type == etype
+    np.testing.assert_allclose(m2.nodes, m.nodes, rtol=0, atol=0)
+    np.testing.assert_array_equal(m2.elems, m.elems)
+    # the quadrature measure agrees (catches any ordering slip that
+    # preserves the node set but scrambles the element maps)
+    assert abs(m2.volume() - m.volume()) < 1e-14
+
+
+def test_gmsh_noncontiguous_ids_and_mixed_types(tmp_path):
+    """A hand-written file with gappy node ids and a mixed element
+    block (boundary lines + triangles): the reader keeps the
+    highest-dimension type and densely remaps the ids."""
+    p = str(tmp_path / "mixed.msh")
+    with open(p, "w") as f:
+        f.write("""$MeshFormat
+2.2 0 8
+$EndMeshFormat
+$Nodes
+5
+10 0 0 0
+20 1 0 0
+30 1 1 0
+41 0 1 0
+99 5 5 0
+$EndNodes
+$Elements
+4
+1 1 2 0 1 10 20
+2 1 2 0 1 20 30
+3 2 2 0 1 10 20 30
+4 2 2 0 1 10 30 41
+$EndElements
+""")
+    m = read_gmsh(p)
+    assert m.elem_type == "TRI3"
+    assert m.n_elems == 2
+    # node 99 is unreferenced by the triangles -> dropped
+    assert m.n_nodes == 4
+    assert m.dim == 2
+    assert abs(m.volume() - 1.0) < 1e-14   # unit square from 2 tris
+
+
+def test_gmsh_explicit_type_selection(tmp_path):
+    """elem_type picks a lower-dimension block when requested."""
+    p = str(tmp_path / "two.msh")
+    with open(p, "w") as f:
+        f.write("""$MeshFormat
+2.2 0 8
+$EndMeshFormat
+$Nodes
+8
+1 0 0 0
+2 1 0 0
+3 1 1 0
+4 0 1 0
+5 0 0 1
+6 1 0 1
+7 1 1 1
+8 0 1 1
+$EndNodes
+$Elements
+3
+1 5 2 0 1 1 2 3 4 5 6 7 8
+2 3 2 0 1 1 2 3 4
+3 3 2 0 1 5 6 7 8
+$EndElements
+""")
+    m = read_gmsh(p)                       # default: highest dim wins
+    assert m.elem_type == "HEX8"
+    m2 = read_gmsh(p, elem_type="QUAD4")
+    assert m2.elem_type == "QUAD4"
+    assert m2.n_elems == 2
+
+
+def test_gmsh_version_guard(tmp_path):
+    p = str(tmp_path / "v4.msh")
+    with open(p, "w") as f:
+        f.write("$MeshFormat\n4.1 0 8\n$EndMeshFormat\n")
+    with pytest.raises(ValueError, match="v2 ASCII"):
+        read_gmsh(p)
+
+
+def test_ibfe_runs_from_file_loaded_mesh(tmp_path):
+    """The IBFE-ex0 variant driven by a FILE-LOADED mesh: write the
+    disc to .msh, read it back, build the FE assembly and run coupled
+    IB/FE steps — the end-to-end external-geometry path."""
+    from ibamr_tpu.fe.fem import neo_hookean
+    from ibamr_tpu.grid import StaggeredGrid
+    from ibamr_tpu.integrators.ibfe import IBFEMethod
+    from ibamr_tpu.integrators.ib import IBExplicitIntegrator
+    from ibamr_tpu.integrators.ins import INSStaggeredIntegrator
+
+    disc = disc_mesh(radius=0.2, center=(0.5, 0.5), n_rings=3)
+    p = str(tmp_path / "disc.msh")
+    write_gmsh(disc, p)
+    loaded = read_gmsh(p)
+    assert loaded.elem_type == "TRI3"
+    assert abs(loaded.volume() - disc.volume()) < 1e-14
+
+    grid = StaggeredGrid(n=(32, 32), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    ins = INSStaggeredIntegrator(grid, mu=0.05, rho=1.0,
+                                 convective_op_type="centered",
+                                 dtype=F64)
+
+    fe = IBFEMethod(loaded, neo_hookean(1.0, 4.0), kernel="IB_4",
+                    dtype=F64)
+    integ = IBExplicitIntegrator(ins, fe)
+    st = integ.initialize(jnp.asarray(loaded.nodes, F64))
+    for _ in range(3):
+        st = integ.step(st, 1e-3)
+    assert bool(jnp.all(jnp.isfinite(st.X)))
+    # undeformed disc at rest: forces stay near zero, mesh stays put
+    assert float(jnp.max(jnp.abs(st.X - jnp.asarray(loaded.nodes)))) \
+        < 1e-3

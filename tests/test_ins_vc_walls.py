@@ -1,0 +1,233 @@
+"""Wall-bounded variable-coefficient (multiphase) INS — the physical
+no-slip walls of P22 (VERDICT round 3, missing #3 / next-round item 4).
+
+Reference parity: ``INSVCStaggeredHierarchyIntegrator`` with physical
+wall BCs (SURVEY.md §2.2 P22 [U]) — tanks and channels with real
+floors/walls rather than Brinkman-penalized slabs inside a periodic
+box. The wall machinery rides the pinned-face storage convention of
+``integrators.ins_walls``: the wall-normal component's slot 0 is the lo
+wall face (pinned 0) and the hi wall face is its periodic-wrap image,
+so divergence/flux rolls stay exact and the projection's masked-face
+coefficient reproduces the homogeneous-Neumann pressure rows.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ibamr_tpu.grid import StaggeredGrid
+from ibamr_tpu.integrators.ins_vc import (INSVCConservativeIntegrator,
+                                          INSVCStaggeredIntegrator,
+                                          advance_vc)
+from ibamr_tpu.ops import stencils
+
+
+def _wall_normal_faces_zero(st, wall_axes):
+    for d, w in enumerate(wall_axes):
+        if not w:
+            continue
+        idx = [slice(None)] * st.u[d].ndim
+        idx[d] = slice(0, 1)
+        assert float(jnp.max(jnp.abs(st.u[d][tuple(idx)]))) == 0.0
+
+
+def test_hydrostatic_quiescence_closed_tank():
+    """A flat heavy pool under gravity in a CLOSED tank (walls on both
+    axes) stays exactly quiescent: the density-anomaly gravity force on
+    a flat pool is a discrete wall-masked y-gradient, so the Neumann
+    projection absorbs it to solver tolerance."""
+    n = 32
+    g = StaggeredGrid(n=(n, n), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    y = (np.arange(n) + 0.5) / n
+    phi0 = jnp.asarray(np.broadcast_to((0.5 - y)[None, :], (n, n)),
+                       dtype=jnp.float64)
+    integ = INSVCStaggeredIntegrator(
+        g, rho0=1.0, rho1=100.0, mu0=0.01, mu1=0.01,
+        gravity=(0.0, -1.0), sigma=0.0, convective_op_type="none",
+        reinit_interval=1000, cg_tol=1e-11,
+        wall_axes=(True, True), dtype=jnp.float64)
+    st = integ.initialize(phi0)
+    st = advance_vc(integ, st, 1e-3, 20)
+    umax = max(float(jnp.max(jnp.abs(c))) for c in st.u)
+    assert umax < 1e-9, umax
+    _wall_normal_faces_zero(st, (True, True))
+
+
+def test_hydrostatic_quiescence_conservative_walled():
+    """Conservative form, same closed-tank quiescence (arithmetic face
+    rule + conserved density)."""
+    n = 32
+    g = StaggeredGrid(n=(n, n), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    y = (np.arange(n) + 0.5) / n
+    phi0 = jnp.asarray(np.broadcast_to((0.5 - y)[None, :], (n, n)),
+                       dtype=jnp.float64)
+    integ = INSVCConservativeIntegrator(
+        g, rho0=1.0, rho1=100.0, mu0=0.01, mu1=0.01,
+        gravity=(0.0, -1.0), sigma=0.0, convective_op_type="none",
+        reinit_interval=1000, cg_tol=1e-11,
+        wall_axes=(True, True), dtype=jnp.float64)
+    st = integ.initialize(phi0)
+    st = advance_vc(integ, st, 1e-3, 20)
+    umax = max(float(jnp.max(jnp.abs(c))) for c in st.u)
+    assert umax < 1e-9, umax
+
+
+def test_channel_viscous_mode_decay_rate():
+    """Single-phase limit, walls on y only: the lowest no-slip channel
+    mode u_x = sin(pi y/H) decays at the analytic rate
+    (mu/rho)(pi/H)^2 — pins the wall-aware viscous stress (one-sided
+    wall shear with the odd-reflection ghost) quantitatively."""
+    n = 48
+    g = StaggeredGrid(n=(n, n), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    mu = 0.05
+    yc = (jnp.arange(n, dtype=jnp.float64) + 0.5) / n
+    u0x = jnp.broadcast_to(jnp.sin(jnp.pi * yc)[None, :], (n, n))
+    integ = INSVCStaggeredIntegrator(
+        g, rho0=1.0, rho1=1.0, mu0=mu, mu1=mu,
+        convective_op_type="none", reinit_interval=10 ** 9,
+        cg_tol=1e-11, wall_axes=(False, True), dtype=jnp.float64)
+    st = integ.initialize(jnp.ones((n, n), dtype=jnp.float64),
+                          u0_arrays=(u0x, jnp.zeros((n, n),
+                                                    dtype=jnp.float64)))
+    dt = 2e-4
+    steps = 400
+    st = advance_vc(integ, st, dt, steps)
+    t = dt * steps
+    rate = mu * jnp.pi ** 2              # H = 1, rho = 1
+    expected = float(jnp.exp(-rate * t))
+    measured = float(jnp.max(st.u[0]) / jnp.max(u0x))
+    # 2nd-order wall discretization at n=48: a couple of percent
+    assert abs(measured - expected) / expected < 0.03, \
+        (measured, expected)
+
+
+def test_falling_drop_walled_tank_stable_and_conserves():
+    """A heavy drop falling inside a CLOSED tank: stable, discretely
+    divergence-free, wall-normal faces exactly zero, heavy-phase
+    volume drift bounded, and the drop's centroid actually falls."""
+    n = 48
+    g = StaggeredGrid(n=(n, n), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    xx = (np.arange(n) + 0.5) / n
+    X, Y = np.meshgrid(xx, xx, indexing="ij")
+    r = np.sqrt((X - 0.5) ** 2 + (Y - 0.65) ** 2)
+    phi0 = jnp.asarray(0.15 - r, dtype=jnp.float64)  # drop = heavy
+    integ = INSVCStaggeredIntegrator(
+        g, rho0=1.0, rho1=10.0, mu0=0.01, mu1=0.02,
+        gravity=(0.0, -5.0), sigma=0.0, convective_op_type="upwind",
+        reinit_interval=10, cg_tol=1e-10,
+        wall_axes=(True, True), dtype=jnp.float64)
+    st = integ.initialize(phi0)
+    vol0 = float(integ.heavy_phase_volume(st))
+
+    def centroid_y(phi):
+        from ibamr_tpu.physics.level_set import heaviside
+        H = heaviside(phi, integ.eps)
+        yb = jnp.asarray(Y)
+        return float(jnp.sum(H * yb) / jnp.sum(H))
+
+    y0 = centroid_y(st.phi)
+    st = advance_vc(integ, st, 5e-4, 200)
+    assert all(bool(jnp.all(jnp.isfinite(c))) for c in st.u)
+    div = float(jnp.max(jnp.abs(stencils.divergence(st.u, g.dx))))
+    assert div < 1e-7, div
+    _wall_normal_faces_zero(st, (True, True))
+    vol1 = float(integ.heavy_phase_volume(st))
+    assert abs(vol1 - vol0) / vol0 < 0.05, (vol0, vol1)
+    y1 = centroid_y(st.phi)
+    assert y1 < y0 - 0.015, (y0, y1)
+
+
+def test_conservative_walled_mass_exact():
+    """Conservative form in a closed tank: total mass is conserved to
+    roundoff — every wall-face mass flux vanishes identically under
+    the pinned-face convention, so the flux-form update telescopes."""
+    n = 32
+    g = StaggeredGrid(n=(n, n), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    xx = (np.arange(n) + 0.5) / n
+    X, Y = np.meshgrid(xx, xx, indexing="ij")
+    r = np.sqrt((X - 0.5) ** 2 + (Y - 0.6) ** 2)
+    phi0 = jnp.asarray(0.2 - r, dtype=jnp.float64)
+    integ = INSVCConservativeIntegrator(
+        g, rho0=1.0, rho1=50.0, mu0=0.01, mu1=0.05,
+        gravity=(0.0, -2.0), sigma=0.0, convective_op_type="upwind",
+        reinit_interval=10, cg_tol=1e-10,
+        wall_axes=(True, True), dtype=jnp.float64)
+    st = integ.initialize(phi0)
+    m0 = float(integ.total_mass(st))
+    st = advance_vc(integ, st, 5e-4, 100)
+    m1 = float(integ.total_mass(st))
+    assert abs(m1 - m0) / m0 < 1e-12, (m0, m1)
+
+
+def test_reinitialize_walled_keeps_floor_clean():
+    """Reinitializing a pool's signed-distance field with wall_axes
+    must NOT corrupt the floor rows: the periodic wrap sees air above
+    the domain top against water at the bottom (a spurious 'interface'
+    at the floor), the walled version must not."""
+    from ibamr_tpu.physics.level_set import reinitialize
+
+    n = 48
+    dx = (1.0 / n, 1.0 / n)
+    y = (np.arange(n) + 0.5) / n
+    phi = jnp.asarray(np.broadcast_to((y - 0.5)[None, :], (n, n)),
+                      dtype=jnp.float64)   # pool below y=0.5
+    out_w = reinitialize(phi, dx, iters=40, wall_axes=(False, True))
+    # the field is already a signed distance: the walled reinit must be
+    # a near-no-op INCLUDING the floor/top rows
+    err_w = float(jnp.max(jnp.abs(out_w - phi)))
+    assert err_w < 1e-6, err_w
+    # the periodic version corrupts the wrap rows (documents why the
+    # walled variant exists)
+    out_p = reinitialize(phi, dx, iters=40)
+    err_p = float(jnp.max(jnp.abs(out_p - phi)))
+    assert err_p > 100.0 * max(err_w, 1e-12), (err_p, err_w)
+
+
+def test_advect_walled_conserves_and_confines():
+    """Godunov advection with wall_axes: exact conservation (wall-face
+    fluxes vanish) and no leakage of a blob pushed against the wall."""
+    from ibamr_tpu.ops.godunov import advect
+
+    n = 48
+    dx = (1.0 / n, 1.0 / n)
+    xx = (np.arange(n) + 0.5) / n
+    X, Y = np.meshgrid(xx, xx, indexing="ij")
+    Q = jnp.asarray(np.exp(-((X - 0.5) ** 2 + (Y - 0.3) ** 2) / 0.01),
+                    dtype=jnp.float64)
+    # uniform downward velocity, pinned at the walls (storage
+    # convention: slot 0 of the normal component is the wall face)
+    uy = jnp.full((n, n), -0.5, dtype=jnp.float64)
+    uy = uy.at[:, 0].set(0.0)
+    u = (jnp.zeros((n, n), dtype=jnp.float64), uy)
+    s0 = float(jnp.sum(Q))
+    for _ in range(60):
+        Q = advect(Q, u, dx, 5e-3, wall_axes=(False, True))
+    assert abs(float(jnp.sum(Q)) - s0) / s0 < 1e-12
+    assert bool(jnp.all(jnp.isfinite(Q)))
+    assert float(jnp.min(Q)) > -1e-8          # TVD near the wall
+
+
+def test_walled_momentum_wall_shear_sign():
+    """A uniform rightward stream between two no-slip walls must
+    decelerate monotonically (wall shear is the only force) — pins the
+    sign/placement of the one-sided wall-shear assembly."""
+    n = 32
+    g = StaggeredGrid(n=(n, n), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    u0x = jnp.ones((n, n), dtype=jnp.float64)
+    integ = INSVCStaggeredIntegrator(
+        g, rho0=1.0, rho1=1.0, mu0=0.05, mu1=0.05,
+        convective_op_type="none", reinit_interval=10 ** 9,
+        cg_tol=1e-11, wall_axes=(False, True), dtype=jnp.float64)
+    st = integ.initialize(jnp.ones((n, n), dtype=jnp.float64),
+                          u0_arrays=(u0x, jnp.zeros((n, n),
+                                                    dtype=jnp.float64)))
+    means = [1.0]
+    for _ in range(5):
+        st = advance_vc(integ, st, 2e-4, 20)
+        means.append(float(jnp.mean(st.u[0])))
+    assert all(b < a for a, b in zip(means, means[1:])), means
+    # boundary cells decelerate fastest (the shear enters at the wall)
+    prof = np.asarray(jnp.mean(st.u[0], axis=0))
+    assert prof[0] < prof[n // 2]
+    assert prof[-1] < prof[n // 2]

@@ -1,0 +1,132 @@
+"""Relay watcher: poll the TPU relay and fire the flagship bench the
+moment it comes back (VERDICT.md round 3, "Next round" item 1 — treat
+relay-watching as a deliverable, not luck).
+
+Loop: probe the accelerator backend in a killable subprocess every
+``--interval`` seconds. On the first healthy probe, immediately run
+
+  1. ``bench.py`` (staged flagship shootout; stdout JSON captured to
+     ``--out``), and
+  2. ``tools/microbench_transfer.py`` at 256^3 (per-engine legs),
+
+then keep polling: if the relay was healthy but the bench failed to
+produce a TPU-platform JSON line (the relay can die mid-run), the
+watcher re-arms and tries again on the next healthy window, up to
+``--max-captures`` successful captures.
+
+Everything is logged to ``--log`` with timestamps so a later reader can
+reconstruct exactly when the relay was up.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def log(f, msg: str) -> None:
+    line = f"[{time.strftime('%Y-%m-%d %H:%M:%S')}] {msg}"
+    print(line, file=sys.stderr, flush=True)
+    f.write(line + "\n")
+    f.flush()
+
+
+def last_json_line(text: str):
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=float, default=240.0)
+    ap.add_argument("--probe-timeout", type=float, default=90.0)
+    ap.add_argument("--bench-timeout", type=float, default=3600.0)
+    ap.add_argument("--out", type=str,
+                    default=os.path.join(REPO, "BENCH_TPU_CAPTURE.json"))
+    ap.add_argument("--log", type=str,
+                    default=os.path.join(REPO, "relay_watch.log"))
+    ap.add_argument("--max-captures", type=int, default=1)
+    ap.add_argument("--max-hours", type=float, default=11.0)
+    args = ap.parse_args()
+
+    from ibamr_tpu.utils.backend_guard import probe_accelerator
+
+    deadline = time.time() + args.max_hours * 3600.0
+    captures = 0
+    f = open(args.log, "a")
+    log(f, f"watcher start: interval={args.interval}s "
+           f"probe_timeout={args.probe_timeout}s out={args.out}")
+    while time.time() < deadline and captures < args.max_captures:
+        plat, err = probe_accelerator(args.probe_timeout)
+        if plat is None or plat == "cpu":
+            log(f, f"probe: relay unavailable ({err}); sleeping "
+                   f"{args.interval:.0f}s")
+            time.sleep(args.interval)
+            continue
+        log(f, f"probe: HEALTHY platform={plat} — launching bench shootout")
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)  # let the container default win
+        t0 = time.time()
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.join(REPO, "bench.py"),
+                 "--stages", "64,128,256"],
+                capture_output=True, text=True, cwd=REPO, env=env,
+                timeout=args.bench_timeout)
+        except subprocess.TimeoutExpired:
+            log(f, f"bench TIMED OUT after {args.bench_timeout:.0f}s; "
+                   f"re-arming")
+            time.sleep(args.interval)
+            continue
+        dtr = time.time() - t0
+        result = last_json_line(r.stdout or "")
+        log(f, f"bench rc={r.returncode} wall={dtr:.0f}s "
+               f"result={json.dumps(result) if result else 'NO JSON'}")
+        tail = "\n".join((r.stderr or "").strip().splitlines()[-30:])
+        log(f, "bench stderr tail:\n" + tail)
+        if result is not None and result.get("platform") not in (None, "cpu"):
+            with open(args.out, "w") as g:
+                json.dump(result, g, indent=1)
+            log(f, f"CAPTURED TPU bench -> {args.out}")
+            captures += 1
+            # follow with the per-engine microbench while the window is warm
+            try:
+                r2 = subprocess.run(
+                    [sys.executable,
+                     os.path.join(REPO, "tools", "microbench_transfer.py"),
+                     "--n", "256"],
+                    capture_output=True, text=True, cwd=REPO, env=env,
+                    timeout=args.bench_timeout)
+                log(f, f"microbench rc={r2.returncode}\n"
+                       + "\n".join((r2.stdout or "").strip().splitlines()[-25:])
+                       + "\n--- stderr tail ---\n"
+                       + "\n".join((r2.stderr or "").strip().splitlines()[-15:]))
+                with open(args.out.replace(".json", "_microbench.txt"),
+                          "w") as g:
+                    g.write(r2.stdout or "")
+                    g.write("\n--- stderr ---\n")
+                    g.write(r2.stderr or "")
+            except subprocess.TimeoutExpired:
+                log(f, "microbench timed out")
+        else:
+            log(f, "bench ran but did not produce a TPU JSON line; re-arming")
+            time.sleep(args.interval)
+    log(f, f"watcher exit: captures={captures}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
