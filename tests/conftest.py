@@ -160,6 +160,19 @@ SLOW_TESTS = {
     "test_bf16_compute_matches_f32_within_tolerance",
     "test_hydrodynamic_force_measures_body_drag",
     "test_multilevel_ib_sharded_matches_single",
+    # round-4 additions (measured >= ~12 s)
+    "test_two_level_ib_sharded_window_matches_single",
+    "test_two_level_ib_3d_sharded_window_matches_single",
+    "test_multilevel_ib_sharded_boxes_matches_single",
+    "test_nwt_physical_walls_match_brinkman",
+    "test_free_body_trajectory_matches_constraint_ib",
+    "test_explicit_composite_unstable_beyond_limit",
+    "test_implicit_composite_stable_at_10x",
+    "test_implicit_composite_matches_explicit_at_small_dt",
+    "test_falling_drop_walled_tank_stable_and_conserves",
+    "test_channel_viscous_mode_decay_rate",
+    "test_conservative_walled_mass_exact",
+    "test_komega_channel_law_of_the_wall",
 }
 
 

@@ -1,0 +1,86 @@
+"""Implicit IB on the composite two-level hierarchy (VERDICT round 3,
+missing #6 / next-round item 7): Newton-Krylov coupling with
+spread/interp at FINE resolution inside a refined window — the
+``IBImplicitStaggeredHierarchyIntegrator``-on-AMR case the reference
+runs for stiff structures (SURVEY.md P8 [U]).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ibamr_tpu.amr import FineBox
+from ibamr_tpu.amr_ins import TwoLevelIBINS, advance_two_level_ib
+from ibamr_tpu.grid import StaggeredGrid
+from ibamr_tpu.integrators.ib import IBMethod
+from ibamr_tpu.integrators.ib_implicit import (
+    TwoLevelIBImplicit, advance_two_level_ib_implicit)
+from ibamr_tpu.models.membrane2d import make_circle_membrane
+
+_K = 1e5          # spring stiffness (same stiff regime as the uniform
+#                   implicit tests: explicit limit ~1e-4)
+
+
+def _pieces(mu=0.02):
+    g = StaggeredGrid(n=(32, 32), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    box = FineBox(lo=(8, 8), shape=(16, 16))
+    s = make_circle_membrane(48, 0.08, (0.5, 0.5), stiffness=_K,
+                             aspect=1.05, rest_length_factor=1.0)
+    ib = IBMethod(s.force_specs(dtype=jnp.float64), kernel="IB_4")
+    return g, box, ib, s
+
+
+def test_explicit_composite_unstable_beyond_limit():
+    """The stiff membrane blows up the EXPLICIT composite coupling at
+    dt = 5e-4 — establishing the 10x margin the implicit test claims."""
+    g, box, ib, s = _pieces()
+    integ = TwoLevelIBINS(g, box, ib, mu=0.02, proj_tol=1e-8)
+    st = integ.initialize(jnp.asarray(s.vertices, jnp.float64))
+    out = advance_two_level_ib(integ, st, 5e-4, 40)
+    blew_up = (not bool(jnp.all(jnp.isfinite(out.X)))
+               or float(jnp.max(jnp.abs(out.X))) > 10.0)
+    assert blew_up
+
+
+def test_implicit_composite_stable_at_10x():
+    """Backward-Euler Newton-Krylov composite coupling at dt = 5e-4
+    (>= 10x the explicit spring limit, inside the fine level's viscous
+    bound): stable, finite, membrane stays the same scale, and the
+    stiff ellipse actually relaxes toward the circle."""
+    g, box, ib, s = _pieces()
+    imp = TwoLevelIBImplicit(g, box, ib, mu=0.02, proj_tol=1e-8,
+                             scheme="backward_euler",
+                             newton_tol=1e-8, newton_maxiter=12,
+                             inner_m=16, inner_restarts=2,
+                             inner_tol=1e-3)
+    st = imp.initialize(jnp.asarray(s.vertices, jnp.float64))
+    X0 = np.asarray(st.X)
+    r0 = np.linalg.norm(X0 - X0.mean(axis=0), axis=1)
+    ecc0 = r0.max() - r0.min()
+    out = advance_two_level_ib_implicit(imp, st, 5e-4, 40)
+    assert bool(jnp.all(jnp.isfinite(out.X)))
+    X1 = np.asarray(out.X)
+    assert float(np.max(np.abs(X1 - 0.5))) < 0.2      # stayed in window
+    r1 = np.linalg.norm(X1 - X1.mean(axis=0), axis=1)
+    ecc1 = r1.max() - r1.min()
+    assert ecc1 < 0.7 * ecc0, (ecc0, ecc1)            # relaxing
+
+
+def test_implicit_composite_matches_explicit_at_small_dt():
+    """At a SMALL dt both couplings converge to the same trajectory:
+    the implicit composite step at dt=5e-5 tracks the explicit
+    composite reference (same spatial operators, different coupling
+    solve — agreement pins the residual formulation)."""
+    g, box, ib, s = _pieces()
+    X0 = jnp.asarray(s.vertices, jnp.float64)
+    expl = TwoLevelIBINS(g, box, ib, mu=0.02, proj_tol=1e-9)
+    ref = advance_two_level_ib(expl, expl.initialize(X0), 5e-5, 40)
+    imp = TwoLevelIBImplicit(g, box, ib, mu=0.02, proj_tol=1e-9,
+                             scheme="midpoint", newton_tol=1e-10,
+                             newton_maxiter=12, inner_m=20,
+                             inner_restarts=2, inner_tol=1e-5)
+    out = advance_two_level_ib_implicit(imp, imp.initialize(X0),
+                                        5e-5, 40)
+    err = float(jnp.max(jnp.abs(out.X - ref.X)))
+    assert err < 2e-4, err
