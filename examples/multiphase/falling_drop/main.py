@@ -41,11 +41,17 @@ def main(argv):
     n = tuple(geo.get_int_array("n"))
     grid = StaggeredGrid(n=n, x_lo=tuple(geo.get_float_array("x_lo")),
                          x_up=tuple(geo.get_float_array("x_up")))
+    # wall_axes = 0, 1 puts PHYSICAL no-slip walls on both sides of the
+    # flagged axes (a closed tank) instead of the periodic default —
+    # the wall-bounded P22 configuration (input2d.walled)
+    wall_axes = tuple(bool(v) for v in
+                      vc.get_int_array("wall_axes", [0] * len(n)))
     integ = INSVCStaggeredIntegrator(
         grid, rho0=vc.get_float("rho0"), rho1=vc.get_float("rho1"),
         mu0=vc.get_float("mu0"), mu1=vc.get_float("mu1"),
         sigma=vc.get_float("sigma", 0.0),
         gravity=(0.0, vc.get_float("gravity_y", 0.0)),
+        wall_axes=wall_axes if any(wall_axes) else None,
         cg_tol=vc.get_float("cg_tol", 1.0e-5))   # f32 floor
 
     cx, cy = vc.get_float_array("drop_center")

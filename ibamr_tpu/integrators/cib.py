@@ -274,8 +274,8 @@ def advance_free_bodies(method: "CIBMethod", X: jnp.ndarray, FT_fn,
         return (X_new, t + dt), (body_centroids(X_new, bodies), U)
 
     (X_fin, _), (cents, Us) = jax.lax.scan(
-        body, (X, jnp.zeros((), dtype=X.dtype)),
-        jnp.arange(num_steps))
+        body, (X, jnp.zeros((), dtype=X.dtype)), None,
+        length=num_steps)
     return FreeBodyTrajectory(X=X_fin, centroids=cents, U=Us)
 
 

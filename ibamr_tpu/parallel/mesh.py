@@ -467,3 +467,22 @@ def place_state(state, grid: StaggeredGrid, mesh: Mesh):
         return jax.device_put(a, replicated)
 
     return jax.tree_util.tree_map(put, state)
+
+
+def make_sharded_vc_step(integ, mesh: Mesh):
+    """Jitted variable-coefficient (multiphase) INS step with every
+    grid field sharded over ``mesh`` — S1 for the P22 multiphase
+    integrators (`INSVCStaggeredIntegrator` / conservative form, walls
+    or periodic). Everything inside the step is roll-stencil, CG
+    (psum reductions), multigrid V-cycle (strided restriction/
+    prolongation the partitioner resolves), Godunov advection, and
+    level-set reinitialization — all GSPMD-compatible; the pins at the
+    step boundary keep the layouts stable. Equality with the
+    single-device step is pinned by tests/test_parallel.py."""
+    grid = integ.grid
+
+    def step(state, dt):
+        state = shard_state(state, grid, mesh)
+        return shard_state(integ.step(state, dt), grid, mesh)
+
+    return jax.jit(step)

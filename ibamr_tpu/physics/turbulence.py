@@ -81,7 +81,7 @@ class SmagorinskyINS:
 
     def __init__(self, grid: StaggeredGrid, mu: float, rho: float = 1.0,
                  cs: float = 0.17, convective_op_type: str = "upwind",
-                 dtype=jnp.float32):
+                 wall_axes=None, dtype=jnp.float32):
         from ibamr_tpu.integrators.ins_vc import INSVCStaggeredIntegrator
 
         self.grid = grid
@@ -89,10 +89,16 @@ class SmagorinskyINS:
         self.rho = float(rho)
         self.cs = float(cs)
         self.dtype = dtype
+        # wall_axes: physical no-slip walls via the VC wall machinery
+        # (wall-bounded LES channel/duct). The Smagorinsky nu_t itself
+        # is evaluated with periodic strain stencils — a one-cell wall
+        # layer approximation the no-slip momentum BCs dominate.
+        walls = wall_axes is not None and any(wall_axes)
         self._vc = INSVCStaggeredIntegrator(
             grid, rho0=rho, rho1=rho, mu0=mu, mu1=mu,
             convective_op_type=convective_op_type,
-            reinit_interval=0, precond="fft", dtype=dtype)
+            reinit_interval=0, precond="mg" if walls else "fft",
+            wall_axes=wall_axes, dtype=dtype)
 
     def initialize(self, u0: Optional[Vel] = None):
         st = self._vc.initialize(jnp.zeros(self.grid.n,

@@ -173,6 +173,10 @@ SLOW_TESTS = {
     "test_channel_viscous_mode_decay_rate",
     "test_conservative_walled_mass_exact",
     "test_komega_channel_law_of_the_wall",
+    "test_vc_ins_sharded_matches_single",
+    "test_smagorinsky_walled_channel_decays_bounded",
+    "test_falling_drop_3d_walled_smoke",
+    "test_hydrostatic_quiescence_3d_walled_tank",
 }
 
 
