@@ -156,29 +156,26 @@ def _cpu_sharded_child(q, n, n_lat, n_lon, steps, warmup, dt,
             for _ in range(steps):
                 state = step_fn(state, dt)
             jax.block_until_ready(state)
-            el = _t.perf_counter() - t0
-            return round(steps / el, 3), round(compile_s, 2)
+            return _t.perf_counter() - t0, compile_s
 
         mesh = make_mesh(n_devices)
         state = place_state(state0, integ.ins.grid, mesh)
-        sharded_sps, compile_s = timed(make_sharded_ib_step(integ, mesh),
-                                       state)
+        el_sh, compile_s = timed(make_sharded_ib_step(integ, mesh),
+                                 state)
         # single-device leg of the same step: the only scaling signal
         # available without multi-chip hardware (VERDICT round 3 weak
         # #4 — "no scaling measurement exists anywhere"). Virtual CPU
         # devices share the host's cores, so the ratio reads as an
         # SPMD-overhead bound, not real chip scaling; it still catches
         # a sharded-path regression that the single-device number hides
-        single_sps, _ = timed(jax.jit(lambda s, d: integ.step(s, d)),
-                              state0)
+        el_1, _ = timed(jax.jit(lambda s, d: integ.step(s, d)), state0)
         q.put({"n": n, "n_devices": n_devices,
                "markers": n_lat * n_lon,
-               "steps_per_sec": sharded_sps,
-               "ms_per_step": round(1e3 / sharded_sps, 3),
-               "single_device_steps_per_sec": single_sps,
-               "sharded_over_single": round(sharded_sps / single_sps,
-                                            3),
-               "compile_warmup_s": compile_s})
+               "steps_per_sec": round(steps / el_sh, 3),
+               "ms_per_step": round(1e3 * el_sh / steps, 3),
+               "single_device_steps_per_sec": round(steps / el_1, 3),
+               "sharded_over_single": round(el_1 / el_sh, 3),
+               "compile_warmup_s": round(compile_s, 2)})
     except Exception as e:  # noqa: BLE001 - report, parent decides
         q.put({"error": f"{type(e).__name__}: {e}"})
 

@@ -624,7 +624,22 @@ class TwoLevelIBINS:
     refinement tracks the immersed boundary, SURVEY.md §0), transfers
     run at FINE resolution, and the coarse level sees the restricted
     force. The structure must keep delta-support clearance from the box
-    boundary (the proper-nesting analog)."""
+    boundary (the proper-nesting analog).
+
+    ``ib`` is any strategy exposing the marker-cloud IBStrategy seam —
+    ``compute_force(X, U, t)`` plus
+    ``interpolate_velocity``/``spread_force`` with the ``ctx`` protocol
+    (round 4): the classic marker
+    :class:`~ibamr_tpu.integrators.ib.IBMethod`, the finite-element
+    :class:`~ibamr_tpu.integrators.ibfe.IBFEMethod` (the reference's
+    IBFE-on-AMR configuration), incl. the prescribed-motion and
+    surface-method wrappers. (The IMP material-point method carries
+    deformation-gradient state through its OWN integrator and does not
+    fit this seam.) Transfers go through the strategy against the FINE
+    grid, so quadrature-cloud couplings and transfer engines ride the
+    hierarchy unchanged. A ``fast`` transfer engine attached to the
+    strategy must be built for ``box.fine_grid(grid)`` — the shared
+    engine/grid guard (``ib.check_fast_grid``) rejects a mismatch."""
 
     def __init__(self, grid: StaggeredGrid, box: FineBox, ib,
                  rho: float = 1.0, mu: float = 0.01,
@@ -651,26 +666,24 @@ class TwoLevelIBINS:
             fluid=fluid, X=X, U=jnp.zeros_like(X),
             mask=jnp.ones(X.shape[0], dtype=X.dtype))
 
-    def _interp(self, uf_box: Vel, X, mask):
-        from ibamr_tpu.ops import interaction
-
+    def _interp(self, uf_box: Vel, X, mask, ctx=None):
         u_per = _periodic_from_box_mac(uf_box, self.box.fine_n)
-        return interaction.interpolate_vel(u_per, self.fine_grid, X,
-                                           kernel=self.ib.kernel,
-                                           weights=mask)
+        return self.ib.interpolate_velocity(u_per, self.fine_grid, X,
+                                            mask, ctx=ctx)
 
     def step(self, state: TwoLevelIBState, dt: float) -> TwoLevelIBState:
-        from ibamr_tpu.ops import interaction
-
         fluid = state.fluid
         X_n = state.X
         U_n = self._interp(fluid.uf, X_n, state.mask)
         X_half = X_n + 0.5 * dt * U_n
         t_half = fluid.t + 0.5 * dt
         F = self.ib.compute_force(X_half, U_n, t_half)
-        f_per = interaction.spread_vel(F, self.fine_grid, X_half,
-                                       kernel=self.ib.kernel,
-                                       weights=state.mask)
+        # one transfer context per structural position, shared by the
+        # spread and the midpoint interp (the strategy seam's protocol)
+        ctx = self.ib.prepare(X_half, state.mask) \
+            if hasattr(self.ib, "prepare") else None
+        f_per = self.ib.spread_force(F, self.fine_grid, X_half,
+                                     state.mask, ctx=ctx)
         pin_c = self.core.proj._pin_c
         pin_f = self.core.proj._pin_f
         f_f = tuple(pin_f(c) for c in _box_mac_from_periodic(f_per))
@@ -682,7 +695,7 @@ class TwoLevelIBINS:
         fluid_new = self.core.step(fluid, dt, f_c=f_c, f_f=f_f)
         u_mid = tuple(0.5 * (a + b)
                       for a, b in zip(fluid.uf, fluid_new.uf))
-        U_half = self._interp(u_mid, X_half, state.mask)
+        U_half = self._interp(u_mid, X_half, state.mask, ctx=ctx)
         X_new = X_n + dt * U_half
         return TwoLevelIBState(fluid=fluid_new, X=X_new, U=U_half,
                                mask=state.mask)

@@ -47,6 +47,21 @@ class IBState(NamedTuple):
     mask: jnp.ndarray    # (N,) 0/1 active-slot mask (fixed-capacity pool)
 
 
+def check_fast_grid(fast, grid: StaggeredGrid) -> None:
+    """A fast transfer engine bakes in its grid at construction;
+    calling it against a different grid (a regrid, or the FINE grid of
+    a composite hierarchy while the engine was built for the coarse
+    one) must fail loudly — a shape-compatible mismatch would transfer
+    with the wrong dx/origin silently. Shared by every IBStrategy."""
+    eg = getattr(fast, "grid", None)
+    if eg is not None and (tuple(eg.n) != tuple(grid.n)
+                           or eg.x_lo != grid.x_lo
+                           or eg.x_up != grid.x_up):
+        raise ValueError(
+            f"fast engine grid {tuple(eg.n)} != call grid "
+            f"{tuple(grid.n)}; rebuild the engine for this grid")
+
+
 class IBMethod:
     """Classic marker-IB structure container (P9 parity).
 
@@ -83,6 +98,7 @@ class IBMethod:
                              X: jnp.ndarray, mask: jnp.ndarray,
                              ctx=None) -> jnp.ndarray:
         if self.fast is not None:
+            check_fast_grid(self.fast, grid)
             return self.fast.interpolate_vel(u, X, weights=mask, b=ctx)
         return interaction.interpolate_vel(u, grid, X, kernel=self.kernel,
                                            weights=mask)
@@ -91,6 +107,7 @@ class IBMethod:
                      X: jnp.ndarray, mask: jnp.ndarray,
                      ctx=None) -> Vel:
         if self.fast is not None:
+            check_fast_grid(self.fast, grid)
             return self.fast.spread_vel(F, X, weights=mask, b=ctx)
         return interaction.spread_vel(F, grid, X, kernel=self.kernel,
                                       weights=mask)

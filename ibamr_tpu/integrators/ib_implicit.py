@@ -181,7 +181,6 @@ class TwoLevelIBImplicit:
                                        _box_mac_from_periodic,
                                        restrict_mac,
                                        scatter_box_mac_to_coarse)
-        from ibamr_tpu.ops import interaction
 
         expl = self._expl
         fluid = state.fluid
@@ -195,9 +194,8 @@ class TwoLevelIBImplicit:
             U_est = (X_new - X_n) / dt
             t_c = t_half if mid else fluid.t + dt
             F_c = self.ib.compute_force(X_c, U_est, t_c)
-            f_per = interaction.spread_vel(F_c, expl.fine_grid, X_c,
-                                           kernel=self.ib.kernel,
-                                           weights=mask)
+            f_per = self.ib.spread_force(F_c, expl.fine_grid, X_c,
+                                         mask)
             f_f = _box_mac_from_periodic(f_per)
             f_c = scatter_box_mac_to_coarse(
                 tuple(jnp.zeros(self.grid.n, dtype=f_per[0].dtype)

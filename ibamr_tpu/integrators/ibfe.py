@@ -54,15 +54,11 @@ def _check_fast_engine(fast, kernel) -> None:
 
 
 def _check_fast_grid(fast, grid) -> None:
-    """The engine bakes in its grid too; calling with a different grid
-    (e.g. after a regrid) must fail loudly, not transfer on the stale
-    geometry."""
-    eg = getattr(fast, "grid", None)
-    if eg is not None and (tuple(eg.n) != tuple(grid.n)
-                           or eg.x_lo != grid.x_lo or eg.x_up != grid.x_up):
-        raise ValueError(
-            f"fast engine grid {tuple(eg.n)} != call grid "
-            f"{tuple(grid.n)}; rebuild the engine after regridding")
+    """Delegates to the shared engine/grid guard (ib.check_fast_grid),
+    so every IBStrategy enforces the same contract."""
+    from ibamr_tpu.integrators.ib import check_fast_grid
+
+    check_fast_grid(fast, grid)
 
 
 class IBFEMethod:

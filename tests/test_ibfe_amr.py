@@ -1,0 +1,94 @@
+"""IBFE on the composite two-level hierarchy (round 4): the reference
+runs its finite-element structures on locally-refined hierarchies
+(``IBFEMethod`` + AMR, SURVEY.md P17/§0); TwoLevelIBINS now routes its
+transfers through the IBStrategy seam, so the FE coupling (quadrature
+clouds, unified projection) rides the fine window unchanged.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ibamr_tpu.amr import FineBox
+from ibamr_tpu.amr_ins import TwoLevelIBINS, advance_two_level_ib
+from ibamr_tpu.fe.fem import neo_hookean
+from ibamr_tpu.fe.mesh import disc_mesh
+from ibamr_tpu.grid import StaggeredGrid
+from ibamr_tpu.integrators.ibfe import IBFEMethod
+
+F64 = jnp.float64
+
+
+def _stretched_disc(stretch=1.08):
+    m = disc_mesh(radius=0.08, center=(0.5, 0.5), n_rings=3)
+    S = np.diag([stretch, 1.0 / stretch])
+    X0 = (m.nodes - 0.5) @ S.T + 0.5
+    return m, jnp.asarray(X0, F64)
+
+
+def test_ibfe_on_two_level_hierarchy_relaxes():
+    """A pre-stretched hyperelastic disc INSIDE the fine window of a
+    composite two-level hierarchy: runs finite, the elastic energy
+    decays (the disc relaxes toward the reference shape), and the
+    fluid picks up the released energy — the IBFE-on-AMR
+    configuration."""
+    from ibamr_tpu.fe import build_assembly
+    from ibamr_tpu.fe.fem import elastic_energy
+
+    g = StaggeredGrid(n=(32, 32), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    box = FineBox(lo=(8, 8), shape=(16, 16))
+    m, X0 = _stretched_disc()
+    fe = IBFEMethod(m, neo_hookean(1.0, 4.0), kernel="IB_4", dtype=F64)
+    integ = TwoLevelIBINS(g, box, fe, mu=0.05, proj_tol=1e-9)
+    st = integ.initialize(X0)
+
+    asm = build_assembly(m, dtype=F64)
+    W = neo_hookean(1.0, 4.0)
+
+    def energy(X):
+        return float(elastic_energy(asm, W, X))
+
+    e0 = energy(st.X)
+    st = advance_two_level_ib(integ, st, 5e-4, 160)
+    assert bool(jnp.all(jnp.isfinite(st.X)))
+    e1 = energy(st.X)
+    assert e1 < 0.6 * e0, (e0, e1)
+    # the released elastic energy moved the fluid on BOTH levels
+    assert float(jnp.max(jnp.abs(st.fluid.uf[0]))) > 1e-4
+    assert float(jnp.max(jnp.abs(st.fluid.uc[0]))) > 1e-6
+    # composite divergence stays at solver tolerance
+    assert float(integ.core.max_divergence(st.fluid)) < 1e-6
+
+
+def test_ibfe_two_level_matches_uniform_fine():
+    """The composite IBFE run tracks a UNIFORM fine-resolution IBFE
+    run of the same disc (window covers the structure; both see the
+    same fine spacing): node positions agree to a few 1e-3 after the
+    early relaxation — the hierarchy does not distort the FE
+    coupling."""
+    from ibamr_tpu.integrators.ib import IBExplicitIntegrator
+    from ibamr_tpu.integrators.ins import INSStaggeredIntegrator
+
+    m, X0 = _stretched_disc()
+    steps, dt = 80, 5e-4
+
+    # composite: 32^2 coarse + 2x window -> fine spacing 1/64
+    g = StaggeredGrid(n=(32, 32), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    box = FineBox(lo=(8, 8), shape=(16, 16))
+    fe = IBFEMethod(m, neo_hookean(1.0, 4.0), kernel="IB_4", dtype=F64)
+    tl = TwoLevelIBINS(g, box, fe, mu=0.05, proj_tol=1e-9)
+    st_tl = advance_two_level_ib(tl, tl.initialize(X0), dt, steps)
+
+    # uniform 64^2 (same fine spacing everywhere)
+    gu = StaggeredGrid(n=(64, 64), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    ins = INSStaggeredIntegrator(gu, mu=0.05, rho=1.0, dtype=F64)
+    fe_u = IBFEMethod(m, neo_hookean(1.0, 4.0), kernel="IB_4",
+                      dtype=F64)
+    un = IBExplicitIntegrator(ins, fe_u)
+    st_u = un.initialize(X0)
+    step_u = jax.jit(lambda s, d: un.step(s, d))
+    for _ in range(steps):
+        st_u = step_u(st_u, dt)
+
+    err = float(jnp.max(jnp.abs(st_tl.X - st_u.X)))
+    assert err < 5e-3, err
