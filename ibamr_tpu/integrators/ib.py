@@ -57,9 +57,14 @@ def check_fast_grid(fast, grid: StaggeredGrid) -> None:
     if eg is not None and (tuple(eg.n) != tuple(grid.n)
                            or eg.x_lo != grid.x_lo
                            or eg.x_up != grid.x_up):
+        # print the full geometry: in the composite-hierarchy mismatch
+        # (coarse engine vs fine window) the SHAPES can be identical
+        # and only the extents differ
         raise ValueError(
-            f"fast engine grid {tuple(eg.n)} != call grid "
-            f"{tuple(grid.n)}; rebuild the engine for this grid")
+            f"fast engine grid (n={tuple(eg.n)}, x_lo={eg.x_lo}, "
+            f"x_up={eg.x_up}) != call grid (n={tuple(grid.n)}, "
+            f"x_lo={grid.x_lo}, x_up={grid.x_up}); rebuild the "
+            "engine for this grid")
 
 
 class IBMethod:

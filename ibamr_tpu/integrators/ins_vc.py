@@ -236,10 +236,7 @@ class INSVCStaggeredIntegrator:
         dim = g.dim
         dx = g.dx
 
-        def take(a, axis, lo, hi):
-            idx = [slice(None)] * a.ndim
-            idx[axis] = slice(lo, hi)
-            return a[tuple(idx)]
+        take = stencils.axis_slice
 
         out = []
         for d in range(dim):

@@ -43,6 +43,8 @@ from typing import Dict, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 
+from ibamr_tpu.ops import stencils
+
 Vel = Tuple[jnp.ndarray, ...]
 
 # ghost depth of the padded path: PPM face states reach 3 cells out
@@ -158,9 +160,7 @@ def convective_rate(u: Vel, dx: Sequence[float], scheme: str = "centered") -> Ve
 # ---------------------------------------------------------------------------
 
 def _take(a: jnp.ndarray, axis: int, lo: int, hi: int) -> jnp.ndarray:
-    idx = [slice(None)] * a.ndim
-    idx[axis] = slice(lo, hi)
-    return a[tuple(idx)]
+    return stencils.axis_slice(a, axis, lo, hi)
 
 
 def _pad_wrap(a: jnp.ndarray, axis: int, g: int) -> jnp.ndarray:

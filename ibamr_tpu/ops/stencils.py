@@ -189,3 +189,13 @@ def wall_boundary_masks(shape, axis: int):
 
     i = jax.lax.broadcasted_iota(jnp.int32, tuple(shape), axis)
     return i == 0, i == shape[axis] - 1
+
+
+def axis_slice(a: jnp.ndarray, axis: int, lo: int, hi: int) -> jnp.ndarray:
+    """``a[..., lo:hi, ...]`` along ``axis`` — THE shared static-slice
+    helper (the wall-flux concatenation assemblies and the ghost-padded
+    convection path all need it; one definition, not per-module
+    copies)."""
+    idx = [slice(None)] * a.ndim
+    idx[axis] = slice(lo, hi)
+    return a[tuple(idx)]

@@ -153,12 +153,23 @@ class KOmegaModel:
 
     def __init__(self, grid: StaggeredGrid, nu: float,
                  prod_limit: float = 10.0, k_min: float = 1e-12,
-                 omega_min: float = 1e-8):
+                 omega_min: float = 1e-8, wall_axes=None):
         self.grid = grid
         self.nu = float(nu)
         self.prod_limit = float(prod_limit)
         self.k_min = float(k_min)
         self.omega_min = float(omega_min)
+        # wall_axes[d]: no-slip walls on both sides of axis d (round
+        # 4 — the wall-bounded transport the reference runs). Wall
+        # treatment: k = 0 Dirichlet (one-sided half-cell diffusive
+        # wall flux), omega = the Wilcox smooth-wall asymptote
+        # 6 nu/(beta d^2) IMPOSED on the two near-wall layers (the
+        # same rows the wall-resolved channel uses), and advective
+        # wall fluxes vanish identically under the pinned-face
+        # velocity convention.
+        self.wall_axes = (tuple(bool(w) for w in wall_axes)
+                          if wall_axes is not None
+                          else (False,) * grid.dim)
 
     def nu_t(self, st: KOmegaState) -> jnp.ndarray:
         return st.k / jnp.maximum(st.omega, self.omega_min)
@@ -174,15 +185,61 @@ class KOmegaModel:
             flux_div = flux_div + (jnp.roll(flux, -1, d) - flux) / dx[d]
         return flux_div
 
-    def _diff(self, q: jnp.ndarray, D: jnp.ndarray, dx) -> jnp.ndarray:
-        """div(D grad q) with arithmetic face diffusivity, periodic."""
+    def _diff(self, q: jnp.ndarray, D: jnp.ndarray, dx,
+              wall_dirichlet=None) -> jnp.ndarray:
+        """div(D grad q) with arithmetic face diffusivity; periodic on
+        non-wall axes. On wall axes the wall-face flux is assembled
+        one-sided (CONCATENATION — the lo/hi wall fluxes differ, so the
+        periodic-wrap trick cannot carry them): ``wall_dirichlet``
+        gives the wall value (half-cell gradient against it, e.g. k=0);
+        None means zero-flux (used for omega, whose wall rows are
+        imposed anyway)."""
+
+        take = stencils.axis_slice
+
         out = jnp.zeros_like(q)
         for d in range(q.ndim):
             Df = 0.5 * (D + jnp.roll(D, 1, d))
             grad = (q - jnp.roll(q, 1, d)) / dx[d]
             flux = Df * grad
-            out = out + (jnp.roll(flux, -1, d) - flux) / dx[d]
+            if self.wall_axes[d]:
+                n = q.shape[d]
+                interior = take(flux, d, 1, n)
+                if wall_dirichlet is None:
+                    f_lo = jnp.zeros_like(take(flux, d, 0, 1))
+                    f_hi = f_lo
+                else:
+                    wv = wall_dirichlet
+                    f_lo = (take(D, d, 0, 1)
+                            * 2.0 * (take(q, d, 0, 1) - wv) / dx[d])
+                    f_hi = (take(D, d, n - 1, n)
+                            * 2.0 * (wv - take(q, d, n - 1, n)) / dx[d])
+                full = jnp.concatenate([f_lo, interior, f_hi], axis=d)
+                out = out + (take(full, d, 1, n + 1)
+                             - take(full, d, 0, n)) / dx[d]
+            else:
+                out = out + (jnp.roll(flux, -1, d) - flux) / dx[d]
         return out
+
+    def _impose_omega_walls(self, w: jnp.ndarray) -> jnp.ndarray:
+        """Overwrite the two near-wall layers of every wall axis with
+        the Wilcox smooth-wall asymptote omega = 6 nu/(beta d^2)."""
+        if not any(self.wall_axes):
+            return w
+        for d, is_wall in enumerate(self.wall_axes):
+            if not is_wall:
+                continue
+            h = self.grid.dx[d]
+            for layer in (0, 1):
+                dist = (layer + 0.5) * h
+                val = 6.0 * self.nu / (self.beta * dist * dist)
+                idx = [slice(None)] * w.ndim
+                idx[d] = slice(layer, layer + 1)
+                w = w.at[tuple(idx)].set(val)
+                idx[d] = slice(w.shape[d] - 1 - layer,
+                               w.shape[d] - layer)
+                w = w.at[tuple(idx)].set(val)
+        return w
 
     def advance(self, st: KOmegaState, u: Vel, dt: float) -> KOmegaState:
         dx = self.grid.dx
@@ -195,14 +252,16 @@ class KOmegaModel:
 
         k_star = (k + dt * (P_k - self._adv(k, u, dx)
                             + self._diff(k, self.nu
-                                         + self.sigma_star * nu_t, dx)))
+                                         + self.sigma_star * nu_t, dx,
+                                         wall_dirichlet=0.0)))
         w_star = (w + dt * (self.alpha * (w / k) * P_k
                             - self._adv(w, u, dx)
                             + self._diff(w, self.nu
                                          + self.sigma * nu_t, dx)))
         # pointwise-implicit sinks (unconditionally stable)
         k_new = k_star / (1.0 + dt * self.beta_star * w)
-        w_new = w_star / (1.0 + dt * self.beta * w)
+        w_new = self._impose_omega_walls(
+            w_star / (1.0 + dt * self.beta * w))
         return KOmegaState(k=jnp.maximum(k_new, self.k_min),
                            omega=jnp.maximum(w_new, self.omega_min))
 
@@ -215,18 +274,24 @@ class KOmegaINS:
 
     def __init__(self, grid: StaggeredGrid, mu: float, rho: float = 1.0,
                  convective_op_type: str = "upwind",
-                 dtype=jnp.float32):
+                 wall_axes=None, dtype=jnp.float32):
         from ibamr_tpu.integrators.ins_vc import INSVCStaggeredIntegrator
 
         self.grid = grid
         self.mu = float(mu)
         self.rho = float(rho)
         self.dtype = dtype
-        self.model = KOmegaModel(grid, nu=mu / rho)
+        # wall_axes: wall-bounded URANS (round 4) — no-slip momentum
+        # walls via the VC wall machinery, k = 0 / omega-asymptote
+        # walls in the transport model
+        walls = wall_axes is not None and any(wall_axes)
+        self.model = KOmegaModel(grid, nu=mu / rho,
+                                 wall_axes=wall_axes)
         self._vc = INSVCStaggeredIntegrator(
             grid, rho0=rho, rho1=rho, mu0=mu, mu1=mu,
             convective_op_type=convective_op_type,
-            reinit_interval=0, precond="fft", dtype=dtype)
+            reinit_interval=0, precond="mg" if walls else "fft",
+            wall_axes=wall_axes, dtype=dtype)
 
     def initialize(self, u0: Optional[Vel] = None,
                    k0: float = 1e-4, omega0: float = 1.0):

@@ -177,6 +177,10 @@ SLOW_TESTS = {
     "test_smagorinsky_walled_channel_decays_bounded",
     "test_falling_drop_3d_walled_smoke",
     "test_hydrostatic_quiescence_3d_walled_tank",
+    "test_komega_walled_transport_sane",
+    "test_komega_ins_walled_channel_smoke",
+    "test_ibfe_on_two_level_hierarchy_relaxes",
+    "test_ibfe_two_level_matches_uniform_fine",
 }
 
 
