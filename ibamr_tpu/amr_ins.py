@@ -601,21 +601,12 @@ def _box_mac_from_periodic(f_per: Vel) -> Vel:
     """Periodic fine-grid MAC layout (shape nf) -> box layout (+1 normal
     extent). Valid when no marker stencil wraps (structure keeps
     delta-support clearance from the box boundary), so the duplicated
-    face carries zero."""
-    out = []
-    for d, f in enumerate(f_per):
-        first = jnp.take(f, jnp.asarray([0]), axis=d)
-        out.append(jnp.concatenate([f, first], axis=d))
-    return tuple(out)
+    face carries zero. Delegates to the shared layout bridge."""
+    return stencils.mac_complete_from_periodic(f_per)
 
 
 def _periodic_from_box_mac(u_box: Vel, fine_n) -> Vel:
-    out = []
-    for d, u in enumerate(u_box):
-        idx = [slice(None)] * u.ndim
-        idx[d] = slice(0, fine_n[d])
-        out.append(u[tuple(idx)])
-    return tuple(out)
+    return stencils.mac_periodic_from_complete(u_box, fine_n)
 
 
 class TwoLevelIBINS:

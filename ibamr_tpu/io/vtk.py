@@ -275,3 +275,84 @@ def write_vtm_hierarchy(path: str, level_grids, level_fields,
     with open(path, "w") as f:
         f.write(body)
     return path
+
+
+# VTK cell-type ids for the FE element menu. Node orderings: VTK's
+# quadratic simplices list corners then edge midpoints over the same
+# edge sets as fe/fem.py's libMesh-order tables ((0,1),(1,2),(2,0)[,(0,3),
+# (1,3),(2,3)]) — midpoints are direction-free, so connectivity passes
+# through unchanged; QUAD4/HEX8 counterclockwise/bottom-top orders also
+# coincide.
+_VTK_CELL_TYPES = {
+    "TRI3": 5,
+    "QUAD4": 9,
+    "TET4": 10,
+    "HEX8": 12,
+    "TRI6": 22,
+    "TET10": 24,
+}
+
+
+def write_vtu(path: str, nodes: np.ndarray, elems: np.ndarray,
+              elem_type: str,
+              point_data: Optional[Dict[str, np.ndarray]] = None) -> str:
+    """Write an FE mesh (current or reference configuration) as VTK
+    UnstructuredGrid — the IBFE structure-viz analog of the reference's
+    libMesh Exodus output (SURVEY.md T15/T16): ParaView renders the
+    deformed solid with its real element connectivity, not just a
+    marker cloud. ``point_data``: per-node scalars/vectors (zero-padded
+    to 3 components)."""
+    if elem_type not in _VTK_CELL_TYPES:
+        raise ValueError(f"unsupported element type {elem_type!r} "
+                         f"(menu: {sorted(_VTK_CELL_TYPES)})")
+    nodes = np.asarray(nodes, dtype=np.float64)
+    elems = np.asarray(elems, dtype=np.int64)
+    N, dim = nodes.shape
+    if dim < 3:
+        nodes = np.concatenate([nodes, np.zeros((N, 3 - dim))], axis=1)
+    E, nen = elems.shape
+    ctype = _VTK_CELL_TYPES[elem_type]
+    point_data = point_data or {}
+
+    parts = ['<?xml version="1.0"?>\n',
+             '<VTKFile type="UnstructuredGrid" version="0.1" '
+             'byte_order="LittleEndian">\n  <UnstructuredGrid>\n',
+             f'    <Piece NumberOfPoints="{N}" NumberOfCells="{E}">\n',
+             '      <Points>\n        <DataArray type="Float32" '
+             'NumberOfComponents="3" format="ascii">\n',
+             _ascii(nodes.reshape(-1)),
+             '\n        </DataArray>\n      </Points>\n',
+             '      <Cells>\n        <DataArray type="Int64" '
+             'Name="connectivity" format="ascii">\n',
+             " ".join(str(v) for v in elems.reshape(-1)),
+             '\n        </DataArray>\n        <DataArray type="Int64" '
+             'Name="offsets" format="ascii">\n',
+             " ".join(str(nen * (e + 1)) for e in range(E)),
+             '\n        </DataArray>\n        <DataArray type="UInt8" '
+             'Name="types" format="ascii">\n',
+             " ".join(str(ctype) for _ in range(E)),
+             '\n        </DataArray>\n      </Cells>\n']
+    if point_data:
+        parts.append('      <PointData>\n')
+        for name, arr in point_data.items():
+            a = np.asarray(arr, dtype=np.float64)
+            if a.ndim == 1:
+                ncomp = 1
+                flat = a
+            else:
+                if a.shape[1] < 3:
+                    a = np.concatenate(
+                        [a, np.zeros((a.shape[0], 3 - a.shape[1]))],
+                        axis=1)
+                ncomp = a.shape[1]
+                flat = a.reshape(-1)
+            parts.append(f'        <DataArray type="Float32" '
+                         f'Name="{name}" NumberOfComponents="{ncomp}" '
+                         'format="ascii">\n')
+            parts.append(_ascii(flat))
+            parts.append('\n        </DataArray>\n')
+        parts.append('      </PointData>\n')
+    parts.append('    </Piece>\n  </UnstructuredGrid>\n</VTKFile>\n')
+    with open(path, "w") as f:
+        f.write("".join(parts))
+    return path

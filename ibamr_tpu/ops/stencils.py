@@ -199,3 +199,24 @@ def axis_slice(a: jnp.ndarray, axis: int, lo: int, hi: int) -> jnp.ndarray:
     idx = [slice(None)] * a.ndim
     idx[axis] = slice(lo, hi)
     return a[tuple(idx)]
+
+
+def mac_complete_from_periodic(f):
+    """Periodic lower-face MAC layout -> face-complete (+1 on each
+    component's own axis), duplicating the wrap face. Exact when the
+    physics guarantees the boundary faces carry the wrap value — e.g.
+    a spread force whose structure keeps delta-support clearance from
+    the boundary (both boundary faces then carry 0). Shared by the
+    fine-window composite path and the open-boundary IB coupling."""
+    out = []
+    for d, c in enumerate(f):
+        first = axis_slice(c, d, 0, 1)
+        out.append(jnp.concatenate([c, first], axis=d))
+    return tuple(out)
+
+
+def mac_periodic_from_complete(u, n):
+    """Face-complete MAC layout -> periodic lower-face layout (drop
+    each component's upper boundary face). Inverse of
+    :func:`mac_complete_from_periodic` under the clearance contract."""
+    return tuple(axis_slice(c, d, 0, n[d]) for d, c in enumerate(u))

@@ -181,6 +181,8 @@ SLOW_TESTS = {
     "test_komega_ins_walled_channel_smoke",
     "test_ibfe_on_two_level_hierarchy_relaxes",
     "test_ibfe_two_level_matches_uniform_fine",
+    "test_cylinder_wake_drag_re20",
+    "test_ib_open_free_structure_advects",
 }
 
 

@@ -1,0 +1,127 @@
+"""IB coupling on open-boundary domains (round 4): flow past an
+immersed cylinder in an inflow/outflow channel — the reference's
+canonical external-flow IB configuration (SURVEY.md P2/P8).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ibamr_tpu.grid import StaggeredGrid
+from ibamr_tpu.integrators.ib import IBMethod
+from ibamr_tpu.integrators.ib_open import (IBOpenIntegrator,
+                                           advance_ib_open)
+from ibamr_tpu.integrators.ins_open import INSOpenIntegrator
+from ibamr_tpu.solvers.stokes import channel_bc
+
+F64 = jnp.float64
+
+
+def _cylinder_markers(center, radius, n_markers):
+    th = 2.0 * np.pi * np.arange(n_markers) / n_markers
+    return np.stack([center[0] + radius * np.cos(th),
+                     center[1] + radius * np.sin(th)], axis=1)
+
+
+def _target_ib(X0, kappa, eta):
+    X0j = jnp.asarray(X0, F64)
+
+    def force(X, U, t):
+        return -kappa * (X - X0j) - eta * U
+
+    # no springs — pure target points; specs kept empty via force_fn
+    from ibamr_tpu.ops.forces import ForceSpecs
+
+    return IBMethod(ForceSpecs(), kernel="IB_4", force_fn=force)
+
+
+def test_cylinder_wake_drag_re20():
+    """Target-point cylinder (D = 8 dx) in a channel at Re_D = 20:
+    the flow develops a wake deficit behind the body, the measured
+    drag coefficient lands in the physical band for a confined
+    cylinder at this Reynolds number (unbounded C_D ~ 2.0; blockage
+    D/H = 0.25 raises it), the drag is statistically steady by the end
+    of the run, and the markers are held near their anchors."""
+    nx, ny = 64, 32
+    dx = (2.0 / nx, 1.0 / ny)
+    U0, D = 1.0, 0.25
+    mu = U0 * D / 20.0                     # Re_D = 20
+    dt = 3e-3
+    ins = INSOpenIntegrator((nx, ny), dx, channel_bc(2), mu=mu, dt=dt,
+                            bdry={(0, 0, 0): U0}, tol=1e-8,
+                            convective_op_type="stabilized_ppm")
+    X0 = _cylinder_markers((0.6, 0.5), D / 2.0, 40)
+    # spring scale: spreading F multiplies by ~1/dx^2, so the coupled
+    # oscillator frequency is omega^2 ~ kappa/(rho dx^2); kappa = 50
+    # keeps omega*dt ~ 0.7 (stable) while holding markers to ~1e-2 D
+    kappa, eta = 50.0, 1.0
+    integ = IBOpenIntegrator(ins, _target_ib(X0, kappa, eta))
+    st = integ.initialize(X0)
+
+    st = advance_ib_open(integ, st, 900)
+    drag_a = -float(integ.body_force_on_fluid(st)[0])
+    st = advance_ib_open(integ, st, 300)
+    drag_b = -float(integ.body_force_on_fluid(st)[0])
+
+    assert bool(jnp.all(jnp.isfinite(st.fluid.u[0])))
+    assert bool(jnp.all(jnp.isfinite(st.X)))
+
+    # statistically steady drag (Re 20 is steady flow; the slow
+    # marker-drift relaxation leaves a few-percent window drift)
+    assert abs(drag_b - drag_a) < 0.15 * abs(drag_b), (drag_a, drag_b)
+    # calibrated C_D band: unbounded cylinder at Re 20 is ~2.0; the
+    # 25% blockage between NO-SLIP channel walls plus the IB_4
+    # effective diameter (D + ~2dx, i.e. +25% at 8 cells/D) raise the
+    # nominal-D coefficient several-fold (measured ~6.7 at this
+    # config; grows toward the confined-cylinder values of the
+    # blockage literature as resolution refines)
+    cd = drag_b / (0.5 * 1.0 * U0 ** 2 * D)
+    assert 3.0 < cd < 9.0, cd
+
+    # wake: strong centerline deficit ~1 D behind the body (the
+    # measured wake RECIRCULATES, u < 0); recovery downstream
+    u = np.asarray(st.fluid.u[0])
+    j = ny // 2
+    i_wake = int(0.85 / dx[0])             # ~1 diameter behind
+    i_far = int(1.7 / dx[0])
+    assert u[i_wake, j] < 0.3 * U0, u[i_wake, j]
+    assert u[i_far, j] > u[i_wake, j]
+    # blockage accelerates the gap flow past the free stream
+    assert u.max() > 1.3 * U0
+
+    # the target springs hold the body (markers near anchors)
+    disp = float(np.max(np.linalg.norm(np.asarray(st.X) - X0, axis=1)))
+    assert disp < 0.2 * D, disp
+
+
+def test_ib_open_free_structure_advects():
+    """A force-free marker blob released in the channel advects
+    downstream with the flow (the coupling's interp path against the
+    face-complete layout is exact: uniform flow moves markers at
+    exactly U0 before the blob nears the outflow)."""
+    nx, ny = 32, 16
+    dx = (2.0 / nx, 1.0 / ny)
+    U0 = 0.5
+    ins = INSOpenIntegrator((nx, ny), dx, channel_bc(2), mu=1e-12,
+                            dt=0.01, bdry={(0, 0, 0): U0}, tol=1e-11,
+                            convective_op_type="stabilized_ppm")
+    from ibamr_tpu.ops.forces import ForceSpecs
+
+    ib = IBMethod(ForceSpecs(), kernel="IB_4",
+                  force_fn=lambda X, U, t: jnp.zeros_like(X))
+    integ = IBOpenIntegrator(ins, ib)
+    th = 2.0 * np.pi * np.arange(8) / 8
+    X0 = np.stack([0.5 + 0.05 * np.cos(th),
+                   0.5 + 0.05 * np.sin(th)], axis=1)
+    # start from the developed uniform stream (plug inflow, frictionless
+    # center: see test_ins_open free-stream preservation)
+    st = integ.initialize(jnp.asarray(X0, F64))
+    for _ in range(20):                    # develop the stream first
+        st = st._replace(fluid=ins.step(st.fluid))
+    T = 40
+    x_start = float(jnp.mean(st.X[:, 0]))
+    st = advance_ib_open(integ, st, T)
+    adv = float(jnp.mean(st.X[:, 0])) - x_start
+    # the CENTER of the channel carries ~U0 (free stream); the blob
+    # spans a few cells so allow a finite band
+    assert 0.6 * U0 * T * 0.01 < adv < 1.4 * U0 * T * 0.01, adv

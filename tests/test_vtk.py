@@ -117,3 +117,38 @@ def test_vizwriter_hierarchy_series(tmp_path):
                           {"Q": np.ones((8, 8), np.float32)}])
     pvd = (tmp_path / "hierarchy.pvd").read_text()
     assert "amr_000000.vtm" in pvd and "amr_000010.vtm" in pvd
+
+
+def test_vtu_unstructured_fe_mesh(tmp_path):
+    """write_vtu round-trips the FE element menu as UnstructuredGrid:
+    counts, connectivity, cell types, and point data parse back."""
+    import xml.etree.ElementTree as ET
+
+    import numpy as np
+
+    from ibamr_tpu.fe.mesh import (box_hex_mesh, disc_mesh,
+                                   rect_quad_mesh, to_quadratic)
+    from ibamr_tpu.io.vtk import _VTK_CELL_TYPES, write_vtu
+
+    meshes = [disc_mesh(n_rings=3), to_quadratic(disc_mesh(n_rings=2)),
+              rect_quad_mesh(3, 2), box_hex_mesh(2, 2, 2)]
+    for m in meshes:
+        p = write_vtu(str(tmp_path / f"vtu_{m.elem_type}.vtu"), m.nodes, m.elems,
+                      m.elem_type,
+                      point_data={"disp": np.zeros_like(m.nodes),
+                                  "id": np.arange(m.n_nodes)})
+        root = ET.parse(p).getroot()
+        piece = root.find(".//Piece")
+        assert int(piece.get("NumberOfPoints")) == m.n_nodes
+        assert int(piece.get("NumberOfCells")) == m.n_elems
+        conn = [int(v) for v in root.find(
+            ".//DataArray[@Name='connectivity']").text.split()]
+        assert conn == [int(v) for v in m.elems.reshape(-1)]
+        types = {int(v) for v in root.find(
+            ".//DataArray[@Name='types']").text.split()}
+        assert types == {_VTK_CELL_TYPES[m.elem_type]}
+    import pytest
+
+    with pytest.raises(ValueError, match="unsupported element"):
+        write_vtu(str(tmp_path / "bad.vtu"), meshes[0].nodes, meshes[0].elems,
+                  "PYRAMID5")
