@@ -79,11 +79,16 @@ class IBOpenIntegrator:
                    mask=None) -> IBOpenState:
         if fluid is None:
             fluid = self.ins.initialize()
-        X = jnp.asarray(X0)
+        # cast markers to the FLUID dtype (same contract as
+        # IBExplicitIntegrator.initialize): a mixed-precision carry
+        # would either break the scan (f32 markers + f64 fluid) or
+        # silently promote the production-f32 step to f64
+        dtype = self.ins.solver.dtype
+        X = jnp.asarray(X0, dtype=dtype)
         if mask is None:
-            mask = jnp.ones(X.shape[0], dtype=X.dtype)
+            mask = jnp.ones(X.shape[0], dtype=dtype)
         return IBOpenState(fluid=fluid, X=X, U=jnp.zeros_like(X),
-                           mask=jnp.asarray(mask, dtype=X.dtype))
+                           mask=jnp.asarray(mask, dtype=dtype))
 
     # -- single step (pure, jittable) ----------------------------------------
     def step(self, state: IBOpenState) -> IBOpenState:
