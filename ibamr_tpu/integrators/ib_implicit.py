@@ -151,18 +151,20 @@ class TwoLevelIBImplicit:
                  scheme: str = "midpoint",
                  newton_tol: float = 1e-6, newton_maxiter: int = 8,
                  inner_m: int = 12, inner_restarts: int = 2,
-                 inner_tol: float = 1e-3):
+                 inner_tol: float = 1e-3, _expl=None):
         from ibamr_tpu.amr_ins import TwoLevelIBINS
 
         if scheme not in ("midpoint", "backward_euler"):
             raise ValueError(f"unknown implicit IB scheme {scheme!r}")
         # reuse the explicit composite integrator for its core stepping
         # + fine-resolution transfer helpers; only the coupling loop
-        # differs
-        self._expl = TwoLevelIBINS(grid, box, ib, rho=rho, mu=mu,
-                                   convective=convective,
-                                   proj_tol=proj_tol, proj_m=proj_m,
-                                   proj_restarts=proj_restarts)
+        # differs. ``_expl`` lets the moving-window regrid adopt the
+        # explicit integrator it already rebuilt at the new box instead
+        # of paying a second CompositeProjection/FastDiag construction.
+        self._expl = _expl if _expl is not None else TwoLevelIBINS(
+            grid, box, ib, rho=rho, mu=mu, convective=convective,
+            proj_tol=proj_tol, proj_m=proj_m,
+            proj_restarts=proj_restarts)
         self.grid = grid
         self.box = box
         self.ib = ib
@@ -234,3 +236,46 @@ def advance_two_level_ib_implicit(integ: TwoLevelIBImplicit, state,
 
     out, _ = jax.lax.scan(body, state, None, length=num_steps)
     return out
+
+
+def regrid_two_level_ib_implicit(integ: TwoLevelIBImplicit, state,
+                                 move_threshold: int = 2):
+    """Moving-window regrid for the IMPLICIT composite integrator:
+    retag the window from the current markers and rebuild BOTH the
+    explicit core (state transfer runs through the explicit machinery,
+    amr_ins.regrid_two_level_ib) and the implicit wrapper around the
+    new box. Unchanged window returns (integ, state) as-is."""
+    from ibamr_tpu.amr_ins import regrid_two_level_ib
+
+    expl2, state2 = regrid_two_level_ib(integ._expl, state,
+                                        move_threshold=move_threshold)
+    if expl2 is integ._expl:
+        return integ, state
+    core = expl2.core
+    integ2 = TwoLevelIBImplicit(
+        integ.grid, expl2.box, integ.ib, rho=core.rho, mu=core.mu,
+        convective=core.convective, proj_tol=core.proj.tol,
+        proj_m=core.proj.m, proj_restarts=core.proj.restarts,
+        scheme=integ.scheme, newton_tol=integ.newton_tol,
+        newton_maxiter=integ.newton_maxiter, inner_m=integ.inner_m,
+        inner_restarts=integ.inner_restarts,
+        inner_tol=integ.inner_tol, _expl=expl2)
+    return integ2, state2
+
+
+def advance_two_level_ib_implicit_regridding(integ: TwoLevelIBImplicit,
+                                             state, dt: float,
+                                             num_steps: int,
+                                             regrid_interval: int = 20,
+                                             on_chunk=None):
+    """Implicit composite advance with the fine window TRACKING the
+    structure (the regrid-cadence driver shared with the explicit
+    path): jitted chunks of ``regrid_interval`` implicit steps with
+    host-side marker-tagged regrids between them — stiff structures
+    get large dt AND a window that follows them."""
+    from ibamr_tpu.amr_ins import advance_with_regrids
+
+    return advance_with_regrids(
+        integ, state, dt, num_steps, regrid_interval,
+        advance_two_level_ib_implicit, regrid_two_level_ib_implicit,
+        on_chunk=on_chunk)

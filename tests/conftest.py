@@ -183,6 +183,7 @@ SLOW_TESTS = {
     "test_ibfe_two_level_matches_uniform_fine",
     "test_cylinder_wake_drag_re20",
     "test_ib_open_free_structure_advects",
+    "test_implicit_regridding_window_tracks_structure",
 }
 
 
