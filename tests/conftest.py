@@ -184,6 +184,7 @@ SLOW_TESTS = {
     "test_cylinder_wake_drag_re20",
     "test_ib_open_free_structure_advects",
     "test_implicit_regridding_window_tracks_structure",
+    "test_two_level_ib_sharded_window_s2_markers_matches_single",
 }
 
 

