@@ -119,7 +119,15 @@ class IBMethod:
 
 
 class IBExplicitIntegrator:
-    """Explicit IB coupling of an INS integrator and an IBMethod (P8)."""
+    """Explicit IB coupling of an INS integrator and an IBMethod (P8).
+
+    ``ins`` is any fluid integrator exposing ``grid``, ``dtype``,
+    ``initialize()`` and ``step(state, dt, f=...)`` with a state
+    carrying ``u`` and ``t`` — the periodic staggered integrator, the
+    wall-bounded one, and the MULTIPHASE VC forms all satisfy the seam,
+    so capsule-style structures in two-phase flow are the same
+    composition (pass ``ins_state=vc.initialize(phi0)`` to
+    ``initialize``; pinned by tests/test_vc_ib.py)."""
 
     def __init__(self, ins: INSStaggeredIntegrator, ib: IBMethod,
                  scheme: str = "midpoint"):

@@ -202,7 +202,8 @@ def make_sharded_multilevel_step(ml, mesh: Mesh):
 
 def _wrap_sharded_markers(base_ib, grid: StaggeredGrid, mesh: Mesh,
                           marker_cap: Optional[int] = None,
-                          marker_slack: float = 2.0):
+                          marker_slack: float = 2.0,
+                          warn_strategy: bool = False):
     """Build the S2 facade routing an IBMethod's transfers through the
     co-partitioned engine (parallel.lagrangian) on ``grid`` — markers
     owner-bucketed onto the mesh every step, local scatter/gather,
@@ -216,13 +217,19 @@ def _wrap_sharded_markers(base_ib, grid: StaggeredGrid, mesh: Mesh,
     from ibamr_tpu.parallel.lagrangian import ShardedInteraction
 
     if not isinstance(base_ib, IBMethod):
-        import warnings
+        # the GSPMD-resolved path is the INTENDED route for IBFE
+        # quadrature couplings and custom plugins, so the default
+        # (make_sharded_ib_step's sharded_markers=True) stays silent;
+        # an EXPLICIT opt-in (the composite paths) warns so the user
+        # learns their request was not honored
+        if warn_strategy:
+            import warnings
 
-        warnings.warn(
-            "sharded markers disabled: the S2 facade understands "
-            f"marker-point IBMethod transfers only (got "
-            f"{type(base_ib).__name__}); keeping the GSPMD-resolved "
-            "path")
+            warnings.warn(
+                "sharded markers disabled: the S2 facade understands "
+                f"marker-point IBMethod transfers only (got "
+                f"{type(base_ib).__name__}); keeping the "
+                "GSPMD-resolved path")
         return None
     try:
         ShardedInteraction(grid, mesh, kernel=base_ib.kernel, cap=8)
@@ -384,7 +391,8 @@ def make_sharded_two_level_ib_step(integ, mesh: Mesh,
         # Composes with shard_window (the natural pairing); ineligible
         # (fine grid, mesh) geometries fall back with a warning.
         wrapped = _wrap_sharded_markers(
-            integ.ib, integ.fine_grid, mesh, marker_cap, marker_slack)
+            integ.ib, integ.fine_grid, mesh, marker_cap, marker_slack,
+            warn_strategy=True)
         if wrapped is not None:
             integ.ib = wrapped
 

@@ -1,0 +1,111 @@
+"""IB structures in MULTIPHASE (VC) flow — the capsule/biofilm-style
+configuration the reference runs by pairing IBMethod with its VC
+hierarchy integrators (SURVEY.md P8 over P22): the explicit IB coupling
+composes with ``INSVCStaggeredIntegrator`` through the same
+``step(state, dt, f=...)`` seam as the single-phase integrator.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ibamr_tpu.grid import StaggeredGrid
+from ibamr_tpu.integrators.ib import (IBExplicitIntegrator, IBMethod,
+                                      advance_ib)
+from ibamr_tpu.integrators.ins_vc import INSVCStaggeredIntegrator
+from ibamr_tpu.models.membrane2d import make_circle_membrane
+
+F64 = jnp.float64
+
+
+def test_membrane_capsule_sediments_in_two_phase_tank():
+    """An elastic membrane enclosing a HEAVY drop (level set and
+    markers initialized on the same circle) sediments in a closed
+    walled tank: the membrane centroid falls WITH the drop's level-set
+    centroid (the two interface representations stay together), the
+    heavy volume is conserved, everything stays finite and
+    divergence-free, and the wall faces stay pinned."""
+    n = 48
+    g = StaggeredGrid(n=(n, n), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    r0, c0 = 0.12, (0.5, 0.62)
+    vc = INSVCStaggeredIntegrator(
+        g, rho0=1.0, rho1=5.0, mu0=0.02, mu1=0.05,
+        gravity=(0.0, -3.0), convective_op_type="upwind",
+        reinit_interval=10, cg_tol=1e-10, wall_axes=(True, True),
+        dtype=F64)
+    xx = (np.arange(n) + 0.5) / n
+    X, Y = np.meshgrid(xx, xx, indexing="ij")
+    phi0 = jnp.asarray(r0 - np.sqrt((X - c0[0]) ** 2
+                                    + (Y - c0[1]) ** 2))
+    s = make_circle_membrane(64, r0, c0, stiffness=1.0)
+    ib = IBMethod(s.force_specs(dtype=F64), kernel="IB_4")
+    integ = IBExplicitIntegrator(vc, ib)
+    st = integ.initialize(jnp.asarray(s.vertices, F64),
+                          ins_state=vc.initialize(phi0))
+    vol0 = float(vc.heavy_phase_volume(st.ins))
+
+    def ls_centroid_y(phi):
+        from ibamr_tpu.physics.level_set import heaviside
+        H = heaviside(phi, vc.eps)
+        return float(jnp.sum(H * jnp.asarray(Y)) / jnp.sum(H))
+
+    y_ls0 = ls_centroid_y(st.ins.phi)
+    y_mb0 = float(jnp.mean(st.X[:, 1]))
+
+    st = advance_ib(integ, st, 5e-4, 300)
+
+    assert bool(jnp.all(jnp.isfinite(st.X)))
+    assert bool(jnp.all(jnp.isfinite(st.ins.u[0])))
+    assert float(vc.max_divergence(st.ins)) < 1e-7
+    # wall faces pinned
+    for d in (0, 1):
+        idx = [slice(None)] * 2
+        idx[d] = slice(0, 1)
+        assert float(jnp.max(jnp.abs(st.ins.u[d][tuple(idx)]))) == 0.0
+
+    y_ls1 = ls_centroid_y(st.ins.phi)
+    y_mb1 = float(jnp.mean(st.X[:, 1]))
+    # both representations fell ...
+    assert y_ls1 < y_ls0 - 0.01, (y_ls0, y_ls1)
+    assert y_mb1 < y_mb0 - 0.01, (y_mb0, y_mb1)
+    # ... and fell TOGETHER (the membrane is advected by the same
+    # velocity field that transports the level set)
+    assert abs((y_ls1 - y_ls0) - (y_mb1 - y_mb0)) < 0.012, \
+        (y_ls1 - y_ls0, y_mb1 - y_mb0)
+
+    vol1 = float(vc.heavy_phase_volume(st.ins))
+    assert abs(vol1 - vol0) / vol0 < 0.05, (vol0, vol1)
+
+
+def test_membrane_tension_drives_flow_in_two_phase_fluid():
+    """A pre-stretched membrane in a quiescent two-phase box (no
+    gravity): its elastic relaxation must inject momentum into the VC
+    fluid — pins the f-argument coupling path through the variable-
+    density predictor."""
+    n = 32
+    g = StaggeredGrid(n=(n, n), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    vc = INSVCStaggeredIntegrator(
+        g, rho0=1.0, rho1=2.0, mu0=0.05, mu1=0.1,
+        convective_op_type="none", reinit_interval=0, cg_tol=1e-10,
+        dtype=F64)
+    y = (np.arange(n) + 0.5) / n
+    phi0 = jnp.asarray(np.broadcast_to((0.5 - y)[None, :], (n, n)))
+    # everywhere-taut loop (rest length < chord): net inward tension
+    # drives the ellipse toward a circle, injecting momentum
+    s = make_circle_membrane(48, 0.12, (0.5, 0.5), stiffness=5.0,
+                             aspect=1.3, rest_length_factor=0.7)
+    ib = IBMethod(s.force_specs(dtype=F64), kernel="IB_4")
+    integ = IBExplicitIntegrator(vc, ib)
+    st = integ.initialize(jnp.asarray(s.vertices, F64),
+                          ins_state=vc.initialize(phi0))
+    st = advance_ib(integ, st, 5e-4, 60)
+    umax = max(float(jnp.max(jnp.abs(c))) for c in st.ins.u)
+    assert umax > 1e-4, umax                      # flow developed
+    assert bool(jnp.all(jnp.isfinite(st.X)))
+    # the stretched ellipse is relaxing toward the circle
+    X1 = np.asarray(st.X)
+    r = np.linalg.norm(X1 - X1.mean(axis=0), axis=1)
+    X0 = np.asarray(s.vertices)
+    r0 = np.linalg.norm(X0 - X0.mean(axis=0), axis=1)
+    assert (r.max() - r.min()) < (r0.max() - r0.min()), \
+        ((r0.max() - r0.min()), (r.max() - r.min()))
