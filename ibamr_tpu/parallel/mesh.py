@@ -207,10 +207,13 @@ def _wrap_sharded_markers(base_ib, grid: StaggeredGrid, mesh: Mesh,
     """Build the S2 facade routing an IBMethod's transfers through the
     co-partitioned engine (parallel.lagrangian) on ``grid`` — markers
     owner-bucketed onto the mesh every step, local scatter/gather,
-    ppermute halos. Returns None (with a warning) when the strategy is
-    not a marker-point IBMethod or the (grid, mesh) geometry fails the
-    engine's constraints (axis divisibility, halo >= local block) —
-    callers then keep the GSPMD-resolved path. Shared by the uniform
+    ppermute halos. Returns None when the facade cannot engage —
+    silently for a non-IBMethod strategy unless ``warn_strategy``
+    (GSPMD is the intended route for IBFE/plugin couplings; explicit
+    opt-ins pass True to learn their request was not honored), and
+    with a warning when the (grid, mesh) geometry fails the engine's
+    constraints (axis divisibility, halo >= local block) — callers
+    then keep the GSPMD-resolved path. Shared by the uniform
     flagship step and the sharded-window composite step (S2 at the
     FINE level)."""
     from ibamr_tpu.integrators.ib import IBMethod
@@ -280,7 +283,8 @@ def _wrap_sharded_markers(base_ib, grid: StaggeredGrid, mesh: Mesh,
     return _ShardedIB()
 
 
-def make_sharded_ib_step(integ, mesh: Mesh, sharded_markers: bool = True,
+def make_sharded_ib_step(integ, mesh: Mesh,
+                         sharded_markers: Optional[bool] = None,
                          marker_cap: Optional[int] = None,
                          marker_slack: float = 2.0):
     """Jitted coupled IB step (interp -> force -> spread -> fluid solve ->
@@ -301,9 +305,13 @@ def make_sharded_ib_step(integ, mesh: Mesh, sharded_markers: bool = True,
     integ = copy.copy(integ)
     integ.ins = _with_pencil_solvers(integ.ins, mesh)
 
-    if sharded_markers:
-        wrapped = _wrap_sharded_markers(integ.ib, grid, mesh,
-                                        marker_cap, marker_slack)
+    # None = AUTO (default): use the S2 engine when eligible, fall back
+    # silently (GSPMD is the intended route for IBFE/plugin strategies).
+    # True = EXPLICIT request: warn if it cannot be honored.
+    if sharded_markers is None or sharded_markers:
+        wrapped = _wrap_sharded_markers(
+            integ.ib, grid, mesh, marker_cap, marker_slack,
+            warn_strategy=sharded_markers is True)
         if wrapped is not None:
             integ.ib = wrapped
 
@@ -534,5 +542,60 @@ def make_sharded_vc_step(integ, mesh: Mesh):
     def step(state, dt):
         state = shard_state(state, grid, mesh)
         return shard_state(integ.step(state, dt), grid, mesh)
+
+    return jax.jit(step)
+
+
+def _pin_rank_dim(mesh: Mesh, dim: int):
+    """Pin every rank-``dim`` array of a pytree to the spatial sharding
+    (the face-COMPLETE open-boundary layouts have +1 extents, so an
+    exact-shape match cannot classify them; rank works because these
+    states carry only grid-shaped fields at that rank)."""
+    sharding = NamedSharding(mesh, grid_pspec(mesh, dim))
+
+    def pin(a):
+        if hasattr(a, "ndim") and a.ndim == dim:
+            return jax.lax.with_sharding_constraint(a, sharding)
+        return a
+
+    def pin_state(st):
+        return jax.tree_util.tree_map(pin, st)
+
+    return pin_state
+
+
+def make_sharded_open_ins_step(integ, mesh: Mesh):
+    """Jitted inflow/outflow (open-boundary) INS step sharded over
+    ``mesh`` — S1 for the external-flow configuration: the coupled
+    saddle solve's red-black smoothers are masked elementwise ops and
+    its FGMRES reductions are psums, all GSPMD-compatible. Equality
+    with the single-device step is pinned by tests/test_parallel.py."""
+    pin_state = _pin_rank_dim(mesh, len(integ.n))
+
+    def step(state, f=None):
+        if f is not None:
+            f = pin_state(f)
+        return pin_state(integ.step(pin_state(state), f=f))
+
+    return jax.jit(step)
+
+
+def make_sharded_ib_open_step(integ, mesh: Mesh):
+    """Jitted coupled IB step over the OPEN-BOUNDARY fluid
+    (integrators.ib_open) with the Eulerian state sharded over
+    ``mesh`` and markers replicated — flow past an immersed structure
+    on the device mesh."""
+    pin_state = _pin_rank_dim(mesh, len(integ.ins.n))
+    replicated = NamedSharding(mesh, P())
+    pin = jax.lax.with_sharding_constraint
+
+    def pin_all(st):
+        return st._replace(fluid=pin_state(st.fluid),
+                           X=pin(st.X, replicated),
+                           U=pin(st.U, replicated),
+                           mask=pin(st.mask, replicated))
+
+    def step(state):
+        return pin_all(integ.step(pin_all(state)))
 
     return jax.jit(step)

@@ -186,6 +186,8 @@ SLOW_TESTS = {
     "test_implicit_regridding_window_tracks_structure",
     "test_two_level_ib_sharded_window_s2_markers_matches_single",
     "test_membrane_capsule_sediments_in_two_phase_tank",
+    "test_open_ins_sharded_matches_single",
+    "test_ib_open_sharded_matches_single",
 }
 
 

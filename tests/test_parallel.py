@@ -544,3 +544,64 @@ def test_two_level_ib_sharded_window_s2_markers_matches_single():
 
     _tree_allclose(ref, sh, rtol=1e-11, atol=1e-12)
     assert any(not c.sharding.is_fully_replicated for c in sh.fluid.uf)
+
+
+def test_open_ins_sharded_matches_single(mesh8):
+    """The inflow/outflow coupled saddle step (S1 for external flows)
+    sharded over the mesh equals the single-device step."""
+    from ibamr_tpu.integrators.ins_open import INSOpenIntegrator
+    from ibamr_tpu.parallel.mesh import make_sharded_open_ins_step
+    from ibamr_tpu.solvers.stokes import channel_bc
+
+    nx, ny = 32, 16
+    ins = INSOpenIntegrator((nx, ny), (2.0 / nx, 1.0 / ny),
+                            channel_bc(2), mu=0.05, dt=5e-3,
+                            bdry={(0, 0, 0): 1.0}, tol=1e-10)
+    st0 = ins.initialize()
+    ref = st0
+    for _ in range(5):
+        ref = ins.step(ref)
+
+    step = make_sharded_open_ins_step(ins, mesh8)
+    sh = st0
+    for _ in range(5):
+        sh = step(sh)
+
+    _tree_allclose(ref, sh, rtol=1e-12, atol=1e-13)
+    assert len(sh.u[0].sharding.device_set) == 8
+
+
+def test_ib_open_sharded_matches_single(mesh8):
+    """Flow past a target-point body with the open-boundary fluid
+    sharded: the coupled IB step equals the single-device step."""
+    from ibamr_tpu.integrators.ib import IBMethod
+    from ibamr_tpu.integrators.ib_open import IBOpenIntegrator
+    from ibamr_tpu.integrators.ins_open import INSOpenIntegrator
+    from ibamr_tpu.ops.forces import ForceSpecs
+    from ibamr_tpu.parallel.mesh import make_sharded_ib_open_step
+    from ibamr_tpu.solvers.stokes import channel_bc
+
+    nx, ny = 32, 16
+    ins = INSOpenIntegrator((nx, ny), (2.0 / nx, 1.0 / ny),
+                            channel_bc(2), mu=0.02, dt=5e-3,
+                            bdry={(0, 0, 0): 0.8}, tol=1e-10,
+                            convective_op_type="stabilized_ppm")
+    th = 2.0 * np.pi * np.arange(24) / 24
+    X0 = jnp.asarray(np.stack([0.7 + 0.12 * np.cos(th),
+                               0.5 + 0.12 * np.sin(th)], axis=1))
+    ib = IBMethod(ForceSpecs(), kernel="IB_4",
+                  force_fn=lambda X, U, t: -40.0 * (X - X0) - U)
+    integ = IBOpenIntegrator(ins, ib)
+    st0 = integ.initialize(X0)
+
+    ref = st0
+    for _ in range(4):
+        ref = integ.step(ref)
+
+    step = make_sharded_ib_open_step(integ, mesh8)
+    sh = st0
+    for _ in range(4):
+        sh = step(sh)
+
+    _tree_allclose(ref, sh, rtol=1e-11, atol=1e-12)
+    assert len(sh.fluid.u[0].sharding.device_set) == 8
