@@ -188,6 +188,8 @@ SLOW_TESTS = {
     "test_membrane_capsule_sediments_in_two_phase_tank",
     "test_open_ins_sharded_matches_single",
     "test_ib_open_sharded_matches_single",
+    "test_fe_capsule_in_two_phase_fluid",
+    "test_ib_open_3d_sphere_smoke",
 }
 
 
