@@ -220,3 +220,18 @@ def mac_periodic_from_complete(u, n):
     each component's upper boundary face). Inverse of
     :func:`mac_complete_from_periodic` under the clearance contract."""
     return tuple(axis_slice(c, d, 0, n[d]) for d, c in enumerate(u))
+
+
+def central_grad(phi: jnp.ndarray, d: int, dx_d: float,
+                 wall: bool = False) -> jnp.ndarray:
+    """Central difference along ``d``; with ``wall``, plain ONE-SIDED
+    differences at the boundary cells instead of the periodic wrap.
+    THE shared cell-centered wall-gradient helper (level-set geometry,
+    viscoelastic velocity gradients/stress divergence)."""
+    g = (jnp.roll(phi, -1, d) - jnp.roll(phi, 1, d)) / (2.0 * dx_d)
+    if wall:
+        is_lo, is_hi = wall_boundary_masks(phi.shape, d)
+        one_lo = (jnp.roll(phi, -1, d) - phi) / dx_d
+        one_hi = (phi - jnp.roll(phi, 1, d)) / dx_d
+        g = jnp.where(is_lo, one_lo, jnp.where(is_hi, one_hi, g))
+    return g

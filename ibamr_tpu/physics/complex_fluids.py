@@ -67,14 +67,23 @@ def unpack(C: jnp.ndarray, dim: int) -> jnp.ndarray:
     return out
 
 
-def velocity_gradient_cc(u: Vel, dx: Sequence[float]) -> jnp.ndarray:
-    """Cell-centered grad_u[i, j] = du_i/dx_j from MAC velocity."""
+def velocity_gradient_cc(u: Vel, dx: Sequence[float],
+                         wall_axes=None) -> jnp.ndarray:
+    """Cell-centered grad_u[i, j] = du_i/dx_j from MAC velocity.
+    ``wall_axes[j]`` replaces the periodic wrap along axis j with
+    plain one-sided differences at the boundary cells (the
+    face-to-center averages themselves stay exact under the
+    pinned-face storage)."""
+    from ibamr_tpu.ops.stencils import central_grad
+
     dim = len(u)
+    if wall_axes is None:
+        wall_axes = (False,) * dim
     cc = stencils.fc_to_cc(u)
     rows = []
     for i in range(dim):
-        cols = [(jnp.roll(cc[i], -1, j) - jnp.roll(cc[i], 1, j))
-                / (2.0 * dx[j]) for j in range(dim)]
+        cols = [central_grad(cc[i], j, dx[j], wall_axes[j])
+                for j in range(dim)]
         rows.append(jnp.stack(cols, axis=-1))
     return jnp.stack(rows, axis=-2)          # (..., i, j)
 
@@ -99,12 +108,21 @@ def polymer_stress(C: jnp.ndarray, mu_p: float, lam: float,
     return (mu_p / lam) * (C - I)
 
 
-def stress_divergence_mac(tau: jnp.ndarray, grid: StaggeredGrid) -> Vel:
+def stress_divergence_mac(tau: jnp.ndarray, grid: StaggeredGrid,
+                          wall_axes=None) -> Vel:
     """MAC body force f_d = sum_j d_j tau_dj from the packed cell-
     centered stress: face-normal derivative via backward difference to
-    the face, transverse via centered difference shifted to the face."""
+    the face, transverse via centered difference shifted to the face.
+    ``wall_axes``: one-sided transverse differences at wall layers and
+    pinned (zeroed) wall-normal output faces — the forcing consistent
+    with the no-slip wall momentum rows."""
+    from ibamr_tpu.integrators.ins_walls import pin_normal
+    from ibamr_tpu.ops.stencils import central_grad
+
     dim = grid.dim
     dx = grid.dx
+    if wall_axes is None:
+        wall_axes = (False,) * dim
     tf = unpack(tau, dim)
     out = []
     for d in range(dim):
@@ -112,12 +130,13 @@ def stress_divergence_mac(tau: jnp.ndarray, grid: StaggeredGrid) -> Vel:
         for j in range(dim):
             t = tf[..., d, j]
             if j == d:
+                # wrap row lands on the pinned wall face (masked below)
                 g = (t - jnp.roll(t, 1, d)) / dx[d]
             else:
-                g = (jnp.roll(t, -1, j) - jnp.roll(t, 1, j)) / (2.0 * dx[j])
+                g = central_grad(t, j, dx[j], wall_axes[j])
                 g = 0.5 * (g + jnp.roll(g, 1, d))
             acc = g if acc is None else acc + g
-        out.append(acc)
+        out.append(pin_normal(acc, d, wall_axes))
     return tuple(out)
 
 
@@ -126,10 +145,17 @@ class OldroydB:
     polymer body force for the INS step."""
 
     def __init__(self, grid: StaggeredGrid, mu_p: float, lam: float,
-                 dtype=jnp.float32):
+                 wall_axes=None, dtype=jnp.float32):
         self.grid = grid
         self.mu_p = float(mu_p)
         self.lam = float(lam)
+        # wall_axes: no-slip walls on the flagged axes (round 4 — the
+        # wall-bounded viscoelastic channel): conformation advection,
+        # velocity gradients, and the stress divergence all switch to
+        # their wall-aware forms
+        self.wall_axes = (tuple(bool(w) for w in wall_axes)
+                          if wall_axes is not None
+                          else (False,) * grid.dim)
         self.dtype = dtype
 
     def initialize(self) -> jnp.ndarray:
@@ -139,11 +165,13 @@ class OldroydB:
         """Advect each packed component (Godunov) then apply the
         stretching/relaxation source (explicit Euler)."""
         dx = self.grid.dx
-        Cadv = jnp.stack([advect(C[..., k], u, dx, dt)
+        wa = self.wall_axes
+        Cadv = jnp.stack([advect(C[..., k], u, dx, dt, wall_axes=wa)
                           for k in range(C.shape[-1])], axis=-1)
-        gu = velocity_gradient_cc(u, dx)
+        gu = velocity_gradient_cc(u, dx, wall_axes=wa)
         return Cadv + dt * oldroyd_b_source(Cadv, gu, self.lam)
 
     def body_force(self, C: jnp.ndarray) -> Vel:
         tau = polymer_stress(C, self.mu_p, self.lam, self.grid.dim)
-        return stress_divergence_mac(tau, self.grid)
+        return stress_divergence_mac(tau, self.grid,
+                                     wall_axes=self.wall_axes)

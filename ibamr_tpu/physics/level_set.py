@@ -55,17 +55,10 @@ def phase_volume(phi: jnp.ndarray, grid: StaggeredGrid,
 
 def _central_grad(phi: jnp.ndarray, d: int, dx_d: float,
                   wall: bool) -> jnp.ndarray:
-    """Central difference along d; with ``wall``, one-sided at the
-    boundary cells instead of the periodic wrap."""
-    g = (jnp.roll(phi, -1, d) - jnp.roll(phi, 1, d)) / (2.0 * dx_d)
-    if wall:
-        from ibamr_tpu.ops.stencils import wall_boundary_masks
+    """Delegates to the shared ops.stencils.central_grad."""
+    from ibamr_tpu.ops.stencils import central_grad
 
-        is_lo, is_hi = wall_boundary_masks(phi.shape, d)
-        one_lo = (jnp.roll(phi, -1, d) - phi) / dx_d
-        one_hi = (phi - jnp.roll(phi, 1, d)) / dx_d
-        g = jnp.where(is_lo, one_lo, jnp.where(is_hi, one_hi, g))
-    return g
+    return central_grad(phi, d, dx_d, wall)
 
 
 def gradient_norm(phi: jnp.ndarray, dx: Sequence[float],

@@ -345,3 +345,61 @@ def test_drop_buoyancy_relative_motion():
     # variant is the documented trade, module docstring)
     assert v[H].mean() - vmean < -1e-4      # drop sinks
     assert v[~H].mean() - vmean > 1e-6      # ambient rises
+
+
+def test_oldroyd_b_walled_channel_normal_stress():
+    """Wall-bounded VISCOELASTIC channel (round 4): Oldroyd-B coupled
+    to the walled VC momentum step in a body-force-driven channel.
+    The steady viscometric signatures must appear with the right
+    signs and symmetry: C_xy follows the shear (positive near the
+    lower wall, negative near the upper), the first normal-stress
+    difference N1 = C_xx - C_yy is positive in the sheared wall
+    layers and ~0 at the centerline, conformation stays positive
+    (trace >= dim at equilibrium scale), and the wall-normal faces
+    stay pinned."""
+    from ibamr_tpu.integrators.ins_vc import INSVCStaggeredIntegrator
+    from ibamr_tpu.physics.complex_fluids import OldroydB, unpack
+
+    n = 32
+    g = StaggeredGrid(n=(n, n), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    vc = INSVCStaggeredIntegrator(
+        g, rho0=1.0, rho1=1.0, mu0=0.05, mu1=0.05,
+        convective_op_type="none", reinit_interval=10 ** 9,
+        cg_tol=1e-10, wall_axes=(False, True), dtype=jnp.float64)
+    ob = OldroydB(g, mu_p=0.02, lam=0.2, wall_axes=(False, True),
+                  dtype=jnp.float64)
+    st = vc.initialize(jnp.ones((n, n), dtype=jnp.float64))
+    C = ob.initialize()
+    fx = 0.5
+    drive = (jnp.full((n, n), fx, dtype=jnp.float64),
+             jnp.zeros((n, n), dtype=jnp.float64))
+    dt = 1e-3
+
+    @jax.jit
+    def one(st, C):
+        f = ob.body_force(C)
+        f = (f[0] + drive[0], f[1] + drive[1])
+        st2 = vc.step(st, dt, f=f)
+        return st2, ob.step(C, st2.u, dt)
+
+    for _ in range(400):
+        st, C = one(st, C)
+
+    assert bool(jnp.all(jnp.isfinite(st.u[0])))
+    assert bool(jnp.all(jnp.isfinite(C)))
+    assert float(jnp.max(jnp.abs(st.u[1][:, 0:1]))) == 0.0
+
+    Cf = np.asarray(unpack(C, 2))
+    prof_xy = Cf[..., 0, 1].mean(axis=0)     # C_xy(y)
+    N1 = (Cf[..., 0, 0] - Cf[..., 1, 1]).mean(axis=0)
+    # shear sign: du_x/dy > 0 in the lower half -> C_xy = lam*gd > 0
+    assert prof_xy[1] > 1e-4, prof_xy[1]
+    assert prof_xy[-2] < -1e-4, prof_xy[-2]
+    # antisymmetric about the centerline (channel symmetry)
+    np.testing.assert_allclose(prof_xy[1], -prof_xy[-2], rtol=0.05)
+    # N1 positive in the wall layers, ~0 at the centerline
+    assert N1[1] > 5.0 * abs(N1[n // 2]), (N1[1], N1[n // 2])
+    assert N1[-2] > 5.0 * abs(N1[n // 2])
+    # conformation positivity proxy
+    tr = Cf[..., 0, 0] + Cf[..., 1, 1]
+    assert float(tr.min()) > 1.5, float(tr.min())
