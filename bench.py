@@ -174,7 +174,10 @@ def _cpu_sharded_child(q, n, n_lat, n_lon, steps, warmup, dt,
                "steps_per_sec": round(steps / el_sh, 3),
                "ms_per_step": round(1e3 * el_sh / steps, 3),
                "single_device_steps_per_sec": round(steps / el_1, 3),
-               "sharded_over_single": round(el_1 / el_sh, 3),
+               # >1 means the sharded step is FASTER than single-device
+               # (a speedup, renamed from 'sharded_over_single' whose
+               # name read as the inverse ratio — ADVICE round 4)
+               "sharded_speedup": round(el_1 / el_sh, 3),
                "compile_warmup_s": round(compile_s, 2)})
     except Exception as e:  # noqa: BLE001 - report, parent decides
         q.put({"error": f"{type(e).__name__}: {e}"})

@@ -662,6 +662,26 @@ class TwoLevelIBINS:
         return self.ib.interpolate_velocity(u_per, self.fine_grid, X,
                                             mask, ctx=ctx)
 
+    def _spread_two_level(self, F, X, mask, ctx=None):
+        """Spread a Lagrangian force at configuration ``X`` onto BOTH
+        hierarchy levels: fine-window MAC force + conservatively
+        restricted coarse force, each routed through the composite
+        projection's sharding pins. THE single definition of the
+        pin/restrict/scatter sequence — the implicit integrator's
+        Newton residual reuses it, so the partitioner-safe pinning
+        cannot drift between the explicit and implicit paths."""
+        f_per = self.ib.spread_force(F, self.fine_grid, X, mask,
+                                     ctx=ctx)
+        pin_c = self.core.proj._pin_c
+        pin_f = self.core.proj._pin_f
+        f_f = tuple(pin_f(c) for c in _box_mac_from_periodic(f_per))
+        # coarse sees the conservatively restricted force in the box
+        f_c = tuple(pin_c(c) for c in scatter_box_mac_to_coarse(
+            tuple(jnp.zeros(self.grid.n, dtype=f_per[0].dtype)
+                  for _ in range(self.grid.dim)),
+            restrict_mac(f_f), self.box))
+        return f_c, f_f
+
     def step(self, state: TwoLevelIBState, dt: float) -> TwoLevelIBState:
         fluid = state.fluid
         X_n = state.X
@@ -673,16 +693,8 @@ class TwoLevelIBINS:
         # spread and the midpoint interp (the strategy seam's protocol)
         ctx = self.ib.prepare(X_half, state.mask) \
             if hasattr(self.ib, "prepare") else None
-        f_per = self.ib.spread_force(F, self.fine_grid, X_half,
-                                     state.mask, ctx=ctx)
-        pin_c = self.core.proj._pin_c
-        pin_f = self.core.proj._pin_f
-        f_f = tuple(pin_f(c) for c in _box_mac_from_periodic(f_per))
-        # coarse sees the conservatively restricted force in the box
-        f_c = tuple(pin_c(c) for c in scatter_box_mac_to_coarse(
-            tuple(jnp.zeros(self.grid.n, dtype=f_per[0].dtype)
-                  for _ in range(self.grid.dim)),
-            restrict_mac(f_f), self.box))
+        f_c, f_f = self._spread_two_level(F, X_half, state.mask,
+                                          ctx=ctx)
         fluid_new = self.core.step(fluid, dt, f_c=f_c, f_f=f_f)
         u_mid = tuple(0.5 * (a + b)
                       for a, b in zip(fluid.uf, fluid_new.uf))

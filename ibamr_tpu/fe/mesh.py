@@ -282,7 +282,9 @@ def read_gmsh(path: str, elem_type: str = None) -> FEMesh:
     supported type present (the solid body). Node ids may be
     non-contiguous (Gmsh never guarantees contiguity); they are
     remapped densely and unreferenced nodes are dropped. For 2D
-    element types the z column is discarded (Gmsh always writes xyz).
+    element types the z column is discarded only when degenerate
+    (all ~0); a surface mesh embedded in 3D keeps all three columns
+    (spatial dim independent of element dim, as in libMesh).
     """
     with open(path) as f:
         lines = [ln.strip() for ln in f]
@@ -339,11 +341,24 @@ def read_gmsh(path: str, elem_type: str = None) -> FEMesh:
     if elem_type == "TET10":
         elems = elems[:, _TET10_GMSH_TO_LIBMESH]
 
-    dim = _GMSH_IDS[elem_type][2]
-    nodes = xyz[:, :dim]
     # drop nodes not referenced by the kept element block (the file may
-    # carry boundary-only nodes); remap connectivity densely
+    # carry boundary-only nodes or other-dimension blocks); remap
+    # connectivity densely
     used = np.unique(elems)
+    dim = _GMSH_IDS[elem_type][2]
+    # Spatial dim is independent of element dim (libMesh semantics): a
+    # TRI3/TRI6 shell CURVED through 3D (codim-1 IBFE surface) must
+    # keep its z column. A planar sheet — z constant across the nodes
+    # this block references, whether at z=0, an offset plane, or with
+    # CAD-transform roundoff — stays a 2D solid (the volumetric FE
+    # path needs square Jacobians). Spread is measured against the
+    # mesh extent so roundoff-level z noise never promotes.
+    if dim == 2:
+        zs = xyz[used, 2]
+        extent = max(1.0, float(np.ptp(xyz[used], axis=0).max()))
+        if float(np.ptp(zs)) > 1e-9 * extent:
+            dim = 3
+    nodes = xyz[:, :dim]
     remap = -np.ones(nodes.shape[0], dtype=np.int64)
     remap[used] = np.arange(used.size)
     return FEMesh(nodes=nodes[used], elems=remap[elems],

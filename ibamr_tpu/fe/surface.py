@@ -161,6 +161,33 @@ def current_area(asm: SurfaceAssembly, x: jnp.ndarray):
 
 # -- mesh builders -----------------------------------------------------------
 
+def surface_mesh_from_fe(mesh) -> SurfaceMesh:
+    """Adopt a codim-1 :class:`~ibamr_tpu.fe.mesh.FEMesh` — e.g. a
+    Gmsh-loaded TRI3 shell embedded in 3D (``read_gmsh`` keeps all
+    three coordinate columns for such meshes) or an EDGE2 curve — as a
+    :class:`SurfaceMesh` for the codim-1 IBFE machinery. Higher-order
+    surface families (TRI6) are adopted by their corner nodes."""
+    et, nodes, elems = mesh.elem_type, mesh.nodes, mesh.elems
+    if et in ("TRI3", "TRI6") and nodes.shape[1] == 3:
+        corners, out_type = elems[:, :3], "TRI3S"
+    elif et == "EDGE2" and nodes.shape[1] == 2:
+        corners, out_type = elems[:, :2], "EDGE2"
+    else:
+        raise ValueError(
+            f"not a codim-1 configuration: {et} with "
+            f"{nodes.shape[1]}-column nodes (need TRI3/TRI6 in 3D or "
+            "EDGE2 in 2D)")
+    # corner-only adoption can orphan nodes (TRI6 midsides): drop and
+    # remap densely so no inert markers ride along in the IB coupling
+    used = np.unique(corners)
+    remap = -np.ones(nodes.shape[0], dtype=np.int64)
+    remap[used] = np.arange(used.size)
+    return SurfaceMesh(nodes=np.asarray(nodes[used], dtype=float),
+                       elems=np.asarray(remap[corners],
+                                        dtype=np.int64),
+                       elem_type=out_type)
+
+
 def ring_mesh(center=(0.5, 0.5), radius: float = 0.25, n: int = 64,
               aspect: float = 1.0) -> SurfaceMesh:
     """Closed EDGE2 ring (optionally elliptic: semi-axes r*aspect, r)."""

@@ -179,10 +179,7 @@ class TwoLevelIBImplicit:
         return self._expl.initialize(X0, uc=uc)
 
     def step(self, state, dt: float):
-        from ibamr_tpu.amr_ins import (TwoLevelIBState,
-                                       _box_mac_from_periodic,
-                                       restrict_mac,
-                                       scatter_box_mac_to_coarse)
+        from ibamr_tpu.amr_ins import TwoLevelIBState
 
         expl = self._expl
         fluid = state.fluid
@@ -196,20 +193,21 @@ class TwoLevelIBImplicit:
             U_est = (X_new - X_n) / dt
             t_c = t_half if mid else fluid.t + dt
             F_c = self.ib.compute_force(X_c, U_est, t_c)
-            f_per = self.ib.spread_force(F_c, expl.fine_grid, X_c,
-                                         mask)
-            f_f = _box_mac_from_periodic(f_per)
-            f_c = scatter_box_mac_to_coarse(
-                tuple(jnp.zeros(self.grid.n, dtype=f_per[0].dtype)
-                      for _ in range(self.grid.dim)),
-                restrict_mac(f_f), self.box)
+            # one transfer context per configuration, shared by spread
+            # and interp (no redundant bucket prep per residual eval);
+            # the two-level spread (incl. the partitioner-safe
+            # sharding pins) is the explicit integrator's shared
+            # helper, so the pinning cannot drift between paths
+            ctx = self.ib.prepare(X_c, mask) \
+                if hasattr(self.ib, "prepare") else None
+            f_c, f_f = expl._spread_two_level(F_c, X_c, mask, ctx=ctx)
             fluid_new = expl.core.step(fluid, dt, f_c=f_c, f_f=f_f)
             if mid:
                 u_c = tuple(0.5 * (a + b)
                             for a, b in zip(fluid.uf, fluid_new.uf))
             else:
                 u_c = fluid_new.uf
-            U_c = expl._interp(u_c, X_c, mask)
+            U_c = expl._interp(u_c, X_c, mask, ctx=ctx)
             return fluid_new, U_c
 
         def residual(X_new):

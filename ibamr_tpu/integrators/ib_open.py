@@ -42,6 +42,11 @@ class IBOpenState(NamedTuple):
     X: jnp.ndarray
     U: jnp.ndarray
     mask: jnp.ndarray
+    # net Lagrangian force actually spread during the last step (at the
+    # midpoint configuration X_half, U_n, t+dt/2) — carried so drag/lift
+    # diagnostics report the applied force, not a half-step-lagged
+    # recomputation; zeros before the first step
+    F_net: jnp.ndarray
 
 
 class IBOpenIntegrator:
@@ -88,7 +93,8 @@ class IBOpenIntegrator:
         if mask is None:
             mask = jnp.ones(X.shape[0], dtype=dtype)
         return IBOpenState(fluid=fluid, X=X, U=jnp.zeros_like(X),
-                           mask=jnp.asarray(mask, dtype=dtype))
+                           mask=jnp.asarray(mask, dtype=dtype),
+                           F_net=jnp.zeros(X.shape[1], dtype=dtype))
 
     # -- single step (pure, jittable) ----------------------------------------
     def step(self, state: IBOpenState) -> IBOpenState:
@@ -112,16 +118,18 @@ class IBOpenIntegrator:
                                          state.mask, ctx=ctx)
         X_new = X_n + dt * U_half
         return IBOpenState(fluid=fluid_new, X=X_new, U=U_half,
-                           mask=state.mask)
+                           mask=state.mask,
+                           F_net=jnp.sum(F * state.mask[:, None],
+                                         axis=0))
 
     # -- diagnostics ---------------------------------------------------------
     def body_force_on_fluid(self, state: IBOpenState) -> jnp.ndarray:
-        """Net structural force currently applied to the fluid (the
-        NEGATIVE of the hydrodynamic force on the body): sum of the
-        Lagrangian forces — e.g. drag = -sum(F)[flow_axis] for a
-        target-point-held body."""
-        F = self.ib.compute_force(state.X, state.U, state.fluid.t)
-        return jnp.sum(F * state.mask[:, None], axis=0)
+        """Net structural force applied to the fluid during the LAST
+        step (the NEGATIVE of the hydrodynamic force on the body):
+        sum of the Lagrangian forces at the spread configuration
+        (X_half, U_n, t+dt/2) — e.g. drag = -F_net[flow_axis] for a
+        target-point-held body. Before the first step, zero."""
+        return state.F_net
 
 
 def advance_ib_open(integ: IBOpenIntegrator, state: IBOpenState,

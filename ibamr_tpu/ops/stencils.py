@@ -144,33 +144,42 @@ def vorticity_magnitude_cc(u: Sequence[jnp.ndarray], dx: Sequence[float]) -> jnp
 # --------------------------------------------------------------------------
 
 def strain_rate_cc(u: Sequence[jnp.ndarray],
-                   dx: Sequence[float]) -> Tuple[Tuple[jnp.ndarray, ...], ...]:
+                   dx: Sequence[float],
+                   wall_axes: Sequence[bool] | None = None,
+                   ) -> Tuple[Tuple[jnp.ndarray, ...], ...]:
     """Symmetric strain-rate tensor E_ij = (du_i/dx_j + du_j/dx_i)/2 at
-    cell centers (periodic stencils). Diagonal entries use the exact MAC
-    face differences (native centering); off-diagonals use centered
-    differences of the cell-averaged components."""
+    cell centers. Diagonal entries use the exact MAC face differences
+    (native centering); off-diagonals use centered differences of the
+    cell-averaged components via :func:`central_grad`, whose ``wall``
+    mode switches the boundary layers to one-sided differences so no
+    cross-wall wrapped value enters. Diagonal terms need no wall
+    special-case: under the pinned-face no-slip storage convention the
+    wrap face IS the wall face and carries the pinned wall value."""
     dim = len(u)
     ucc = fc_to_cc(u)
-
-    def dcc(f, axis, h):
-        return (jnp.roll(f, -1, axis) - jnp.roll(f, 1, axis)) / (2.0 * h)
+    wall_axes = (tuple(bool(w) for w in wall_axes)
+                 if wall_axes is not None else (False,) * dim)
 
     E = [[None] * dim for _ in range(dim)]
     for i in range(dim):
         # du_i/dx_i from the two faces bounding the cell: exact MAC
         E[i][i] = (jnp.roll(u[i], -1, i) - u[i]) / dx[i]
         for j in range(i + 1, dim):
-            Eij = 0.5 * (dcc(ucc[i], j, dx[j]) + dcc(ucc[j], i, dx[i]))
+            Eij = 0.5 * (central_grad(ucc[i], j, dx[j], wall_axes[j])
+                         + central_grad(ucc[j], i, dx[i], wall_axes[i]))
             E[i][j] = Eij
             E[j][i] = Eij
     return tuple(tuple(row) for row in E)
 
 
 def strain_rate_magnitude_cc(u: Sequence[jnp.ndarray],
-                             dx: Sequence[float]) -> jnp.ndarray:
+                             dx: Sequence[float],
+                             wall_axes: Sequence[bool] | None = None,
+                             ) -> jnp.ndarray:
     """|E| = sqrt(2 E:E) — the shear-rate scalar of generalized-Newtonian
-    viscosity models."""
-    E = strain_rate_cc(u, dx)
+    viscosity models. ``wall_axes`` forwards to :func:`strain_rate_cc`
+    (one-sided boundary-layer differences on wall axes)."""
+    E = strain_rate_cc(u, dx, wall_axes)
     acc = None
     for row in E:
         for e in row:

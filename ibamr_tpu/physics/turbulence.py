@@ -46,12 +46,15 @@ Vel = Tuple[jnp.ndarray, ...]
 # ---------------------------------------------------------------------------
 
 def eddy_viscosity_smagorinsky(u: Vel, dx: Sequence[float],
-                               cs: float = 0.17) -> jnp.ndarray:
+                               cs: float = 0.17,
+                               wall_axes=None) -> jnp.ndarray:
     """Cell-centered LES eddy viscosity ``nu_t = (Cs Delta)^2 |S|``
-    with ``Delta = (prod dx)^(1/dim)`` and ``|S| = sqrt(2 E:E)``."""
+    with ``Delta = (prod dx)^(1/dim)`` and ``|S| = sqrt(2 E:E)``.
+    ``wall_axes`` switches the boundary strain layers to one-sided
+    differences (no cross-wall wrap)."""
     dim = len(u)
     delta = math.prod(float(h) for h in dx) ** (1.0 / dim)
-    S = stencils.strain_rate_magnitude_cc(u, dx)
+    S = stencils.strain_rate_magnitude_cc(u, dx, wall_axes=wall_axes)
     return (cs * delta) ** 2 * S
 
 
@@ -90,9 +93,8 @@ class SmagorinskyINS:
         self.cs = float(cs)
         self.dtype = dtype
         # wall_axes: physical no-slip walls via the VC wall machinery
-        # (wall-bounded LES channel/duct). The Smagorinsky nu_t itself
-        # is evaluated with periodic strain stencils — a one-cell wall
-        # layer approximation the no-slip momentum BCs dominate.
+        # (wall-bounded LES channel/duct). The Smagorinsky nu_t strain
+        # uses one-sided boundary-layer differences on wall axes.
         walls = wall_axes is not None and any(wall_axes)
         self._vc = INSVCStaggeredIntegrator(
             grid, rho0=rho, rho1=rho, mu0=mu, mu1=mu,
@@ -110,7 +112,8 @@ class SmagorinskyINS:
         """One LES step: freeze ``mu_eff`` from the current resolved
         field, then take the VC step with that viscosity."""
         mu_t = self.rho * eddy_viscosity_smagorinsky(
-            state.u, self.grid.dx, self.cs)
+            state.u, self.grid.dx, self.cs,
+            wall_axes=self._vc.wall_axes)
         return _vc_step_with_extra_viscosity(self._vc, state, dt, mu_t)
 
 
@@ -246,7 +249,12 @@ class KOmegaModel:
         k = jnp.maximum(st.k, self.k_min)
         w = jnp.maximum(st.omega, self.omega_min)
         nu_t = k / w
-        S2 = stencils.strain_rate_magnitude_cc(u, dx) ** 2
+        # wall-aware strain: one-sided boundary-layer differences on
+        # wall axes so production never sees cross-wall wrapped velocity
+        # gradients — consistent with the one-sided wall diffusion and
+        # channel_komega's one-sided production (ADVICE round 4)
+        S2 = stencils.strain_rate_magnitude_cc(
+            u, dx, wall_axes=self.wall_axes) ** 2
         P_k = jnp.minimum(nu_t * S2,
                           self.prod_limit * self.beta_star * k * w)
 

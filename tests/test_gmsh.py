@@ -149,3 +149,62 @@ def test_ibfe_runs_from_file_loaded_mesh(tmp_path):
     # undeformed disc at rest: forces stay near zero, mesh stays put
     assert float(jnp.max(jnp.abs(st.X - jnp.asarray(loaded.nodes)))) \
         < 1e-3
+
+
+def test_gmsh_surface_embedded_in_3d_keeps_z(tmp_path):
+    """A TRI3 shell embedded in 3D (curved codim-1 IBFE configuration,
+    ADVICE round 4): the reader keeps all three coordinate columns
+    instead of silently flattening, and the surface bridge makes the
+    result consumable by the codim-1 machinery."""
+    from ibamr_tpu.fe.surface import (build_surface_assembly,
+                                      sphere_surface_mesh,
+                                      surface_mesh_from_fe)
+
+    sph = sphere_surface_mesh(radius=0.3, n_subdiv=1)
+    fem_like = FEMesh(nodes=sph.nodes, elems=sph.elems,
+                      elem_type="TRI3")
+    p = str(tmp_path / "shell.msh")
+    write_gmsh(fem_like, p)
+    loaded = read_gmsh(p)
+    assert loaded.dim == 3                 # z preserved
+    assert loaded.elem_type == "TRI3"
+    np.testing.assert_allclose(loaded.nodes, sph.nodes, atol=1e-12)
+
+    surf = surface_mesh_from_fe(loaded)
+    asm = build_surface_assembly(surf)
+    # octahedron-subdivision sphere area converges to 4 pi r^2 from
+    # below; at n_subdiv=1 it is ~83% of the limit
+    area = float(np.sum(np.asarray(asm.wdA)))
+    assert 0.80 * 4 * np.pi * 0.3 ** 2 < area < 4 * np.pi * 0.3 ** 2
+
+
+def test_gmsh_planar_sheet_not_promoted_by_other_blocks(tmp_path):
+    """A mixed-dimension file (planar TRI3 sheet at z=0 + a TET4 block
+    with z>0): selecting the TRI3 block must NOT inherit dim=3 from
+    the unreferenced tet nodes (code-review round 5)."""
+    p = str(tmp_path / "mixed3d.msh")
+    with open(p, "w") as f:
+        f.write("""$MeshFormat
+2.2 0 8
+$EndMeshFormat
+$Nodes
+7
+1 0 0 0
+2 1 0 0
+3 0 1 0
+4 2 0 0.5
+5 3 0 0.5
+6 2 1 0.5
+7 2 0 1.5
+$EndNodes
+$Elements
+2
+1 2 2 0 1 1 2 3
+2 4 2 0 1 4 5 6 7
+$EndElements
+""")
+    tri = read_gmsh(p, elem_type="TRI3")
+    assert tri.dim == 2                    # planar sheet stays 2D
+    assert abs(tri.volume() - 0.5) < 1e-14
+    tet = read_gmsh(p, elem_type="TET4")
+    assert tet.dim == 3
