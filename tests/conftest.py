@@ -190,6 +190,8 @@ SLOW_TESTS = {
     "test_ib_open_sharded_matches_single",
     "test_fe_capsule_in_two_phase_fluid",
     "test_ib_open_3d_sphere_smoke",
+    # round-5 additions
+    "test_shedding_cylinder_adaptive_dt",
 }
 
 

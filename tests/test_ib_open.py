@@ -160,3 +160,61 @@ def test_ib_open_3d_sphere_smoke():
     # markers held near anchors
     disp = float(jnp.max(jnp.linalg.norm(st.X - X0, axis=1)))
     assert disp < 0.1, disp
+
+
+def test_shedding_cylinder_adaptive_dt():
+    """Vortex-shedding cylinder under CFL-ADAPTIVE dt (VERDICT round 4
+    item 6): alpha = rho/dt no longer baked into the saddle solve, so
+    the hierarchy_driver CFL loop drives the ib_open family. Pins:
+
+    - the CFL bound actually bites (observed dt < cfg.dt cap, and more
+      than one distinct dt over the run — adaptivity, not a constant);
+    - at Re_D = 100 the near-wake transverse flow is active (lift
+      fluctuates: the F_net[1] history changes sign after transients —
+      shedding onset, impossible in the steady Re=20 configuration);
+    - the flow stays finite and divergence stays at solver tolerance
+      through every dt change.
+    """
+    from ibamr_tpu.utils.hierarchy_driver import HierarchyDriver, RunConfig
+
+    nx, ny = 64, 32
+    dx = (2.0 / nx, 1.0 / ny)
+    U0, D = 1.0, 0.25
+    mu = U0 * D / 100.0                    # Re_D = 100: unsteady wake
+    dt_cap = 6e-3
+    ins = INSOpenIntegrator((nx, ny), dx, channel_bc(2), mu=mu,
+                            dt=dt_cap,
+                            bdry={(0, 0, 0): U0}, tol=1e-8,
+                            convective_op_type="stabilized_ppm")
+    # off-center body seeds the asymmetric mode early
+    X0 = _cylinder_markers((0.6, 0.47), D / 2.0, 40)
+    integ = IBOpenIntegrator(ins, _target_ib(X0, 50.0, 1.0))
+    st = integ.initialize(X0)
+
+    lifts, dts = [], []
+
+    def metrics(s, k):
+        lifts.append(float(s.F_net[1]))
+        dts.append(float(s.fluid.t))
+        return {}
+
+    drv = HierarchyDriver(
+        integ, RunConfig(dt=dt_cap, num_steps=1500, health_interval=5,
+                         cfl=0.3),
+        metrics_fn=metrics)
+    out = drv.run(st)
+
+    assert bool(jnp.all(jnp.isfinite(out.fluid.u[0])))
+    assert bool(jnp.all(jnp.isfinite(out.X)))
+    assert float(ins.max_divergence(out.fluid)) < 1e-6
+
+    chunk_dt = np.diff([0.0] + dts) / 5.0      # per-step dt per chunk
+    # the developed flow (blockage accelerates past U0) pulls the CFL
+    # bound below the cap, and the bound moves as the wake evolves
+    assert chunk_dt.min() < dt_cap - 1e-9
+    assert len({round(v, 12) for v in chunk_dt}) > 3   # dt adapted
+    # shedding onset: the second-half lift history crosses zero
+    late = np.asarray(lifts[len(lifts) // 2:])
+    late = late - late.mean()
+    crossings = int(np.sum(np.abs(np.diff(np.sign(late))) > 0))
+    assert crossings >= 2, f"no lift oscillation: {late[:8]}..."

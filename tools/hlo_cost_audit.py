@@ -1,0 +1,263 @@
+"""Relay-independent HLO traffic/FLOP audit of the flagship step
+(VERDICT round 4, "Next round" item 1b).
+
+``jit(...).lower().compile()`` on the host-CPU backend builds the same
+HLO module structure the TPU backend compiles, and XLA's
+``cost_analysis()`` / ``memory_analysis()`` report the module's
+bytes-accessed and FLOP totals — numbers that do NOT need the relay.
+This turns the transfer-engine claims ("occupancy packing lifts slot
+utilization so every weight operand shrinks by the same factor; bf16
+compression halves what remains") into measured per-engine byte
+counts:
+
+- per engine (scatter / mxu / packed / *_bf16): the ISOLATED spread
+  and interp contractions at flagship shapes, plus bucket prep;
+- the full coupled step and the isolated fluid solve, for the
+  phase-share picture that the on-chip ``phases`` table measures in
+  wall-clock.
+
+Every leg runs in its own child process (the XLA CPU pipeline has a
+rare native-crash flake; an isolated leg loses one data point, not the
+artifact). Results land in ``HLO_COST_r05.json`` and feed PERF.md.
+
+Caveats (stated in the artifact): CPU-backend fusion/layout decisions
+differ from TPU in the details, so treat ratios between engines as the
+signal, not absolute byte counts; `bytes accessed` is XLA's HLO-level
+estimate (each buffer counted once per producing/consuming op), not an
+HBM-transaction trace. The pallas engines cannot be audited this way
+(interpret-mode lowering on CPU carries no real cost model) — their
+evidence remains the on-chip shootout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import multiprocessing as mp
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _leg_child(q, n, n_lat, n_lon, engine, piece):
+    try:
+        from ibamr_tpu.utils.backend_guard import force_cpu
+
+        jax = force_cpu()
+        import jax.numpy as jnp
+
+        from ibamr_tpu.models.shell3d import build_shell_example
+
+        integ, state = build_shell_example(
+            n_cells=n, n_lat=n_lat, n_lon=n_lon, radius=0.25,
+            aspect=1.2, stiffness=1.0, rest_length_factor=0.75,
+            mu=0.05, use_fast_interaction=engine)
+        ib = integ.ib
+        grid = integ.ins.grid
+        dt = 5e-5
+        X, mask = state.X, state.mask
+        t0 = time.perf_counter()
+
+        if piece == "step":
+            fn = jax.jit(lambda s: integ.step(s, dt))
+            lowered = fn.lower(state)
+        elif piece == "fluid":
+            f = tuple(jnp.zeros_like(u) for u in state.ins.u)
+            fn = jax.jit(lambda st, ff: integ.ins.step(st, dt, f=ff))
+            lowered = fn.lower(state.ins, f)
+        elif piece == "spread":
+            F = jnp.zeros_like(X)
+
+            def spread(Xa, Fa, m):
+                ctx = ib.prepare(Xa, m)
+                return ib.spread_force(Fa, grid, Xa, m, ctx=ctx)
+
+            lowered = jax.jit(spread).lower(X, F, mask)
+        elif piece == "interp":
+            u = state.ins.u
+
+            def interp(ua, Xa, m):
+                ctx = ib.prepare(Xa, m)
+                return ib.interpolate_velocity(ua, grid, Xa, m,
+                                               ctx=ctx)
+
+            lowered = jax.jit(interp).lower(u, X, mask)
+        elif piece == "bucket_prep":
+            if ib.fast is None:
+                q.put({"skipped": "no fast engine -> no bucket prep"})
+                return
+            lowered = jax.jit(lambda Xa, m: ib.prepare(Xa, m)).lower(
+                X, mask)
+        elif piece == "transfers_fused":
+            # spread + 2x interp sharing ONE bucket prep — the step's
+            # actual per-position transfer block, so op-boundary
+            # effects (shared canonicalization, fused masks) show up
+            F = jnp.zeros_like(X)
+            u = state.ins.u
+
+            def block(ua, Xa, Fa, m):
+                ctx = ib.prepare(Xa, m)
+                U1 = ib.interpolate_velocity(ua, grid, Xa, m, ctx=ctx)
+                fv = ib.spread_force(Fa, grid, Xa, m, ctx=ctx)
+                U2 = ib.interpolate_velocity(ua, grid, Xa, m, ctx=ctx)
+                return U1, fv, U2
+
+            lowered = jax.jit(block).lower(u, X, F, mask)
+        else:
+            raise ValueError(piece)
+
+        # contraction census: backend-independent operand bytes of
+        # every dot_general in the traced program — the (B,cap,P) /
+        # (B,cap,nz) einsum operands ARE the claimed dominant traffic,
+        # and their traced dtypes/shapes show exactly what occupancy
+        # packing and bf16 compression do to them
+        census = {"dot_lhs_bytes": 0, "dot_rhs_bytes": 0,
+                  "dot_out_bytes": 0, "dot_count": 0, "dot_flops": 0}
+
+        def _walk(jaxpr):
+            for eqn in jaxpr.eqns:
+                if eqn.primitive.name == "dot_general":
+                    lhs, rhs = eqn.invars[0].aval, eqn.invars[1].aval
+                    outv = eqn.outvars[0].aval
+                    census["dot_lhs_bytes"] += (
+                        lhs.size * lhs.dtype.itemsize)
+                    census["dot_rhs_bytes"] += (
+                        rhs.size * rhs.dtype.itemsize)
+                    census["dot_out_bytes"] += (
+                        outv.size * outv.dtype.itemsize)
+                    dims = eqn.params["dimension_numbers"][0]
+                    contracted = 1
+                    for ax in dims[0]:
+                        contracted *= lhs.shape[ax]
+                    census["dot_flops"] += 2 * outv.size * contracted
+                    census["dot_count"] += 1
+                for sub in eqn.params.values():
+                    if hasattr(sub, "jaxpr"):
+                        _walk(sub.jaxpr)
+
+        try:
+            if piece == "spread":
+                cj = jax.make_jaxpr(spread)(X, F, mask)
+            elif piece == "interp":
+                cj = jax.make_jaxpr(interp)(u, X, mask)
+            elif piece == "transfers_fused":
+                cj = jax.make_jaxpr(block)(u, X, F, mask)
+            elif piece == "step":
+                cj = jax.make_jaxpr(lambda s: integ.step(s, dt))(state)
+            elif piece == "fluid":
+                cj = jax.make_jaxpr(
+                    lambda st, ff: integ.ins.step(st, dt, f=ff))(
+                        state.ins, f)
+            else:
+                cj = jax.make_jaxpr(
+                    lambda Xa, m: ib.prepare(Xa, m))(X, mask)
+            _walk(cj.jaxpr)
+        except Exception as ce:  # census is best-effort
+            census["census_error"] = f"{type(ce).__name__}: {ce}"
+
+        compiled = lowered.compile()
+        ca = compiled.cost_analysis() or {}
+        ma = compiled.memory_analysis()
+        out = {
+            "n": n,
+            "markers": int(X.shape[0]),
+            "engine": str(engine),
+            "piece": piece,
+            "flops": float(ca.get("flops", -1.0)),
+            "bytes_accessed": float(ca.get("bytes accessed", -1.0)),
+            "bytes_out": float(ca.get("bytes accessedout{}", -1.0)),
+            "compile_s": round(time.perf_counter() - t0, 1),
+            **census,
+        }
+        if ma is not None:
+            out.update({
+                "arg_bytes": int(ma.argument_size_in_bytes),
+                "out_bytes": int(ma.output_size_in_bytes),
+                "temp_bytes": int(ma.temp_size_in_bytes),
+            })
+        q.put(out)
+    except Exception as e:  # noqa: BLE001 - report to parent
+        q.put({"error": f"{type(e).__name__}: {e}",
+               "engine": str(engine), "piece": piece, "n": n})
+
+
+def run_leg(n, n_lat, n_lon, engine, piece, timeout_s):
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    p = ctx.Process(target=_leg_child,
+                    args=(q, n, n_lat, n_lon, engine, piece))
+    p.start()
+    p.join(timeout_s)
+    if p.is_alive():
+        p.terminate()
+        p.join(10)
+        return {"error": f"timeout > {timeout_s:.0f}s",
+                "engine": str(engine), "piece": piece, "n": n}
+    try:
+        return q.get_nowait()
+    except Exception:
+        return {"error": f"child died rc={p.exitcode}",
+                "engine": str(engine), "piece": piece, "n": n}
+
+
+ENGINES = {
+    "scatter": False,
+    "mxu": True,
+    "mxu_bf16": "mxu_bf16",
+    "packed": "packed",
+    "packed_bf16": "packed_bf16",
+}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=256)
+    ap.add_argument("--n-lat", type=int, default=316)
+    ap.add_argument("--n-lon", type=int, default=316)
+    ap.add_argument("--quick-n", type=int, default=64,
+                    help="small cross-check size (0 disables)")
+    ap.add_argument("--timeout", type=float, default=2400.0)
+    ap.add_argument("--out", type=str,
+                    default=os.path.join(REPO, "HLO_COST_r05.json"))
+    args = ap.parse_args()
+
+    legs = []
+    sizes = ([(args.quick_n, 100, 100)] if args.quick_n else []) + \
+        [(args.n, args.n_lat, args.n_lon)]
+    for n, nla, nlo in sizes:
+        for label, eng in ENGINES.items():
+            pieces = ["spread", "interp"]
+            if eng is not False:
+                pieces.append("bucket_prep")
+            if label in ("packed", "mxu"):
+                pieces.append("transfers_fused")
+            if label == "packed":
+                pieces += ["step", "fluid"]
+            for piece in pieces:
+                legs.append((n, nla, nlo, label, eng, piece))
+
+    results = []
+    for i, (n, nla, nlo, label, eng, piece) in enumerate(legs):
+        print(f"[audit] {i + 1}/{len(legs)}: n={n} engine={label} "
+              f"piece={piece}", flush=True)
+        r = run_leg(n, nla, nlo, eng, piece, args.timeout)
+        r["engine"] = label
+        print(f"[audit]   -> {json.dumps(r)}", flush=True)
+        results.append(r)
+        with open(args.out, "w") as f:
+            json.dump({"note": (
+                "XLA HLO cost_analysis on the host-CPU backend "
+                "(same HLO structure as TPU; ratios between engines "
+                "are the signal, absolute bytes are backend "
+                "estimates). pallas engines excluded: interpret-mode "
+                "lowering carries no cost model."),
+                "legs": results}, f, indent=1)
+    print(f"[audit] wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
