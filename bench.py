@@ -474,7 +474,8 @@ def main():
             # and report the best configuration as the headline value.
             # Each leg is deadline-guarded; the pallas leg runs in a
             # terminable child (remote-compile stall history).
-            for label in ("packed", "packed_bf16", "pallas_packed"):
+            for label in ("packed", "packed_bf16", "packed3",
+                          "packed3_bf16", "pallas_packed"):
                 if time.perf_counter() - t_start > args.deadline:
                     errors.append(f"flagship[{label}]: skipped "
                                   "(deadline)")
@@ -518,6 +519,7 @@ def main():
                     for label, fast in (("mxu", True),
                                         ("scatter", False),
                                         ("packed", "packed"),
+                                        ("packed3", "packed3"),
                                         ("pallas", "pallas"),
                                         ("pallas_packed",
                                          "pallas_packed")):

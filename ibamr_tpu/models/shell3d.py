@@ -149,7 +149,8 @@ def build_shell_example(
         # "mxu_bf16"|"packed_bf16" }
         if use_fast_interaction is None:
             _KNOB = ("auto", "scatter", "mxu", "packed", "pallas",
-                     "pallas_packed", "mxu_bf16", "packed_bf16")
+                     "pallas_packed", "mxu_bf16", "packed_bf16",
+                     "packed3", "packed3_bf16")
             eng = ib_db.get_string("transfer_engine", "auto").lower()
             if eng not in _KNOB:
                 raise ValueError(
@@ -189,7 +190,7 @@ def build_shell_example(
             and all(v % 8 == 0 for v in n[:-1])
             and all(v >= 8 + support + 1 for v in n[:-1]))
     _ENGINES = (True, False, None, "pallas", "packed", "pallas_packed",
-                "mxu_bf16", "packed_bf16")
+                "mxu_bf16", "packed_bf16", "packed3", "packed3_bf16")
     if use_fast_interaction not in _ENGINES:
         raise ValueError(
             f"unknown use_fast_interaction {use_fast_interaction!r}; "
@@ -208,6 +209,33 @@ def build_shell_example(
             fast = PallasInteraction(
                 grid, kernel=kernel, tile=8, cap=cap,
                 overflow_cap=max(2048, n_markers // 4))
+        elif use_fast_interaction in ("packed3", "packed3_bf16"):
+            from ibamr_tpu.ops.interaction_packed3 import (
+                PackedInteraction3, suggest_chunks3)
+            # z-tile: the largest of (16, 8) that divides the z extent
+            # AND leaves room for the footprint (extent >= tz+s+1, s=4
+            # for IB_4 — make_geometry3's own constraints)
+            from ibamr_tpu.ops.delta import get_kernel as _gk
+            _s = _gk(kernel)[0]
+            tz = next((t for t in (16, 8)
+                       if n[-1] % t == 0 and n[-1] >= t + _s + 1
+                       and t >= _s + 1), None)
+            if tz is None:
+                raise ValueError(
+                    f"packed3 engine: no valid z tile for n_z = "
+                    f"{n[-1]} with kernel {kernel!r} (need n_z "
+                    f"divisible by 8 or 16 with n_z >= tile+"
+                    f"{_s + 1}); use the 'packed' engine instead")
+            Q3 = suggest_chunks3(grid, structure.vertices,
+                                 kernel=kernel, tile=8, tile_last=tz,
+                                 chunk=64, slack=1.3)
+            fast = PackedInteraction3(
+                grid, kernel=kernel, tile=8, tile_last=tz, chunk=64,
+                nchunks=Q3,
+                overflow_cap=max(2048, n_markers // 4),
+                compute_dtype=(jnp.bfloat16
+                               if use_fast_interaction
+                               == "packed3_bf16" else None))
         elif use_fast_interaction in ("packed", "pallas_packed",
                                       "packed_bf16"):
             from ibamr_tpu.ops.interaction_packed import (

@@ -81,29 +81,18 @@ def suggest_chunks(grid: StaggeredGrid, X, kernel: Kernel = "IB_4",
     return max(8, int(math.ceil(need * slack)))
 
 
-def pack_markers(geom: BucketGeometry, grid: StaggeredGrid,
-                 X: jnp.ndarray, weights: Optional[jnp.ndarray] = None,
-                 nchunks: int = 1024,
-                 overflow_cap: Optional[int] = None) -> PackedBuckets:
-    """Bucket markers by tile, then pack tiles' markers into ``Q``
-    chunks of ``geom.cap`` slots, allocated compactly in tile order."""
+def chunk_pack_core(bid: jnp.ndarray, X: jnp.ndarray,
+                    weights: jnp.ndarray, Q: int, c: int, B: int,
+                    overflow_cap: int):
+    """THE occupancy-packing core shared by every chunk-packed layout
+    (xy-packed here, fully-blocked in interaction_packed3 — one
+    definition so the sort/assign/scatter/overflow machinery cannot
+    diverge between engines): given per-marker tile ids ``bid`` in
+    [0, B), pack markers into ``Q`` chunks of ``c`` slots allocated
+    compactly in tile order. Returns
+    (Xb, wb, slot_of_marker, w_overflow, o_idx, o_w, n_over,
+    exceeded, tile_of_chunk)."""
     N, dim = X.shape
-    if weights is None:
-        weights = jnp.ones((N,), dtype=X.dtype)
-    if overflow_cap is None:
-        overflow_cap = min(N, max(2048, 1 << int(math.ceil(
-            math.log2(max(N // 8, 1))))))
-    s = geom.support
-    c = geom.cap
-    Q = int(nchunks)
-    bid = jnp.zeros((N,), dtype=jnp.int32)
-    for d in range(dim - 1):
-        xi = (X[:, d] - grid.x_lo[d]) / grid.dx[d] - 0.5
-        j0 = jnp.floor(xi - 0.5 * s).astype(jnp.int32) + 1
-        b = jnp.mod(j0, grid.n[d]) // geom.tile[d]
-        bid = bid * geom.nblk[d] + b
-    B = int(np.prod(geom.nblk))
-
     order = jnp.argsort(bid)
     bid_s = bid[order]
     counts = jnp.zeros((B,), dtype=jnp.int32).at[bid].add(1)
@@ -141,6 +130,40 @@ def pack_markers(geom: BucketGeometry, grid: StaggeredGrid,
     tid = jnp.full((Q + 1,), B - 1, dtype=jnp.int32)
     tid = tid.at[jnp.where(keep, chunk_s, Q)].set(
         bid_s.astype(jnp.int32))[:Q]
+    return (Xb, wb, slot_of_marker, w_overflow, o_idx, o_w, n_over,
+            exceeded, tid)
+
+
+def default_overflow_cap(N: int) -> int:
+    """Shared overflow-buffer sizing heuristic."""
+    return min(N, max(2048, 1 << int(math.ceil(
+        math.log2(max(N // 8, 1))))))
+
+
+def pack_markers(geom: BucketGeometry, grid: StaggeredGrid,
+                 X: jnp.ndarray, weights: Optional[jnp.ndarray] = None,
+                 nchunks: int = 1024,
+                 overflow_cap: Optional[int] = None) -> PackedBuckets:
+    """Bucket markers by tile, then pack tiles' markers into ``Q``
+    chunks of ``geom.cap`` slots, allocated compactly in tile order."""
+    N, dim = X.shape
+    if weights is None:
+        weights = jnp.ones((N,), dtype=X.dtype)
+    if overflow_cap is None:
+        overflow_cap = default_overflow_cap(N)
+    s = geom.support
+    Q = int(nchunks)
+    bid = jnp.zeros((N,), dtype=jnp.int32)
+    for d in range(dim - 1):
+        xi = (X[:, d] - grid.x_lo[d]) / grid.dx[d] - 0.5
+        j0 = jnp.floor(xi - 0.5 * s).astype(jnp.int32) + 1
+        b = jnp.mod(j0, grid.n[d]) // geom.tile[d]
+        bid = bid * geom.nblk[d] + b
+    B = int(np.prod(geom.nblk))
+
+    (Xb, wb, slot_of_marker, w_overflow, o_idx, o_w, n_over,
+     exceeded, tid) = chunk_pack_core(bid, X, weights, Q, geom.cap, B,
+                                      overflow_cap)
     x0 = []
     for d in range(dim - 1):
         ids = tid

@@ -209,6 +209,10 @@ ENGINES = {
     "mxu_bf16": "mxu_bf16",
     "packed": "packed",
     "packed_bf16": "packed_bf16",
+    # round 5: fully-blocked (z-tiled) packing + spill-folding
+    # overlap-add (ops.interaction_packed3)
+    "packed3": "packed3",
+    "packed3_bf16": "packed3_bf16",
 }
 
 
@@ -222,7 +226,16 @@ def main() -> int:
     ap.add_argument("--timeout", type=float, default=2400.0)
     ap.add_argument("--out", type=str,
                     default=os.path.join(REPO, "HLO_COST_r05.json"))
+    ap.add_argument("--engines", type=str, default="",
+                    help="comma-separated engine subset (default all)")
     args = ap.parse_args()
+    global ENGINES
+    if args.engines:
+        subset = {s.strip() for s in args.engines.split(",")}
+        unknown = subset - set(ENGINES)
+        if unknown:
+            raise SystemExit(f"unknown engines {sorted(unknown)}")
+        ENGINES = {k: v for k, v in ENGINES.items() if k in subset}
 
     legs = []
     sizes = ([(args.quick_n, 100, 100)] if args.quick_n else []) + \
@@ -232,29 +245,47 @@ def main() -> int:
             pieces = ["spread", "interp"]
             if eng is not False:
                 pieces.append("bucket_prep")
-            if label in ("packed", "mxu"):
+            if label in ("packed", "mxu", "packed3"):
                 pieces.append("transfers_fused")
+            if label in ("packed", "packed3"):
+                pieces.append("step")
             if label == "packed":
-                pieces += ["step", "fluid"]
+                pieces.append("fluid")
             for piece in pieces:
                 legs.append((n, nla, nlo, label, eng, piece))
 
-    results = []
+    # merge-don't-clobber: an --engines subset run must not destroy
+    # the fuller artifact's other legs (re-measured legs replace their
+    # own (n, engine, piece) slot only)
+    doc = {"note": (
+        "XLA HLO cost_analysis on the host-CPU backend "
+        "(same HLO structure as TPU; ratios between engines "
+        "are the signal, absolute bytes are backend "
+        "estimates). pallas engines excluded: interpret-mode "
+        "lowering carries no cost model."), "legs": []}
+    if os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                doc = json.load(f)
+        except Exception:
+            pass
+
+    def upsert(r):
+        key = (r.get("n"), r.get("engine"), r.get("piece"))
+        doc["legs"] = [x for x in doc["legs"]
+                       if (x.get("n"), x.get("engine"),
+                           x.get("piece")) != key]
+        doc["legs"].append(r)
+
     for i, (n, nla, nlo, label, eng, piece) in enumerate(legs):
         print(f"[audit] {i + 1}/{len(legs)}: n={n} engine={label} "
               f"piece={piece}", flush=True)
         r = run_leg(n, nla, nlo, eng, piece, args.timeout)
         r["engine"] = label
         print(f"[audit]   -> {json.dumps(r)}", flush=True)
-        results.append(r)
+        upsert(r)
         with open(args.out, "w") as f:
-            json.dump({"note": (
-                "XLA HLO cost_analysis on the host-CPU backend "
-                "(same HLO structure as TPU; ratios between engines "
-                "are the signal, absolute bytes are backend "
-                "estimates). pallas engines excluded: interpret-mode "
-                "lowering carries no cost model."),
-                "legs": results}, f, indent=1)
+            json.dump(doc, f, indent=1)
     print(f"[audit] wrote {args.out}")
     return 0
 
