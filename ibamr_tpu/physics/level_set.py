@@ -11,10 +11,11 @@ engines; both are rebuilt TPU-first:
   upwinding and the Russo-Smereka subcell fix pinning the zero level.
   A fixed iteration count under ``lax.fori_loop`` — fully jittable.
 - :func:`fast_sweeping_distance` — the FastSweepingLSMethod analog:
-  the reference's Gauss-Seidel ordered sweeps are inherently serial, so
-  the rebuild runs the SAME Eikonal update as Jacobi iterations
-  (whole-array rolls): each iteration propagates the solution one cell,
-  like one sweep front, but every cell updates in parallel on the VPU.
+  directional sweeps that keep the reference's Gauss-Seidel causality
+  ALONG the swept axis (a ``lax.scan`` over slices — information
+  crosses the whole axis in one pass) while updating each transverse
+  slice as one parallel VPU op; a handful of alternating rounds
+  replaces the reference's serial 2^dim orderings.
 
 Interface calculus (LevelSetUtilities analog): smoothed Heaviside/delta,
 phase volume, curvature — the ingredients the multiphase integrator
@@ -178,56 +179,133 @@ def reinitialize(phi: jnp.ndarray, dx: Sequence[float],
     return jax.lax.fori_loop(0, iters, body, phi)
 
 
-def fast_sweeping_distance(phi: jnp.ndarray, dx: Sequence[float],
-                           iters: int = None) -> jnp.ndarray:
-    """Signed distance by Jacobi-iterated Eikonal updates.
+def _eikonal_solve(mins, h: float) -> jnp.ndarray:
+    """Upwind Eikonal solve sum_d ((u - a_d)/h)^2 = 1 from per-axis
+    neighbor minima ``mins`` (near-isotropic spacing h, the same
+    assumption as the reference's FastSweepingLSMethod update)."""
+    dim = len(mins)
+    if dim == 2:
+        a = jnp.minimum(mins[0], mins[1])
+        b = jnp.maximum(mins[0], mins[1])
+        one_d = a + h
+        disc = 2.0 * h * h - (b - a) ** 2
+        two_d = 0.5 * (a + b + jnp.sqrt(jnp.maximum(disc, 0.0)))
+        return jnp.where(one_d <= b, one_d, two_d)
+    s = jnp.sort(jnp.stack(mins, axis=-1), axis=-1)
+    a, b, c = s[..., 0], s[..., 1], s[..., 2]
+    u1 = a + h
+    disc2 = 2.0 * h * h - (b - a) ** 2
+    u2 = 0.5 * (a + b + jnp.sqrt(jnp.maximum(disc2, 0.0)))
+    sum3 = a + b + c
+    disc3 = sum3 ** 2 - 3.0 * (a * a + b * b + c * c - h * h)
+    u3 = (sum3 + jnp.sqrt(jnp.maximum(disc3, 0.0))) / 3.0
+    return jnp.where(u1 <= b, u1, jnp.where(u2 <= c, u2, u3))
 
-    The FastSweepingLSMethod analog: the frozen interface band keeps its
-    subcell distances (phi / |grad phi|); every other cell repeatedly
-    applies the upwind Eikonal update  u = min_neighbors + solve of
-    sum_d ((u - a_d)/h_d)^2 = 1  until the front has swept the domain
-    (``iters`` defaults to the max grid extent, one cell per pass —
-    each Jacobi pass is one whole-array VPU kernel instead of the
-    reference's serial Gauss-Seidel sweeps).
+
+def fast_sweeping_distance(phi: jnp.ndarray, dx: Sequence[float],
+                           iters: int = None,
+                           sweeps: int = 4,
+                           wall_axes=None) -> jnp.ndarray:
+    """Signed distance by FAST SWEEPING (Zhao 2004): the
+    ``FastSweepingLSMethod`` analog (SURVEY.md P22,
+    ``src/level_set/FastSweepingLSMethod.cpp`` [U]).
+
+    The frozen interface band keeps its subcell distances
+    (phi/|grad phi|); outside it, directional sweeps propagate the
+    upwind Eikonal update. The TPU-native formulation keeps the
+    reference's Gauss-Seidel causality ALONG the swept axis (a
+    ``lax.scan`` over slices: slice i sees slice i-1's already-updated
+    values) while updating each transverse slice as one parallel VPU
+    op — a sweep carries information across the whole axis in ONE
+    pass. The transverse axes are lagged (the price of
+    slice-parallelism vs the reference's strictly causal serial
+    orderings), so diagonal characteristics converge over the
+    alternating passes geometrically (~2x error reduction per round)
+    rather than in exactly 2^dim orderings: ``sweeps`` = 4 rounds of
+    the 2*dim directional passes reach O(h) accuracy at every grid
+    size tested (32-128), and the pass count stays ~an order of
+    magnitude below the O(n) pseudo-time iterations the relaxation
+    PDE needs — pinned by tests/test_physics_p22.py.
+
+    ``iters`` is accepted for backward compatibility and ignored (the
+    sweep count does not scale with the grid); passing it warns.
+    ``wall_axes`` marks wall-bounded axes: no distance information
+    crosses a wall (no wrap in the interface detection, the transverse
+    minima, or the sweep seed) — the same convention as
+    :func:`reinitialize`.
     """
+    if iters is not None:
+        import warnings
+
+        warnings.warn(
+            "fast_sweeping_distance(iters=...) is ignored: the "
+            "directional-sweep solver's cost is set by `sweeps` "
+            "(grid-size independent), not a Jacobi iteration count",
+            DeprecationWarning, stacklevel=2)
     dim = phi.ndim
-    if iters is None:
-        iters = int(max(phi.shape))
-    near = _interface_cells(phi)
-    g0 = jnp.maximum(gradient_norm(phi, dx), 1e-8)
+    if wall_axes is None:
+        wall_axes = (False,) * dim
+    wall_axes = tuple(bool(w) for w in wall_axes)
+    h = float(dx[0])
+    near = _interface_cells(phi, wall_axes=wall_axes)
+    g0 = jnp.maximum(gradient_norm(phi, dx, wall_axes=wall_axes), 1e-8)
     d_band = jnp.abs(phi) / g0
     sgn = jnp.where(phi >= 0, 1.0, -1.0)
-    big = float(sum(n * h for n, h in zip(phi.shape, dx)))
+    big = float(sum(n * hh for n, hh in zip(phi.shape, dx)))
     u0 = jnp.where(near, d_band, big)
 
-    def eikonal_update(u):
-        # per-axis upwind neighbor values
-        mins = [jnp.minimum(jnp.roll(u, 1, d), jnp.roll(u, -1, d))
-                for d in range(dim)]
-        if dim == 2:
-            a = jnp.minimum(mins[0], mins[1])
-            b = jnp.maximum(mins[0], mins[1])
-            h = dx[0]     # assume near-isotropic spacing
-            one_d = a + h
-            disc = 2.0 * h * h - (b - a) ** 2
-            two_d = 0.5 * (a + b + jnp.sqrt(jnp.maximum(disc, 0.0)))
-            cand = jnp.where(one_d <= b, one_d, two_d)
-        else:
-            s = jnp.sort(jnp.stack(mins, axis=-1), axis=-1)
-            h = dx[0]
-            a, b, c = s[..., 0], s[..., 1], s[..., 2]
-            u1 = a + h
-            disc2 = 2.0 * h * h - (b - a) ** 2
-            u2 = 0.5 * (a + b + jnp.sqrt(jnp.maximum(disc2, 0.0)))
-            sum3 = a + b + c
-            disc3 = sum3 ** 2 - 3.0 * (a * a + b * b + c * c - h * h)
-            u3 = (sum3 + jnp.sqrt(jnp.maximum(disc3, 0.0))) / 3.0
-            cand = jnp.where(u1 <= b, u1, jnp.where(u2 <= c, u2, u3))
-        return jnp.minimum(u, cand)
+    def sweep_axis(u, d, forward):
+        """One directional pass along axis d: scan over slices;
+        within a slice the d-axis upwind value is the carry (already
+        updated — Gauss-Seidel) min the lagged downstream neighbor;
+        transverse neighbor minima are lagged (Jacobi), one whole
+        slice per scan step."""
+        from ibamr_tpu.ops.stencils import wall_boundary_masks
 
-    def body(_, u):
-        u = eikonal_update(u)
-        return jnp.where(near, d_band, u)
+        um = jnp.moveaxis(u, d, 0)
+        nm = jnp.moveaxis(near, d, 0)
+        bm = jnp.moveaxis(d_band, d, 0)
+        if not forward:
+            um, nm, bm = um[::-1], nm[::-1], bm[::-1]
+        # lagged downstream neighbor (next slice); on a wall axis the
+        # last slice has none (big), elsewhere periodic wrap
+        down = jnp.roll(um, -1, 0)
+        if wall_axes[d]:
+            down = down.at[-1].set(big)
+        # lagged transverse mins per slice; wall axes exclude the
+        # wrapped neighbor (one-sided at the boundary rows)
+        tmins = []
+        trans = [a for a in range(dim) if a != d]
+        for k, a in enumerate(trans):
+            ax = k + 1                      # axis of um after moveaxis
+            lo_n = jnp.roll(um, 1, ax)
+            hi_n = jnp.roll(um, -1, ax)
+            if wall_axes[a]:
+                is_lo, is_hi = wall_boundary_masks(um.shape, ax)
+                lo_n = jnp.where(is_lo, big, lo_n)
+                hi_n = jnp.where(is_hi, big, hi_n)
+            tmins.append(jnp.minimum(lo_n, hi_n))
 
-    u = jax.lax.fori_loop(0, iters, body, u0)
+        def step(carry, inp):
+            u_sl, n_sl, b_sl, down_sl, *t_sl = inp
+            a_d = jnp.minimum(carry, down_sl)
+            cand = _eikonal_solve([a_d] + list(t_sl), h)
+            new = jnp.minimum(u_sl, cand)
+            new = jnp.where(n_sl, b_sl, new)
+            return new, new
+
+        # seed: the opposite face's (old) slice on periodic axes;
+        # nothing beyond a wall
+        seed = jnp.full_like(um[-1], big) if wall_axes[d] else um[-1]
+        _, um_new = jax.lax.scan(step, seed,
+                                 (um, nm, bm, down, *tmins))
+        if not forward:
+            um_new = um_new[::-1]
+        return jnp.moveaxis(um_new, 0, d)
+
+    u = u0
+    for _ in range(int(sweeps)):
+        for d in range(dim):
+            u = sweep_axis(u, d, True)
+            u = sweep_axis(u, d, False)
     return sgn * u

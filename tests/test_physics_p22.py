@@ -403,3 +403,90 @@ def test_oldroyd_b_walled_channel_normal_stress():
     # conformation positivity proxy
     tr = Cf[..., 0, 0] + Cf[..., 1, 1]
     assert float(tr.min()) > 1.5, float(tr.min())
+
+
+def test_fast_sweeping_grid_independent_and_beats_pde_iterations():
+    """VERDICT round 4 item 9 pins: (a) the directional-sweep solver
+    reaches O(h) accuracy with the DEFAULT sweep count at every grid
+    size (4 rounds of 2*dim passes = 16 scans, an order of magnitude
+    below the O(n) pseudo-time iterations the relaxation PDE needs to
+    carry distance information n cells); (b) the two agree in the
+    interface neighborhood."""
+    for n in (32, 64, 128):
+        dx = (1.0 / n, 1.0 / n)
+        c = (np.arange(n) + 0.5) / n
+        X, Y = np.meshgrid(c, c, indexing="ij")
+        exact = np.sqrt((X - 0.5) ** 2 + (Y - 0.5) ** 2) - 0.3
+        phi = jnp.tanh(8.0 * jnp.asarray(exact)) * 0.05
+
+        d_fs = ls.fast_sweeping_distance(phi, dx)
+        mask = np.abs(exact) < 0.15
+        err_fs = np.max(np.abs(np.asarray(d_fs) - exact)[mask])
+        # same sweeps at every n: accuracy must not degrade with n
+        assert err_fs < 2.5 / n, (n, err_fs)
+
+        # the relaxation PDE with the same total number of whole-grid
+        # passes (16) has NOT converged away from the band (information
+        # moves one cell per pseudo-step); at n cells it needs O(n)
+        it_pde = 16
+        d_pde = ls.reinitialize(phi, dx, iters=it_pde)
+        far = np.abs(exact) > 0.25 * 1.0
+        err_pde = np.max(np.abs(np.asarray(d_pde) - exact)[far])
+        assert err_pde > 5.0 * err_fs, (err_pde, err_fs)
+
+    # (b) steady-state agreement: a converged PDE reinit and the
+    # sweeping solver agree where both are valid (near band, away
+    # from the periodic wrap seam)
+    n = 64
+    dx = (1.0 / n, 1.0 / n)
+    c = (np.arange(n) + 0.5) / n
+    X, Y = np.meshgrid(c, c, indexing="ij")
+    exact = np.sqrt((X - 0.5) ** 2 + (Y - 0.5) ** 2) - 0.3
+    phi = jnp.tanh(8.0 * jnp.asarray(exact)) * 0.05
+    d_fs = ls.fast_sweeping_distance(phi, dx)
+    d_pde = ls.reinitialize(phi, dx, iters=400)
+    mask = np.abs(exact) < 0.12
+    gap = np.max(np.abs(np.asarray(d_fs) - np.asarray(d_pde))[mask])
+    assert gap < 3.0 / n, gap
+
+
+def test_fast_sweeping_3d_sphere():
+    """3D branch of the Eikonal solve: sphere distance recovered from a
+    magnitude-destroyed level set."""
+    n = 32
+    dx = (1.0 / n,) * 3
+    c = (np.arange(n) + 0.5) / n
+    X, Y, Z = np.meshgrid(c, c, c, indexing="ij")
+    exact = np.sqrt((X - 0.5) ** 2 + (Y - 0.5) ** 2
+                    + (Z - 0.5) ** 2) - 0.3
+    phi = jnp.tanh(8.0 * jnp.asarray(exact)) * 0.05
+    d = ls.fast_sweeping_distance(phi, dx)
+    mask = np.abs(exact) < 0.12
+    err = np.max(np.abs(np.asarray(d) - exact)[mask])
+    assert err < 3.0 / n, err
+
+
+def test_fast_sweeping_wall_axes_no_tunnel():
+    """Wall-bounded sweeping (parity with reinitialize's wall_axes):
+    a flat pool surface near the domain bottom, walls on the y axis.
+    Without the wall flag the periodic wrap would see the phase jump
+    across the top/bottom boundary and tunnel small distances through;
+    with it, the distance grows monotonically to the top and matches
+    the exact |y - y0| distance."""
+    n = 64
+    dx = (1.0 / n, 1.0 / n)
+    c = (np.arange(n) + 0.5) / n
+    _, Y = np.meshgrid(c, c, indexing="ij")
+    y0 = 0.25
+    exact = Y - y0                       # flat interface at y = 0.25
+    phi = jnp.tanh(10.0 * jnp.asarray(exact)) * 0.03
+    d_wall = ls.fast_sweeping_distance(phi, dx,
+                                       wall_axes=(False, True))
+    err = np.max(np.abs(np.asarray(d_wall) - exact))
+    assert err < 2.5 / n, err
+    # the periodic solver on the same data DOES wrap (control: the
+    # wall flag is load-bearing) — near the top boundary the wrapped
+    # distance is ~the distance through the floor, much smaller
+    d_per = ls.fast_sweeping_distance(phi, dx)
+    top_err = np.max(np.abs(np.asarray(d_per) - exact)[:, -4:])
+    assert top_err > 10.0 / n, top_err
