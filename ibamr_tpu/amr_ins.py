@@ -105,6 +105,176 @@ def fill_fine_ghosts_mac(uf: Vel, uc: Vel, box: FineBox,
     return tuple(out)
 
 
+def box_strain_magnitude(uext: Vel, dx_f, g: int, fine_n):
+    """|S| = sqrt(2 E:E) at cell centers of a ghost-extended box MAC
+    field, keeping a (g-1)-deep ghost shell (diagonal strain needs one
+    face beyond the cell; off-diagonals one cell of each neighbor).
+    The cell-centered twin of ops.stencils.strain_rate_cc on the
+    face-complete box layout — input ghosts g, output ghosts g-1."""
+    dim = len(uext)
+    go = g - 1
+    cells = tuple(n + 2 * go for n in fine_n)
+
+    # cell-averaged components (for off-diagonal centered differences)
+    ucc = []
+    for d in range(dim):
+        c = uext[d]
+        lo = [slice(None)] * dim
+        hi = [slice(None)] * dim
+        lo[d] = slice(0, -1)
+        hi[d] = slice(1, None)
+        ucc.append(0.5 * (c[tuple(lo)] + c[tuple(hi)]))   # ghosts g
+    acc = None
+    for i in range(dim):
+        # exact MAC diagonal: faces bounding the cell
+        lo = [slice(None)] * dim
+        hi = [slice(None)] * dim
+        lo[i] = slice(0, -1)
+        hi[i] = slice(1, None)
+        Eii = (uext[i][tuple(hi)] - uext[i][tuple(lo)]) / dx_f[i]
+        Eii = Eii[tuple(slice(g - go, g - go + cells[a])
+                        for a in range(dim))]
+        t = Eii * Eii
+        acc = t if acc is None else acc + t
+        for j in range(i + 1, dim):
+            def dcc(f, ax):
+                lo2 = [slice(None)] * dim
+                hi2 = [slice(None)] * dim
+                lo2[ax] = slice(0, -2)
+                hi2[ax] = slice(2, None)
+                return (f[tuple(hi2)] - f[tuple(lo2)]) \
+                    / (2.0 * dx_f[ax])
+
+            a1 = dcc(ucc[i], j)     # ghosts g, minus 1 on axis j
+            a2 = dcc(ucc[j], i)     # ghosts g, minus 1 on axis i
+            # crop both to the common (g-1)-ghost cell window
+            def crop_mixed(a, lost_ax):
+                sl = []
+                for ax in range(dim):
+                    base = g - 1 if ax == lost_ax else g
+                    sl.append(slice(base - go, base - go + cells[ax]))
+                return a[tuple(sl)]
+
+            Eij = 0.5 * (crop_mixed(a1, j) + crop_mixed(a2, i))
+            acc = acc + 2.0 * Eij * Eij
+    return jnp.sqrt(2.0 * acc)
+
+
+def box_eddy_viscous_force(uext: Vel, mu_ext, dx_f, g: int, fine_n):
+    """div(2 mu D(u)) on the ghost-extended box MAC layout — the fine-
+    level twin of INSVCStaggeredIntegrator._viscous_force (periodic
+    rolls there, explicit slices here). ``mu_ext`` is cell-centered
+    with ``g-1`` ghosts (box_strain_magnitude's output shell); the
+    result is interior box MAC components (shape fine_n + e_d). Needs
+    g >= 3 so every stencil stays inside valid ghosts."""
+    dim = len(uext)
+    gm = g - 1                              # mu ghost depth
+
+    def face_crop(a, d, offs):
+        """Crop array ``a`` whose axis offsets (vs the interior box
+        face array of component d) are ``offs[ax]`` ghost layers."""
+        out = []
+        for ax in range(dim):
+            n = fine_n[ax] + (1 if ax == d else 0)
+            out.append(slice(offs[ax], offs[ax] + n))
+        return a[tuple(out)]
+
+    forces = []
+    for d in range(dim):
+        acc = None
+        for j in range(dim):
+            if j == d:
+                # tau_dd = 2 mu du_d/dx_d at cells (mu ghosts gm)
+                lo = [slice(None)] * dim
+                hi = [slice(None)] * dim
+                lo[d] = slice(0, -1)
+                hi[d] = slice(1, None)
+                dudd = (uext[d][tuple(hi)] - uext[d][tuple(lo)]) \
+                    / dx_f[d]               # cell-like, ghosts g
+                # align mu (ghosts gm) with dudd (ghosts g)
+                sl = tuple(slice(g - gm, g - gm + fine_n[a] + 2 * gm)
+                           for a in range(dim))
+                tau = 2.0 * mu_ext * dudd[sl]     # ghosts gm
+                lo2 = [slice(None)] * dim
+                hi2 = [slice(None)] * dim
+                lo2[d] = slice(0, -1)
+                hi2[d] = slice(1, None)
+                dtau = (tau[tuple(hi2)] - tau[tuple(lo2)]) / dx_f[d]
+                # dtau: faces along d with gm-1 offset... face k uses
+                # cells k-1,k -> face array ghosts gm on transverse,
+                # gm - ? along d: entries = n_d + 2gm - 1 faces,
+                # interior faces n_d + 1 -> offset gm - 1
+                offs = [gm] * dim
+                offs[d] = gm - 1
+                term = face_crop(dtau, d, offs)
+            else:
+                # tau_dj at (d, j) corners: mu corner-averaged.
+                # Raw central differences (corner-positioned):
+                #   dudj: diff of u_d (face-complete on d) along j
+                #   dujd: diff of u_j (face-complete on j) along d
+                # Corner (kd, kj) lives at entry kd+g on a face-kept
+                # axis and kd+g-1 on the diffed axis; both are aligned
+                # to mu's corner window (corners 1-gm .. n+gm-1 on the
+                # d/j axes, cells with gm ghosts elsewhere).
+                lo = [slice(None)] * dim
+                hi = [slice(None)] * dim
+                lo[j] = slice(0, -1)
+                hi[j] = slice(1, None)
+                dudj = (uext[d][tuple(hi)] - uext[d][tuple(lo)]) \
+                    / dx_f[j]
+                lo2 = [slice(None)] * dim
+                hi2 = [slice(None)] * dim
+                lo2[d] = slice(0, -1)
+                hi2[d] = slice(1, None)
+                dujd = (uext[j][tuple(hi2)] - uext[j][tuple(lo2)]) \
+                    / dx_f[d]
+
+                def align(a, diffed_ax, kept_ax):
+                    sl = []
+                    for ax in range(dim):
+                        if ax == diffed_ax:
+                            start = g - gm
+                            want = fine_n[ax] + 2 * gm - 1
+                        elif ax == kept_ax:
+                            start = g - gm + 1
+                            want = fine_n[ax] + 2 * gm - 1
+                        else:
+                            start = g - gm
+                            want = fine_n[ax] + 2 * gm
+                        sl.append(slice(start, start + want))
+                    return a[tuple(sl)]
+
+                # mu at corners: average the 4 cells around the (d, j)
+                # corner; mu_ext ghosts gm -> corner extent
+                # n_ax + 2gm - 1 on d and j
+                m = mu_ext
+                for ax in (d, j):
+                    lo3 = [slice(None)] * dim
+                    hi3 = [slice(None)] * dim
+                    lo3[ax] = slice(0, -1)
+                    hi3[ax] = slice(1, None)
+                    m = 0.5 * (m[tuple(lo3)] + m[tuple(hi3)])
+                tau = m * (align(dudj, j, d) + align(dujd, d, j))
+                # term = dtau/dx_j at d-faces: diff along j of the
+                # corner array -> d-face-like
+                lo4 = [slice(None)] * dim
+                hi4 = [slice(None)] * dim
+                lo4[j] = slice(0, -1)
+                hi4[j] = slice(1, None)
+                dtau = (tau[tuple(hi4)] - tau[tuple(lo4)]) / dx_f[j]
+                # dtau extents: d: n_d + 2gm - 1 (corner count along
+                # d = faces), j: n_j + 2gm - 2 cells, others n + 2gm.
+                # interior: d faces n_d + 1 -> offset gm - 1; j cells
+                # n_j -> offset gm - 1; others offset gm
+                offs = [gm] * dim
+                offs[d] = gm - 1
+                offs[j] = gm - 1
+                term = face_crop(dtau, d, offs)
+            acc = term if acc is None else acc + term
+        forces.append(acc)
+    return tuple(forces)
+
+
 def _box_convective_rate(uext: Vel, dx_f, g: int, fine_n) -> Vel:
     """Centered conservative N(u)_d on ghost-extended box MAC arrays;
     returns component d at its own faces (shape fine_n + e_d). Same

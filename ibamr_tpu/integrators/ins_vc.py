@@ -70,6 +70,8 @@ class INSVCStaggeredIntegrator:
                  precond: str = "mg",
                  wall_axes: Optional[Sequence[bool]] = None,
                  tangential=None,
+                 open_outlet: bool = False,
+                 still_level: Optional[float] = None,
                  dtype=jnp.float32):
         self.grid = grid
         self.rho = (float(rho0), float(rho1))
@@ -107,6 +109,57 @@ class INSVCStaggeredIntegrator:
         # FAC-preconditioned VC Poisson, SURVEY.md T8/P22)
         self.precond = precond
         self.dtype = dtype
+        # open_outlet (round 5, VERDICT item 3a — open-boundary x VC
+        # two-phase): axis 0 becomes wall(lo) -> OUTLET(hi). The
+        # pinned-face layout's single axis-0 wrap slot stores the
+        # OUTLET face (free, pressure-Dirichlet-corrected); the inlet
+        # face is an implicit impermeable back wall (the NWT geometry:
+        # back wall + generation zone + working region + beach +
+        # outlet). Advection/stress stencils still wrap axis 0 — valid
+        # under the SANDWICH CONTRACT: a generation zone at the lo end
+        # and a damping beach before the outlet keep both sides of the
+        # wrap near still water, so wrapped neighbors agree to the
+        # relaxation tolerance (the same clearance-style contract the
+        # IB layout bridges use). Gravity is referenced to the STILL
+        # density profile (rho - rho_still(z)) g, so the still state
+        # has p = 0 and the outlet's homogeneous Dirichlet is exact.
+        self.open_outlet = bool(open_outlet)
+        self.still_level = still_level
+        self._rho_still = None
+        if self.open_outlet:
+            if precond != "mg":
+                raise ValueError(
+                    "open_outlet requires the 'mg' preconditioner "
+                    "(the FFT inverse assumes a periodic domain)")
+            if self.wall_axes[0]:
+                raise ValueError(
+                    "open_outlet replaces axis 0's boundary pair "
+                    "(wall lo -> outlet hi); wall_axes[0] must be "
+                    "False")
+            if still_level is None and any(
+                    gv != 0.0 for gv in self.gravity):
+                raise ValueError(
+                    "open_outlet with gravity needs still_level (the "
+                    "still free-surface height referencing the "
+                    "hydrostatic profile so outlet p = 0 is exact)")
+            if any(gv != 0.0
+                   for gv in self.gravity[:grid.dim - 1]):
+                raise ValueError(
+                    "open_outlet supports gravity along the LAST axis "
+                    "only (the still hydrostatic reference is a "
+                    "z-profile; a transverse gravity component would "
+                    "silently break the outlet's p = 0 exactness)")
+            if still_level is not None:
+                zax = grid.dim - 1
+                z = (grid.x_lo[zax]
+                     + (jnp.arange(grid.n[zax], dtype=dtype) + 0.5)
+                     * grid.dx[zax])
+                shape = [1] * grid.dim
+                shape[zax] = grid.n[zax]
+                phi_still = (z.reshape(shape)
+                             - float(still_level)) * jnp.ones(
+                    grid.n, dtype=dtype)
+                self._rho_still = self.density(phi_still)
 
     # -- wall helpers --------------------------------------------------------
     def _pin_normal(self, c: jnp.ndarray, d: int) -> jnp.ndarray:
@@ -164,6 +217,8 @@ class INSVCStaggeredIntegrator:
         # discrete-exactness argument (see ins_walls module docstring)
         inv_rho_face = tuple(self._pin_normal(c, d)
                              for d, c in enumerate(inv_rho_face))
+        if self.open_outlet:
+            return self._project_vc_open(u, rho_cc, dt, inv_rho_face)
         div = stencils.divergence(u, dx)
         div = div - jnp.mean(div)
         rho_ref = min(self.rho)
@@ -215,6 +270,93 @@ class INSVCStaggeredIntegrator:
                       for d, (c, rf, gc)
                       in enumerate(zip(u, inv_rho_face, gp)))
         return u_new, p
+
+    def _project_vc_open(self, u: Vel, rho_cc, dt, inv_rho_face):
+        """Variable-density projection with axis 0 = wall(lo) ->
+        OUTLET(hi): no pressure nullspace (the outlet's homogeneous
+        Dirichlet anchors p), the axis-0 operator/divergence/correction
+        assembled from the explicit (n+1)-face flux array
+        [wall 0, interior, outlet half-cell], and the MG
+        preconditioner carries the matching mixed Neumann/Dirichlet
+        BCs. The axis-0 wrap slot of u_0 stores the outlet face."""
+        from ibamr_tpu.bc import (DIRICHLET, NEUMANN, AxisBC, DomainBC,
+                                  SideBC, neumann_axis, periodic_axis)
+        from ibamr_tpu.solvers.multigrid import PoissonMultigrid
+
+        g = self.grid
+        dx = g.dx
+        take = stencils.axis_slice
+        n0 = g.n[0]
+        # outlet face coefficient: one-sided against cell n0-1
+        inv_out = dt * take(1.0 / rho_cc, 0, n0 - 1, n0)
+
+        def axis0_fluxes(p):
+            gp_int = (take(p, 0, 1, n0) - take(p, 0, 0, n0 - 1)) / dx[0]
+            flux_int = dt * take(inv_rho_face[0], 0, 1, n0) * gp_int
+            flux_out = inv_out * (0.0 - take(p, 0, n0 - 1, n0)) \
+                / (0.5 * dx[0])
+            wall = jnp.zeros_like(flux_out)
+            return jnp.concatenate([wall, flux_int, flux_out], axis=0)
+
+        def _gp_t(p, d):
+            # transverse face gradient (periodic/wall-pinned axes only
+            # — axis 0 has its own explicit face assembly)
+            return (p - jnp.roll(p, 1, d)) / dx[d]
+
+        def A(p):
+            fx = axis0_fluxes(p)
+            div = (take(fx, 0, 1, n0 + 1) - take(fx, 0, 0, n0)) / dx[0]
+            for d in range(1, g.dim):
+                flux = dt * inv_rho_face[d] * _gp_t(p, d)
+                div = div + (jnp.roll(flux, -1, d) - flux) / dx[d]
+            return -div
+
+        def div_star(uv):
+            # axis 0: [wall 0, interior slots 1.., outlet (slot 0)]
+            ux = uv[0]
+            faces0 = jnp.concatenate(
+                [jnp.zeros_like(take(ux, 0, 0, 1)),
+                 take(ux, 0, 1, n0), take(ux, 0, 0, 1)], axis=0)
+            div = (take(faces0, 0, 1, n0 + 1)
+                   - take(faces0, 0, 0, n0)) / dx[0]
+            for d in range(1, g.dim):
+                div = div + (jnp.roll(uv[d], -1, d) - uv[d]) / dx[d]
+            return div
+
+        axes = [AxisBC(SideBC(NEUMANN), SideBC(DIRICHLET))]
+        for d in range(1, g.dim):
+            axes.append(neumann_axis() if self.wall_axes[d]
+                        else periodic_axis())
+        bc = DomainBC(axes=tuple(axes))
+        mg = PoissonMultigrid(g.n, bc, dx, D=dt / rho_cc,
+                              dtype=rho_cc.dtype)
+
+        def M(r):
+            return -mg.vcycle(jnp.zeros_like(r), r)
+
+        eps = float(jnp.finfo(rho_cc.dtype).eps)
+        tol_eff = max(self.cg_tol, 20.0 * eps)
+        res = krylov.cg(A, -div_star(u), M=M, tol=tol_eff,
+                        maxiter=self.cg_maxiter)
+        p = res.x
+        u_new = []
+        for d in range(g.dim):
+            if d == 0:
+                # slot 0 is the outlet face (half-cell coefficient);
+                # interior slots use the standard face correction
+                corr_out = inv_out * (0.0 - take(p, 0, n0 - 1, n0)) \
+                    / (0.5 * dx[0])
+                c = jnp.concatenate(
+                    [take(u[0], 0, 0, 1) - corr_out,
+                     take(u[0], 0, 1, n0)
+                     - dt * take(inv_rho_face[0], 0, 1, n0)
+                     * (take(p, 0, 1, n0)
+                        - take(p, 0, 0, n0 - 1)) / dx[0]], axis=0)
+                u_new.append(c)
+            else:
+                u_new.append(self._pin_normal(
+                    u[d] - dt * inv_rho_face[d] * _gp_t(p, d), d))
+        return tuple(u_new), p
 
     # -- variable-viscosity stress -------------------------------------------
     def _viscous_force(self, u: Vel, mu_cc: jnp.ndarray) -> Vel:
@@ -297,7 +439,13 @@ class INSVCStaggeredIntegrator:
         kap = (ls.curvature(phi, dx, wall_axes=self.wall_axes)
                if self.sigma else None)
         dlt = ls.delta(phi, self.eps) if self.sigma else None
-        drho = rho_cc - jnp.mean(rho_cc)
+        # open-outlet: reference the STILL hydrostatic profile so the
+        # quiescent state has p = 0 (outlet Dirichlet exact); periodic
+        # and walled tanks keep the net-force-free mean anomaly
+        if self._rho_still is not None:
+            drho = rho_cc - self._rho_still
+        else:
+            drho = rho_cc - jnp.mean(rho_cc)
         for d in range(g.dim):
             f = _cc_to_face(drho, d) * self.gravity[d]
             if self.sigma:
@@ -361,6 +509,15 @@ class INSVCStaggeredIntegrator:
             if f is not None:
                 rhs = rhs + f[d] * inv_rho_face[d]
             u_star.append(self._pin_normal(u[d] + dt * rhs, d))
+
+        if self.open_outlet:
+            # seed the outlet face (axis-0 wrap slot) by zero-gradient
+            # outflow extrapolation; the projection then sets it from
+            # mass conservation + the outlet pressure condition
+            n0 = g.n[0]
+            u_star[0] = jnp.concatenate(
+                [stencils.axis_slice(u_star[0], 0, n0 - 1, n0),
+                 stencils.axis_slice(u_star[0], 0, 1, n0)], axis=0)
 
         # variable-density pressure-increment projection
         u_new, dp = self.project_vc(tuple(u_star), rho_cc, dt)

@@ -564,6 +564,66 @@ def _pin_rank_dim(mesh: Mesh, dim: int):
     return pin_state
 
 
+def make_sharded_les_two_level_step(les, mesh: Mesh):
+    """Jitted composite-window LES step (round 5, VERDICT item 3b
+    sharded): the coarse level sharded over ``mesh``, the refined
+    window replicated (the default cost model of
+    make_sharded_two_level_ib_step), with the composite projection's
+    level-crossing pins installed. The per-level eddy-stress forces
+    are pure stencil work and follow their level's sharding."""
+    import copy
+
+    grid = les.grid
+    spatial = NamedSharding(mesh, grid_pspec(mesh, grid.dim))
+    replicated = NamedSharding(mesh, P())
+
+    les = copy.copy(les)
+    les.core = copy.copy(les.core)
+    proj = copy.copy(les.core.proj)
+    proj.level_sharding = spatial
+    proj.window_sharding = replicated
+    proj.build_dense_coarse_solver()   # host-side: not legal mid-trace
+    les.core.proj = proj
+
+    pin = jax.lax.with_sharding_constraint
+
+    def pin_state(st):
+        return st._replace(
+            uc=tuple(pin(c, spatial) for c in st.uc),
+            uf=tuple(pin(f, replicated) for f in st.uf))
+
+    def step(state, dt):
+        return pin_state(les.step(pin_state(state), dt))
+
+    return jax.jit(step)
+
+
+def make_sharded_cib_constraint(cibm, mesh: Mesh):
+    """Jitted CIB prescribed-kinematics solve with the Eulerian fields
+    of every nested mobility application (spread force, Stokes
+    velocity) sharded over ``mesh`` and the marker arrays replicated —
+    S1 through the CIB composition (round 5, VERDICT item 3c sharded;
+    works for both the periodic and the WALLED domain, whose saddle
+    FGMRES smoothers/reductions are the same GSPMD-compatible ops as
+    the open-boundary path's)."""
+    import copy
+
+    spatial = NamedSharding(mesh, grid_pspec(mesh, cibm.grid.dim))
+    replicated = NamedSharding(mesh, P())
+    pin = jax.lax.with_sharding_constraint
+
+    cibm = copy.copy(cibm)
+    cibm.field_pin = lambda a: pin(a, spatial)
+
+    def solve(X, U):
+        X = pin(X, replicated)
+        U = pin(U, replicated)
+        lam, FT, info = cibm.solve_constraint(X, U)
+        return pin(lam, replicated), pin(FT, replicated), info
+
+    return jax.jit(solve)
+
+
 def make_sharded_open_ins_step(integ, mesh: Mesh):
     """Jitted inflow/outflow (open-boundary) INS step sharded over
     ``mesh`` — S1 for the external-flow configuration: the coupled

@@ -117,6 +117,110 @@ class SmagorinskyINS:
         return _vc_step_with_extra_viscosity(self._vc, state, dt, mu_t)
 
 
+class TwoLevelSmagorinskyINS:
+    """LES in a REFINED WINDOW (round 5, VERDICT item 3b — AMR x P22):
+    the composite two-level INS core advances both levels with an
+    explicit Smagorinsky eddy-stress force per level, each level's
+    nu_t = (Cs Delta_level)^2 |S| from its OWN resolved strain and
+    filter width (the standard grid-filter convention, so the window
+    carries a smaller filter scale exactly as the reference's
+    turbulence modules do when composed with
+    ``IBHierarchyIntegrator``-style refinement [U]).
+
+    Coarse-level force: the periodic VC stress divergence
+    (INSVCStaggeredIntegrator._viscous_force). Fine-level force: the
+    ghost-extended box twins (amr_ins.box_strain_magnitude /
+    box_eddy_viscous_force — pinned exactly equal to the periodic
+    operator on wrap-filled ghosts). Molecular viscosity stays in the
+    composite core's semi-implicit treatment.
+    """
+
+    def __init__(self, grid: StaggeredGrid, box, mu: float,
+                 rho: float = 1.0, cs: float = 0.17,
+                 convective: bool = True, proj_tol: float = 1e-9,
+                 proj_m: int = 24, proj_restarts: int = 8):
+        from ibamr_tpu.amr_ins import TwoLevelINS
+        from ibamr_tpu.integrators.ins_vc import INSVCStaggeredIntegrator
+
+        self.core = TwoLevelINS(grid, box, rho=rho, mu=mu,
+                                convective=convective,
+                                proj_tol=proj_tol, proj_m=proj_m,
+                                proj_restarts=proj_restarts)
+        self.grid = grid
+        self.box = box
+        self.rho = float(rho)
+        self.cs = float(cs)
+        # periodic coarse-level stress machinery (mu passed per call)
+        self._vc = INSVCStaggeredIntegrator(grid, rho0=rho, rho1=rho,
+                                            mu0=mu, mu1=mu,
+                                            reinit_interval=0,
+                                            precond="fft")
+
+    def initialize(self, uc):
+        return self.core.initialize(uc)
+
+    def _eddy_forces(self, state):
+        from ibamr_tpu.amr_ins import (box_eddy_viscous_force,
+                                       box_strain_magnitude,
+                                       fill_fine_ghosts_mac)
+
+        g = self.grid
+        dim = g.dim
+        # coarse: periodic machinery at the coarse filter width
+        mu_t_c = self.rho * eddy_viscosity_smagorinsky(
+            state.uc, g.dx, self.cs)
+        f_c = self._vc._viscous_force(state.uc, mu_t_c)
+        # fine: ghost-extended box machinery at the fine filter width
+        G = 3
+        dx_f = self.core.dx_f
+        uext = fill_fine_ghosts_mac(state.uf, state.uc, self.box,
+                                    ghost=G)
+        S = box_strain_magnitude(uext, dx_f, G, self.box.fine_n)
+        delta_f = math.prod(float(h) for h in dx_f) ** (1.0 / dim)
+        mu_ext = self.rho * (self.cs * delta_f) ** 2 * S
+        f_f = box_eddy_viscous_force(uext, mu_ext, dx_f, G,
+                                     self.box.fine_n)
+        return f_c, f_f
+
+    def step(self, state, dt: float):
+        f_c, f_f = self._eddy_forces(state)
+        return self.core.step(state, dt, f_c=f_c, f_f=f_f)
+
+    def stable_dt(self, state, cfl: float = 0.5):
+        """Advisory dt bound including the EXPLICIT eddy viscosity the
+        class adds: the core's limit uses molecular mu only, and the
+        fine level's eddy-diffusion limit rho dx_f^2/(2 dim mu_eff)
+        binds whenever mu_t >> mu (code-review round 5)."""
+        import jax.numpy as jnp
+
+        from ibamr_tpu.amr_ins import (box_strain_magnitude,
+                                       fill_fine_ghosts_mac)
+
+        base = self.core.stable_dt(state, cfl)
+        dim = self.grid.dim
+        mu = self.core.mu
+        out = base
+        # coarse-level eddy limit
+        mu_t_c = self.rho * eddy_viscosity_smagorinsky(
+            state.uc, self.grid.dx, self.cs)
+        mu_eff_c = mu + jnp.max(mu_t_c)
+        out = jnp.minimum(out, self.rho * min(self.grid.dx) ** 2
+                          / (2.0 * dim * mu_eff_c))
+        # fine-level eddy limit
+        G = 3
+        dx_f = self.core.dx_f
+        uext = fill_fine_ghosts_mac(state.uf, state.uc, self.box,
+                                    ghost=G)
+        S = box_strain_magnitude(uext, dx_f, G, self.box.fine_n)
+        delta_f = math.prod(float(h) for h in dx_f) ** (1.0 / dim)
+        mu_eff_f = mu + self.rho * (self.cs * delta_f) ** 2 * jnp.max(S)
+        return jnp.minimum(out, self.rho * min(dx_f) ** 2
+                           / (2.0 * dim * mu_eff_f))
+
+    def max_divergence(self, state):
+        return self.core.max_divergence(state)
+
+
 # ---------------------------------------------------------------------------
 # Wilcox k-omega
 # ---------------------------------------------------------------------------

@@ -192,6 +192,15 @@ SLOW_TESTS = {
     "test_ib_open_3d_sphere_smoke",
     # round-5 additions
     "test_shedding_cylinder_adaptive_dt",
+    "test_open_outlet_passes_throughflow",
+    "test_open_outlet_wave_train_finite_and_bounded",
+    "test_les_refined_window_matches_uniform_fine",
+    "test_walled_cib_mobility_symmetric_and_confined",
+    "test_walled_cib_wall_approach_monotonicity",
+    "test_walled_cib_prescribed_kinematics_and_free_step",
+    "test_vc_open_outlet_sharded_matches_single",
+    "test_les_two_level_sharded_matches_single",
+    "test_cib_walled_sharded_matches_single",
 }
 
 
