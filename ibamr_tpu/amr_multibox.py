@@ -253,14 +253,23 @@ class MultiBoxDynamicAdvDiff:
 
         Fc, Qc_new = win._coarse_advance(Qc, dt)
 
-        Qf_out = []
-        for k in range(self.K):       # static pool: unrolled
-            Qf_k, acc_lo, acc_hi = win._fine_substeps(
-                Qc, Qc_new, Qf[k], lo[k], dt)
+        # ALL windows read the pristine coarse predictor before ANY
+        # writeback (Jacobi ordering): at the minimum separation a
+        # window's quadratic ghost stencil can reach the gap cell a
+        # neighbor's reflux writes, and a read-after-write interleave
+        # would make the result depend on the box index order. The
+        # read-then-write order is box-order-independent and is what
+        # the device-parallel placement (make_sharded_multibox_step)
+        # computes, so the two paths stay equal at every separation.
+        subs = [win._fine_substeps(Qc, Qc_new, Qf[k], lo[k], dt)
+                for k in range(self.K)]        # static pool: unrolled
+        for k in range(self.K):
+            Qf_k, acc_lo, acc_hi = subs[k]
             Qc_new = win._restrict_and_reflux(
                 Qc_new, Qf_k, lo[k], Fc, acc_lo, acc_hi, dt)
-            Qf_out.append(Qf_k)
-        return MultiBoxState(Qc=Qc_new, Qf=jnp.stack(Qf_out), lo=lo)
+        return MultiBoxState(Qc=Qc_new,
+                             Qf=jnp.stack([s[0] for s in subs]),
+                             lo=lo)
 
     def advance(self, state: MultiBoxState, dt: float,
                 num_steps: int) -> MultiBoxState:

@@ -564,6 +564,133 @@ def _pin_rank_dim(mesh: Mesh, dim: int):
     return pin_state
 
 
+def make_sharded_multibox_step(mb, mesh: Mesh,
+                               costs=None,
+                               X=None, w_marker: float = 4.0):
+    """Workload-BALANCED box->device placement for the K-window
+    multi-box hierarchy (round 5, VERDICT item 4 — the real
+    ``LoadBalancer::loadBalanceBoxLevel`` analog [U], closing S3):
+
+    - per-window costs from the S3 cost model (fine cells +
+      w_marker x markers, ``parallel.workload.box_costs``) unless
+      given explicitly;
+    - greedy LPT bin-packing assigns boxes to devices UNEVENLY
+      (``parallel.workload.lpt_assign``) — a hot window (marker
+      cluster) gets a device to itself while cold windows share;
+    - the jitted step gathers the boxes into a device-major padded
+      slot pool sharded over the mesh, runs all fine-window substeps
+      (the dominant work) device-parallel via vmap against the
+      pristine coarse predictor, then applies the cheap coarse
+      restriction/reflux writebacks sequentially in box order — the
+      SAME read-then-write (Jacobi) ordering the plain step uses, so
+      1-vs-8 equality holds at stencil tolerance at EVERY window
+      separation (tests/test_workload.py).
+
+    Returns the jitted ``step(state, dt)``; ``step.placement()``
+    yields the assignment/per-device loads for work-spread checks and
+    ``step.rebuild(state)`` re-places after a host-side regrid moved
+    the windows (placement is never checked on the hot path — no
+    device sync per step).
+
+    ``costs`` overrides the cost model for the INITIAL layout only; a
+    ``rebuild`` after a regrid always re-derives costs from the new
+    origins (an explicit stale-cost placement would silently defeat
+    the balancing the rebuild exists to restore).
+    """
+    import numpy as _np
+
+    from ibamr_tpu.parallel.workload import box_costs, lpt_assign
+
+    D = int(_np.prod(mesh.devices.shape))
+    K = mb.K
+    win = mb.win
+    state_holder = {"explicit_costs": costs}
+
+    def build(lo_np):
+        c = state_holder.pop("explicit_costs", None)
+        if c is None:
+            c = box_costs(lo_np, mb.win.box_shape, mb.grid,
+                          ratio=mb.ratio, X=X, w_marker=w_marker)
+        device_of_box, load = lpt_assign(c, D)
+        M = int(max(1, _np.bincount(device_of_box,
+                                    minlength=D).max()))
+        slot_box = _np.zeros(D * M, dtype=_np.int64)   # pad: box 0
+        slot_of_box = _np.zeros(K, dtype=_np.int64)
+        fill = _np.zeros(D, dtype=_np.int64)
+        for k in range(K):
+            d = int(device_of_box[k])
+            s = d * M + int(fill[d])
+            fill[d] += 1
+            slot_box[s] = k
+            slot_of_box[k] = s
+        return c, device_of_box, load, M, slot_box, slot_of_box
+
+    placement = None
+
+    def make(lo_np):
+        nonlocal placement
+        c, device_of_box, load, M, slot_box, slot_of_box = build(lo_np)
+        placement = {
+            "costs": c, "device_of_box": device_of_box,
+            "load": load, "slots_per_device": M,
+        }
+        slot_box_j = jnp.asarray(slot_box)
+        slot_of_box_j = jnp.asarray(slot_of_box)
+        pool_sh = NamedSharding(mesh, P(mesh.axis_names[0]
+                                        if len(mesh.axis_names) == 1
+                                        else mesh.axis_names))
+        replicated = NamedSharding(mesh, P())
+        pin = jax.lax.with_sharding_constraint
+
+        def step(state, dt):
+            Qc = pin(state.Qc, replicated)
+            Qf = pin(state.Qf, replicated)
+            lo = pin(state.lo, replicated)
+            Fc, Qc_new = win._coarse_advance(Qc, dt)
+            Qf_slots = pin(jnp.take(Qf, slot_box_j, axis=0), pool_sh)
+            lo_slots = jnp.take(lo, slot_box_j, axis=0)
+            sub = jax.vmap(
+                lambda qf, l: win._fine_substeps(Qc, Qc_new, qf, l,
+                                                 dt))
+            Qf_new_s, acc_lo_s, acc_hi_s = sub(Qf_slots, lo_slots)
+            Qf_new_s = pin(Qf_new_s, pool_sh)
+            for k in range(K):            # cheap, exact, box order
+                s = int(slot_of_box[k])
+                Qc_new = win._restrict_and_reflux(
+                    Qc_new, Qf_new_s[s], lo[k], Fc,
+                    [a[s] for a in acc_lo_s],
+                    [a[s] for a in acc_hi_s], dt)
+            Qf_new = pin(jnp.take(Qf_new_s, slot_of_box_j, axis=0),
+                         replicated)
+            from ibamr_tpu.amr_multibox import MultiBoxState
+
+            return MultiBoxState(Qc=pin(Qc_new, replicated),
+                                 Qf=Qf_new, lo=lo)
+
+        return jax.jit(step)
+
+    _compiled = [None]
+
+    def stepper(state, dt):
+        # placement built lazily on FIRST call; never re-checked on
+        # the hot path (np.asarray(state.lo) would force a device
+        # sync per step). Regrid callers invalidate via rebuild().
+        if _compiled[0] is None:
+            _compiled[0] = make(_np.asarray(state.lo))
+        return _compiled[0](state, dt)
+
+    def rebuild(state):
+        """Re-place after a host-side regrid moved the windows."""
+        _compiled[0] = make(_np.asarray(state.lo))
+
+    def get_placement():
+        return placement
+
+    stepper.placement = get_placement
+    stepper.rebuild = rebuild
+    return stepper
+
+
 def make_sharded_les_two_level_step(les, mesh: Mesh):
     """Jitted composite-window LES step (round 5, VERDICT item 3b
     sharded): the coarse level sharded over ``mesh``, the refined

@@ -38,7 +38,8 @@ import numpy as np
 from ibamr_tpu.grid import StaggeredGrid
 
 __all__ = ["shard_marker_counts", "workload_estimate", "choose_mesh",
-           "recommended_capacity", "needs_rebalance", "WorkloadReport"]
+           "recommended_capacity", "needs_rebalance", "WorkloadReport",
+           "box_costs", "lpt_assign"]
 
 
 def _factorizations(P: int, naxes: int) -> List[Tuple[int, ...]]:
@@ -149,6 +150,49 @@ def choose_mesh(X: np.ndarray, grid: StaggeredGrid, n_devices: int,
             f"no valid factorization of {n_devices} devices for grid "
             f"{grid.n} (min_block={min_block})")
     return best
+
+
+def box_costs(lo: np.ndarray, box_shape: Sequence[int],
+              grid: StaggeredGrid, ratio: int = 2,
+              X: Optional[np.ndarray] = None,
+              w_marker: float = 4.0) -> np.ndarray:
+    """Per-window workload of a K-box fine level: fine cells +
+    ``w_marker`` x markers inside each window (the same cost model as
+    :func:`workload_estimate`, per box instead of per shard — the
+    SAMRAI ``LoadBalancer`` weights patches exactly this way before
+    bin-packing them onto ranks [U])."""
+    lo = np.asarray(lo)
+    K = lo.shape[0]
+    cells = float(np.prod([s * ratio for s in box_shape]))
+    costs = np.full(K, cells, dtype=np.float64)
+    if X is not None and len(X):
+        Xi = np.asarray(X)
+        for k in range(K):
+            inside = np.ones(len(Xi), dtype=bool)
+            for d in range(grid.dim):
+                x0 = grid.x_lo[d] + lo[k, d] * grid.dx[d]
+                x1 = x0 + box_shape[d] * grid.dx[d]
+                inside &= (Xi[:, d] >= x0) & (Xi[:, d] < x1)
+            costs[k] += w_marker * int(inside.sum())
+    return costs
+
+
+def lpt_assign(costs: np.ndarray, n_devices: int
+               ) -> Tuple[np.ndarray, np.ndarray]:
+    """Greedy LPT (longest-processing-time) bin-packing: sort items by
+    descending cost, always assign to the least-loaded device — the
+    classic 4/3-approximation the reference's greedy
+    ``LoadBalancer::loadBalanceBoxLevel`` uses [U]. Returns
+    (device_of_item (K,), per-device load (n_devices,))."""
+    costs = np.asarray(costs, dtype=np.float64)
+    order = np.argsort(-costs)
+    load = np.zeros(n_devices, dtype=np.float64)
+    device = np.zeros(costs.size, dtype=np.int64)
+    for k in order:
+        d = int(np.argmin(load))
+        device[k] = d
+        load[d] += costs[k]
+    return device, load
 
 
 def needs_rebalance(X: np.ndarray, grid: StaggeredGrid,
