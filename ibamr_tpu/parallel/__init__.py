@@ -21,5 +21,6 @@ from ibamr_tpu.parallel.mesh import (  # noqa: F401
     make_mesh,
     make_sharded_ib_step,
     make_sharded_ins_step,
+    make_sharded_step,
     shard_state,
 )

@@ -102,67 +102,124 @@ def _with_pencil_solvers(ins_integ, mesh: Mesh):
     return integ2
 
 
-def make_sharded_ins_step(integ, mesh: Mesh):
-    """Jitted INS step with grid arrays sharded over ``mesh``.
+# ---------------------------------------------------------------------------
+# THE sharding seam (round 5, VERDICT item 7): one generic pinned-step
+# wrapper + per-family PREPARE hooks + a name-dispatched entry point.
+# Each integrator family contributes only what is genuinely its own —
+# a solver-seam swap and/or a custom state pinner — and the wrapping,
+# argument pinning, and jit live in exactly one place.
+# ---------------------------------------------------------------------------
 
-    Periodic domains: GSPMD roll-stencil halos + explicit pencil-FFT
-    solves. Wall-bounded domains: the fast-diagonalization solves are
-    dense per-axis eigenvector MATMULS (plus FFTs on the periodic
-    axes), which the SPMD partitioner distributes directly — the
-    transform along a sharded axis becomes an MXU matmul with an
-    all-gather of that axis, exactly the communication a transpose-
-    based distributed transform needs anyway. No seam swap required;
-    correctness is pinned by the 1-vs-8-device equality tests."""
-    if any(getattr(integ, "wall_axes", ())):
+def _prepare_fluid(ins, mesh: Mesh):
+    """Solver-seam prepare for a uniform INS integrator: periodic
+    domains swap in the pencil-decomposed distributed FFT; wall-bounded
+    domains keep their fast-diagonalization solves (dense per-axis
+    eigenvector matmuls the SPMD partitioner distributes directly —
+    the transform along a sharded axis becomes an MXU matmul with an
+    all-gather, exactly a transpose-based distributed transform's
+    communication)."""
+    if any(getattr(ins, "wall_axes", ())):
         import copy
 
-        integ = copy.copy(integ)
-        integ.fused_stokes = None   # defensive: walls never set it
-    else:
-        integ = _with_pencil_solvers(integ, mesh)
-    grid = integ.grid
+        ins = copy.copy(ins)
+        ins.fused_stokes = None   # defensive: walls never set it
+        return ins
+    return _with_pencil_solvers(ins, mesh)
 
-    def step(state, dt, f=None, q=None):
-        state = shard_state(state, grid, mesh)
-        if f is not None:
-            f = shard_state(f, grid, mesh)
-        if q is not None:
-            q = shard_state(q, grid, mesh)
-        return shard_state(integ.step(state, dt, f=f, q=q), grid, mesh)
+
+def _generic_pinned_step(integ, mesh: Mesh, prepare=None,
+                         pin_state=None):
+    """The one wrapper every simple (single-level) family uses: pin
+    the state and every array argument to the family's sharding,
+    call ``integ.step``, pin the result, jit. ``pin_state`` defaults
+    to the exact-shape grid pinner (``shard_state``); rank-based
+    layouts (face-complete open boundaries) pass ``_pin_rank_dim``."""
+    if prepare is not None:
+        integ = prepare(integ, mesh)
+    if pin_state is None:
+        grid = integ.grid
+
+        def pin_state(t):
+            return shard_state(t, grid, mesh)
+
+    def step(state, *args, **kwargs):
+        args = tuple(pin_state(a) for a in args)
+        kwargs = {k: pin_state(v) for k, v in kwargs.items()}
+        return pin_state(integ.step(pin_state(state), *args,
+                                    **kwargs))
 
     return jax.jit(step)
 
 
-def make_sharded_adv_diff_step(integ, mesh: Mesh):
-    """Jitted adv-diff step with grid arrays sharded over ``mesh``."""
+def make_sharded_ins_step(integ, mesh: Mesh):
+    """Jitted INS step with grid arrays sharded over ``mesh``
+    (periodic: pencil-FFT solves; walls: partitioner-distributed
+    fastdiag matmuls — see _prepare_fluid)."""
+    return _generic_pinned_step(integ, mesh,
+                                prepare=_prepare_fluid)
+
+
+def _prepare_adv_diff(integ, mesh: Mesh):
+    # Quantities with wall BCs keep their fast-diagonalization solves;
+    # fully-periodic quantities get the pencil-FFT Helmholtz — the
+    # integrator consults helmholtz_solve only where _wall_solvers[i]
+    # is None, so the pencil plan is built exactly when some quantity
+    # needs it (an all-wall integrator must not trip pencil
+    # divisibility checks).
     import copy
 
     from ibamr_tpu.parallel.fftpar import PencilFFT
 
-    # Quantities with wall BCs keep their fast-diagonalization solves
-    # (per-axis dense matmuls the SPMD partitioner distributes
-    # directly, see make_sharded_ins_step); fully-periodic quantities
-    # get the pencil-FFT Helmholtz — the integrator consults
-    # helmholtz_solve only where _wall_solvers[i] is None, so the
-    # pencil plan is built exactly when some quantity needs it (an
-    # all-wall integrator must not trip pencil divisibility checks).
     integ = copy.copy(integ)
     if any(s is None for s in getattr(integ, '_wall_solvers', (None,))):
         pencil = PencilFFT(integ.grid, mesh)
         integ.helmholtz_solve = pencil.helmholtz_cc
-    grid = integ.grid
+    return integ
 
-    def step(state, dt, u=None, sources=None):
-        state = shard_state(state, grid, mesh)
-        if u is not None:
-            u = shard_state(u, grid, mesh)
-        if sources is not None:
-            sources = [None if s is None else shard_state(s, grid, mesh)
-                       for s in sources]
-        return shard_state(integ.step(state, dt, u=u, sources=sources),
-                           grid, mesh)
 
-    return jax.jit(step)
+def make_sharded_adv_diff_step(integ, mesh: Mesh):
+    """Jitted adv-diff step with grid arrays sharded over ``mesh``."""
+    return _generic_pinned_step(integ, mesh,
+                                prepare=_prepare_adv_diff)
+
+
+def make_sharded_step(integ, mesh: Mesh, **opts):
+    """THE sharding entry point (round 5, VERDICT item 7): dispatch
+    any integrator to its family's sharded-step builder by class name.
+    ``opts`` forward to the family builder (e.g. ``shard_window=`` for
+    the composite families, ``sharded_markers=`` for IB). Integrators
+    outside the table that expose ``.grid`` and ``.step`` get the
+    generic exact-shape pinned wrapper — a new single-level family
+    needs NO factory at all."""
+    table = {
+        "INSStaggeredIntegrator": make_sharded_ins_step,
+        "AdvDiffSemiImplicitIntegrator": make_sharded_adv_diff_step,
+        "INSVCStaggeredIntegrator": make_sharded_vc_step,
+        "INSVCConservativeIntegrator": make_sharded_vc_step,
+        "INSOpenIntegrator": make_sharded_open_ins_step,
+        "IBOpenIntegrator": make_sharded_ib_open_step,
+        "IBExplicitIntegrator": make_sharded_ib_step,
+        "TwoLevelIBINS": make_sharded_two_level_ib_step,
+        "MultiLevelAdvDiff": make_sharded_multilevel_step,
+        "MultiLevelINS": make_sharded_multilevel_ins_step,
+        "MultiLevelIBINS": make_sharded_multilevel_ib_step,
+        "MultiBoxDynamicAdvDiff": make_sharded_multibox_step,
+        "TwoLevelSmagorinskyINS": make_sharded_les_two_level_step,
+        "CIBMethod": make_sharded_cib_constraint,
+    }
+    # walk the MRO so SUBCLASSES of a registered family inherit its
+    # prepare seam (a name-only match would silently drop e.g. the
+    # pencil-solver swap for a user's INSStaggeredIntegrator subclass)
+    for klass in type(integ).__mro__:
+        builder = table.get(klass.__name__)
+        if builder is not None:
+            return builder(integ, mesh, **opts)
+    if hasattr(integ, "grid") and hasattr(integ, "step"):
+        return _generic_pinned_step(integ, mesh, **opts)
+    raise TypeError(
+        f"no sharded-step builder for {type(integ).__name__}; expose "
+        f".grid/.step for the generic wrapper or register a family "
+        f"builder")
 
 
 def make_sharded_multilevel_step(ml, mesh: Mesh):
@@ -303,7 +360,7 @@ def make_sharded_ib_step(integ, mesh: Mesh,
 
     grid = integ.ins.grid
     integ = copy.copy(integ)
-    integ.ins = _with_pencil_solvers(integ.ins, mesh)
+    integ.ins = _prepare_fluid(integ.ins, mesh)
 
     # None = AUTO (default): use the S2 engine when eligible, fall back
     # silently (GSPMD is the intended route for IBFE/plugin strategies).
@@ -315,12 +372,12 @@ def make_sharded_ib_step(integ, mesh: Mesh,
         if wrapped is not None:
             integ.ib = wrapped
 
-    def step(state, dt):
-        state = state._replace(ins=shard_state(state.ins, grid, mesh))
-        new = integ.step(state, dt)
-        return new._replace(ins=shard_state(new.ins, grid, mesh))
+    def pin_ib(st):
+        if hasattr(st, "ins"):
+            return st._replace(ins=shard_state(st.ins, grid, mesh))
+        return st
 
-    return jax.jit(step)
+    return _generic_pinned_step(integ, mesh, pin_state=pin_ib)
 
 
 def make_sharded_two_level_ib_step(integ, mesh: Mesh,
@@ -530,20 +587,12 @@ def place_state(state, grid: StaggeredGrid, mesh: Mesh):
 def make_sharded_vc_step(integ, mesh: Mesh):
     """Jitted variable-coefficient (multiphase) INS step with every
     grid field sharded over ``mesh`` — S1 for the P22 multiphase
-    integrators (`INSVCStaggeredIntegrator` / conservative form, walls
-    or periodic). Everything inside the step is roll-stencil, CG
-    (psum reductions), multigrid V-cycle (strided restriction/
-    prolongation the partitioner resolves), Godunov advection, and
-    level-set reinitialization — all GSPMD-compatible; the pins at the
-    step boundary keep the layouts stable. Equality with the
-    single-device step is pinned by tests/test_parallel.py."""
-    grid = integ.grid
-
-    def step(state, dt):
-        state = shard_state(state, grid, mesh)
-        return shard_state(integ.step(state, dt), grid, mesh)
-
-    return jax.jit(step)
+    integrators (`INSVCStaggeredIntegrator` incl. the open-outlet
+    tank / conservative form, walls or periodic). Everything inside
+    the step is roll-stencil, CG (psum reductions), multigrid V-cycle,
+    Godunov advection, and level-set reinitialization — all
+    GSPMD-compatible. Equality pinned by tests/test_parallel.py."""
+    return _generic_pinned_step(integ, mesh)
 
 
 def _pin_rank_dim(mesh: Mesh, dim: int):
@@ -757,14 +806,8 @@ def make_sharded_open_ins_step(integ, mesh: Mesh):
     saddle solve's red-black smoothers are masked elementwise ops and
     its FGMRES reductions are psums, all GSPMD-compatible. Equality
     with the single-device step is pinned by tests/test_parallel.py."""
-    pin_state = _pin_rank_dim(mesh, len(integ.n))
-
-    def step(state, f=None):
-        if f is not None:
-            f = pin_state(f)
-        return pin_state(integ.step(pin_state(state), f=f))
-
-    return jax.jit(step)
+    return _generic_pinned_step(
+        integ, mesh, pin_state=_pin_rank_dim(mesh, len(integ.n)))
 
 
 def make_sharded_ib_open_step(integ, mesh: Mesh):
@@ -772,17 +815,16 @@ def make_sharded_ib_open_step(integ, mesh: Mesh):
     (integrators.ib_open) with the Eulerian state sharded over
     ``mesh`` and markers replicated — flow past an immersed structure
     on the device mesh."""
-    pin_state = _pin_rank_dim(mesh, len(integ.ins.n))
+    pin_fluid = _pin_rank_dim(mesh, len(integ.ins.n))
     replicated = NamedSharding(mesh, P())
     pin = jax.lax.with_sharding_constraint
 
     def pin_all(st):
-        return st._replace(fluid=pin_state(st.fluid),
-                           X=pin(st.X, replicated),
-                           U=pin(st.U, replicated),
-                           mask=pin(st.mask, replicated))
+        if hasattr(st, "fluid"):
+            return st._replace(fluid=pin_fluid(st.fluid),
+                               X=pin(st.X, replicated),
+                               U=pin(st.U, replicated),
+                               mask=pin(st.mask, replicated))
+        return st        # scalars/aux passed through step args
 
-    def step(state):
-        return pin_all(integ.step(pin_all(state)))
-
-    return jax.jit(step)
+    return _generic_pinned_step(integ, mesh, pin_state=pin_all)

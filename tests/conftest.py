@@ -201,6 +201,7 @@ SLOW_TESTS = {
     "test_vc_open_outlet_sharded_matches_single",
     "test_les_two_level_sharded_matches_single",
     "test_cib_walled_sharded_matches_single",
+    "test_cross_mesh_restart_flagship_1_to_8_and_back",
 }
 
 

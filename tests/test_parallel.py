@@ -842,3 +842,101 @@ def test_cross_mesh_restart_composite_two_level(tmp_path):
     for _ in range(3):
         sh = stepN(sh, dt)
     _tree_allclose(ref, sh, rtol=1e-10, atol=1e-11)
+
+
+def test_make_sharded_step_dispatch():
+    """The ONE sharding entry point (round 5, VERDICT item 7):
+    make_sharded_step dispatches every registered family and its
+    result equals the family factory's."""
+    from ibamr_tpu.grid import StaggeredGrid
+    from ibamr_tpu.integrators.ins import INSStaggeredIntegrator
+    from ibamr_tpu.integrators.ins_vc import INSVCStaggeredIntegrator
+    from ibamr_tpu.parallel.mesh import make_sharded_step
+
+    mesh = make_mesh(8)
+    g = StaggeredGrid(n=(16, 16), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+
+    ins = INSStaggeredIntegrator(g, mu=0.02, dtype=jnp.float64)
+    st = ins.initialize()
+    out = make_sharded_step(ins, mesh)(st, 1e-3)
+    ref = make_sharded_ins_step(ins, mesh)(st, 1e-3)
+    _tree_allclose(ref, out, rtol=1e-14, atol=1e-14)
+
+    vc = INSVCStaggeredIntegrator(g, rho0=1.0, rho1=2.0, mu0=0.01,
+                                  mu1=0.01, dtype=jnp.float64,
+                                  precond="fft")
+    xx = (np.arange(16) + 0.5) / 16
+    X, Y = np.meshgrid(xx, xx, indexing="ij")
+    phi = jnp.asarray(0.1 - np.sqrt((X - 0.5) ** 2 + (Y - 0.5) ** 2))
+    stv = vc.initialize(phi)
+    outv = make_sharded_step(vc, mesh)(stv, 1e-3)
+    assert bool(jnp.all(jnp.isfinite(outv.u[0])))
+    assert len(outv.u[0].sharding.device_set) == 8
+
+    # unknown single-level integrators ride the generic wrapper
+    class Minimal:
+        grid = g
+
+        def step(self, s, dt):
+            return tuple(c + dt for c in s)
+
+    m_out = make_sharded_step(Minimal(), mesh)(
+        tuple(jnp.zeros((16, 16)) for _ in range(2)), 1e-3)
+    assert float(m_out[0][0, 0]) == 1e-3
+
+    with np.testing.assert_raises(TypeError):
+        make_sharded_step(object(), mesh)
+
+
+def test_wall_bounded_ib_sharded_matches_single():
+    """IB over a WALL-BOUNDED fluid sharded over the mesh: the seam
+    consolidation routes walled INS through _prepare_fluid (fastdiag
+    matmuls distributed by the partitioner) instead of raising — this
+    pins that the enabled path is exact (round-5 code review)."""
+    from ibamr_tpu.grid import StaggeredGrid
+    from ibamr_tpu.integrators.ib import IBExplicitIntegrator, IBMethod
+    from ibamr_tpu.integrators.ins import INSStaggeredIntegrator
+    from ibamr_tpu.ops.forces import ForceSpecs
+
+    g = StaggeredGrid(n=(32, 32), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    ins = INSStaggeredIntegrator(g, mu=0.02, wall_axes=(True, True),
+                                 dtype=jnp.float64)
+    th = np.linspace(0, 2 * np.pi, 17)[:-1]
+    X0 = jnp.asarray(np.stack([0.5 + 0.1 * np.cos(th),
+                               0.5 + 0.1 * np.sin(th)], -1))
+    ib = IBMethod(ForceSpecs(), kernel="IB_4",
+                  force_fn=lambda X, U, t: -30.0 * (X - X0))
+    integ = IBExplicitIntegrator(ins, ib)
+    st0 = integ.initialize(X0)
+
+    step1 = jax.jit(lambda s, d: integ.step(s, d))
+    ref = st0
+    for _ in range(3):
+        ref = step1(ref, 1e-3)
+
+    mesh = make_mesh(8)
+    stepN = make_sharded_ib_step(integ, mesh)
+    sh = st0
+    for _ in range(3):
+        sh = stepN(sh, 1e-3)
+    _tree_allclose(ref, sh, rtol=1e-12, atol=1e-12)
+
+
+def test_make_sharded_step_subclass_inherits_family():
+    """MRO dispatch: a SUBCLASS of a registered family gets the
+    family's prepare seam (the pencil-solver swap), not the bare
+    generic wrapper (round-5 code review)."""
+    from ibamr_tpu.grid import StaggeredGrid
+    from ibamr_tpu.integrators.ins import INSStaggeredIntegrator
+    from ibamr_tpu.parallel.mesh import make_sharded_step
+
+    class MyINS(INSStaggeredIntegrator):
+        pass
+
+    g = StaggeredGrid(n=(16, 16), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    ins = MyINS(g, mu=0.02, dtype=jnp.float64)
+    mesh = make_mesh(8)
+    st = ins.initialize()
+    ref = make_sharded_ins_step(ins, mesh)(st, 1e-3)
+    out = make_sharded_step(ins, mesh)(st, 1e-3)
+    _tree_allclose(ref, out, rtol=1e-14, atol=1e-14)
