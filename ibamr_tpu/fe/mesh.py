@@ -213,6 +213,76 @@ def to_quadratic(mesh: FEMesh) -> FEMesh:
     return FEMesh(nodes=all_nodes, elems=elems, elem_type=new_type)
 
 
+def to_quadratic_tensor(mesh: FEMesh, serendipity: bool = False
+                        ) -> FEMesh:
+    """Convert a tensor mesh to its quadratic family member
+    (QUAD4 -> QUAD9/QUAD8, HEX8 -> HEX27/HEX20) by inserting edge
+    midpoints (shared), plus face centers and the cell center for the
+    full (non-serendipity) families — node order matching
+    fe.fem's libMesh-convention shape tables (corners, edges[,
+    faces, center])."""
+    if mesh.elem_type == "QUAD4":
+        edges = [(0, 1), (1, 2), (2, 3), (3, 0)]
+        faces = []
+        new_type = "QUAD8" if serendipity else "QUAD9"
+        center_nodes = not serendipity
+    elif mesh.elem_type == "HEX8":
+        edges = [(0, 1), (1, 2), (2, 3), (3, 0),
+                 (0, 4), (1, 5), (2, 6), (3, 7),
+                 (4, 5), (5, 6), (6, 7), (7, 4)]
+        faces = [(0, 1, 2, 3), (0, 1, 5, 4), (1, 2, 6, 5),
+                 (2, 3, 7, 6), (3, 0, 4, 7), (4, 5, 6, 7)]
+        new_type = "HEX20" if serendipity else "HEX27"
+        center_nodes = not serendipity
+        if serendipity:
+            faces = []
+    else:
+        raise ValueError(f"to_quadratic_tensor: {mesh.elem_type} is "
+                         "not a linear tensor type")
+    E = mesh.n_elems
+    next_id = mesh.n_nodes
+    new_pts = []
+    edge_id = {}
+    mids = np.zeros((E, len(edges)), dtype=mesh.elems.dtype)
+    for e in range(E):
+        conn = mesh.elems[e]
+        for m, (i, j) in enumerate(edges):
+            key = (min(conn[i], conn[j]), max(conn[i], conn[j]))
+            if key not in edge_id:
+                edge_id[key] = next_id
+                new_pts.append(0.5 * (mesh.nodes[conn[i]]
+                                      + mesh.nodes[conn[j]]))
+                next_id += 1
+            mids[e, m] = edge_id[key]
+    cols = [mesh.elems, mids]
+    if faces:
+        face_id = {}
+        fmids = np.zeros((E, len(faces)), dtype=mesh.elems.dtype)
+        for e in range(E):
+            conn = mesh.elems[e]
+            for m, idx in enumerate(faces):
+                key = tuple(sorted(int(conn[i]) for i in idx))
+                if key not in face_id:
+                    face_id[key] = next_id
+                    new_pts.append(np.mean(
+                        [mesh.nodes[conn[i]] for i in idx], axis=0))
+                    next_id += 1
+                fmids[e, m] = face_id[key]
+        cols.append(fmids)
+    if center_nodes:
+        centers = np.arange(next_id, next_id + E,
+                            dtype=mesh.elems.dtype)[:, None]
+        new_pts.extend(np.mean(mesh.nodes[mesh.elems[e]], axis=0)
+                       for e in range(E))
+        next_id += E
+        cols.append(centers)
+    all_nodes = np.concatenate([mesh.nodes, np.asarray(new_pts)],
+                               axis=0)
+    return FEMesh(nodes=all_nodes,
+                  elems=np.concatenate(cols, axis=1),
+                  elem_type=new_type)
+
+
 def rect_quad_mesh(nx: int, ny: int,
                    x_lo=(0.0, 0.0), x_up=(1.0, 1.0)) -> FEMesh:
     """Structured QUAD4 mesh of a rectangle."""

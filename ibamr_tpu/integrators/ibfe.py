@@ -74,7 +74,8 @@ class IBFEMethod:
                  damping: float = 0.0,
                  body_force: Optional[Callable] = None,
                  dtype=jnp.float32,
-                 fast=None):
+                 fast=None,
+                 transfer_level: int = 0):
         if coupling not in ("nodal", "unified"):
             raise ValueError(f"unknown IBFE coupling scheme {coupling!r}")
         # optional transfer engine (FastInteraction / PackedInteraction
@@ -92,11 +93,31 @@ class IBFEMethod:
         self.coupling = coupling
         self.damping = damping
         self.body_force = body_force  # optional (x, t) -> nodal force
+        # transfer tables: the stiffness assembly by default, or a
+        # DENSER rule (fem.transfer_quadrature) for the
+        # Eulerian<->Lagrangian coupling — the reference's
+        # FEDataManager::updateQuadratureRule adapts exactly this rule
+        # to the deformed configuration [U]; pick the level host-side
+        # from fem.suggest_transfer_level (per regrid cadence)
+        from ibamr_tpu.fe.fem import build_transfer_assembly
+        self.transfer_level = int(transfer_level)
+        if self.transfer_level > 0 and coupling == "nodal":
+            raise ValueError(
+                "transfer_level applies to the 'unified' "
+                "(quadrature-point) coupling only; nodal coupling "
+                "transfers at the nodes and has no quadrature rule "
+                "to densify")
+        self.tasm: FEAssembly = (
+            self.asm if self.transfer_level <= 0
+            else build_transfer_assembly(mesh, self.transfer_level,
+                                         dtype=dtype))
         # static node<->quad transfer weights, hoisted out of the
         # per-step calls (they depend only on the assembly)
         from ibamr_tpu.fe.fem import _node_qp_weights
-        self._wwden = _node_qp_weights(self.asm.elems, self.asm.shape,
-                                       self.asm.wdV, self.asm.n_nodes)
+        self._wwden = _node_qp_weights(self.tasm.elems,
+                                       self.tasm.shape,
+                                       self.tasm.wdV,
+                                       self.tasm.n_nodes)
 
     # -- IBStrategy surface --------------------------------------------------
     def prepare(self, X: jnp.ndarray, mask: jnp.ndarray):
@@ -108,7 +129,7 @@ class IBFEMethod:
             return None
         if self.coupling == "nodal":
             return self.fast.buckets(X, mask)
-        return self.fast.buckets(quad_positions(self.asm, X))
+        return self.fast.buckets(quad_positions(self.tasm, X))
 
     def compute_force(self, X: jnp.ndarray, U: jnp.ndarray,
                       t) -> jnp.ndarray:
@@ -130,7 +151,7 @@ class IBFEMethod:
             return interaction.interpolate_vel(u, grid, X,
                                                kernel=self.kernel,
                                                weights=mask)
-        xq = quad_positions(self.asm, X)
+        xq = quad_positions(self.tasm, X)
         if self.fast is not None:
             _check_fast_grid(self.fast, grid)
             Uq = self.fast.interpolate_vel(u, xq, b=ctx)
@@ -139,8 +160,10 @@ class IBFEMethod:
                                              kernel=self.kernel)
         # nodal mask honored the same way the nodal path does: inactive
         # slots interpolate to zero (and so do not move)
-        out = nodal_average_from_quads(self.asm.elems, self.asm.shape,
-                                       self.asm.wdV, self.asm.n_nodes,
+        out = nodal_average_from_quads(self.tasm.elems,
+                                       self.tasm.shape,
+                                       self.tasm.wdV,
+                                       self.tasm.n_nodes,
                                        Uq, ww_den=self._wwden)
         return out * mask[:, None]
 
@@ -158,10 +181,10 @@ class IBFEMethod:
         # conservation on every element family; see fem.
         # distribute_to_quads); nodal mask zeroes inactive slots
         from ibamr_tpu.fe.fem import distribute_to_quads
-        Fq = distribute_to_quads(self.asm.elems, self.asm.shape,
-                                 self.asm.wdV, self.asm.n_nodes,
+        Fq = distribute_to_quads(self.tasm.elems, self.tasm.shape,
+                                 self.tasm.wdV, self.tasm.n_nodes,
                                  F * mask[:, None], ww_den=self._wwden)
-        xq = quad_positions(self.asm, X)
+        xq = quad_positions(self.tasm, X)
         if self.fast is not None:
             _check_fast_grid(self.fast, grid)
             return self.fast.spread_vel(Fq, xq, b=ctx)
