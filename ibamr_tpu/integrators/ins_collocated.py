@@ -61,6 +61,7 @@ class INSCollocatedIntegrator:
 
     def __init__(self, grid: StaggeredGrid, rho: float = 1.0,
                  mu: float = 0.01, convective_op_type: str = "centered",
+                 wall_axes=None,
                  dtype=jnp.float32):
         if convective_op_type not in ("centered", "upwind", "none"):
             raise ValueError(
@@ -70,6 +71,93 @@ class INSCollocatedIntegrator:
         self.mu = float(mu)
         self.convective_op_type = convective_op_type
         self.dtype = dtype
+        # wall_axes[d]: NO-SLIP walls on both sides of axis d (round 5
+        # — P5 closure: the collocated family beyond periodic-FFT).
+        # Cell-centered unknowns with walls at faces: velocity solves
+        # are Dirichlet-at-face fast-diagonalization transforms,
+        # the projection Poisson is Neumann, and every explicit
+        # stencil sees odd-reflection (velocity) / even-reflection
+        # (pressure, phi) ghosts — the same convention as
+        # solvers.fastdiag.laplacian_1d_cc, so the implicit and
+        # explicit halves of the step share one discrete operator.
+        self.wall_axes = (tuple(bool(w) for w in wall_axes)
+                          if wall_axes is not None
+                          else (False,) * grid.dim)
+        self._vel_solver = None
+        self._phi_solver = None
+        if any(self.wall_axes):
+            from ibamr_tpu.bc import (AxisBC, DomainBC, dirichlet_axis,
+                                      neumann_axis, periodic_axis)
+            from ibamr_tpu.solvers.fastdiag import FastDiagSolver
+
+            vel_bc = DomainBC(axes=tuple(
+                dirichlet_axis() if w else periodic_axis()
+                for w in self.wall_axes))
+            phi_bc = DomainBC(axes=tuple(
+                neumann_axis() if w else periodic_axis()
+                for w in self.wall_axes))
+            self._vel_solver = FastDiagSolver(grid, vel_bc,
+                                              ("cc",) * grid.dim)
+            self._phi_solver = FastDiagSolver(grid, phi_bc,
+                                              ("cc",) * grid.dim)
+
+    # -- wall-aware cell-centered stencils -----------------------------------
+    def _ext(self, c: jnp.ndarray, d: int, sign: float) -> jnp.ndarray:
+        """One ghost layer along axis d by homogeneous reflection. The
+        coefficient comes from bc.ghost_reflect_coeff — the SAME
+        single-sourced convention the ghost fill, the
+        fast-diagonalization matrices, and the multigrid diagonals use
+        — so ``sign`` (-1 velocity Dirichlet, +1 pressure Neumann) is
+        validated against it rather than hardcoded twice."""
+        from ibamr_tpu.bc import (DIRICHLET, NEUMANN, SideBC,
+                                  ghost_reflect_coeff)
+        from ibamr_tpu.ops.stencils import axis_slice
+        kind = DIRICHLET if sign < 0 else NEUMANN
+        r = ghost_reflect_coeff(SideBC(kind), self.grid.dx[d])
+        n = c.shape[d]
+        lo = r * axis_slice(c, d, 0, 1)
+        hi = r * axis_slice(c, d, n - 1, n)
+        return jnp.concatenate([lo, c, hi], axis=d)
+
+    def _d_central(self, c, d, sign):
+        """Central first derivative along d, wall-aware when flagged."""
+        dx = self.grid.dx[d]
+        if not self.wall_axes[d]:
+            return (jnp.roll(c, -1, d) - jnp.roll(c, 1, d)) / (2.0 * dx)
+        from ibamr_tpu.ops.stencils import axis_slice
+        e = self._ext(c, d, sign)
+        n = c.shape[d]
+        return (axis_slice(e, d, 2, n + 2)
+                - axis_slice(e, d, 0, n)) / (2.0 * dx)
+
+    def _d_upwind(self, c, d, a, sign):
+        dx = self.grid.dx[d]
+        if not self.wall_axes[d]:
+            dm = (c - jnp.roll(c, 1, d)) / dx
+            dp = (jnp.roll(c, -1, d) - c) / dx
+        else:
+            from ibamr_tpu.ops.stencils import axis_slice
+            e = self._ext(c, d, sign)
+            n = c.shape[d]
+            dm = (c - axis_slice(e, d, 0, n)) / dx
+            dp = (axis_slice(e, d, 2, n + 2) - c) / dx
+        return jnp.where(a > 0, dm, dp)
+
+    def _lap(self, c, sign):
+        g = self.grid
+        acc = jnp.zeros_like(c)
+        for d in range(g.dim):
+            dx = g.dx[d]
+            if not self.wall_axes[d]:
+                acc = acc + (jnp.roll(c, -1, d) - 2.0 * c
+                             + jnp.roll(c, 1, d)) / dx ** 2
+            else:
+                from ibamr_tpu.ops.stencils import axis_slice
+                e = self._ext(c, d, sign)
+                n = c.shape[d]
+                acc = acc + (axis_slice(e, d, 2, n + 2) - 2.0 * c
+                             + axis_slice(e, d, 0, n)) / dx ** 2
+        return acc
 
     # -- state ----------------------------------------------------------------
     def initialize(self, u0=None,
@@ -106,17 +194,29 @@ class INSCollocatedIntegrator:
     def _approx_project(self, u: Vel) -> Tuple[Vel, jnp.ndarray]:
         """ABS approximate projection: MAC divergence of face-averaged
         velocity drives the Poisson solve; cell-centered central
-        gradient corrects."""
+        gradient corrects. Wall axes: the wall face velocity is zero
+        (pinned slot), the Poisson problem is Neumann with the
+        constant mode projected out, and the correction gradient uses
+        even-reflection ghosts."""
         g = self.grid
         dx = g.dx
-        # face-normal average: component d onto its lower d-face
-        u_face = tuple(0.5 * (u[d] + jnp.roll(u[d], 1, d))
-                       for d in range(g.dim))
-        div = stencils.divergence(u_face, dx)
-        phi = fft.solve_poisson_periodic(div, dx)
-        grad_cc = tuple(
-            (jnp.roll(phi, -1, d) - jnp.roll(phi, 1, d)) / (2.0 * dx[d])
-            for d in range(g.dim))
+        # face-normal average: component d onto its lower d-face; on a
+        # wall axis the wrap slot IS both wall faces and carries 0
+        u_face = []
+        for d in range(g.dim):
+            uf = 0.5 * (u[d] + jnp.roll(u[d], 1, d))
+            if self.wall_axes[d]:
+                from ibamr_tpu.integrators.ins_walls import pin_normal
+                uf = pin_normal(uf, d, self.wall_axes)
+            u_face.append(uf)
+        div = stencils.divergence(tuple(u_face), dx)
+        if self._phi_solver is not None:
+            phi = self._phi_solver.solve(div, alpha=0.0, beta=1.0,
+                                         zero_nullspace=True)
+        else:
+            phi = fft.solve_poisson_periodic(div, dx)
+        grad_cc = tuple(self._d_central(phi, d, +1.0)
+                        for d in range(g.dim))
         return tuple(c - gc for c, gc in zip(u, grad_cc)), phi
 
     # -- one step -------------------------------------------------------------
@@ -127,37 +227,60 @@ class INSCollocatedIntegrator:
         dx = g.dx
         u, p = state.u, state.p
 
+        walls = any(self.wall_axes)
         if self.convective_op_type == "none":
             n_star = tuple(jnp.zeros_like(c) for c in u)
             n_curr = n_star
         else:
-            n_curr = _cc_convective_rate(u, dx, self.convective_op_type)
+            # one loop for both domains: _d_central/_d_upwind dispatch
+            # per axis (periodic roll, or odd no-slip ghosts on wall
+            # axes), so the periodic path reduces exactly to the old
+            # _cc_convective_rate
+            out = []
+            for d in range(g.dim):
+                acc = jnp.zeros_like(u[d])
+                for a in range(g.dim):
+                    if self.convective_op_type == "centered":
+                        dd = self._d_central(u[d], a, -1.0)
+                    else:
+                        dd = self._d_upwind(u[d], a, u[a], -1.0)
+                    acc = acc + u[a] * dd
+                out.append(acc)
+            n_curr = tuple(out)
             c1 = jnp.where(state.k == 0, 1.0, 1.5).astype(self.dtype)
             c2 = jnp.where(state.k == 0, 0.0, -0.5).astype(self.dtype)
             n_star = tuple(c1 * a + c2 * b
                            for a, b in zip(n_curr, state.n_prev))
 
-        grad_p = tuple(
-            (jnp.roll(p, -1, d) - jnp.roll(p, 1, d)) / (2.0 * dx[d])
-            for d in range(g.dim))
+        grad_p = tuple(self._d_central(p, d, +1.0)
+                       for d in range(g.dim))
         rhs = []
         for d in range(g.dim):
-            lap = stencils.laplacian(u[d], dx)
+            lap = (self._lap(u[d], -1.0) if walls
+                   else stencils.laplacian(u[d], dx))
             r = (rho / dt) * u[d] + 0.5 * mu * lap \
                 - rho * n_star[d] - grad_p[d]
             if f is not None:
                 r = r + f[d]
             rhs.append(r)
-        # cell-centered Helmholtz solve per component (periodic FFT)
-        u_star = tuple(
-            fft.solve_helmholtz_periodic(c, dx, alpha=rho / dt,
-                                         beta=-0.5 * mu)
-            for c in rhs)
+        # cell-centered Helmholtz solve per component: periodic FFT,
+        # or the Dirichlet-at-face fastdiag transforms on wall axes
+        if self._vel_solver is not None:
+            u_star = tuple(
+                self._vel_solver.solve(c, alpha=rho / dt,
+                                       beta=-0.5 * mu)
+                for c in rhs)
+        else:
+            u_star = tuple(
+                fft.solve_helmholtz_periodic(c, dx, alpha=rho / dt,
+                                             beta=-0.5 * mu)
+                for c in rhs)
 
         u_new, phi0 = self._approx_project(u_star)
         phi = (rho / dt) * phi0
-        p_new = p + phi - (0.5 * mu * dt / rho) * stencils.laplacian(
-            phi, dx)
+        p_new = p + phi - (0.5 * mu * dt / rho) * (
+            self._lap(phi, +1.0) if walls
+            else stencils.laplacian(phi, dx))
 
         return CollocatedINSState(u=u_new, p=p_new, n_prev=n_curr,
                                   t=state.t + dt, k=state.k + 1)
@@ -169,12 +292,12 @@ class INSCollocatedIntegrator:
 
     def max_divergence(self, state: CollocatedINSState) -> jnp.ndarray:
         """Cell-centered central divergence — O(h^2) small, NOT roundoff
-        (approximate projection)."""
+        (approximate projection). Wall axes use the odd-ghost stencil
+        (no cross-wall wrap in the diagnostic)."""
         g = self.grid
         div = jnp.zeros(g.n, dtype=state.u[0].dtype)
         for d in range(g.dim):
-            div = div + (jnp.roll(state.u[d], -1, d)
-                         - jnp.roll(state.u[d], 1, d)) / (2.0 * g.dx[d])
+            div = div + self._d_central(state.u[d], d, -1.0)
         return jnp.max(jnp.abs(div))
 
 

@@ -202,6 +202,9 @@ SLOW_TESTS = {
     "test_les_two_level_sharded_matches_single",
     "test_cib_walled_sharded_matches_single",
     "test_cross_mesh_restart_flagship_1_to_8_and_back",
+    "test_filament_example_short",
+    "test_oscillating_cylinder_example",
+    "test_filament_length_conservation",
 }
 
 

@@ -159,3 +159,80 @@ def test_stochastic_flux_conserves_scalar():
     dq = forcing.sample(jax.random.PRNGKey(2), dt=1e-3)
     assert abs(float(jnp.sum(dq))) < 1e-8
     assert float(jnp.std(dq)) > 0.0
+
+
+# ---------------------------------------------------------------------------
+# Wall-bounded collocated INS (round 5: P5 closure — the collocated
+# family beyond periodic-FFT)
+# ---------------------------------------------------------------------------
+
+def test_collocated_walled_channel_decay_rate():
+    """No-slip channel decay of the u_x = sin(pi y) mode: the measured
+    rate must match mu * (discrete Dirichlet-cc eigenvalue) — the
+    SAME 1D operator the fast-diagonalization solve transforms with,
+    so the implicit and explicit halves share one discretization.
+    Measured agreement: 1.3e-8 relative (CN time error at this dt)."""
+    import numpy as np
+
+    from ibamr_tpu.bc import dirichlet_axis
+    from ibamr_tpu.integrators.ins_collocated import (
+        INSCollocatedIntegrator, advance_collocated)
+    from ibamr_tpu.solvers.fastdiag import laplacian_1d_cc
+
+    n = 32
+    g = StaggeredGrid(n=(n, n), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    mu = 0.02
+    col = INSCollocatedIntegrator(g, mu=mu, wall_axes=(False, True),
+                                  convective_op_type="none",
+                                  dtype=jnp.float64)
+    y = (np.arange(n) + 0.5) / n
+    u0 = np.broadcast_to(np.sin(np.pi * y)[None, :], (n, n)).copy()
+    st = col.initialize(u0_arrays=(jnp.asarray(u0),
+                                   jnp.zeros((n, n))))
+    dt, steps = 2e-3, 100
+    st = advance_collocated(col, st, dt, steps)
+    rate = -float(jnp.log(jnp.max(st.u[0]) / np.max(u0))) / (dt * steps)
+    lam = np.linalg.eigvalsh(laplacian_1d_cc(n, 1.0 / n,
+                                             dirichlet_axis()))
+    rate_disc = mu * (-lam[-1])
+    assert abs(rate - rate_disc) / rate_disc < 1e-6, (rate, rate_disc)
+
+
+def test_collocated_walled_quiescence_and_convection_stable():
+    """Exact quiescence at rest; a convecting vortex between walls
+    stays finite with O(h^2)-small cell divergence (the approximate
+    projection's documented residual)."""
+    import numpy as np
+
+    from ibamr_tpu.integrators.ins_collocated import (
+        INSCollocatedIntegrator, advance_collocated)
+
+    n = 32
+    g = StaggeredGrid(n=(n, n), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    col = INSCollocatedIntegrator(g, mu=5e-3,
+                                  wall_axes=(True, True),
+                                  convective_op_type="upwind",
+                                  dtype=jnp.float64)
+    st0 = col.initialize()
+    st0 = advance_collocated(col, st0, 1e-3, 5)
+    assert max(float(jnp.max(jnp.abs(c))) for c in st0.u) == 0.0
+
+    c = (np.arange(n) + 0.5) / n
+    X, Y = np.meshgrid(c, c, indexing="ij")
+    sig = 0.12
+    psi_amp = 0.05
+    u0 = psi_amp * -(Y - 0.5) / sig ** 2 * np.exp(
+        -((X - 0.5) ** 2 + (Y - 0.5) ** 2) / (2 * sig ** 2))
+    v0 = psi_amp * (X - 0.5) / sig ** 2 * np.exp(
+        -((X - 0.5) ** 2 + (Y - 0.5) ** 2) / (2 * sig ** 2))
+    st = col.initialize(u0_arrays=(jnp.asarray(u0), jnp.asarray(v0)))
+    st_mid = advance_collocated(col, st, 1e-3, 20)
+    st_end = advance_collocated(col, st_mid, 1e-3, 80)
+    assert bool(jnp.all(jnp.isfinite(st_end.u[0])))
+    # approximate projection: central divergence small, not roundoff
+    assert float(col.max_divergence(st_end)) < 0.05
+    # energy decays monotonically (no-slip walls + viscosity, no
+    # forcing) — compare through the integrator's own functional
+    ke_mid = float(col.kinetic_energy(st_mid))
+    ke_end = float(col.kinetic_energy(st_end))
+    assert ke_end < ke_mid
