@@ -240,7 +240,9 @@ def run_engine_leg(jax, label, engine, n, n_lat, n_lon, args, t_start,
     stall history) and must land on the parent's platform; the rest
     run in-process. Shared by the flagship shootout and the mid-size
     compare so the guard policy cannot drift between them."""
-    if label.startswith("pallas"):
+    if label.startswith(("pallas", "hybrid")):
+        # guarded child: these engines contain Pallas programs (the
+        # relay's remote-compile service stalled on one in round 2)
         budget = max(60.0, min(600.0, args.deadline
                                - (time.perf_counter() - t_start)))
         st = run_pallas_stage_guarded(n, n_lat, n_lon, args.steps,
@@ -508,7 +510,8 @@ def main():
             # Each leg is deadline-guarded; the pallas leg runs in a
             # terminable child (remote-compile stall history).
             for label in ("packed", "packed_bf16", "packed3",
-                          "packed3_bf16", "pallas_packed"):
+                          "packed3_bf16", "pallas_packed",
+                          "hybrid_packed_bf16"):
                 if time.perf_counter() - t_start > args.deadline:
                     errors.append(f"flagship[{label}]: skipped "
                                   "(deadline)")

@@ -150,7 +150,8 @@ def build_shell_example(
         if use_fast_interaction is None:
             _KNOB = ("auto", "scatter", "mxu", "packed", "pallas",
                      "pallas_packed", "mxu_bf16", "packed_bf16",
-                     "packed3", "packed3_bf16")
+                     "packed3", "packed3_bf16", "hybrid_packed",
+                     "hybrid_packed_bf16")
             eng = ib_db.get_string("transfer_engine", "auto").lower()
             if eng not in _KNOB:
                 raise ValueError(
@@ -190,7 +191,8 @@ def build_shell_example(
             and all(v % 8 == 0 for v in n[:-1])
             and all(v >= 8 + support + 1 for v in n[:-1]))
     _ENGINES = (True, False, None, "pallas", "packed", "pallas_packed",
-                "mxu_bf16", "packed_bf16", "packed3", "packed3_bf16")
+                "mxu_bf16", "packed_bf16", "packed3", "packed3_bf16",
+                "hybrid_packed", "hybrid_packed_bf16")
     if use_fast_interaction not in _ENGINES:
         raise ValueError(
             f"unknown use_fast_interaction {use_fast_interaction!r}; "
@@ -237,7 +239,8 @@ def build_shell_example(
                                if use_fast_interaction
                                == "packed3_bf16" else None))
         elif use_fast_interaction in ("packed", "pallas_packed",
-                                      "packed_bf16"):
+                                      "packed_bf16", "hybrid_packed",
+                                      "hybrid_packed_bf16"):
             from ibamr_tpu.ops.interaction_packed import (
                 PackedInteraction, suggest_chunks)
             Q = suggest_chunks(grid, structure.vertices, kernel=kernel,
@@ -248,6 +251,16 @@ def build_shell_example(
                 fast = PallasPackedInteraction(
                     grid, kernel=kernel, tile=8, chunk=128, nchunks=Q,
                     overflow_cap=max(2048, n_markers // 4))
+            elif use_fast_interaction in ("hybrid_packed",
+                                          "hybrid_packed_bf16"):
+                from ibamr_tpu.ops.pallas_interaction import (
+                    HybridPackedInteraction)
+                fast = HybridPackedInteraction(
+                    grid, kernel=kernel, tile=8, chunk=128, nchunks=Q,
+                    overflow_cap=max(2048, n_markers // 4),
+                    compute_dtype=(jnp.bfloat16
+                                   if use_fast_interaction
+                                   == "hybrid_packed_bf16" else None))
             else:
                 fast = PackedInteraction(
                     grid, kernel=kernel, tile=8, chunk=128, nchunks=Q,

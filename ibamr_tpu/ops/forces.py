@@ -107,16 +107,63 @@ def spring_energy(X: jnp.ndarray, s: SpringSpecs) -> jnp.ndarray:
         s.enabled * s.stiffness * (length - s.rest_length) ** 2)
 
 
+_SCATTER_PLAN_CACHE: dict = {}
+
+
+def _scatter_plan(index_arrays, N: int):
+    """Host-side assembly plan: concatenate the (static) per-family
+    scatter indices, argsort them once, return (perm, sorted_ids) as
+    device constants. Spec topology never changes between calls, so
+    the sort runs once per spec set (cached) and the runtime assembly
+    becomes gather + sorted segment_sum — TPU scatter-add with 1e5+
+    duplicate indices serializes (measured 13.1 ms of the flagship
+    step at 256^3; this path removes it). Raises on traced indices;
+    the caller falls back to the scatter-add assembly."""
+    key = tuple(id(a) for a in index_arrays) + (N,)
+    hit = _SCATTER_PLAN_CACHE.get(key)
+    if hit is not None:
+        return hit[0], hit[1]
+    import numpy as np
+    ids = np.concatenate([np.asarray(a).ravel() for a in index_arrays])
+    perm = np.argsort(ids, kind="stable")
+    # cache NUMPY arrays: jnp constants minted inside a jit trace are
+    # tracers, and caching a tracer across traces is a leak
+    plan = (perm.astype(np.int32), ids[perm].astype(np.int32))
+    if len(_SCATTER_PLAN_CACHE) > 64:
+        # backstop bound; dropping entries only costs a re-sort
+        _SCATTER_PLAN_CACHE.clear()
+    # anchor the index arrays via weakrefs whose finalizer evicts the
+    # entry: a discarded model's device buffers are freed rather than
+    # pinned by the cache, and an id() can only be recycled AFTER its
+    # entry is gone — no stale-hit hazard either way. Non-weakref-able
+    # arrays are pinned strongly (same guarantee, costs their memory).
+    import weakref
+
+    def _evict(_ref, _key=key):
+        _SCATTER_PLAN_CACHE.pop(_key, None)
+    try:
+        anchors = tuple(weakref.ref(a, _evict) for a in index_arrays)
+    except TypeError:
+        anchors = index_arrays
+    _SCATTER_PLAN_CACHE[key] = (plan[0], plan[1], anchors)
+    return plan
+
+
 def compute_lagrangian_force(X: jnp.ndarray, U: jnp.ndarray,
                              specs: ForceSpecs,
                              num_markers: Optional[int] = None) -> jnp.ndarray:
     """Assemble F(X, U) over all marker nodes -> (N, dim).
 
     ``num_markers`` must equal X.shape[0] (static); it exists only for
-    clarity at call sites. All accumulations are segment-sum scatters.
+    clarity at call sites. When the spec index arrays are concrete
+    (the usual case: topology is closed over by the jitted step), all
+    family contributions accumulate through ONE gather + sorted
+    ``segment_sum``; traced indices fall back to scatter-adds.
     """
     N = X.shape[0] if num_markers is None else num_markers
-    F = jnp.zeros_like(X)
+
+    idx_arrays = []   # static scatter indices, one per contribution
+    val_arrays = []   # matching (M, dim) contribution vectors
 
     if specs.springs is not None:
         s = specs.springs
@@ -125,23 +172,35 @@ def compute_lagrangian_force(X: jnp.ndarray, U: jnp.ndarray,
         safe = jnp.where(length > 0, length, 1.0)
         tension = s.enabled * s.stiffness * (length - s.rest_length)
         fvec = (tension / safe)[:, None] * d            # force on idx0
-        F = F.at[s.idx0].add(fvec)
-        F = F.at[s.idx1].add(-fvec)
+        idx_arrays += [s.idx0, s.idx1]
+        val_arrays += [fvec, -fvec]
 
     if specs.beams is not None:
         b = specs.beams
         D = (X[b.prev] - 2.0 * X[b.mid] + X[b.nxt]
              - b.rest_curvature)                        # (M, dim)
         cD = (b.enabled * b.rigidity)[:, None] * D
-        F = F.at[b.prev].add(-cD)
-        F = F.at[b.mid].add(2.0 * cD)
-        F = F.at[b.nxt].add(-cD)
+        idx_arrays += [b.prev, b.mid, b.nxt]
+        val_arrays += [-cD, 2.0 * cD, -cD]
 
     if specs.targets is not None:
         tgt = specs.targets
         disp = tgt.X_target - X[tgt.idx]
         fvec = (tgt.enabled * tgt.stiffness)[:, None] * disp \
             - (tgt.enabled * tgt.damping)[:, None] * U[tgt.idx]
-        F = F.at[tgt.idx].add(fvec)
+        idx_arrays += [tgt.idx]
+        val_arrays += [fvec]
 
-    return F
+    if not idx_arrays:
+        return jnp.zeros_like(X)
+
+    try:
+        perm, sorted_ids = _scatter_plan(tuple(idx_arrays), N)
+    except jax.errors.TracerArrayConversionError:
+        F = jnp.zeros_like(X)
+        for idx, val in zip(idx_arrays, val_arrays):
+            F = F.at[idx].add(val)
+        return F
+    vals = jnp.concatenate(val_arrays, axis=0)[perm]
+    return jax.ops.segment_sum(vals, sorted_ids, num_segments=N,
+                               indices_are_sorted=True)

@@ -121,6 +121,32 @@ class Buckets(NamedTuple):
     x0: Tuple[jnp.ndarray, ...]   # per blocked axis: (B,) tile origin cell
 
 
+def compact_overflow(order: jnp.ndarray, keep: jnp.ndarray,
+                     slot_sorted: jnp.ndarray, weights: jnp.ndarray,
+                     N: int, overflow_cap: int):
+    """Shared overflow machinery for every bucketed/packed layout (one
+    definition so the pad-slot conventions the downstream fallbacks
+    rely on cannot diverge between engine families): the per-ORIGINAL-
+    marker slot / overflow-weight write-back (``order`` is a
+    permutation -> unique-indices scatters) and the compact overflow
+    list via sized nonzero (positions come out in the same increasing
+    order a stable argsort produced; pad entries carry weight 0).
+    Returns (slot_of_marker, w_overflow, o_idx, o_w, n_over,
+    exceeded)."""
+    slot_of_marker = jnp.zeros((N,), dtype=jnp.int32).at[order].set(
+        slot_sorted.astype(jnp.int32), unique_indices=True)
+    w_overflow = jnp.zeros((N,), dtype=weights.dtype).at[order].set(
+        jnp.where(keep, 0.0, weights[order]), unique_indices=True)
+    o_pos = jnp.nonzero(~keep, size=overflow_cap, fill_value=N)[0]
+    o_valid = o_pos < N
+    o_pos_c = jnp.minimum(o_pos, N - 1)
+    o_idx = order[o_pos_c].astype(jnp.int32)
+    o_w = jnp.where(o_valid, weights[order[o_pos_c]], 0.0)
+    n_over = N - jnp.sum(keep)
+    return (slot_of_marker, w_overflow, o_idx, o_w, n_over,
+            n_over > overflow_cap)
+
+
 def bucket_markers(geom: BucketGeometry, grid: StaggeredGrid,
                    X: jnp.ndarray,
                    weights: Optional[jnp.ndarray] = None,
@@ -144,35 +170,28 @@ def bucket_markers(geom: BucketGeometry, grid: StaggeredGrid,
 
     order = jnp.argsort(bid)
     bid_s = bid[order]
-    start = jnp.searchsorted(bid_s, jnp.arange(B, dtype=bid_s.dtype))
+    edges = jnp.searchsorted(bid_s,
+                             jnp.arange(B + 1, dtype=bid_s.dtype))
+    start, counts = edges[:-1], jnp.diff(edges).astype(jnp.int32)
     rank = jnp.arange(N, dtype=jnp.int32) - start[bid_s].astype(jnp.int32)
     keep = rank < cap
     slot_sorted = jnp.where(keep, bid_s * cap + rank, B * cap)
 
-    # scatter marker data into the padded pool (extra trailing slot
-    # swallows overflow writes)
-    Xb = jnp.zeros((B * cap + 1, dim), dtype=X.dtype)
-    Xb = Xb.at[slot_sorted].set(X[order])[:-1].reshape(B, cap, dim)
-    wb = jnp.zeros((B * cap + 1,), dtype=weights.dtype)
-    wb = wb.at[slot_sorted].set(
-        jnp.where(keep, weights[order], 0.0))[:-1].reshape(B, cap)
+    # slot -> sorted-marker position as pure GATHERS (TPU scatter over
+    # 1e5 indices serializes; gather of the same layout does not —
+    # bitwise-identical pool to the old scatter construction)
+    slot_b = jnp.arange(B * cap, dtype=jnp.int32) // cap
+    slot_r = jnp.arange(B * cap, dtype=jnp.int32) % cap
+    src = jnp.where(slot_r < counts[slot_b],
+                    start[slot_b].astype(jnp.int32) + slot_r, N)
+    Xb = jnp.take(X[order], src, axis=0, mode="fill",
+                  fill_value=0).reshape(B, cap, dim)
+    wb = jnp.take(weights[order], src, mode="fill",
+                  fill_value=0).reshape(B, cap)
 
-    # slot per ORIGINAL marker index (for interp write-back)
-    slot_of_marker = jnp.zeros((N,), dtype=jnp.int32)
-    slot_of_marker = slot_of_marker.at[order].set(
-        slot_sorted.astype(jnp.int32))
-    w_overflow = jnp.zeros((N,), dtype=weights.dtype)
-    w_overflow = w_overflow.at[order].set(
-        jnp.where(keep, 0.0, weights[order]))
-
-    # compact overflow buffer: scatter cost is driven by INDEX count,
-    # so the fallback must see only the overflow markers, not all N
-    ord2 = jnp.argsort(keep)            # stable: overflow first
-    o_pos = ord2[:overflow_cap]
-    o_idx = order[o_pos].astype(jnp.int32)
-    o_w = jnp.where(keep[o_pos], 0.0, weights[order[o_pos]])
-    n_over = N - jnp.sum(keep)
-    exceeded = n_over > overflow_cap
+    (slot_of_marker, w_overflow, o_idx, o_w, n_over,
+     exceeded) = compact_overflow(order, keep, slot_sorted, weights, N,
+                                  overflow_cap)
 
     # tile origins per blocked axis, broadcast over the flat block index
     x0 = []

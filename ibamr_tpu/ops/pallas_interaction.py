@@ -467,6 +467,57 @@ class PallasPackedInteraction:
                           for d in range(self.grid.dim)], axis=-1)
 
 
+class HybridPackedInteraction:
+    """Pallas-packed SPREAD + XLA packed (bf16-compressible) INTERP
+    over ONE shared PackedBuckets context. Motivated by the round-5
+    on-chip phases table: within the packed engine spread costs 28.8 ms
+    to interp's 13.7 for identical dot work — the spread overlap-add's
+    materialized per-tile partials are the waste, and the Pallas spread
+    program accumulates them in VMEM instead; interp has no such
+    asymmetry, and the XLA interp with bf16-compressed operands is the
+    measured-cheapest interp. This engine composes the best measured
+    direction of each backend. Same exactness contract as both parents
+    (scatter-oracle equality, overflow fallback)."""
+
+    def __init__(self, grid: StaggeredGrid, kernel: Kernel = "IB_4",
+                 tile: int = 8, chunk: int = 128, nchunks: int = 1024,
+                 overflow_cap: Optional[int] = None,
+                 compute_dtype=None, interpret: Optional[bool] = None):
+        from ibamr_tpu.ops.interaction_packed import PackedInteraction
+
+        self._pal = PallasPackedInteraction(
+            grid, kernel=kernel, tile=tile, chunk=chunk,
+            nchunks=nchunks, overflow_cap=overflow_cap,
+            interpret=interpret)
+        self._xla = PackedInteraction(
+            grid, kernel=kernel, tile=tile, chunk=chunk,
+            nchunks=nchunks, overflow_cap=overflow_cap,
+            compute_dtype=compute_dtype)
+        self.grid = grid
+        self.kernel: Kernel = kernel
+        self.geom = self._xla.geom
+        self.nchunks = int(nchunks)
+        self.overflow_cap = overflow_cap
+
+    def buckets(self, X: jnp.ndarray,
+                weights: Optional[jnp.ndarray] = None):
+        return self._xla.buckets(X, weights)
+
+    def spread_vel(self, F: jnp.ndarray, X: jnp.ndarray,
+                   weights: Optional[jnp.ndarray] = None,
+                   b=None) -> tuple:
+        if b is None:
+            b = self.buckets(X, weights=weights)
+        return self._pal.spread_vel(F, X, weights=weights, b=b)
+
+    def interpolate_vel(self, u, X: jnp.ndarray,
+                        weights: Optional[jnp.ndarray] = None,
+                        b=None) -> jnp.ndarray:
+        if b is None:
+            b = self.buckets(X, weights=weights)
+        return self._xla.interpolate_vel(u, X, weights=weights, b=b)
+
+
 class PallasInteraction:
     """Drop-in FastInteraction-shaped engine with BOTH transfers as
     Pallas tile kernels (3D only): spread via :class:`PallasSpread3D`'s

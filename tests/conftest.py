@@ -205,6 +205,7 @@ SLOW_TESTS = {
     "test_filament_example_short",
     "test_oscillating_cylinder_example",
     "test_filament_length_conservation",
+    "test_dam_break_example_short",
 }
 
 
