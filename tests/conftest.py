@@ -206,6 +206,8 @@ SLOW_TESTS = {
     "test_oscillating_cylinder_example",
     "test_filament_length_conservation",
     "test_dam_break_example_short",
+    "test_eel_example_swims_against_wave",
+    "test_ibfe_beam_example_bends_downstream",
 }
 
 
