@@ -13,9 +13,16 @@ from __future__ import annotations
 
 import argparse
 import math
+import os
+import sys
 import time
 
 import numpy as np
+
+# importable regardless of caller cwd (the relay watcher invokes this
+# as a script; python puts tools/ on sys.path, not the repo root)
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 
 def timeit(fn, reps):
@@ -166,8 +173,10 @@ def main():
 
     # pallas-packed: same chunk layout, Pallas tile programs
     t_ppspread3 = t_ppinterp3 = None
+    t_hyspread3 = t_hyinterp3 = None
     if not args.no_pallas:
-        from ibamr_tpu.ops.pallas_interaction import PallasPackedInteraction
+        from ibamr_tpu.ops.pallas_interaction import (
+            HybridPackedInteraction, PallasPackedInteraction)
 
         ppeng = PallasPackedInteraction(grid, tile=args.tile, chunk=128,
                                         nchunks=Q,
@@ -177,6 +186,16 @@ def main():
             lambda: ppeng.spread_vel(F, X, b=ppb)), r)
         t_ppinterp3 = timeit(jax.jit(
             lambda: ppeng.interpolate_vel(u, X, b=ppb)), r)
+
+        # hybrid: pallas spread + XLA bf16 interp on the SAME context
+        hyeng = HybridPackedInteraction(grid, tile=args.tile, chunk=128,
+                                        nchunks=Q,
+                                        overflow_cap=max(2048, N // 4),
+                                        compute_dtype=jnp.bfloat16)
+        t_hyspread3 = timeit(jax.jit(
+            lambda: hyeng.spread_vel(F, X, b=ppb)), r)
+        t_hyinterp3 = timeit(jax.jit(
+            lambda: hyeng.interpolate_vel(u, X, b=ppb)), r)
 
     gb = (A.nbytes + Wlast.nbytes + T.nbytes) / 1e9
     print(f"bucket_build      {t_bucket:8.2f} ms")
@@ -206,6 +225,9 @@ def main():
     if t_ppspread3 is not None:
         print(f"pallas-pk sprd 3c {t_ppspread3:8.2f} ms")
         print(f"pallas-pk intp 3c {t_ppinterp3:8.2f} ms")
+    if t_hyspread3 is not None:
+        print(f"hybrid sprd 3ch   {t_hyspread3:8.2f} ms")
+        print(f"hybrid intp 3ch   {t_hyinterp3:8.2f} ms")
 
 
 if __name__ == "__main__":
