@@ -208,6 +208,7 @@ SLOW_TESTS = {
     "test_dam_break_example_short",
     "test_eel_example_swims_against_wave",
     "test_ibfe_beam_example_bends_downstream",
+    "test_dam_break_restart_continuation",
 }
 
 
