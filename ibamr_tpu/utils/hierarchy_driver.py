@@ -119,12 +119,25 @@ class HierarchyDriver:
         # one compiled chunk per distinct length (a handful at most:
         # cadence-aligned lengths repeat) — no masked-tail waste
         self._chunks = {}
+        # traces observed per chunk length: the retrace observable the
+        # no-retrace contract is tested against. jit's _cache_size()
+        # cannot serve here — the process-global pjit LRU can evict a
+        # live entry in a long session, reading as 0 even though no
+        # retrace happened (and a later call would silently recompile)
+        self.trace_counts = {}
 
     def _chunk(self, n: int):
         if n not in self._chunks:
             base_step = self._base_step
+            # local alias: the closure must not capture self, or the
+            # global pjit cache would pin the whole driver (integrator,
+            # history, callbacks) for the cache entry's lifetime
+            counts = self.trace_counts
 
             def chunk(state, dt):
+                # runs at TRACE time only: counts compilations, not calls
+                counts[n] = counts.get(n, 0) + 1
+
                 def body(s, _):
                     return base_step(s, dt), None
 

@@ -65,6 +65,22 @@ def mesh2x4():
     return Mesh(devs, axis_names=("x", "y"))
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _bound_xla_state_per_module():
+    """Clear jax's compilation caches after every test module.
+
+    The monolithic full-gate run (650+ tests, one process) accumulates
+    hundreds of compiled CPU executables; at ~45% of the round-5 suite
+    XLA's CPU compiler segfaulted inside backend_compile_and_load —
+    reproducibly, while every file passes in isolation (the split-gate
+    receipt). Dropping the executables between modules bounds the
+    in-process compiler/runtime state the monolithic run carries; each
+    module re-compiles only its own shapes, so the wall-clock cost is
+    minor."""
+    yield
+    jax.clear_caches()
+
+
 # ---------------------------------------------------------------------------
 # Fast/slow test tiers (VERDICT round 2, item 8): the full suite is the
 # pre-commit gate (~60 min on the virtual 8-device CPU mesh); the

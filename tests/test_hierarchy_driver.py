@@ -81,7 +81,7 @@ def test_divergence_halts_with_diagnostic():
 
 def test_cfl_dt_recompute_no_retrace():
     """dt is traced: changing it between chunks must not retrigger
-    compilation (counted via jit cache stats)."""
+    compilation (counted via the driver's trace counter)."""
     integ = _ins()
     st = _tg_state(integ)
     cfg = RunConfig(dt=2e-3, num_steps=40, health_interval=10, cfl=0.3)
@@ -89,4 +89,9 @@ def test_cfl_dt_recompute_no_retrace():
     out = drv.run(st)
     assert bool(jnp.all(jnp.isfinite(out.u[0])))
     assert len(drv._chunks) == 1                  # one chunk length
-    assert drv._chunks[10]._cache_size() == 1     # dt traced: no retrace
+    # dt traced: no retrace. Counted by the driver's trace counter, not
+    # jit._cache_size() — the process-global pjit LRU can evict a live
+    # entry in a long test session (observed in the round-5 full gate:
+    # _cache_size() == 0 after ~280 in-process tests) and the count
+    # must survive that.
+    assert drv.trace_counts[10] == 1
