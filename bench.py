@@ -262,9 +262,11 @@ def run_engine_leg(jax, label, engine, n, n_lat, n_lon, args, t_start,
 
 
 def phase_breakdown(jax, integ, state, dt: float, iters: int = 10) -> dict:
-    """Per-phase ms/step on the current device: bucket prep, interp,
+    """Per-phase ms/step on the current device: bucket prep (+ the
+    half-step slot-preserving refresh when the engine has one), interp,
     force, spread, fluid solve — the TimerManager-style table SURVEY §6
-    asks for. Each phase is jitted standalone; the sum differs from the
+    asks for. ``bucket_prep_per_step`` records how many full preps the
+    midpoint step actually pays (1 with refresh, 2 without). Each phase is jitted standalone; the sum differs from the
     fused step (XLA fuses across phases there), so the table names the
     dominant phase rather than reconstructing the exact step time."""
     import time as _t
@@ -288,6 +290,17 @@ def phase_breakdown(jax, integ, state, dt: float, iters: int = 10) -> dict:
     if getattr(ib, "fast", None) is not None:
         ctx = timeit("bucket_prep",
                      jax.jit(lambda X: ib.prepare(X, mask)), state.X)
+        refresh = getattr(ib, "refresh", None)
+        refreshes = (refresh is not None
+                     and refresh(ctx, state.X, mask)[0] is not None)
+        if refreshes:
+            # slot-preserving half-step refresh: with it the midpoint
+            # step pays bucket_prep ONCE per step (plus this cheaper
+            # re-gather); without it, twice
+            timeit("bucket_refresh",
+                   jax.jit(lambda c, X: refresh(c, X, mask)[0]),
+                   ctx, state.X)
+        out["bucket_prep_per_step"] = 1 if refreshes else 2
     U = timeit("interp",
                jax.jit(lambda u, X, c: ib.interpolate_velocity(
                    u, grid, X, mask, ctx=c)),
@@ -302,8 +315,9 @@ def phase_breakdown(jax, integ, state, dt: float, iters: int = 10) -> dict:
     timeit("fluid_solve",
            jax.jit(lambda s, f: integ.ins.step(s, dt, f=f)),
            state.ins, f)
-    out["dominant"] = max((k for k in out if k != "dominant"),
-                          key=lambda k: out[k])
+    out["dominant"] = max(
+        (k for k in out if k not in ("dominant", "bucket_prep_per_step")),
+        key=lambda k: out[k])
     return out
 
 
@@ -328,8 +342,11 @@ def run_stage(jax, n: int, n_lat: int, n_lon: int, steps: int,
 
     # donate the state: the step rewrites every field, so reusing the
     # input buffers saves one full state allocation per step (~0.5 GB
-    # of HBM traffic at 256^3)
-    step = jax.jit(lambda s, dt: integ.step(s, dt), donate_argnums=0)
+    # of HBM traffic at 256^3). step_with_stats rides the refresh_hit
+    # flag out beside the state (None when the engine has no
+    # slot-preserving half-step refresh).
+    step = jax.jit(lambda s, dt: integ.step_with_stats(s, dt),
+                   donate_argnums=0)
 
     def hard_sync(s):
         # block_until_ready proved unreliable over the axon relay after
@@ -342,30 +359,40 @@ def run_stage(jax, n: int, n_lat: int, n_lon: int, steps: int,
         nonlocal state
         t_c0 = time.perf_counter()
         for _ in range(max(warmup, 1)):
-            state = step(state, dt)
+            state, _ = step(state, dt)
         hard_sync(state)
         compile_s = time.perf_counter() - t_c0
 
+        # accumulate refresh hits as a device scalar (no per-step sync;
+        # a host round-trip per step would poison the timing)
+        hit_acc = None
         t0 = time.perf_counter()
         for _ in range(steps):
-            state = step(state, dt)
+            state, st_stats = step(state, dt)
+            rh = st_stats.get("refresh_hit")
+            if rh is not None:
+                rh = rh.astype(jax.numpy.int32)
+                hit_acc = rh if hit_acc is None else hit_acc + rh
         hard_sync(state)
-        return compile_s, time.perf_counter() - t0
+        elapsed = time.perf_counter() - t0
+        if hit_acc is not None:
+            hit_acc = int(jax.device_get(hit_acc))
+        return compile_s, elapsed, hit_acc
 
-    compile_s, elapsed = timed_run()
+    compile_s, elapsed, refresh_hits = timed_run()
     # plausibility floor: one 256^3 step streams >1 GB of HBM; anything
     # under 1 ms/step at n>=128 is a relay timing artifact -> remeasure
     if n >= 128 and (elapsed / steps) * 1e3 < 1.0:
         log(f"[bench] n={n}: implausible {elapsed / steps * 1e3:.3f} "
             "ms/step; remeasuring once")
-        _, elapsed = timed_run()
+        _, elapsed, refresh_hits = timed_run()
 
     import numpy as np
     if not bool(np.isfinite(np.asarray(jax.device_get(state.X))).all()):
         raise FloatingPointError(f"non-finite marker state at n={n}")
 
     n_markers = int(state.X.shape[0])
-    return {
+    out = {
         "n": n,
         "markers": n_markers,
         "steps_per_sec": round(steps / elapsed, 4),
@@ -374,6 +401,12 @@ def run_stage(jax, n: int, n_lat: int, n_lon: int, steps: int,
         "fast_path": {True: "mxu", False: "scatter",
                       None: "auto"}.get(use_fast, use_fast),
     }
+    if refresh_hits is not None:
+        # slot-preserving half-step refresh bookkeeping: hits took the
+        # cheap re-gather, falls paid a full re-pack (drift bound blown)
+        out["refresh_hits"] = refresh_hits
+        out["repack_falls"] = steps - refresh_hits
+    return out
 
 
 def main():
@@ -519,7 +552,7 @@ def main():
             # terminable child (remote-compile stall history).
             for label in ("packed", "packed_bf16", "packed3",
                           "packed3_bf16", "pallas_packed",
-                          "hybrid_packed_bf16"):
+                          "hybrid_bf16"):
                 if time.perf_counter() - t_start > args.deadline:
                     errors.append(f"flagship[{label}]: skipped "
                                   "(deadline)")
@@ -554,19 +587,22 @@ def main():
                     n_lat = max(16, int(round(args.n_lat * frac)))
                     n_lon = max(16, int(round(args.n_lon * frac)))
                     cmp = {}
-                    # five-way transfer-engine compare: scatter /
-                    # MXU-bucketed / occupancy-packed / Pallas tile
-                    # kernel / Pallas-packed (VERDICT round 2 item 5 +
-                    # round 3 packed engines). A Pallas compile stall
-                    # (the relay's remote-compile service choked on it
-                    # in round 2) only loses that engine's entry.
+                    # transfer-engine compare: scatter / MXU-bucketed /
+                    # occupancy-packed / Pallas tile kernel /
+                    # Pallas-packed / hybrid pallas-spread + bf16-interp
+                    # (VERDICT round 2 item 5 + round 3 packed engines).
+                    # A Pallas compile stall (the relay's remote-compile
+                    # service choked on it in round 2) only loses that
+                    # engine's entry.
                     for label, fast in (("mxu", True),
                                         ("scatter", False),
                                         ("packed", "packed"),
                                         ("packed3", "packed3"),
                                         ("pallas", "pallas"),
                                         ("pallas_packed",
-                                         "pallas_packed")):
+                                         "pallas_packed"),
+                                        ("hybrid_bf16",
+                                         "hybrid_bf16")):
                         if time.perf_counter() - t_start > args.deadline:
                             errors.append(f"compare[{label}]: skipped "
                                           "(deadline)")

@@ -147,12 +147,12 @@ def build_shell_example(
         kernel = ib_db.get_string("delta_fcn", kernel)
         # reference-style engine knob: IBMethod { transfer_engine =
         # "auto"|"scatter"|"mxu"|"packed"|"pallas"|"pallas_packed"|
-        # "mxu_bf16"|"packed_bf16" }
+        # "mxu_bf16"|"packed_bf16"|...|"hybrid_bf16" }
         if use_fast_interaction is None:
             _KNOB = ("auto", "scatter", "mxu", "packed", "pallas",
                      "pallas_packed", "mxu_bf16", "packed_bf16",
                      "packed3", "packed3_bf16", "hybrid_packed",
-                     "hybrid_packed_bf16")
+                     "hybrid_packed_bf16", "hybrid_bf16")
             eng = ib_db.get_string("transfer_engine", "auto").lower()
             if eng not in _KNOB:
                 raise ValueError(
@@ -199,7 +199,7 @@ def build_shell_example(
         use_fast_interaction = "packed" if eligible else False
     _ENGINES = (True, False, None, "pallas", "packed", "pallas_packed",
                 "mxu_bf16", "packed_bf16", "packed3", "packed3_bf16",
-                "hybrid_packed", "hybrid_packed_bf16")
+                "hybrid_packed", "hybrid_packed_bf16", "hybrid_bf16")
     if use_fast_interaction not in _ENGINES:
         raise ValueError(
             f"unknown use_fast_interaction {use_fast_interaction!r}; "
@@ -249,7 +249,8 @@ def build_shell_example(
                                == "packed3_bf16" else None))
         elif use_fast_interaction in ("packed", "pallas_packed",
                                       "packed_bf16", "hybrid_packed",
-                                      "hybrid_packed_bf16"):
+                                      "hybrid_packed_bf16",
+                                      "hybrid_bf16"):
             from ibamr_tpu.ops.interaction_packed import (
                 PackedInteraction, suggest_chunks)
             Q = suggest_chunks(grid, structure.vertices, kernel=kernel,
@@ -261,7 +262,11 @@ def build_shell_example(
                     grid, kernel=kernel, tile=8, chunk=128, nchunks=Q,
                     overflow_cap=max(2048, n_markers // 4))
             elif use_fast_interaction in ("hybrid_packed",
-                                          "hybrid_packed_bf16"):
+                                          "hybrid_packed_bf16",
+                                          "hybrid_bf16"):
+                # "hybrid_bf16" is the canonical name of the
+                # pallas-spread + XLA-bf16-interp composition
+                # ("hybrid_packed_bf16" kept as an alias)
                 from ibamr_tpu.ops.pallas_interaction import (
                     HybridPackedInteraction)
                 fast = HybridPackedInteraction(
@@ -269,7 +274,8 @@ def build_shell_example(
                     overflow_cap=max(2048, n_markers // 4),
                     compute_dtype=(jnp.bfloat16
                                    if use_fast_interaction
-                                   == "hybrid_packed_bf16" else None))
+                                   in ("hybrid_packed_bf16",
+                                       "hybrid_bf16") else None))
             else:
                 fast = PackedInteraction(
                     grid, kernel=kernel, tile=8, chunk=128, nchunks=Q,

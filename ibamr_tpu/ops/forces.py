@@ -112,23 +112,36 @@ _SCATTER_PLAN_CACHE: dict = {}
 
 def _scatter_plan(index_arrays, N: int):
     """Host-side assembly plan: concatenate the (static) per-family
-    scatter indices, argsort them once, return (perm, sorted_ids) as
-    device constants. Spec topology never changes between calls, so
-    the sort runs once per spec set (cached) and the runtime assembly
-    becomes gather + sorted segment_sum — TPU scatter-add with 1e5+
-    duplicate indices serializes (measured 13.1 ms of the flagship
-    step at 256^3; this path removes it). Raises on traced indices;
-    the caller falls back to the scatter-add assembly."""
+    scatter indices, argsort them once, and build the static (N, K)
+    GATHER table (row i = positions in the concatenated value list
+    contributing to marker i, padded with the out-of-range sentinel
+    M). Spec topology never changes between calls, so the sort runs
+    once per spec set (cached) and the runtime assembly becomes pure
+    gathers — TPU scatter-add with 1e5+ duplicate indices serializes
+    (measured 13.1 ms of the flagship step at 256^3), and even the
+    sorted ``segment_sum`` still lowers to an HLO scatter; the gather
+    table removes the scatter entirely for bounded-degree topologies
+    (the caller keeps the sorted segment_sum for hub topologies where
+    K blows the table up). Returns (perm, sorted_ids, gather). Raises
+    on traced indices; the caller falls back to scatter-add assembly."""
     key = tuple(id(a) for a in index_arrays) + (N,)
     hit = _SCATTER_PLAN_CACHE.get(key)
     if hit is not None:
-        return hit[0], hit[1]
+        return hit[0], hit[1], hit[2]
     import numpy as np
     ids = np.concatenate([np.asarray(a).ravel() for a in index_arrays])
-    perm = np.argsort(ids, kind="stable")
+    M = ids.shape[0]
+    perm = np.argsort(ids, kind="stable").astype(np.int64)
+    sorted_ids = ids[perm]
+    counts = np.bincount(ids, minlength=N)
+    K = int(counts.max()) if M else 0
+    starts = np.concatenate([[0], np.cumsum(counts)])
+    gather = np.full((N, max(K, 1)), M, dtype=np.int32)
+    rank = np.arange(M, dtype=np.int64) - starts[sorted_ids]
+    gather[sorted_ids, rank] = perm
     # cache NUMPY arrays: jnp constants minted inside a jit trace are
     # tracers, and caching a tracer across traces is a leak
-    plan = (perm.astype(np.int32), ids[perm].astype(np.int32))
+    plan = (perm.astype(np.int32), sorted_ids.astype(np.int32), gather)
     if len(_SCATTER_PLAN_CACHE) > 64:
         # backstop bound; dropping entries only costs a re-sort
         _SCATTER_PLAN_CACHE.clear()
@@ -145,7 +158,7 @@ def _scatter_plan(index_arrays, N: int):
         anchors = tuple(weakref.ref(a, _evict) for a in index_arrays)
     except TypeError:
         anchors = index_arrays
-    _SCATTER_PLAN_CACHE[key] = (plan[0], plan[1], anchors)
+    _SCATTER_PLAN_CACHE[key] = (plan[0], plan[1], plan[2], anchors)
     return plan
 
 
@@ -157,7 +170,10 @@ def compute_lagrangian_force(X: jnp.ndarray, U: jnp.ndarray,
     ``num_markers`` must equal X.shape[0] (static); it exists only for
     clarity at call sites. When the spec index arrays are concrete
     (the usual case: topology is closed over by the jitted step), all
-    family contributions accumulate through ONE gather + sorted
+    family contributions accumulate through a static (N, K) gather
+    table + axis sum — ZERO scatter ops in the compiled HLO (pinned
+    by tests/test_forces_hlo.py). Hub topologies whose max degree K
+    would blow the table up (N*K > 4*(M+N)) keep the sorted
     ``segment_sum``; traced indices fall back to scatter-adds.
     """
     N = X.shape[0] if num_markers is None else num_markers
@@ -195,12 +211,20 @@ def compute_lagrangian_force(X: jnp.ndarray, U: jnp.ndarray,
         return jnp.zeros_like(X)
 
     try:
-        perm, sorted_ids = _scatter_plan(tuple(idx_arrays), N)
+        perm, sorted_ids, gather = _scatter_plan(tuple(idx_arrays), N)
     except jax.errors.TracerArrayConversionError:
         F = jnp.zeros_like(X)
         for idx, val in zip(idx_arrays, val_arrays):
             F = F.at[idx].add(val)
         return F
-    vals = jnp.concatenate(val_arrays, axis=0)[perm]
-    return jax.ops.segment_sum(vals, sorted_ids, num_segments=N,
+    vals = jnp.concatenate(val_arrays, axis=0)
+    M, K = vals.shape[0], gather.shape[1]
+    if N * K <= 4 * (M + N):
+        # bounded-degree topology (every real structure: springs/beams
+        # touch each node a handful of times): gather rows + axis sum,
+        # no scatter anywhere in the lowering
+        contrib = jnp.take(vals, jnp.asarray(gather.reshape(-1)),
+                           axis=0, mode="fill", fill_value=0)
+        return jnp.sum(contrib.reshape(N, K, vals.shape[1]), axis=1)
+    return jax.ops.segment_sum(vals[perm], sorted_ids, num_segments=N,
                                indices_are_sorted=True)

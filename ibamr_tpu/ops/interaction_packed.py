@@ -183,6 +183,78 @@ def pack_markers(geom: BucketGeometry, grid: StaggeredGrid,
                          x0=tuple(x0), tile_of_chunk=tid)
 
 
+def refresh_packed(geom: BucketGeometry, grid: StaggeredGrid,
+                   b: PackedBuckets, X: jnp.ndarray,
+                   weights: Optional[jnp.ndarray] = None
+                   ) -> Tuple[PackedBuckets, jnp.ndarray]:
+    """Slot-preserving half-step refresh: re-gather the NEW positions
+    ``X`` into the existing pack-time chunk layout of ``b`` instead of
+    re-running the full sort/bucket/pack.
+
+    Exactness: a chunk's footprint covers cells ``[x0-1, x0+tile+s-1]``
+    (``_blocked_axis_weights`` starts one cell below the tile origin).
+    On a staggered grid axis ``d`` sees TWO stencil origins per marker
+    — the cell-centered one (offset 0.5; components != d) and the
+    face-centered one (offset 0.0; component d, up to one cell higher)
+    — and the transfer stays EXACT for any drifted position whose new
+    origins BOTH satisfy ``mod(j0 - (x0-1), n) <= tile+1`` on every
+    blocked axis (then every stencil cell of every component still
+    lands in the footprint, and the mod-centered distances evaluate
+    the same periodic weights the scatter oracle uses). In continuous
+    terms that gives every marker at least half a cell of forward
+    slack and a full cell backward, so CFL-bounded substep drift
+    always passes. Overflow markers stay exact regardless: the
+    compact-scatter fallbacks evaluate at call-time ``X``.
+
+    The drift bound is checked jittably; when ANY live packed marker
+    violates it the whole layout falls back to a full re-pack under
+    ``lax.cond`` (identical static shapes), so the result is exact
+    either way. Returns ``(buckets, hit)`` with ``hit`` True when the
+    cheap re-gather was sufficient."""
+    N, dim = X.shape
+    if weights is None:
+        weights = jnp.ones((N,), dtype=X.dtype)
+    # both lax.cond branches must carry identical pytrees: the re-pack
+    # branch derives its weight fields from ``weights``, the refresh
+    # branch keeps ``b``'s
+    weights = jnp.asarray(weights, dtype=b.wb.dtype)
+    Q, c = b.Xb.shape[0], b.Xb.shape[1]
+    ocap = b.o_idx.shape[0]
+    s = geom.support
+    slot = b.slot_of_marker
+    chunk_of_marker = jnp.minimum(slot // c, Q - 1)
+
+    # drift-bound check per blocked axis, against the ASSIGNED chunk's
+    # pack-time tile origin (overflow/inactive markers are exempt:
+    # their transfers never read the packed layout)
+    ok = jnp.ones((N,), dtype=bool)
+    for d in range(dim - 1):
+        x0 = b.x0[d][chunk_of_marker]
+        for off in (0.5, 0.0):      # cell- and face-centered origins
+            xi = (X[:, d] - grid.x_lo[d]) / grid.dx[d] - off
+            j0 = jnp.floor(xi - 0.5 * s).astype(jnp.int32) + 1
+            r = jnp.mod(j0 - (x0 - 1), grid.n[d])
+            ok &= r <= geom.tile[d] + 1
+    ok |= (slot >= Q * c) | (weights == 0)
+    hit = jnp.all(ok)
+
+    # slot -> marker inverse (one N-sized scatter; duplicates only at
+    # the discarded overflow sentinel Q*c), then one gather of the new
+    # positions into the pack-time slots. Everything else in the
+    # layout (weights, overflow lists, chunk->tile map) is
+    # position-independent and carries over.
+    inv = jnp.full((Q * c + 1,), N, dtype=jnp.int32).at[slot].set(
+        jnp.arange(N, dtype=jnp.int32))
+    Xb = jnp.take(X, inv[:-1], axis=0, mode="fill",
+                  fill_value=0).reshape(Q, c, dim)
+
+    return jax.lax.cond(
+        hit,
+        lambda: b._replace(Xb=Xb),
+        lambda: pack_markers(geom, grid, X, weights, nchunks=Q,
+                             overflow_cap=ocap)), hit
+
+
 def spread_packed(geom: BucketGeometry, grid: StaggeredGrid,
                   b: PackedBuckets, F: jnp.ndarray, X: jnp.ndarray,
                   centering, kernel: Kernel,
@@ -248,6 +320,14 @@ class PackedInteraction:
         return pack_markers(self.geom, self.grid, X, weights,
                             nchunks=self.nchunks,
                             overflow_cap=self.overflow_cap)
+
+    def refresh(self, b: PackedBuckets, X: jnp.ndarray,
+                weights: Optional[jnp.ndarray] = None
+                ) -> Tuple[PackedBuckets, jnp.ndarray]:
+        """Slot-preserving re-gather of new positions into ``b``'s
+        chunk layout (full re-pack fallback under the drift bound);
+        returns ``(buckets, hit)`` — see :func:`refresh_packed`."""
+        return refresh_packed(self.geom, self.grid, b, X, weights)
 
     def interpolate_vel(self, u: Vel, X: jnp.ndarray,
                         weights: Optional[jnp.ndarray] = None,

@@ -402,6 +402,16 @@ class PallasPackedInteraction:
                             nchunks=self.nchunks,
                             overflow_cap=self.overflow_cap)
 
+    def refresh(self, b, X: jnp.ndarray,
+                weights: Optional[jnp.ndarray] = None):
+        """Slot-preserving half-step refresh (pallas twin): the chunk
+        layout is the shared interaction_packed one, so the re-gather
+        and drift-bound fallback are identical — the Pallas programs
+        only ever see the resulting PackedBuckets."""
+        from ibamr_tpu.ops.interaction_packed import refresh_packed
+
+        return refresh_packed(self.geom, self.grid, b, X, weights)
+
     def _visited_mask(self, b) -> jnp.ndarray:
         import numpy as np
 
@@ -502,6 +512,12 @@ class HybridPackedInteraction:
     def buckets(self, X: jnp.ndarray,
                 weights: Optional[jnp.ndarray] = None):
         return self._xla.buckets(X, weights)
+
+    def refresh(self, b, X: jnp.ndarray,
+                weights: Optional[jnp.ndarray] = None):
+        """Both backends read the ONE shared PackedBuckets, so one
+        slot-preserving refresh serves spread and interp alike."""
+        return self._xla.refresh(b, X, weights)
 
     def spread_vel(self, F: jnp.ndarray, X: jnp.ndarray,
                    weights: Optional[jnp.ndarray] = None,

@@ -109,3 +109,27 @@ def test_packed_pallas_overflow_fallback():
     U_ref = interaction.interpolate_vel(u, g, X, kernel="IB_4")
     np.testing.assert_allclose(np.asarray(U_pl), np.asarray(U_ref),
                                atol=2e-6 * float(jnp.max(jnp.abs(U_ref))))
+
+
+def test_packed_pallas_refresh_drifted_context():
+    # slot-preserving half-step refresh: the Pallas programs only ever
+    # see the resulting PackedBuckets, so a refreshed context must be
+    # as exact through the kernel as a freshly packed one — both under
+    # drift (re-gather) and past the bound (full re-pack fallback)
+    rng = np.random.default_rng(4)
+    g = StaggeredGrid(n=(16, 16, 16), x_lo=(0.0,) * 3, x_up=(1.0,) * 3)
+    X = jnp.asarray(rng.uniform(0, 1, (150, 3)), dtype=jnp.float32)
+    F = jnp.asarray(rng.standard_normal((150, 3)), dtype=jnp.float32)
+    eng = _engine(g, X, chunk=32)
+    b = eng.buckets(X)
+    dx = float(g.dx[0])
+    for drift, want_hit in ((-0.4 * dx, True), (2.5 * dx, False)):
+        Xd = X + jnp.float32(drift)
+        b2, hit = eng.refresh(b, Xd)
+        assert bool(hit) == want_hit, drift
+        f_pl = eng.spread_vel(F, Xd, b=b2)
+        f_ref = interaction.spread_vel(F, g, Xd, kernel="IB_4")
+        for a, c in zip(f_ref, f_pl):
+            scale = float(jnp.max(jnp.abs(a)))
+            np.testing.assert_allclose(np.asarray(c), np.asarray(a),
+                                       atol=2e-6 * scale)

@@ -1,0 +1,2 @@
+# makes tools/ importable (tests import the HLO op census from
+# tools.hlo_cost_audit); the scripts themselves stay runnable directly.

@@ -26,7 +26,10 @@ signal, not absolute byte counts; `bytes accessed` is XLA's HLO-level
 estimate (each buffer counted once per producing/consuming op), not an
 HBM-transaction trace. The pallas engines cannot be audited this way
 (interpret-mode lowering on CPU carries no real cost model) — their
-evidence remains the on-chip shootout.
+evidence remains the on-chip shootout. The hybrid_bf16 engine is
+audited PARTIALLY for the same reason: its interp / bucket-prep /
+refresh legs are plain XLA and appear here; its spread leg is the
+pallas kernel and does not.
 """
 
 from __future__ import annotations
@@ -35,11 +38,33 @@ import argparse
 import json
 import multiprocessing as mp
 import os
+import re
 import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+
+
+def hlo_op_counts(text: str) -> dict:
+    """Opcode census of an optimized-HLO dump (``compiled.as_text()``).
+
+    Quoted metadata (op_name/source strings) can contain anything,
+    including op-like tokens — strip quoted spans per line BEFORE
+    matching, then take the first ``opcode(`` token on the RHS of each
+    ``=`` assignment. Backend-independent: the census runs on whatever
+    module the caller compiled. tests/test_forces_hlo.py uses it to pin
+    the zero-scatter force-assembly guarantee.
+    """
+    counts: dict = {}
+    for line in text.splitlines():
+        if "=" not in line:
+            continue
+        rhs = re.sub(r'"[^"]*"', '""', line.split("=", 1)[1])
+        m = re.search(r"\b([a-z][a-z0-9_.-]*)\s*\(", rhs)
+        if m:
+            counts[m.group(1)] = counts.get(m.group(1), 0) + 1
+    return counts
 
 
 def _leg_child(q, n, n_lat, n_lon, engine, piece):
@@ -91,6 +116,17 @@ def _leg_child(q, n, n_lat, n_lon, engine, piece):
                 return
             lowered = jax.jit(lambda Xa, m: ib.prepare(Xa, m)).lower(
                 X, mask)
+        elif piece == "refresh":
+            # slot-preserving half-step refresh: the re-gather the
+            # midpoint step pays INSTEAD of a second bucket_prep
+            if ib.fast is None \
+                    or getattr(ib.fast, "refresh", None) is None:
+                q.put({"skipped": "engine has no refresh path"})
+                return
+            ctx0 = jax.jit(lambda Xa, m: ib.prepare(Xa, m))(X, mask)
+            lowered = jax.jit(
+                lambda c, Xa, m: ib.refresh(c, Xa, m)[0]).lower(
+                    ctx0, X, mask)
         elif piece == "transfers_fused":
             # spread + 2x interp sharing ONE bucket prep — the step's
             # actual per-position transfer block, so op-boundary
@@ -151,6 +187,10 @@ def _leg_child(q, n, n_lat, n_lon, engine, piece):
                 cj = jax.make_jaxpr(
                     lambda st, ff: integ.ins.step(st, dt, f=ff))(
                         state.ins, f)
+            elif piece == "refresh":
+                cj = jax.make_jaxpr(
+                    lambda c, Xa, m: ib.refresh(c, Xa, m)[0])(
+                        ctx0, X, mask)
             else:
                 cj = jax.make_jaxpr(
                     lambda Xa, m: ib.prepare(Xa, m))(X, mask)
@@ -160,7 +200,18 @@ def _leg_child(q, n, n_lat, n_lon, engine, piece):
 
         compiled = lowered.compile()
         ca = compiled.cost_analysis() or {}
+        if isinstance(ca, (list, tuple)):
+            # older jax returns one properties dict per partition
+            ca = ca[0] if ca else {}
         ma = compiled.memory_analysis()
+        try:
+            # scatter census: the round-5 tax the force-assembly gather
+            # table and refresh path exist to eliminate
+            ops = hlo_op_counts(compiled.as_text())
+            scatter_ops = sum(v for k, v in ops.items()
+                              if k.startswith("scatter"))
+        except Exception:
+            scatter_ops = None
         out = {
             "n": n,
             "markers": int(X.shape[0]),
@@ -172,6 +223,8 @@ def _leg_child(q, n, n_lat, n_lon, engine, piece):
             "compile_s": round(time.perf_counter() - t0, 1),
             **census,
         }
+        if scatter_ops is not None:
+            out["scatter_ops"] = scatter_ops
         if ma is not None:
             out.update({
                 "arg_bytes": int(ma.argument_size_in_bytes),
@@ -213,6 +266,9 @@ ENGINES = {
     # overlap-add (ops.interaction_packed3)
     "packed3": "packed3",
     "packed3_bf16": "packed3_bf16",
+    # round 6: pallas-spread + bf16-interp hybrid (XLA legs only — the
+    # pallas spread has no CPU cost model; see module docstring)
+    "hybrid_bf16": "hybrid_bf16",
 }
 
 
@@ -242,15 +298,20 @@ def main() -> int:
         [(args.n, args.n_lat, args.n_lon)]
     for n, nla, nlo in sizes:
         for label, eng in ENGINES.items():
-            pieces = ["spread", "interp"]
-            if eng is not False:
-                pieces.append("bucket_prep")
+            if label.startswith("hybrid"):
+                # only the XLA legs: spread is the pallas kernel
+                pieces = ["interp", "bucket_prep", "refresh"]
+            else:
+                pieces = ["spread", "interp"]
+                if eng is not False:
+                    pieces.append("bucket_prep")
             if label in ("packed", "mxu", "packed3"):
                 pieces.append("transfers_fused")
             if label in ("packed", "packed3"):
                 pieces.append("step")
             if label == "packed":
                 pieces.append("fluid")
+                pieces.append("refresh")
             for piece in pieces:
                 legs.append((n, nla, nlo, label, eng, piece))
 

@@ -124,6 +124,16 @@ def main():
     print(f"packed: Q={Q} slots={Q * 128} util={N / (Q * 128):.3f} "
           f"overflow={int(jnp.sum(pb.w_overflow > 0))}")
     t_pbucket = timeit(jax.jit(lambda: peng.buckets(X)), r)
+
+    # slot-preserving refresh vs full re-pack: a half-step-sized drift
+    # (well under the footprint slack) re-gathers into the pack-time
+    # layout — the integrator pays THIS instead of a second bucket_prep
+    dxm = float(min(grid.dx))
+    Xh = X + jnp.asarray([[0.3 * dxm, -0.2 * dxm, 0.15 * dxm]],
+                         dtype=X.dtype)
+    refresh_hit = bool(jax.jit(lambda: peng.refresh(pb, Xh)[1])())
+    t_refresh = timeit(jax.jit(lambda: peng.refresh(pb, Xh)[0]), r)
+
     t_pspread3 = timeit(jax.jit(lambda: peng.spread_vel(F, X, b=pb)), r)
     t_pinterp3 = timeit(jax.jit(
         lambda: peng.interpolate_vel(u, X, b=pb)), r)
@@ -211,6 +221,9 @@ def main():
     est = 3 * (t_weights + t_einsum + t_overlap)
     print(f"sum est 3ch sprd  {est:8.2f} ms")
     print(f"packed bucket     {t_pbucket:8.2f} ms")
+    print(f"packed refresh    {t_refresh:8.2f} ms   "
+          f"(vs full re-pack {t_pbucket:.2f} ms, "
+          f"hit={refresh_hit})")
     print(f"packed spread 3ch {t_pspread3:8.2f} ms")
     print(f"packed interp 3ch {t_pinterp3:8.2f} ms")
     print(f"mxu-bf16 sprd 3ch {t_bspread3:8.2f} ms")
@@ -226,8 +239,9 @@ def main():
         print(f"pallas-pk sprd 3c {t_ppspread3:8.2f} ms")
         print(f"pallas-pk intp 3c {t_ppinterp3:8.2f} ms")
     if t_hyspread3 is not None:
-        print(f"hybrid sprd 3ch   {t_hyspread3:8.2f} ms")
-        print(f"hybrid intp 3ch   {t_hyinterp3:8.2f} ms")
+        # the hybrid_bf16 registry engine: pallas spread + bf16 interp
+        print(f"hybrid_bf16 sprd  {t_hyspread3:8.2f} ms")
+        print(f"hybrid_bf16 intp  {t_hyinterp3:8.2f} ms")
 
 
 if __name__ == "__main__":
