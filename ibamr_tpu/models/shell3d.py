@@ -92,6 +92,170 @@ def shell_volume(X: np.ndarray, center: Tuple[float, float, float]):
     return (4.0 / 3.0) * math.pi * jnp.mean(r ** 3)
 
 
+def construct_transfer_engine(name, grid: StaggeredGrid, vertices,
+                              kernel: str):
+    """Registry builder: construct the named transfer engine against
+    ``grid`` for a structure with marker positions ``vertices``.
+    ``name`` uses the ``use_fast_interaction`` vocabulary (True/False/
+    str); "scatter" returns None (the IBMethod scatter/gather path).
+    Raises on unsatisfiable geometry (e.g. packed3 with no valid z
+    tile) — :func:`build_engine_with_fallback` turns such failures
+    into degradation instead of death."""
+    import jax.numpy as jnp
+
+    from ibamr_tpu.ops.interaction_packed import normalize_engine_name
+
+    name = normalize_engine_name(name)
+    if name == "scatter":
+        return None
+    n_markers = vertices.shape[0]
+
+    def bounded_cap():
+        # pole-clustered tiles overflow into the compact scatter
+        # path; keep the dense capacity bounded so padding FLOPs
+        # stay sane. Only the bucketed (mxu/pallas) layouts use a
+        # per-tile cap — the packed layouts size chunks instead.
+        from ibamr_tpu.ops.interaction_fast import suggest_cap
+        return min(suggest_cap(grid, vertices, kernel=kernel, tile=8,
+                               slack=1.2),
+                   1024)
+
+    if name == "pallas":
+        from ibamr_tpu.ops.pallas_interaction import PallasInteraction
+        return PallasInteraction(
+            grid, kernel=kernel, tile=8, cap=bounded_cap(),
+            overflow_cap=max(2048, n_markers // 4))
+    if name in ("packed3", "packed3_bf16"):
+        from ibamr_tpu.ops.interaction_packed3 import (
+            PackedInteraction3, suggest_chunks3)
+        # z-tile: the largest of (16, 8) that divides the z extent
+        # AND leaves room for the footprint (extent >= tz+s+1, s=4
+        # for IB_4 — make_geometry3's own constraints)
+        from ibamr_tpu.ops.delta import get_kernel as _gk
+        _s = _gk(kernel)[0]
+        n = grid.n
+        tz = next((t for t in (16, 8)
+                   if n[-1] % t == 0 and n[-1] >= t + _s + 1
+                   and t >= _s + 1), None)
+        if tz is None:
+            raise ValueError(
+                f"packed3 engine: no valid z tile for n_z = "
+                f"{n[-1]} with kernel {kernel!r} (need n_z "
+                f"divisible by 8 or 16 with n_z >= tile+"
+                f"{_s + 1}); use the 'packed' engine instead")
+        Q3 = suggest_chunks3(grid, vertices, kernel=kernel, tile=8,
+                             tile_last=tz, chunk=64, slack=1.3)
+        return PackedInteraction3(
+            grid, kernel=kernel, tile=8, tile_last=tz, chunk=64,
+            nchunks=Q3,
+            overflow_cap=max(2048, n_markers // 4),
+            compute_dtype=(jnp.bfloat16 if name == "packed3_bf16"
+                           else None))
+    if name in ("packed", "pallas_packed", "packed_bf16",
+                "hybrid_packed", "hybrid_packed_bf16", "hybrid_bf16"):
+        from ibamr_tpu.ops.interaction_packed import (
+            PackedInteraction, suggest_chunks)
+        Q = suggest_chunks(grid, vertices, kernel=kernel, tile=8,
+                           chunk=128, slack=1.3)
+        if name == "pallas_packed":
+            from ibamr_tpu.ops.pallas_interaction import (
+                PallasPackedInteraction)
+            return PallasPackedInteraction(
+                grid, kernel=kernel, tile=8, chunk=128, nchunks=Q,
+                overflow_cap=max(2048, n_markers // 4))
+        if name in ("hybrid_packed", "hybrid_packed_bf16",
+                    "hybrid_bf16"):
+            # "hybrid_bf16" is the canonical name of the
+            # pallas-spread + XLA-bf16-interp composition
+            # ("hybrid_packed_bf16" kept as an alias)
+            from ibamr_tpu.ops.pallas_interaction import (
+                HybridPackedInteraction)
+            return HybridPackedInteraction(
+                grid, kernel=kernel, tile=8, chunk=128, nchunks=Q,
+                overflow_cap=max(2048, n_markers // 4),
+                compute_dtype=(jnp.bfloat16
+                               if name in ("hybrid_packed_bf16",
+                                           "hybrid_bf16") else None))
+        return PackedInteraction(
+            grid, kernel=kernel, tile=8, chunk=128, nchunks=Q,
+            overflow_cap=max(2048, n_markers // 4),
+            compute_dtype=(jnp.bfloat16 if name == "packed_bf16"
+                           else None))
+    if name in ("mxu", "mxu_bf16"):
+        from ibamr_tpu.ops.interaction_fast import FastInteraction
+        return FastInteraction(
+            grid, kernel=kernel, tile=8, cap=bounded_cap(),
+            overflow_cap=max(2048, n_markers // 4),
+            compute_dtype=(jnp.bfloat16 if name == "mxu_bf16"
+                           else None))
+    raise ValueError(f"unknown transfer engine {name!r}")
+
+
+def probe_transfer_engine(fast, vertices) -> None:
+    """Trace AND compile (without executing) a bucket + spread +
+    interp composition at the real marker shapes — the cheap stand-in
+    for 'does this engine's first step survive': trace-time failures
+    (a monkeypatched or buggy engine method) and XLA/Mosaic compile
+    failures (the round-2 Pallas remote-compile stall) both surface
+    here, at build time, where degradation is still possible."""
+    if fast is None:
+        return
+    import jax
+    import jax.numpy as jnp
+
+    X = jnp.asarray(vertices)
+    F = jnp.zeros_like(X)
+
+    def fn(F, X):
+        b = fast.buckets(X)
+        g = fast.spread_vel(F, X, b=b)
+        return fast.interpolate_vel(g, X, b=b)
+
+    jax.jit(fn).lower(F, X).compile()
+
+
+# engines worth a build-time compile probe: the Pallas-backed family,
+# whose compile path (Mosaic lowering, this container's remote-compile
+# relay) has actually failed in the field (round 2). The plain-XLA
+# engines skip the probe — construction errors still degrade, and
+# probing them would tax every build for a failure mode never observed.
+_PROBED_ENGINES = frozenset(
+    {"pallas", "pallas_packed", "hybrid_packed", "hybrid_packed_bf16",
+     "hybrid_bf16"})
+
+
+def build_engine_with_fallback(name, grid: StaggeredGrid, vertices,
+                               kernel: str, probe="auto"):
+    """Construct ``name``'s transfer engine, degrading down the
+    registry fallback chain (ops.interaction_packed.ENGINE_FALLBACKS)
+    when construction or compile fails: each failure logs a warning
+    naming the failed engine and its replacement, and the run
+    continues on the next engine instead of dying. ``probe`` is True /
+    False / "auto" (probe only the Pallas-backed engines). The
+    terminal "scatter" link cannot fail (engine None). Returns
+    ``(engine_or_None, engine_name)``."""
+    import warnings
+
+    from ibamr_tpu.ops.interaction_packed import fallback_chain
+
+    chain = fallback_chain(name)
+    for i, eng_name in enumerate(chain):
+        try:
+            fast = construct_transfer_engine(eng_name, grid, vertices,
+                                             kernel)
+            if probe is True or (probe == "auto"
+                                 and eng_name in _PROBED_ENGINES):
+                probe_transfer_engine(fast, vertices)
+            return fast, eng_name
+        except Exception as e:
+            nxt = chain[i + 1]
+            warnings.warn(
+                f"transfer engine {eng_name!r} failed to "
+                f"build/compile ({type(e).__name__}: {e}); degrading "
+                f"to {nxt!r}", RuntimeWarning)
+    raise AssertionError("unreachable: scatter link cannot fail")
+
+
 def build_shell_example(
         n_cells: int = 64,
         n_lat: int = 32,
@@ -107,7 +271,9 @@ def build_shell_example(
         convective_op_type: str = "centered",
         use_fast_interaction: Optional[bool] = None,
         dtype=None,
-        input_db=None) -> Tuple[IBExplicitIntegrator, IBState]:
+        input_db=None,
+        engine_fallback: bool = True) -> Tuple[IBExplicitIntegrator,
+                                               IBState]:
     """Assemble the ex4-equivalent simulation (3D periodic unit box).
 
     ``use_fast_interaction``: True = bucketed-MXU spread/interp engine
@@ -124,6 +290,11 @@ def build_shell_example(
     marker count is large enough to matter (promoted from bucketed-MXU
     after the round-5 on-chip shootout: packed measured 2.6x mxu at
     256^3, roundoff-exact), scatter otherwise.
+
+    ``engine_fallback`` (default True; knob ``IBMethod {
+    engine_fallback = FALSE }``): when the chosen engine fails to
+    build or compile, degrade down the registry fallback chain
+    (docs/RESILIENCE.md) with a warning instead of raising.
     """
     import jax.numpy as jnp
 
@@ -161,6 +332,10 @@ def build_shell_example(
             use_fast_interaction = {
                 "auto": None, "scatter": False, "mxu": True,
             }.get(eng, eng)
+        # IBMethod { engine_fallback = FALSE } pins the named engine:
+        # a build/compile failure raises instead of degrading
+        engine_fallback = ib_db.get_bool("engine_fallback",
+                                         engine_fallback)
         sh = input_db.get_database_with_default("Shell")
         n_lat = sh.get_int("n_lat", n_lat)
         n_lon = sh.get_int("n_lon", n_lon)
@@ -204,93 +379,12 @@ def build_shell_example(
         raise ValueError(
             f"unknown use_fast_interaction {use_fast_interaction!r}; "
             f"one of {_ENGINES}")
-    fast = None
-    if use_fast_interaction:
-        def bounded_cap():
-            # pole-clustered tiles overflow into the compact scatter
-            # path; keep the dense capacity bounded so padding FLOPs
-            # stay sane. Only the bucketed (mxu/pallas) layouts use a
-            # per-tile cap — the packed layouts size chunks instead.
-            from ibamr_tpu.ops.interaction_fast import suggest_cap
-            return min(suggest_cap(grid, structure.vertices,
-                                   kernel=kernel, tile=8, slack=1.2),
-                       1024)
-        if use_fast_interaction == "pallas":
-            from ibamr_tpu.ops.pallas_interaction import PallasInteraction
-            fast = PallasInteraction(
-                grid, kernel=kernel, tile=8, cap=bounded_cap(),
-                overflow_cap=max(2048, n_markers // 4))
-        elif use_fast_interaction in ("packed3", "packed3_bf16"):
-            from ibamr_tpu.ops.interaction_packed3 import (
-                PackedInteraction3, suggest_chunks3)
-            # z-tile: the largest of (16, 8) that divides the z extent
-            # AND leaves room for the footprint (extent >= tz+s+1, s=4
-            # for IB_4 — make_geometry3's own constraints)
-            from ibamr_tpu.ops.delta import get_kernel as _gk
-            _s = _gk(kernel)[0]
-            tz = next((t for t in (16, 8)
-                       if n[-1] % t == 0 and n[-1] >= t + _s + 1
-                       and t >= _s + 1), None)
-            if tz is None:
-                raise ValueError(
-                    f"packed3 engine: no valid z tile for n_z = "
-                    f"{n[-1]} with kernel {kernel!r} (need n_z "
-                    f"divisible by 8 or 16 with n_z >= tile+"
-                    f"{_s + 1}); use the 'packed' engine instead")
-            Q3 = suggest_chunks3(grid, structure.vertices,
-                                 kernel=kernel, tile=8, tile_last=tz,
-                                 chunk=64, slack=1.3)
-            fast = PackedInteraction3(
-                grid, kernel=kernel, tile=8, tile_last=tz, chunk=64,
-                nchunks=Q3,
-                overflow_cap=max(2048, n_markers // 4),
-                compute_dtype=(jnp.bfloat16
-                               if use_fast_interaction
-                               == "packed3_bf16" else None))
-        elif use_fast_interaction in ("packed", "pallas_packed",
-                                      "packed_bf16", "hybrid_packed",
-                                      "hybrid_packed_bf16",
-                                      "hybrid_bf16"):
-            from ibamr_tpu.ops.interaction_packed import (
-                PackedInteraction, suggest_chunks)
-            Q = suggest_chunks(grid, structure.vertices, kernel=kernel,
-                               tile=8, chunk=128, slack=1.3)
-            if use_fast_interaction == "pallas_packed":
-                from ibamr_tpu.ops.pallas_interaction import (
-                    PallasPackedInteraction)
-                fast = PallasPackedInteraction(
-                    grid, kernel=kernel, tile=8, chunk=128, nchunks=Q,
-                    overflow_cap=max(2048, n_markers // 4))
-            elif use_fast_interaction in ("hybrid_packed",
-                                          "hybrid_packed_bf16",
-                                          "hybrid_bf16"):
-                # "hybrid_bf16" is the canonical name of the
-                # pallas-spread + XLA-bf16-interp composition
-                # ("hybrid_packed_bf16" kept as an alias)
-                from ibamr_tpu.ops.pallas_interaction import (
-                    HybridPackedInteraction)
-                fast = HybridPackedInteraction(
-                    grid, kernel=kernel, tile=8, chunk=128, nchunks=Q,
-                    overflow_cap=max(2048, n_markers // 4),
-                    compute_dtype=(jnp.bfloat16
-                                   if use_fast_interaction
-                                   in ("hybrid_packed_bf16",
-                                       "hybrid_bf16") else None))
-            else:
-                fast = PackedInteraction(
-                    grid, kernel=kernel, tile=8, chunk=128, nchunks=Q,
-                    overflow_cap=max(2048, n_markers // 4),
-                    compute_dtype=(jnp.bfloat16
-                                   if use_fast_interaction
-                                   == "packed_bf16" else None))
-        else:
-            from ibamr_tpu.ops.interaction_fast import FastInteraction
-            fast = FastInteraction(
-                grid, kernel=kernel, tile=8, cap=bounded_cap(),
-                overflow_cap=max(2048, n_markers // 4),
-                compute_dtype=(jnp.bfloat16
-                               if use_fast_interaction == "mxu_bf16"
-                               else None))
+    if engine_fallback:
+        fast, _eng = build_engine_with_fallback(
+            use_fast_interaction, grid, structure.vertices, kernel)
+    else:
+        fast = construct_transfer_engine(
+            use_fast_interaction, grid, structure.vertices, kernel)
     ib = IBMethod(structure.force_specs(dtype=dtype), kernel=kernel,
                   fast=fast)
     integ = IBExplicitIntegrator(ins, ib, scheme="midpoint")

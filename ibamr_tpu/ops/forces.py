@@ -107,7 +107,16 @@ def spring_energy(X: jnp.ndarray, s: SpringSpecs) -> jnp.ndarray:
         s.enabled * s.stiffness * (length - s.rest_length) ** 2)
 
 
-_SCATTER_PLAN_CACHE: dict = {}
+import collections
+import threading
+
+# insertion/access-ordered for single-entry LRU eviction; the lock
+# keeps concurrent traces (multi-threaded jit) from interleaving
+# get/insert. RLock: the weakref eviction finalizer below can fire
+# during a GC triggered INSIDE the locked region.
+_SCATTER_PLAN_CACHE: "collections.OrderedDict" = collections.OrderedDict()
+_SCATTER_PLAN_LOCK = threading.RLock()
+_SCATTER_PLAN_MAX = 64
 
 
 def _scatter_plan(index_arrays, N: int):
@@ -125,9 +134,11 @@ def _scatter_plan(index_arrays, N: int):
     K blows the table up). Returns (perm, sorted_ids, gather). Raises
     on traced indices; the caller falls back to scatter-add assembly."""
     key = tuple(id(a) for a in index_arrays) + (N,)
-    hit = _SCATTER_PLAN_CACHE.get(key)
-    if hit is not None:
-        return hit[0], hit[1], hit[2]
+    with _SCATTER_PLAN_LOCK:
+        hit = _SCATTER_PLAN_CACHE.get(key)
+        if hit is not None:
+            _SCATTER_PLAN_CACHE.move_to_end(key)    # LRU freshness
+            return hit[0], hit[1], hit[2]
     import numpy as np
     ids = np.concatenate([np.asarray(a).ravel() for a in index_arrays])
     M = ids.shape[0]
@@ -142,9 +153,6 @@ def _scatter_plan(index_arrays, N: int):
     # cache NUMPY arrays: jnp constants minted inside a jit trace are
     # tracers, and caching a tracer across traces is a leak
     plan = (perm.astype(np.int32), sorted_ids.astype(np.int32), gather)
-    if len(_SCATTER_PLAN_CACHE) > 64:
-        # backstop bound; dropping entries only costs a re-sort
-        _SCATTER_PLAN_CACHE.clear()
     # anchor the index arrays via weakrefs whose finalizer evicts the
     # entry: a discarded model's device buffers are freed rather than
     # pinned by the cache, and an id() can only be recycled AFTER its
@@ -153,12 +161,18 @@ def _scatter_plan(index_arrays, N: int):
     import weakref
 
     def _evict(_ref, _key=key):
-        _SCATTER_PLAN_CACHE.pop(_key, None)
+        with _SCATTER_PLAN_LOCK:
+            _SCATTER_PLAN_CACHE.pop(_key, None)
     try:
         anchors = tuple(weakref.ref(a, _evict) for a in index_arrays)
     except TypeError:
         anchors = index_arrays
-    _SCATTER_PLAN_CACHE[key] = (plan[0], plan[1], plan[2], anchors)
+    with _SCATTER_PLAN_LOCK:
+        while len(_SCATTER_PLAN_CACHE) >= _SCATTER_PLAN_MAX:
+            # single-entry LRU eviction: the bound holds without
+            # discarding every hot plan (cost of a miss is one re-sort)
+            _SCATTER_PLAN_CACHE.popitem(last=False)
+        _SCATTER_PLAN_CACHE[key] = (plan[0], plan[1], plan[2], anchors)
     return plan
 
 

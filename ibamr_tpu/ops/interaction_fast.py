@@ -48,6 +48,41 @@ from ibamr_tpu.ops.interaction import _centering_offsets
 
 Vel = Tuple[jnp.ndarray, ...]
 
+# Debug-mode enforcement of the compact_overflow pad convention
+# (ADVICE r5 item 4): pad slots of the compact overflow list carry the
+# REAL marker index order[N-1] with weight 0, so correctness requires
+# every consumer to weight contributions by ``o_w`` — a 0 weight makes
+# the pad entry inert unless the aliased marker's value is non-finite
+# (0 * inf = nan) or a future engine family forgets the weighting.
+# With the flag on (env IBAMR_TPU_DEBUG_OVERFLOW=1, or set
+# ``debug_overflow_pad(True)``), both consumers re-derive their compact
+# contribution with pad entries hard-masked and assert bitwise
+# agreement at runtime via jax.debug.callback.
+import os as _os
+
+_DEBUG_OVERFLOW_PAD = bool(int(_os.environ.get(
+    "IBAMR_TPU_DEBUG_OVERFLOW", "0")))
+
+
+def debug_overflow_pad(enabled: bool) -> bool:
+    """Toggle the pad-inertness debug check; returns the previous
+    value. Takes effect at TRACE time — flip it before jitting."""
+    global _DEBUG_OVERFLOW_PAD
+    prev, _DEBUG_OVERFLOW_PAD = _DEBUG_OVERFLOW_PAD, bool(enabled)
+    return prev
+
+
+def _check_pad_inert(tag: str, with_pads: jnp.ndarray,
+                     pads_masked: jnp.ndarray) -> None:
+    def _host_check(a, b):
+        if not np.array_equal(np.asarray(a), np.asarray(b)):
+            raise FloatingPointError(
+                f"compact_overflow pad convention violated in {tag}: "
+                f"o_w == 0 pad entries contributed to the result "
+                f"(non-finite aliased marker value, or a consumer "
+                f"not weighting by o_w)")
+    jax.debug.callback(_host_check, with_pads, pads_masked)
+
 
 class BucketGeometry(NamedTuple):
     """Static bucketing configuration (python ints -> one compilation)."""
@@ -347,9 +382,19 @@ def spread_overflow_fallbacks(out: jnp.ndarray, b: Buckets,
     compact scatter for the buffered overflow, exact full-scatter when
     the buffer itself overflowed (shared by both bucketed engines)."""
     def compact(o):
-        return interaction.spread(F[b.o_idx], grid, X[b.o_idx],
-                                  centering=centering, kernel=kernel,
-                                  weights=b.o_w, out=o)
+        # pad slots rely on o_w == 0 making them inert (the index
+        # aliases a real marker — compact_overflow's convention)
+        res = interaction.spread(F[b.o_idx], grid, X[b.o_idx],
+                                 centering=centering, kernel=kernel,
+                                 weights=b.o_w, out=o)
+        if _DEBUG_OVERFLOW_PAD:
+            live = b.o_w != 0
+            masked = interaction.spread(
+                jnp.where(live, F[b.o_idx], 0.0), grid, X[b.o_idx],
+                centering=centering, kernel=kernel, weights=b.o_w,
+                out=o)
+            _check_pad_inert("spread_overflow_fallbacks", res, masked)
+        return res
 
     def full(o):
         return interaction.spread(F, grid, X, centering=centering,
@@ -414,9 +459,16 @@ def unbucket_with_overflow(Ub: jnp.ndarray, b: Buckets, f: jnp.ndarray,
     U = jnp.where(b.slot_of_marker < Ub.size, U, 0.0)
 
     def compact(U):
+        # pad slots rely on o_w == 0 making them inert (the index
+        # aliases a real marker — compact_overflow's convention)
         Uo = interaction.interpolate(f, grid, X[b.o_idx],
                                      centering=centering, kernel=kernel,
                                      weights=b.o_w)
+        if _DEBUG_OVERFLOW_PAD:
+            _check_pad_inert(
+                "unbucket_with_overflow",
+                jnp.where(b.o_w != 0, 0.0, Uo),
+                jnp.zeros_like(Uo))
         return U.at[b.o_idx].add(Uo)
 
     def full(U):

@@ -349,3 +349,50 @@ class PackedInteraction:
                                    d, self.kernel,
                                    compute_dtype=self.compute_dtype)
                      for d in range(self.grid.dim))
+
+
+# -- engine registry: graceful degradation chain -----------------------------
+#
+# Registry-level fallback order for every named transfer engine. When an
+# engine's construction, compile, or probe execution fails (a Pallas
+# remote-compile stall, a Mosaic lowering regression, a geometry
+# constraint on an unusual grid), the run degrades one link down this
+# chain — trading measured speed for availability — instead of dying.
+# Every chain terminates at "scatter" (the always-correct XLA
+# scatter/gather oracle, engine object None). Consumed by
+# models.shell3d.build_engine_with_fallback; pinned by
+# tests/test_resilience.py with monkeypatched failures.
+
+ENGINE_FALLBACKS = {
+    "pallas_packed": "packed",
+    "hybrid_bf16": "packed_bf16",
+    "hybrid_packed_bf16": "packed_bf16",   # alias of hybrid_bf16
+    "packed_bf16": "packed",
+    "packed3_bf16": "packed3",
+    "packed3": "packed",
+    "packed": "scatter",
+    "pallas": "mxu",
+    "mxu_bf16": "mxu",
+    "mxu": "scatter",
+}
+
+
+def normalize_engine_name(name) -> str:
+    """Map the ``use_fast_interaction`` vocabulary (True/False/str) to
+    a canonical registry name."""
+    if name is True:
+        return "mxu"
+    if name is False or name is None or name == "scatter":
+        return "scatter"
+    return str(name).lower()
+
+
+def fallback_chain(name):
+    """The degradation order starting AT ``name`` (inclusive), ending
+    at "scatter". Raises KeyError for unknown engine names."""
+    cur = normalize_engine_name(name)
+    chain = [cur]
+    while cur != "scatter":
+        cur = ENGINE_FALLBACKS[cur]
+        chain.append(cur)
+    return chain

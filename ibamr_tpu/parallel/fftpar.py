@@ -29,6 +29,11 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+try:                        # jax >= 0.6 promotes shard_map to the top level
+    from jax import shard_map
+except ImportError:         # 0.4/0.5: experimental namespace only
+    from jax.experimental.shard_map import shard_map
+
 from ibamr_tpu.grid import StaggeredGrid
 from ibamr_tpu.parallel.mesh import grid_pspec
 
@@ -175,7 +180,7 @@ class PencilFFT:
                         *scalars) -> jnp.ndarray:
         kernel = self._make_kernel(op, rhs.dtype)
         scalars = tuple(jnp.asarray(s, dtype=rhs.dtype) for s in scalars)
-        fn = jax.shard_map(
+        fn = shard_map(
             kernel, mesh=self.mesh,
             in_specs=(self.spec,) + tuple(P() for _ in scalars),
             out_specs=self.spec)

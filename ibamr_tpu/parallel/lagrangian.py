@@ -46,6 +46,11 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:                        # jax >= 0.6 promotes shard_map to the top level
+    from jax import shard_map
+except ImportError:         # 0.4/0.5: experimental namespace only
+    from jax.experimental.shard_map import shard_map
+
 from ibamr_tpu.grid import StaggeredGrid
 from ibamr_tpu.ops import interaction
 from ibamr_tpu.ops.delta import Kernel, get_kernel
@@ -289,7 +294,7 @@ class ShardedInteraction:
                 buf = self._halo_add(buf, d)
             return buf
 
-        out = jax.shard_map(
+        out = shard_map(
             kernel, mesh=self.mesh,
             in_specs=(self.row_spec2, self.row_spec, self.row_spec),
             out_specs=self.grid_spec)(b.Xb, Fb, b.wb)
@@ -328,7 +333,7 @@ class ShardedInteraction:
             vals = jnp.take(fl.reshape(-1), lin, axis=0)
             return jnp.sum(vals * wgt, axis=-1) * wl
 
-        Ub = jax.shard_map(
+        Ub = shard_map(
             kernel, mesh=self.mesh,
             in_specs=(self.grid_spec, self.row_spec2, self.row_spec),
             out_specs=self.row_spec)(f, b.Xb, b.wb)

@@ -10,6 +10,20 @@ rank-count support).
 
 Format: one ``.npz`` per checkpoint holding every leaf keyed by its pytree
 path, plus a small JSON sidecar for metadata. No pickle anywhere.
+
+Crash safety (the RestartManager durability contract, SURVEY.md §5.4):
+every file lands via write-to-temp + ``fsync`` + ``os.replace``, so a
+kill at ANY instant leaves either the previous complete checkpoint or
+the new complete one — never a truncated ``restore.*.npz`` that
+``latest_step`` would select and ``restore_checkpoint`` crash on. The
+sidecar is written AFTER the array file and carries an integrity record
+(per-leaf CRC32 plus a whole-file digest); a checkpoint is *verified*
+iff its sidecar parses and the digests match. ``latest_step`` /
+``restore_checkpoint`` skip unverified checkpoints and fall back to the
+newest verified one, and ``_prune`` never deletes the last verified
+checkpoint — so no sequence of crashes loses more than one checkpoint
+interval (pinned by tests/test_resilience.py, including a SIGKILL-mid-
+write subprocess drill).
 """
 
 from __future__ import annotations
@@ -17,6 +31,7 @@ from __future__ import annotations
 import json
 import os
 import re
+import zlib
 from typing import Any, Dict, Optional
 
 import jax
@@ -46,6 +61,11 @@ def _path_str(path) -> str:
 
 
 SCHEMA_VERSION = 1
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint failed integrity verification (truncated file,
+    flipped bytes, or a tampered/missing sidecar)."""
 
 
 def state_schema(state: Any) -> Dict[str, Any]:
@@ -103,19 +123,109 @@ def _gather_arrays(state: Any) -> Dict[str, np.ndarray]:
             for path, leaf in leaves}
 
 
+def _leaf_crc(arr: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
+
+
+def _file_crc(path: str, chunk: int = 1 << 20) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            buf = f.read(chunk)
+            if not buf:
+                break
+            crc = zlib.crc32(buf, crc)
+    return crc & 0xFFFFFFFF
+
+
+def _fsync_dir(directory: str) -> None:
+    # durability of the os.replace itself (a crash after replace but
+    # before the directory entry hits disk could resurrect the old name)
+    try:
+        fd = os.open(directory, os.O_RDONLY)
+    except OSError:
+        return                      # e.g. non-POSIX fs; best effort
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _atomic_write(path: str, write_fn) -> None:
+    """Write via temp name + fsync + os.replace: the file at ``path``
+    is always either absent, the old complete version, or the new
+    complete version — never torn."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            write_fn(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+    _fsync_dir(os.path.dirname(path) or ".")
+
+
 def _write_arrays(directory: str, arrays: Dict[str, np.ndarray],
                   schema: Dict[str, Any], step: int,
                   metadata: Optional[Dict[str, Any]], keep: int) -> str:
     os.makedirs(directory, exist_ok=True)
     fname = os.path.join(directory, f"restore.{step:08d}.npz")
-    np.savez(fname, **arrays)
+    _atomic_write(fname, lambda f: np.savez(f, **arrays))
     meta = dict(metadata or {})
     meta["step"] = step
     meta["schema"] = schema
-    with open(fname.replace(".npz", ".json"), "w") as f:
-        json.dump(meta, f)
+    # integrity record: per-leaf CRCs catch in-file tampering down to
+    # the leaf; the whole-file digest makes verification a single
+    # sequential read. Written AFTER the npz replace, so a complete
+    # sidecar implies a complete array file (the commit marker).
+    meta["integrity"] = {
+        "leaves": {k: _leaf_crc(v) for k, v in arrays.items()},
+        "npz_crc32": _file_crc(fname),
+        "npz_size": os.path.getsize(fname),
+    }
+    payload = json.dumps(meta).encode()
+    _atomic_write(fname.replace(".npz", ".json"),
+                  lambda f: f.write(payload))
     _prune(directory, keep)
     return fname
+
+
+def _read_sidecar(directory: str, step: int) -> Optional[Dict[str, Any]]:
+    """Parse the sidecar; None if absent or torn (invalid JSON)."""
+    path = os.path.join(directory, f"restore.{step:08d}.json")
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def verify_checkpoint(directory: str, step: int) -> bool:
+    """True iff step's checkpoint is complete and intact: the sidecar
+    parses and the array file matches its recorded size and whole-file
+    CRC32. Legacy sidecars (written before the integrity record
+    existed) are accepted — they predate atomic writes but refusing
+    them would orphan every pre-upgrade run."""
+    fname = os.path.join(directory, f"restore.{step:08d}.npz")
+    if not os.path.exists(fname):
+        return False
+    meta = _read_sidecar(directory, step)
+    if meta is None:
+        return False
+    integ = meta.get("integrity")
+    if integ is None:
+        return True                 # legacy checkpoint: trusted as-is
+    try:
+        if os.path.getsize(fname) != integ.get("npz_size"):
+            return False
+        return _file_crc(fname) == integ.get("npz_crc32")
+    except OSError:
+        return False
 
 
 def save_checkpoint(directory: str, state: Any, step: int,
@@ -126,26 +236,58 @@ def save_checkpoint(directory: str, state: Any, step: int,
                          state_schema(state), step, metadata, keep)
 
 
-def _prune(directory: str, keep: int) -> None:
-    ckpts = sorted(
-        f for f in os.listdir(directory)
-        if f.startswith("restore.") and f.endswith(".npz"))
-    for f in ckpts[:-keep] if keep > 0 else []:
-        os.remove(os.path.join(directory, f))
-        side = os.path.join(directory, f.replace(".npz", ".json"))
-        if os.path.exists(side):
-            os.remove(side)
-
-
-def latest_step(directory: str) -> Optional[int]:
-    if not os.path.isdir(directory):
-        return None
+def _all_steps(directory: str) -> list:
     steps = []
     for f in os.listdir(directory):
         m = re.fullmatch(r"restore\.(\d+)\.npz", f)
         if m:
             steps.append(int(m.group(1)))
-    return max(steps) if steps else None
+    return sorted(steps)
+
+
+def _prune(directory: str, keep: int) -> None:
+    # stale temp files are debris from a killed writer (a *different*
+    # process: our own pid's temps are live in the async worker)
+    for f in os.listdir(directory):
+        m = re.search(r"\.tmp\.(\d+)$", f)
+        if m and int(m.group(1)) != os.getpid():
+            try:
+                os.remove(os.path.join(directory, f))
+            except OSError:
+                pass
+    if keep <= 0:
+        return
+    steps = _all_steps(directory)
+    doomed = steps[:-keep]
+    if not doomed:
+        return
+    # the newest VERIFIED checkpoint is sacrosanct: if every younger
+    # checkpoint is corrupt, deleting it would leave nothing to roll
+    # back to — prune must never shorten the recovery chain to zero
+    last_verified = next((s for s in reversed(steps)
+                          if verify_checkpoint(directory, s)), None)
+    for s in doomed:
+        if s == last_verified:
+            continue
+        os.remove(os.path.join(directory, f"restore.{s:08d}.npz"))
+        side = os.path.join(directory, f"restore.{s:08d}.json")
+        if os.path.exists(side):
+            os.remove(side)
+
+
+def latest_step(directory: str,
+                verified_only: bool = True) -> Optional[int]:
+    """Newest restorable step. With ``verified_only`` (the default)
+    corrupt or sidecar-less checkpoints are skipped — the answer is the
+    newest checkpoint :func:`verify_checkpoint` vouches for, never a
+    truncated file a crash left behind."""
+    if not os.path.isdir(directory):
+        return None
+    steps = _all_steps(directory)
+    if not verified_only:
+        return steps[-1] if steps else None
+    return next((s for s in reversed(steps)
+                 if verify_checkpoint(directory, s)), None)
 
 
 class AsyncCheckpointWriter:
@@ -187,13 +329,30 @@ class AsyncCheckpointWriter:
         for f in done:
             f.result()              # re-raise the worker failure here
 
+    @staticmethod
+    def _write_with_retry(directory, arrays, schema, step, metadata,
+                          keep):
+        # one retry before surfacing: a transient fs hiccup (NFS blip,
+        # ENOSPC race with the pruner) must not cost the interval —
+        # the atomic-replace protocol makes the retry idempotent.
+        # `_write_arrays` is looked up per call so fault injection
+        # (tools.fault_injection.failing_checkpoint_writes) sees both
+        # attempts.
+        try:
+            return _write_arrays(directory, arrays, schema, step,
+                                 metadata, keep)
+        except Exception:
+            return _write_arrays(directory, arrays, schema, step,
+                                 metadata, keep)
+
     def save(self, state: Any, step: int,
              metadata: Optional[Dict[str, Any]] = None):
         self._raise_finished()
         arrays = _gather_arrays(state)      # sync: donation-safe
         schema = state_schema(state)
-        fut = self._exec.submit(_write_arrays, self.directory, arrays,
-                                schema, step, metadata, self.keep)
+        fut = self._exec.submit(self._write_with_retry, self.directory,
+                                arrays, schema, step, metadata,
+                                self.keep)
         self._pending.append(fut)
         return fut
 
@@ -222,19 +381,52 @@ def restore_checkpoint(directory: str, template: Any,
     given, maps (path_str, np_array) -> jax.Array for re-sharding onto a
     possibly different device mesh.
 
+    With ``step=None`` the newest VERIFIED checkpoint is restored:
+    corrupt or sidecar-less checkpoints (what a kill mid-write leaves
+    behind) are skipped with a warning, falling back through older
+    checkpoints until one loads. An explicit ``step`` raises
+    :class:`CheckpointCorruptError` if that checkpoint fails
+    verification. Schema mismatches (a refactored state layout) raise
+    ``ValueError`` in both modes — that is a diagnosis, not corruption.
+
     Returns (state, step, metadata).
     """
-    if step is None:
-        step = latest_step(directory)
-        if step is None:
-            raise FileNotFoundError(f"no checkpoints in {directory}")
+    if step is not None:
+        fname = os.path.join(directory, f"restore.{step:08d}.npz")
+        if not os.path.exists(fname):
+            raise FileNotFoundError(fname)
+        if not verify_checkpoint(directory, step):
+            raise CheckpointCorruptError(
+                f"checkpoint {fname} failed integrity verification "
+                f"(truncated/corrupt file or missing sidecar)")
+        return _load_step(directory, step, template, sharding_fn)
+
+    steps = _all_steps(directory) if os.path.isdir(directory) else []
+    if not steps:
+        raise FileNotFoundError(f"no checkpoints in {directory}")
+    import warnings
+
+    for s in reversed(steps):
+        if not verify_checkpoint(directory, s):
+            warnings.warn(
+                f"skipping unverified checkpoint step {s} in "
+                f"{directory} (corrupt or sidecar-less — a crash "
+                f"mid-write leaves exactly this)")
+            continue
+        try:
+            return _load_step(directory, s, template, sharding_fn)
+        except CheckpointCorruptError as e:
+            warnings.warn(f"skipping checkpoint step {s}: {e}")
+    raise FileNotFoundError(
+        f"no verified checkpoints in {directory} "
+        f"({len(steps)} candidate(s), all corrupt)")
+
+
+def _load_step(directory: str, step: int, template: Any, sharding_fn):
     fname = os.path.join(directory, f"restore.{step:08d}.npz")
     data = np.load(fname)
-    meta_path = fname.replace(".npz", ".json")
-    metadata: Dict[str, Any] = {}
-    if os.path.exists(meta_path):
-        with open(meta_path) as f:
-            metadata = json.load(f)
+    metadata: Dict[str, Any] = _read_sidecar(directory, step) or {}
+    leaf_crcs = (metadata.get("integrity") or {}).get("leaves", {})
 
     paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
     # schema validation: a refactored state NamedTuple produces a clear
@@ -254,6 +446,10 @@ def restore_checkpoint(directory: str, template: Any,
         if key not in data:
             raise KeyError(f"checkpoint {fname} missing leaf {key!r}")
         arr = data[key]
+        if key in leaf_crcs and _leaf_crc(arr) != leaf_crcs[key]:
+            raise CheckpointCorruptError(
+                f"checkpoint {fname}: leaf {key!r} fails its recorded "
+                f"CRC32 — the array file and sidecar disagree")
         tgt_dtype = getattr(leaf, "dtype", None)
         if tgt_dtype is not None and arr.dtype != tgt_dtype:
             arr = arr.astype(tgt_dtype)

@@ -119,24 +119,42 @@ class HierarchyDriver:
         # one compiled chunk per distinct length (a handful at most:
         # cadence-aligned lengths repeat) — no masked-tail waste
         self._chunks = {}
-        # traces observed per chunk length: the retrace observable the
-        # no-retrace contract is tested against. jit's _cache_size()
-        # cannot serve here — the process-global pjit LRU can evict a
-        # live entry in a long session, reading as 0 even though no
-        # retrace happened (and a later call would silently recompile)
+        # DISTINCT INPUT SIGNATURES observed per chunk length: the
+        # retrace observable the no-retrace contract is tested against.
+        # jit's _cache_size() cannot serve here — the process-global
+        # pjit LRU can evict a live entry in a long session, reading as
+        # 0 even though no retrace happened (and a later call would
+        # silently recompile). Counting raw trace events is also too
+        # coupled: a re-trace after jax.clear_caches() (the per-module
+        # conftest fixture) or an AOT .lower() re-enters the closure
+        # without any NEW signature (ADVICE r5 item 3) — so the dict
+        # counts distinct (shape, dtype) signatures instead, which a
+        # benign re-trace of a known signature leaves unchanged.
         self.trace_counts = {}
+        self._trace_sigs = {}
 
     def _chunk(self, n: int):
         if n not in self._chunks:
             base_step = self._base_step
-            # local alias: the closure must not capture self, or the
+            # local aliases: the closure must not capture self, or the
             # global pjit cache would pin the whole driver (integrator,
             # history, callbacks) for the cache entry's lifetime
             counts = self.trace_counts
+            sigs = self._trace_sigs
 
             def chunk(state, dt):
-                # runs at TRACE time only: counts compilations, not calls
-                counts[n] = counts.get(n, 0) + 1
+                # runs at TRACE time only: record the input signature;
+                # the count is the number of DISTINCT signatures, so a
+                # benign re-trace (cache cleared, AOT lower) of a
+                # known signature does not read as a retrace
+                sig = (
+                    tuple((tuple(l.shape), str(l.dtype))
+                          for l in jax.tree_util.tree_leaves(state)
+                          if hasattr(l, "shape")),
+                    (tuple(getattr(dt, "shape", ())),
+                     str(getattr(dt, "dtype", type(dt).__name__))))
+                sigs.setdefault(n, set()).add(sig)
+                counts[n] = len(sigs[n])
 
                 def body(s, _):
                     return base_step(s, dt), None

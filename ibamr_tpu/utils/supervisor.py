@@ -1,0 +1,225 @@
+"""Supervised rollback-and-retry run loop (the ResilientDriver).
+
+Reference parity: the ``RestartManager`` + health-loop machinery
+(SURVEY.md §5.2-5.4) — the reference answers a mid-run failure with
+"restart from the last dump"; this module makes that loop automatic.
+:class:`ResilientDriver` wraps :class:`HierarchyDriver.run` so a run
+that loses its numerical footing (``SimulationDiverged``), its
+checkpoint write (the async writer's one retry + verified-fallback
+restore), or its host (SIGTERM/SIGINT preemption) finishes anyway:
+
+- **divergence** -> roll back to the newest VERIFIED checkpoint (or
+  the initial state when none exists), shrink dt by ``dt_backoff``,
+  and retry, up to ``max_retries`` times;
+- **preemption** -> drain the async writer, write a final synchronous
+  checkpoint of the last healthy post-chunk state, and return;
+- every recovery appends one structured JSONL record to
+  ``incidents.jsonl`` (schema in docs/RESILIENCE.md) so operators see
+  what the run survived, not just that it finished.
+
+The supervisor owns the checkpoint cadence: it installs an
+:class:`AsyncCheckpointWriter`-backed ``checkpoint_fn`` on the wrapped
+driver (chaining to any user callback) and tracks the last healthy
+state via the driver's per-chunk ``metrics_fn`` hook. Divergence can
+never poison the chain — the driver raises BEFORE the cadence callback
+sees a non-finite state.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import time
+from typing import Any, Callable, Optional
+
+from ibamr_tpu.utils.checkpoint import (AsyncCheckpointWriter,
+                                        latest_step, restore_checkpoint,
+                                        save_checkpoint)
+from ibamr_tpu.utils.hierarchy_driver import SimulationDiverged
+
+
+class PreemptionSignal(BaseException):
+    """Raised by the installed SIGTERM/SIGINT handler. BaseException so
+    integrator/callback ``except Exception`` blocks cannot swallow the
+    shutdown request."""
+
+    def __init__(self, signum: int):
+        self.signum = signum
+        super().__init__(f"preemption signal {signal.Signals(signum).name}")
+
+
+class ResilientDriver:
+    """Wrap a :class:`HierarchyDriver` with rollback-and-retry.
+
+    Parameters
+    ----------
+    driver:
+        The :class:`HierarchyDriver` to supervise. Its
+        ``cfg.restart_interval`` sets the checkpoint cadence (and
+        therefore the maximum progress one crash can cost).
+    checkpoint_dir:
+        Where checkpoints and ``incidents.jsonl`` live.
+    max_retries:
+        Divergence recoveries allowed before the last
+        ``SimulationDiverged`` is re-raised.
+    dt_backoff:
+        Multiplier applied to ``cfg.dt`` on every divergence recovery
+        (0.5 halves the step). With ``cfg.cfl`` set the backed-off dt
+        still acts as the cap.
+    keep:
+        Checkpoints retained on disk (the pruner never deletes the
+        last verified one regardless).
+    sharding_fn:
+        Forwarded to :func:`restore_checkpoint` on rollback — restores
+        stay correct when the run is later resumed on a different
+        device mesh.
+    handle_signals:
+        Install SIGTERM/SIGINT handlers for the duration of ``run``
+        (main thread only; silently skipped elsewhere).
+    """
+
+    def __init__(self, driver, checkpoint_dir: str, *,
+                 max_retries: int = 3, dt_backoff: float = 0.5,
+                 keep: int = 3, sharding_fn: Optional[Callable] = None,
+                 handle_signals: bool = True,
+                 incident_log: Optional[str] = None):
+        if max_retries < 0:
+            raise ValueError("max_retries must be >= 0")
+        if not (0.0 < dt_backoff <= 1.0):
+            raise ValueError("dt_backoff must be in (0, 1]")
+        self.driver = driver
+        self.directory = checkpoint_dir
+        self.max_retries = max_retries
+        self.dt_backoff = dt_backoff
+        self.keep = keep
+        self.sharding_fn = sharding_fn
+        self.handle_signals = handle_signals
+        self.incident_log = incident_log or os.path.join(
+            checkpoint_dir, "incidents.jsonl")
+        self.incidents = []           # in-memory mirror of the JSONL
+        self.preempted = False
+        self.preempt_signum: Optional[int] = None
+        self._last: Optional[tuple] = None   # (state, step) post-chunk
+
+    # -- incident records ---------------------------------------------------
+
+    def _record(self, rec: dict) -> dict:
+        rec = dict(rec)
+        rec["time"] = time.time()
+        self.incidents.append(rec)
+        os.makedirs(os.path.dirname(self.incident_log) or ".",
+                    exist_ok=True)
+        with open(self.incident_log, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+        return rec
+
+    # -- rollback -----------------------------------------------------------
+
+    def _rollback(self, template: Any, initial: tuple):
+        """(state, step) to resume from: newest verified checkpoint,
+        else the initial state."""
+        step = latest_step(self.directory)
+        if step is None:
+            return initial[0], initial[1], None
+        state, k, _ = restore_checkpoint(self.directory, template,
+                                         sharding_fn=self.sharding_fn)
+        return state, k, k
+
+    # -- main entry ---------------------------------------------------------
+
+    def run(self, state, start_step: int = 0):
+        """Advance to ``cfg.num_steps`` surviving divergence and
+        preemption; returns the final state (check ``self.preempted``
+        to distinguish a completed run from a preempted one)."""
+        driver = self.driver
+        initial = (state, start_step)
+        self._last = initial
+        writer = AsyncCheckpointWriter(self.directory, keep=self.keep)
+
+        user_ckpt = driver.checkpoint_fn
+        user_metrics = driver.metrics_fn
+
+        def ckpt_fn(s, k):
+            writer.save(s, k)
+            if user_ckpt is not None:
+                user_ckpt(s, k)
+
+        def metrics_fn(s, k):
+            # per-chunk hook: remember the last HEALTHY state — the
+            # driver raises on divergence before this runs
+            self._last = (s, k)
+            return user_metrics(s, k) if user_metrics is not None else None
+
+        driver.checkpoint_fn = ckpt_fn
+        driver.metrics_fn = metrics_fn
+
+        old_handlers = {}
+        if self.handle_signals:
+            def _handler(signum, frame):
+                raise PreemptionSignal(signum)
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                try:
+                    old_handlers[sig] = signal.signal(sig, _handler)
+                except ValueError:     # not the main thread
+                    break
+
+        retries = 0
+        cur_state, cur_step = state, start_step
+        try:
+            while True:
+                try:
+                    out = driver.run(cur_state, start_step=cur_step)
+                    writer.wait()      # every interval durably on disk
+                    return out
+                except SimulationDiverged as e:
+                    dt_before = driver.cfg.dt
+                    if retries >= self.max_retries:
+                        self._record({
+                            "event": "give_up", "step": e.step,
+                            "bad_leaves": list(e.bad_leaves),
+                            "retries": retries,
+                            "dt": dt_before})
+                        raise
+                    retries += 1
+                    try:
+                        writer.wait()  # pending intervals land first
+                    except Exception:
+                        pass           # roll back to what's on disk
+                    cur_state, cur_step, ck = self._rollback(initial[0],
+                                                             initial)
+                    driver.cfg.dt = dt_before * self.dt_backoff
+                    self._record({
+                        "event": "divergence", "step": e.step,
+                        "bad_leaves": list(e.bad_leaves),
+                        "retry": retries,
+                        "max_retries": self.max_retries,
+                        "rollback_step": cur_step,
+                        "from_checkpoint": ck is not None,
+                        "dt_before": dt_before,
+                        "dt_after": driver.cfg.dt})
+        except PreemptionSignal as e:
+            self.preempted = True
+            self.preempt_signum = e.signum
+            try:
+                writer.wait()          # drain enqueued intervals
+            except Exception:
+                pass
+            st, k = self._last
+            save_checkpoint(self.directory, st, k, keep=self.keep,
+                            metadata={"preempted": True})
+            self._record({
+                "event": "preemption",
+                "signal": signal.Signals(e.signum).name,
+                "step": k, "checkpoint_step": k})
+            return st
+        finally:
+            driver.checkpoint_fn = user_ckpt
+            driver.metrics_fn = user_metrics
+            for sig, h in old_handlers.items():
+                signal.signal(sig, h)
+            try:
+                writer.close()
+            except Exception:
+                pass

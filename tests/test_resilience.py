@@ -1,0 +1,547 @@
+"""Resilience layer (PR 2): atomic verified checkpoints, supervised
+rollback-and-retry, graceful engine degradation, fault injection.
+
+Every failure path the recovery machinery claims to handle is
+EXERCISED here with a deterministic injected fault
+(tools.fault_injection): torn/corrupt/uncommitted checkpoints, flaky
+writes under the async writer, NaN divergence under the supervisor,
+preemption signals, a monkeypatch-killed transfer engine, and a
+SIGKILL-mid-write subprocess drill proving no crash sequence loses
+more than one checkpoint interval.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ibamr_tpu.grid import StaggeredGrid
+from ibamr_tpu.integrators.ins import INSStaggeredIntegrator
+from ibamr_tpu.utils import checkpoint as ckpt
+from ibamr_tpu.utils.checkpoint import (AsyncCheckpointWriter,
+                                        CheckpointCorruptError,
+                                        latest_step, restore_checkpoint,
+                                        save_checkpoint,
+                                        verify_checkpoint)
+from ibamr_tpu.utils.hierarchy_driver import (HierarchyDriver, RunConfig,
+                                              SimulationDiverged)
+from ibamr_tpu.utils.supervisor import ResilientDriver
+from tools.fault_injection import (corrupt_checkpoint, crash_state,
+                                   drop_sidecar,
+                                   failing_checkpoint_writes, inject_nan,
+                                   nan_injector_step, truncate_checkpoint)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _ins(n=16, mu=0.01, **kw):
+    g = StaggeredGrid(n=(n, n), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    return INSStaggeredIntegrator(g, rho=1.0, mu=mu, dtype=jnp.float64,
+                                  **kw)
+
+
+def _tg_state(integ):
+    import math
+    g = integ.grid
+    xf, yc = g.face_centers(0, jnp.float64)
+    xc, yf = g.face_centers(1, jnp.float64)
+    u = jnp.sin(2 * math.pi * xf) * jnp.cos(2 * math.pi * yc) + 0 * yc
+    v = -jnp.cos(2 * math.pi * xc) * jnp.sin(2 * math.pi * yf) + 0 * xc
+    return integ.initialize(u0_arrays=(u, v))
+
+
+# ---------------------------------------------------------------------------
+# checkpoint integrity: every damage mode a crash/bad disk can inflict
+# ---------------------------------------------------------------------------
+
+def test_truncated_checkpoint_skipped(tmp_path):
+    d = str(tmp_path)
+    for s in (5, 10):
+        save_checkpoint(d, crash_state(s), s)
+    truncate_checkpoint(d, 10)
+    assert not verify_checkpoint(d, 10)
+    assert verify_checkpoint(d, 5)
+    assert latest_step(d) == 5                      # newest VERIFIED
+    assert latest_step(d, verified_only=False) == 10
+    with pytest.warns(UserWarning, match="unverified"):
+        st, k, _ = restore_checkpoint(d, crash_state(5))
+    assert k == 5
+    assert np.array_equal(np.asarray(st["u"]), crash_state(5)["u"])
+
+
+def test_byte_flip_caught_by_whole_file_crc(tmp_path):
+    d = str(tmp_path)
+    save_checkpoint(d, crash_state(7), 7)
+    assert verify_checkpoint(d, 7)
+    corrupt_checkpoint(d, 7)                        # same size, one bit
+    assert not verify_checkpoint(d, 7)
+    with pytest.raises(CheckpointCorruptError):
+        restore_checkpoint(d, crash_state(7), step=7)
+    with pytest.warns(UserWarning), pytest.raises(FileNotFoundError,
+                                                  match="all corrupt"):
+        restore_checkpoint(d, crash_state(7))       # nothing to fall to
+    with pytest.raises(FileNotFoundError):
+        restore_checkpoint(d, crash_state(7), step=99)
+
+
+def test_missing_sidecar_means_uncommitted(tmp_path):
+    d = str(tmp_path)
+    for s in (5, 10):
+        save_checkpoint(d, crash_state(s), s)
+    drop_sidecar(d, 10)
+    assert not verify_checkpoint(d, 10)
+    assert latest_step(d) == 5
+
+
+def test_leaf_crc_mismatch_detected_and_fallback(tmp_path):
+    """A tampered sidecar whose file-level digest still matches must be
+    caught by the per-leaf CRC at load time, and step=None restore must
+    fall back to the previous verified checkpoint."""
+    d = str(tmp_path)
+    for s in (5, 10):
+        save_checkpoint(d, crash_state(s), s)
+    side = os.path.join(d, "restore.00000010.json")
+    with open(side) as f:
+        meta = json.load(f)
+    meta["integrity"]["leaves"]["u"] ^= 1
+    with open(side, "w") as f:
+        json.dump(meta, f)
+    assert verify_checkpoint(d, 10)     # whole-file digest still OK...
+    with pytest.raises(CheckpointCorruptError, match="CRC32"):
+        restore_checkpoint(d, crash_state(10), step=10)
+    with pytest.warns(UserWarning, match="skipping checkpoint step 10"):
+        st, k, _ = restore_checkpoint(d, crash_state(5))
+    assert k == 5
+
+
+def test_prune_never_deletes_last_verified(tmp_path):
+    d = str(tmp_path)
+    for s in (1, 2, 3):
+        save_checkpoint(d, crash_state(s), s, keep=0)    # keep=0: no prune
+    corrupt_checkpoint(d, 2)
+    corrupt_checkpoint(d, 3)
+    ckpt._prune(d, keep=1)
+    # doomed = {1, 2}; 1 is the newest verified so it is spared
+    assert verify_checkpoint(d, 1)
+    assert not os.path.exists(os.path.join(d, "restore.00000002.npz"))
+    assert latest_step(d) == 1
+    st, k, _ = restore_checkpoint(d, crash_state(1))
+    assert k == 1
+
+
+def test_async_writer_retries_flaky_write(tmp_path):
+    d = str(tmp_path)
+    w = AsyncCheckpointWriter(d, keep=3)
+    try:
+        with failing_checkpoint_writes({0}) as ctr:
+            w.save(crash_state(4), 4)
+            w.wait()
+        assert ctr["calls"] == 2                    # attempt + retry
+        assert verify_checkpoint(d, 4)
+    finally:
+        w.close()
+
+
+def test_async_writer_double_failure_surfaces_once(tmp_path):
+    d = str(tmp_path)
+    w = AsyncCheckpointWriter(d, keep=3)
+    try:
+        with failing_checkpoint_writes({0, 1}):
+            w.save(crash_state(4), 4)
+            with pytest.raises(OSError, match="injected"):
+                w.wait()
+        # the failure must not poison later saves
+        w.save(crash_state(8), 8)
+        w.wait()
+        assert latest_step(d) == 8
+        assert not verify_checkpoint(d, 4)
+    finally:
+        w.close()
+
+
+def test_inject_nan_matches_only_floating_leaves():
+    st = inject_nan(crash_state(3), "u")
+    assert np.all(np.isnan(np.asarray(st["u"])))
+    assert int(st["k"]) == 3
+    with pytest.raises(KeyError):
+        inject_nan(crash_state(3), "nope")
+    with pytest.raises(KeyError):
+        inject_nan(crash_state(3), "k")             # int leaf: no match
+
+
+# ---------------------------------------------------------------------------
+# supervised rollback-and-retry
+# ---------------------------------------------------------------------------
+
+def _nan_driver(integ, dt0, *, gated=True, num_steps=12):
+    cfg = RunConfig(dt=dt0, num_steps=num_steps, restart_interval=4,
+                    health_interval=2)
+    return HierarchyDriver(
+        integ, cfg,
+        step_fn=nan_injector_step(
+            integ.step, at_step=6, leaf_path="u[0]",
+            dt_gate=dt0 * 0.99 if gated else None))
+
+
+def test_supervisor_recovers_from_divergence(tmp_path):
+    """The acceptance drill: NaN at step 6 -> rollback to the step-4
+    checkpoint, dt backoff (which disarms the dt-gated fault), run to
+    completion, one structured JSONL incident — and the recovered run
+    is BITWISE the clean run restarted from that checkpoint at the
+    backed-off dt."""
+    integ = _ins()
+    st0 = _tg_state(integ)
+    dt0 = 1e-3
+    d = str(tmp_path)
+    drv = _nan_driver(integ, dt0)
+    sup = ResilientDriver(drv, d, max_retries=2, dt_backoff=0.5,
+                          handle_signals=False)
+    out = sup.run(st0)
+    assert int(out.k) == 12
+    assert bool(jnp.all(jnp.isfinite(out.u[0])))
+    assert not sup.preempted
+    assert drv.cfg.dt == pytest.approx(dt0 * 0.5)
+
+    [rec] = [r for r in sup.incidents if r["event"] == "divergence"]
+    assert rec["step"] == 6
+    assert rec["bad_leaves"]
+    assert rec["retry"] == 1 and rec["max_retries"] == 2
+    assert rec["rollback_step"] == 4 and rec["from_checkpoint"]
+    assert rec["dt_before"] == pytest.approx(dt0)
+    assert rec["dt_after"] == pytest.approx(dt0 * 0.5)
+    with open(os.path.join(d, "incidents.jsonl")) as f:
+        lines = [json.loads(l) for l in f]
+    assert [l["event"] for l in lines] == ["divergence"]
+    assert all("time" in l for l in lines)
+
+    # checkpoints landed at the cadence of the RECOVERED run
+    assert latest_step(d) == 12
+
+    # recovered == clean-restart-from-checkpoint, bitwise
+    st4, k4, _ = restore_checkpoint(d, out, step=4)
+    assert k4 == 4
+    drv2 = _nan_driver(integ, dt0)
+    drv2.cfg.dt = dt0 * 0.5
+    ref = drv2.run(st4, start_step=4)
+    for a, b in zip(jax.tree_util.tree_leaves(out),
+                    jax.tree_util.tree_leaves(ref)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_supervisor_gives_up_after_max_retries(tmp_path):
+    """An UNGATED fault re-fires on every retry: the supervisor must
+    stop at max_retries, record a give_up incident, and re-raise."""
+    integ = _ins()
+    st0 = _tg_state(integ)
+    drv = _nan_driver(integ, 1e-3, gated=False)
+    sup = ResilientDriver(drv, str(tmp_path), max_retries=1,
+                          dt_backoff=0.5, handle_signals=False)
+    with pytest.raises(SimulationDiverged):
+        sup.run(st0)
+    assert [r["event"] for r in sup.incidents] == ["divergence",
+                                                   "give_up"]
+    assert sup.incidents[-1]["retries"] == 1
+
+
+def test_supervisor_preemption_writes_final_checkpoint(tmp_path):
+    """SIGTERM mid-run: the installed handler raises through the step
+    loop; the supervisor drains the writer, writes a final synchronous
+    checkpoint of the last healthy state, records the incident, and
+    returns instead of dying."""
+    integ = _ins()
+    st0 = _tg_state(integ)
+    d = str(tmp_path)
+    cfg = RunConfig(dt=1e-3, num_steps=40, restart_interval=10,
+                    health_interval=2)
+    fired = []
+
+    def metrics_fn(s, k):
+        if k >= 6 and not fired:
+            fired.append(k)
+            os.kill(os.getpid(), signal.SIGTERM)
+        return None
+
+    drv = HierarchyDriver(integ, cfg, metrics_fn=metrics_fn)
+    sup = ResilientDriver(drv, d, handle_signals=True)
+    before = signal.getsignal(signal.SIGTERM)
+    out = sup.run(st0)
+    assert sup.preempted and sup.preempt_signum == signal.SIGTERM
+    assert signal.getsignal(signal.SIGTERM) == before   # handler restored
+    k_final = int(out.k)
+    assert k_final >= 6
+    assert latest_step(d) == k_final
+    st, k, meta = restore_checkpoint(d, out)
+    assert k == k_final and meta.get("preempted") is True
+    [rec] = [r for r in sup.incidents if r["event"] == "preemption"]
+    assert rec["signal"] == "SIGTERM"
+    assert rec["checkpoint_step"] == k_final
+
+
+# ---------------------------------------------------------------------------
+# graceful engine degradation
+# ---------------------------------------------------------------------------
+
+def test_engine_fallback_vocabulary():
+    from ibamr_tpu.ops.interaction_packed import (ENGINE_FALLBACKS,
+                                                  fallback_chain,
+                                                  normalize_engine_name)
+
+    assert normalize_engine_name(True) == "mxu"
+    assert normalize_engine_name(False) == "scatter"
+    assert normalize_engine_name(None) == "scatter"
+    assert fallback_chain("hybrid_bf16") == [
+        "hybrid_bf16", "packed_bf16", "packed", "scatter"]
+    assert fallback_chain("pallas_packed") == [
+        "pallas_packed", "packed", "scatter"]
+    assert fallback_chain("scatter") == ["scatter"]
+    for name in ENGINE_FALLBACKS:
+        chain = fallback_chain(name)
+        assert chain[-1] == "scatter"
+        assert len(chain) == len(set(chain))        # no cycles
+    with pytest.raises(KeyError):
+        fallback_chain("no_such_engine")
+
+
+def test_failed_engine_degrades_and_matches_fallback(monkeypatch):
+    """A transfer engine whose build/compile probe fails must degrade
+    down the registry chain with a warning — and the degraded model's
+    step must be BITWISE the step of a model built directly on the
+    fallback engine."""
+    from ibamr_tpu.models.shell3d import build_shell_example
+    from ibamr_tpu.ops import pallas_interaction
+
+    def boom(self, *a, **kw):
+        raise RuntimeError("injected engine failure")
+
+    monkeypatch.setattr(pallas_interaction.HybridPackedInteraction,
+                        "spread_vel", boom)
+    with pytest.warns(RuntimeWarning, match="degrading to 'packed_bf16'"):
+        integ, state = build_shell_example(
+            n_cells=16, n_lat=8, n_lon=8,
+            use_fast_interaction="hybrid_bf16")
+    assert type(integ.ib.fast).__name__ == "PackedInteraction"
+    assert integ.ib.fast.compute_dtype == jnp.bfloat16
+
+    integ2, state2 = build_shell_example(
+        n_cells=16, n_lat=8, n_lon=8,
+        use_fast_interaction="packed_bf16", engine_fallback=False)
+    s1 = jax.jit(integ.step)(state, 1e-4)
+    s2 = jax.jit(integ2.step)(state2, 1e-4)
+    for a, b in zip(jax.tree_util.tree_leaves(s1),
+                    jax.tree_util.tree_leaves(s2)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_engine_fallback_off_raises(monkeypatch):
+    """With the fallback disabled a broken engine fails the build loudly
+    (construction failure here: without the compile probe, a broken
+    METHOD would only surface at first step)."""
+    from ibamr_tpu.models.shell3d import build_shell_example
+    from ibamr_tpu.ops import pallas_interaction
+
+    def boom(self, *a, **kw):
+        raise RuntimeError("injected engine failure")
+
+    monkeypatch.setattr(pallas_interaction.HybridPackedInteraction,
+                        "__init__", boom)
+    with pytest.raises(RuntimeError, match="injected"):
+        build_shell_example(n_cells=16, n_lat=8, n_lon=8,
+                            use_fast_interaction="hybrid_bf16",
+                            engine_fallback=False)
+
+
+# ---------------------------------------------------------------------------
+# satellites: retrace observable + overflow-pad debug check
+# ---------------------------------------------------------------------------
+
+def test_trace_counts_distinct_signatures():
+    """trace_counts counts DISTINCT input signatures: a benign re-trace
+    of a known signature (cache cleared) must not read as a retrace; a
+    genuinely new signature must."""
+    integ = _ins()
+    st = _tg_state(integ)
+    cfg = RunConfig(dt=1e-3, num_steps=20, health_interval=10)
+    drv = HierarchyDriver(integ, cfg)
+    out = drv.run(st)
+    assert drv.trace_counts[10] == 1
+    jax.clear_caches()                  # forces a re-trace next call
+    drv._chunk(10)(out, 1e-3)
+    assert drv.trace_counts[10] == 1    # same signature: no retrace
+    drv._chunk(10)(out, jnp.asarray(1e-3, dtype=jnp.float32))
+    assert drv.trace_counts[10] == 2    # new dt dtype: real retrace
+
+
+def test_overflow_pad_debug_check_clean():
+    """Debug mode asserts (in-jit, via host callback) that o_w == 0
+    overflow pad entries contribute nothing; the clean path must pass
+    and still match the scatter oracle."""
+    from ibamr_tpu.ops import interaction
+    from ibamr_tpu.ops import interaction_fast as ifast
+
+    grid = StaggeredGrid(n=(32, 32), x_lo=(0, 0), x_up=(1, 1))
+    rng = np.random.RandomState(2)
+    X = jnp.asarray(0.1 + 0.05 * rng.rand(200, 2), dtype=jnp.float64)
+    F = jnp.asarray(rng.randn(200, 2), dtype=jnp.float64)
+    prev = ifast.debug_overflow_pad(True)
+    try:
+        assert prev is False
+        fast = ifast.FastInteraction(grid, tile=8, cap=8)
+        b = fast.buckets(X)
+        assert bool(b.any_overflow)     # pads actually in play
+        f_new = fast.spread_vel(F, X)
+        jax.block_until_ready(f_new)    # host check ran, no violation
+        f_ref = interaction.spread_vel(F, grid, X)
+        for a, c in zip(f_ref, f_new):
+            scale = float(jnp.max(jnp.abs(a))) + 1e-12
+            assert float(jnp.max(jnp.abs(a - c))) < 1e-5 * scale
+        u = tuple(jnp.asarray(rng.randn(32, 32), dtype=jnp.float64)
+                  for _ in range(2))
+        U = fast.interpolate_vel(u, X)
+        jax.block_until_ready(U)
+        U_ref = interaction.interpolate_vel(u, grid, X)
+        assert float(jnp.max(jnp.abs(U_ref - U))) < 1e-5
+    finally:
+        ifast.debug_overflow_pad(prev)
+
+
+# ---------------------------------------------------------------------------
+# cross-mesh restore of a RECOVERED run
+# ---------------------------------------------------------------------------
+
+def test_cross_mesh_restore_of_recovered_run(tmp_path):
+    """A supervised run that rolled back on one device resumes onto the
+    virtual 8-device mesh: restored leaves are bitwise the single-device
+    final state, the same-mesh continuation is bitwise, and the sharded
+    continuation matches the single-device one to spectral-solver
+    tolerance (the test_parallel cross-mesh bound)."""
+    from jax.sharding import NamedSharding, PartitionSpec as PSpec
+
+    from ibamr_tpu.parallel import make_mesh
+    from ibamr_tpu.parallel.mesh import grid_pspec, make_sharded_ins_step
+
+    integ = _ins()
+    st0 = _tg_state(integ)
+    dt0 = 1e-3
+    d = str(tmp_path)
+    drv = _nan_driver(integ, dt0)
+    sup = ResilientDriver(drv, d, max_retries=2, dt_backoff=0.5,
+                          handle_signals=False)
+    out = sup.run(st0)
+    assert [r["event"] for r in sup.incidents] == ["divergence"]
+    assert latest_step(d) == 12
+    dt2 = drv.cfg.dt                    # the backed-off dt resumes
+
+    # same-mesh restore: bitwise state, bitwise continuation
+    st1, k1, _ = restore_checkpoint(d, out)
+    assert k1 == 12
+    for a, b in zip(jax.tree_util.tree_leaves(st1),
+                    jax.tree_util.tree_leaves(out)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    step1 = jax.jit(integ.step)
+    one_a, one_b = step1(st1, dt2), step1(out, dt2)
+    for a, b in zip(jax.tree_util.tree_leaves(one_a),
+                    jax.tree_util.tree_leaves(one_b)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    # cross-mesh restore: grid-shaped leaves spatially sharded over 8
+    # devices, scalars replicated (test_parallel resharder idiom)
+    mesh = make_mesh(8, max_axes=2)
+    spatial = NamedSharding(mesh, grid_pspec(mesh, 2))
+    repl = NamedSharding(mesh, PSpec())
+
+    def resharder(key, arr):
+        sh = spatial if np.ndim(arr) == 2 else repl
+        return jax.device_put(jnp.asarray(arr), sh)
+
+    sh_st, k8, _ = restore_checkpoint(d, out, sharding_fn=resharder)
+    assert k8 == 12
+    assert len(sh_st.u[0].sharding.device_set) == 8
+    for a, b in zip(jax.tree_util.tree_leaves(sh_st),
+                    jax.tree_util.tree_leaves(out)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    stepN = make_sharded_ins_step(integ, mesh)
+    sh, one = sh_st, st1
+    for _ in range(3):
+        sh = stepN(sh, dt2)
+        one = step1(one, dt2)
+    np.testing.assert_allclose(np.asarray(sh.u[0]), np.asarray(one.u[0]),
+                               rtol=1e-10, atol=1e-11)
+    np.testing.assert_allclose(np.asarray(sh.p), np.asarray(one.p),
+                               rtol=1e-10, atol=1e-11)
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL-mid-write subprocess drill (slow tier)
+# ---------------------------------------------------------------------------
+
+def _spawn_crash_child(d, steps=60, interval=5):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.Popen(
+        [sys.executable, "-m", "tools.fault_injection",
+         "--crash-child", str(d), "--steps", str(steps),
+         "--interval", str(interval)],
+        cwd=REPO_ROOT, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True, bufsize=1)
+
+
+def test_kill_mid_write_loses_at_most_one_interval(tmp_path):
+    """SIGKILL the checkpoint-writer child the instant a save lands,
+    three crash cycles in a row: after every kill the newest VERIFIED
+    checkpoint is no older than the last acknowledged save (at most
+    the in-flight interval is lost) and restores bitwise against the
+    closed-form trajectory. A deliberate corruption on top of the last
+    crash costs exactly one more interval. Then the child runs to
+    completion from the wreckage."""
+    d = str(tmp_path)
+    last_acked = 0
+    for cycle in range(3):
+        p = _spawn_crash_child(d)
+        acked = None
+        try:
+            for line in p.stdout:
+                if line.startswith("SAVED"):
+                    acked = int(line.split()[1])
+                    if acked > last_acked:
+                        break           # kill mid-run, write just landed
+                elif line.startswith("DONE"):
+                    break
+        finally:
+            p.kill()
+            p.wait()
+        assert acked is not None and acked > last_acked, \
+            f"cycle {cycle}: child made no progress"
+        last_acked = acked
+        ls = latest_step(d)
+        assert ls is not None and ls >= acked       # <= 1 interval lost
+        st, k, _ = restore_checkpoint(d, template=crash_state(ls),
+                                      step=ls)
+        assert k == ls
+        assert np.array_equal(np.asarray(st["u"]), crash_state(ls)["u"])
+
+    # compound the crash with bitrot on the newest checkpoint: the
+    # fallback costs one more interval, never the whole chain
+    newest = latest_step(d)
+    corrupt_checkpoint(d, newest)
+    ls2 = latest_step(d)
+    assert ls2 is not None and ls2 >= newest - 5
+    with pytest.warns(UserWarning):
+        st, k, _ = restore_checkpoint(d, template=crash_state(ls2))
+    assert k == ls2
+    assert np.array_equal(np.asarray(st["u"]), crash_state(ls2)["u"])
+
+    p = _spawn_crash_child(d)
+    out, _ = p.communicate(timeout=300)
+    assert p.returncode == 0, out
+    assert "DONE" in out
+    assert latest_step(d) == 60
+    st, k, _ = restore_checkpoint(d, template=crash_state(60))
+    assert k == 60
+    assert np.array_equal(np.asarray(st["u"]), crash_state(60)["u"])

@@ -1,0 +1,368 @@
+"""Fault-injection harness for the resilience layer (PR 2 tentpole 4).
+
+The recovery machinery (atomic verified checkpoints, the
+ResilientDriver rollback loop, engine degradation) is only trustworthy
+if the failure paths are EXERCISED — a recovery path that has never run
+is a second bug waiting behind the first. This module supplies the
+deterministic fault injectors the resilience tests and the multichip
+dryrun drill are built from:
+
+- :func:`nan_injector_step` / :func:`inject_nan` — poison a named state
+  leaf with NaN at a chosen step, inside or outside jit. The jittable
+  wrapper is dt-gated so a supervised retry at backed-off dt passes
+  cleanly (the injected fault models a too-aggressive timestep, the
+  exact failure dt-backoff exists to cure).
+- :func:`truncate_checkpoint` / :func:`corrupt_checkpoint` /
+  :func:`drop_sidecar` — the three on-disk damage modes a crash or a
+  bad disk can leave: a short file, flipped bytes at unchanged size,
+  and an array file whose commit marker never landed.
+- :func:`failing_checkpoint_writes` — make the Nth checkpoint write(s)
+  raise, underneath the async writer's retry.
+- :func:`run_crash_child` — the deterministic checkpoint-writer loop
+  the SIGKILL-mid-write subprocess drill runs as its victim: the whole
+  trajectory is a closed-form function of the step count
+  (:func:`crash_state`), so the parent can verify any restored
+  checkpoint bitwise without trusting the child.
+- :func:`run_smoke` — a self-contained end-to-end drill (supervised
+  NaN recovery + corruption fallback + flaky-write retry) wired into
+  ``__graft_entry__.dryrun_multichip`` as path 16 and exposed as
+  ``python -m tools.fault_injection --smoke``.
+
+Everything here is deliberately boring and deterministic: no random
+fuzzing, every fault lands at a named step/byte so a failure
+reproduces.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import os
+import tempfile
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# NaN injection
+# ---------------------------------------------------------------------------
+
+def _match_paths(state, leaf_path: str):
+    """Pytree paths whose keystr contains ``leaf_path`` (e.g. ``"u[0]"``
+    matches the first MAC velocity component of an INSState)."""
+    import jax
+
+    flat = jax.tree_util.tree_flatten_with_path(state)[0]
+    return [jax.tree_util.keystr(p) for p, _ in flat
+            if leaf_path in jax.tree_util.keystr(p)]
+
+
+def inject_nan(state, leaf_path: str):
+    """Host-side: return ``state`` with NaN written into every floating
+    leaf whose path contains ``leaf_path``. Raises if nothing matches
+    (a typo'd path must not silently inject nothing)."""
+    import jax
+    import jax.numpy as jnp
+
+    hit = []
+
+    def _poison(path, leaf):
+        key = jax.tree_util.keystr(path)
+        if leaf_path in key and hasattr(leaf, "dtype") \
+                and jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating):
+            hit.append(key)
+            bad = jnp.asarray(leaf).at[...].set(jnp.nan)
+            return bad
+        return leaf
+
+    out = jax.tree_util.tree_map_with_path(_poison, state)
+    if not hit:
+        raise KeyError(
+            f"no floating leaf path contains {leaf_path!r}; "
+            f"available: {_match_paths(state, '')}")
+    return out
+
+
+def nan_injector_step(step_fn, at_step: int, leaf_path: str = "u",
+                      dt_gate: float | None = None,
+                      step_attr: str = "k"):
+    """Wrap ``step_fn(state, dt) -> state`` so the stepped state comes
+    out poisoned (NaN in every floating leaf matching ``leaf_path``)
+    exactly when its step counter ``state.<step_attr>`` equals
+    ``at_step`` — jit/scan-safe (the fault is a ``jnp.where`` on traced
+    values, not python control flow).
+
+    ``dt_gate`` arms the fault only while ``dt >= dt_gate``: a
+    supervised retry at backed-off dt then passes cleanly, modelling an
+    instability that a smaller timestep cures. Without it the injector
+    would re-fire on every retry and the supervisor could never win.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def wrapped(state, dt):
+        out = step_fn(state, dt)
+        k = getattr(out, step_attr)
+        fire = jnp.asarray(k) == at_step
+        if dt_gate is not None:
+            fire = jnp.logical_and(fire, jnp.asarray(dt) >= dt_gate)
+        hit = []
+
+        def _poison(path, leaf):
+            key = jax.tree_util.keystr(path)
+            if leaf_path in key and hasattr(leaf, "dtype") \
+                    and jnp.issubdtype(leaf.dtype, jnp.floating):
+                hit.append(key)
+                return jnp.where(fire, jnp.asarray(jnp.nan, leaf.dtype),
+                                 leaf)
+            return leaf
+
+        out = jax.tree_util.tree_map_with_path(_poison, out)
+        if not hit:
+            raise KeyError(f"no floating leaf path contains {leaf_path!r}")
+        return out
+
+    return wrapped
+
+
+# ---------------------------------------------------------------------------
+# On-disk checkpoint damage
+# ---------------------------------------------------------------------------
+
+def _ckpt_path(directory: str, step: int, ext: str = "npz") -> str:
+    return os.path.join(directory, f"restore.{step:08d}.{ext}")
+
+
+def truncate_checkpoint(directory: str, step: int,
+                        keep_bytes: int | None = None) -> str:
+    """Chop the array file short (default: half) — what a torn write
+    WOULD look like if the writer were not atomic. The sidecar's size
+    record must now flunk verification."""
+    path = _ckpt_path(directory, step)
+    size = os.path.getsize(path)
+    keep = size // 2 if keep_bytes is None else keep_bytes
+    with open(path, "r+b") as f:
+        f.truncate(keep)
+    return path
+
+def corrupt_checkpoint(directory: str, step: int,
+                       offset: int | None = None) -> str:
+    """Flip one byte WITHOUT changing the size — the bad-disk/bitrot
+    mode that only the CRC32 can catch."""
+    path = _ckpt_path(directory, step)
+    size = os.path.getsize(path)
+    pos = size // 2 if offset is None else offset
+    with open(path, "r+b") as f:
+        f.seek(pos)
+        b = f.read(1)
+        f.seek(pos)
+        f.write(bytes([b[0] ^ 0xFF]))
+    return path
+
+
+def drop_sidecar(directory: str, step: int) -> str:
+    """Remove the JSON commit marker: the array file may be perfect but
+    without a sidecar the checkpoint never committed."""
+    path = _ckpt_path(directory, step, "json")
+    os.remove(path)
+    return path
+
+
+@contextlib.contextmanager
+def failing_checkpoint_writes(fail_calls, exc_type=OSError):
+    """Patch ``checkpoint._write_arrays`` so the 0-based call indices
+    in ``fail_calls`` raise ``exc_type``. The async writer's retry
+    looks the symbol up per attempt, so ``{0}`` fails only the first
+    attempt and the retry lands. Yields the call counter dict."""
+    from ibamr_tpu.utils import checkpoint as _ckpt
+
+    fail = set(fail_calls)
+    orig = _ckpt._write_arrays
+    counter = {"calls": 0}
+
+    def flaky(*args, **kwargs):
+        i = counter["calls"]
+        counter["calls"] += 1
+        if i in fail:
+            raise exc_type(f"injected checkpoint write failure (call {i})")
+        return orig(*args, **kwargs)
+
+    _ckpt._write_arrays = flaky
+    try:
+        yield counter
+    finally:
+        _ckpt._write_arrays = orig
+
+
+# ---------------------------------------------------------------------------
+# Crash-child loop (SIGKILL-mid-write victim)
+# ---------------------------------------------------------------------------
+
+def crash_state(step: int, n: int = 64) -> dict:
+    """Closed-form deterministic trajectory: the state after ``step``
+    iterations of a fixed contraction map. float64 numpy, so every
+    process that evaluates it gets bitwise-identical leaves — the
+    parent verifies a child's checkpoint by recomputing, not by
+    trusting the (possibly killed) child."""
+    u = np.linspace(0.0, 1.0, n)
+    for k in range(1, step + 1):
+        u = np.cos(u) * 0.9 + 0.01 * k
+    return {"u": u, "k": np.int64(step)}
+
+
+def run_crash_child(directory: str, num_steps: int, interval: int,
+                    keep: int = 3) -> int:
+    """The victim loop: resume from the newest VERIFIED checkpoint,
+    iterate the contraction map, checkpoint every ``interval`` steps
+    printing ``SAVED <k>`` markers (the parent kills on a marker).
+    Returns the step reached."""
+    from ibamr_tpu.utils.checkpoint import (latest_step,
+                                            restore_checkpoint,
+                                            save_checkpoint)
+
+    start = latest_step(directory)
+    if start is None:
+        start, u = 0, crash_state(0)["u"]
+    else:
+        state, start, _ = restore_checkpoint(
+            directory, template=crash_state(start), step=start)
+        u = np.asarray(state["u"])
+    print(f"START {start}", flush=True)
+    for k in range(start + 1, num_steps + 1):
+        u = np.cos(u) * 0.9 + 0.01 * k
+        if k % interval == 0:
+            save_checkpoint(directory, {"u": u, "k": np.int64(k)}, k,
+                            keep=keep)
+            print(f"SAVED {k}", flush=True)
+    print("DONE", flush=True)
+    return num_steps
+
+
+# ---------------------------------------------------------------------------
+# End-to-end smoke drill
+# ---------------------------------------------------------------------------
+
+def run_smoke(directory: str | None = None) -> dict:
+    """Deterministic end-to-end resilience drill on a 16^2 INS run:
+
+    1. supervised recovery — NaN injected at step 6 diverges the run;
+       the ResilientDriver rolls back to the step-4 checkpoint, halves
+       dt (which disarms the dt-gated injector) and completes;
+    2. corruption fallback — flip a byte in the newest checkpoint and
+       prove ``latest_step``/``restore_checkpoint`` fall back to the
+       newest VERIFIED one;
+    3. flaky-write retry — fail the next write's first attempt and
+       prove the async writer's retry still lands a verified file.
+
+    Returns (and the CLI prints) a one-line JSON summary. Raises on
+    any failed expectation — wired into the multichip dryrun rotation,
+    so a regression in the recovery path fails CI, not a real run.
+    """
+    import jax.numpy as jnp
+
+    from ibamr_tpu.grid import StaggeredGrid
+    from ibamr_tpu.integrators.ins import INSStaggeredIntegrator
+    from ibamr_tpu.utils.checkpoint import (AsyncCheckpointWriter,
+                                            latest_step,
+                                            restore_checkpoint,
+                                            verify_checkpoint)
+    from ibamr_tpu.utils.hierarchy_driver import HierarchyDriver, RunConfig
+    from ibamr_tpu.utils.supervisor import ResilientDriver
+
+    tmp = None
+    if directory is None:
+        tmp = tempfile.TemporaryDirectory(prefix="ibamr_fault_smoke_")
+        directory = tmp.name
+    try:
+        g = StaggeredGrid(n=(16, 16), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+        integ = INSStaggeredIntegrator(g, rho=1.0, mu=0.05)
+        xf, yc = g.face_centers(0, jnp.float32)
+        xc, yf = g.face_centers(1, jnp.float32)
+        u = jnp.sin(2 * jnp.pi * xf) * jnp.cos(2 * jnp.pi * yc) + 0 * yc
+        v = -jnp.cos(2 * jnp.pi * xc) * jnp.sin(2 * jnp.pi * yf) + 0 * xc
+        st0 = integ.initialize(u0_arrays=(u, v))
+
+        dt0 = 1e-3
+        cfg = RunConfig(dt=dt0, num_steps=12, restart_interval=4,
+                        health_interval=2)
+        drv = HierarchyDriver(
+            integ, cfg,
+            step_fn=nan_injector_step(integ.step, at_step=6,
+                                      leaf_path="u[0]",
+                                      dt_gate=dt0 * 0.99))
+        sup = ResilientDriver(drv, directory, max_retries=2,
+                              dt_backoff=0.5, handle_signals=False)
+        out = sup.run(st0)
+        if int(out.k) != cfg.num_steps:
+            raise AssertionError(f"supervised run stopped at {int(out.k)}")
+        if not bool(jnp.all(jnp.isfinite(out.u[0]))):
+            raise AssertionError("supervised run finished non-finite")
+        div = [r for r in sup.incidents if r["event"] == "divergence"]
+        if len(div) != 1 or div[0]["rollback_step"] != 4:
+            raise AssertionError(f"unexpected incidents: {sup.incidents}")
+
+        # 2. corruption fallback
+        newest = latest_step(directory)
+        corrupt_checkpoint(directory, newest)
+        if verify_checkpoint(directory, newest):
+            raise AssertionError("byte flip went undetected")
+        fell_back = latest_step(directory)
+        if fell_back is None or fell_back >= newest:
+            raise AssertionError("latest_step did not fall back")
+        import warnings
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            _, got, _ = restore_checkpoint(directory, template=out)
+        if got != fell_back:
+            raise AssertionError("restore did not fall back")
+
+        # 3. flaky-write retry under the async writer
+        w = AsyncCheckpointWriter(directory, keep=3)
+        try:
+            with failing_checkpoint_writes({0}) as ctr:
+                w.save(out, 99)
+                w.wait()
+            if ctr["calls"] != 2:
+                raise AssertionError(f"expected a retry, saw {ctr}")
+        finally:
+            w.close()
+        if not verify_checkpoint(directory, 99):
+            raise AssertionError("retried write is not verified")
+
+        return {"fault_smoke": "ok", "divergence_incidents": len(div),
+                "rollback_step": div[0]["rollback_step"],
+                "corrupt_step_skipped": newest,
+                "fallback_step": fell_back,
+                "flaky_write_calls": ctr["calls"]}
+    finally:
+        if tmp is not None:
+            tmp.cleanup()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="deterministic fault-injection drills")
+    ap.add_argument("--smoke", action="store_true",
+                    help="run the end-to-end resilience drill")
+    ap.add_argument("--crash-child", metavar="DIR",
+                    help="run the checkpoint-writer victim loop in DIR")
+    ap.add_argument("--steps", type=int, default=40)
+    ap.add_argument("--interval", type=int, default=5)
+    ap.add_argument("--keep", type=int, default=3)
+    ap.add_argument("--dir", default=None,
+                    help="work directory for --smoke (default: temp)")
+    args = ap.parse_args(argv)
+    if args.crash_child:
+        run_crash_child(args.crash_child, args.steps, args.interval,
+                        keep=args.keep)
+        return 0
+    if args.smoke:
+        print(json.dumps(run_smoke(args.dir)), flush=True)
+        return 0
+    ap.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
