@@ -431,9 +431,26 @@ def main():
                     help="capture a jax device profile of the final "
                          "stage into this directory (TensorBoard/"
                          "Perfetto viewable)")
+    ap.add_argument("--heartbeat", type=str, default="",
+                    help="write a liveness heartbeat.json to this path "
+                         "(or directory) so an external watcher can "
+                         "tell a hung relay from a slow stage")
     args = ap.parse_args()
 
     t_start = time.perf_counter()
+    wd = None
+    if args.heartbeat:
+        from ibamr_tpu.utils.watchdog import RunWatchdog
+
+        # generous floor: a remote-compile stall is minutes, a 256^3
+        # XLA compile can legitimately be too — the watcher's kill
+        # policy lives outside, this only keeps the file honest
+        wd = RunWatchdog(heartbeat_path=args.heartbeat, interval_s=5.0,
+                         stall_factor=4.0, min_stall_s=300.0,
+                         on_stall=lambda rec: log(
+                             f"[bench] WATCHDOG STALL: {rec}"))
+        wd.start()
+        wd.beat(step=0)
     result = {
         "metric": f"IB/explicit/ex4 3D shell {args.n}^3: timesteps/sec",
         "value": 0.0,
@@ -517,6 +534,7 @@ def main():
                 log(f"[bench] stage n={n} markers~{n_lat * n_lon} ...")
                 from ibamr_tpu.utils.timers import profile_trace
 
+                t_stage = time.perf_counter()
                 with profile_trace(args.profile if n == args.n else ""):
                     # the ramp pins the BUCKETED-MXU engine: it has been
                     # the staged baseline since round 1, and keeping it
@@ -529,6 +547,10 @@ def main():
                                       use_fast=True)
                 log(f"[bench] stage n={n}: {stage['steps_per_sec']} "
                     "steps/s")
+                if wd is not None:
+                    wd.beat(step=len(result["stages"]) + 1,
+                            last_chunk_wall_s=(time.perf_counter()
+                                               - t_stage))
                 stage["platform"] = platform  # stages can straddle a
                 # mid-run CPU->TPU upgrade; label each measurement
                 result["stages"].append(stage)
@@ -558,12 +580,17 @@ def main():
                                   "(deadline)")
                     continue
                 try:
+                    t_leg = time.perf_counter()
                     st = run_engine_leg(jax, label, label, args.n,
                                         args.n_lat, args.n_lon, args,
                                         t_start, platform)
                     st["platform"] = platform
                     log(f"[bench] flagship {label}: "
                         f"{st['steps_per_sec']} steps/s")
+                    if wd is not None:
+                        wd.beat(step=len(result["stages"]) + 1,
+                                last_chunk_wall_s=(time.perf_counter()
+                                                   - t_leg))
                     result["stages"].append(st)
                     if st["steps_per_sec"] > result["value"]:
                         result["value"] = st["steps_per_sec"]
@@ -708,6 +735,9 @@ def main():
         result["error"] = (f"{type(e).__name__}: {e}\n"
                            + traceback.format_exc()[-1500:])
 
+    if wd is not None:
+        wd.beat(step=len(result["stages"]) + 1)   # final liveness mark
+        wd.stop()
     print(json.dumps(result), flush=True)
 
 

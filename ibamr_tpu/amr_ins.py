@@ -468,6 +468,12 @@ class CompositeProjection:
         # ibamr_tpu.solvers.fac.FACCompositePoisson) replacing the
         # default FFT+fastdiag level-solver combination
         self._external_precond = preconditioner
+        # convergence surfacing: eager projections record the inner
+        # FGMRES stats here (and mirror them onto the FAC object when
+        # ``preconditioner`` is its bound method) so metrics_fn/bench
+        # can log convergence without re-running the solve
+        self.last_solve_stats = None
+        self.record_stats = False
         # GSPMD pins (parallel.mesh.make_sharded_two_level_ib_step):
         # coarse-level arrays pinned to the spatial sharding, fine-box
         # arrays pinned replicated, at EVERY level crossing — the
@@ -590,6 +596,11 @@ class CompositeProjection:
         sol = fgmres(self.operator, (rhs_c, div_f),
                      M=self._precondition, m=self.m, tol=self.tol,
                      restarts=self.restarts)
+        from ibamr_tpu.solvers.escalation import record_solve_stats
+        record_solve_stats(
+            self, sol, solver="fgmres",
+            use_callback=self.record_stats,
+            mirrors=(getattr(self._external_precond, "__self__", None),))
         phi_c, phi_f = self._pin_c(sol.x[0]), self._pin_f(sol.x[1])
         phi_eff = self._phi_eff(phi_c, phi_f)
 

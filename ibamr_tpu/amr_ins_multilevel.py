@@ -88,6 +88,11 @@ class MultiLevelCompositeProjection:
             raise ValueError("need at least 2 levels (use the uniform "
                              "integrator for L=1)")
         self._external_precond = preconditioner
+        # convergence surfacing (same contract as CompositeProjection):
+        # eager projections record the inner FGMRES stats, mirrored
+        # onto the FAC object when ``preconditioner`` is a bound method
+        self.last_solve_stats = None
+        self.record_stats = False
         self.tol = float(tol)
         self.m = int(m)
         self.restarts = int(restarts)
@@ -244,6 +249,11 @@ class MultiLevelCompositeProjection:
 
         sol = fgmres(self.operator, tuple(divs), M=self._precondition,
                      m=self.m, tol=self.tol, restarts=self.restarts)
+        from ibamr_tpu.solvers.escalation import record_solve_stats
+        record_solve_stats(
+            self, sol, solver="fgmres",
+            use_callback=self.record_stats,
+            mirrors=(getattr(self._external_precond, "__self__", None),))
         phis = self._pin_all(sol.x)
         eff = self._effective(phis)
         exts = self._extended(eff)

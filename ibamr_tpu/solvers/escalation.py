@@ -1,0 +1,208 @@
+"""Solver non-convergence surfacing + escalation (PR 3 tentpole 2).
+
+Every Krylov solve in the framework returns a ``SolveResult`` with
+``iters``/``resnorm``/``converged`` — and until this PR every
+integrator caller DISCARDED them: a Stokes solve that stagnated at
+resnorm 1e-2 fed its garbage update straight into the next timestep,
+and the first visible symptom was a NaN chunks later. This module is
+the production answer:
+
+- :func:`record_solve_stats` threads a solve's stats onto its owning
+  solver object (``last_solve_stats``) so ``metrics_fn``/bench can log
+  them WITHOUT re-running the solve. Eager solves record directly;
+  traced solves record through ``jax.debug.callback`` only when the
+  owner opted in (``record_stats=True``) — the default adds nothing to
+  jitted/sharded paths.
+- :func:`escalate_solve` walks a DECLARED fallback chain, mirroring
+  PR 2's ``ENGINE_FALLBACKS`` shape: each level names a cheap recipe
+  (more FGMRES restarts, a longer Krylov basis, a more accurate inner
+  preconditioner — the "tighter inner tol" knob) and the walk stops at
+  the first level that converges. Level 0 converging returns its
+  result untouched (bitwise the plain solve). Any walk past level 0
+  lands a structured ``solver_escalation``/``solver_breakdown``
+  incident; an exhausted chain raises :class:`SolverBreakdown`, which
+  subclasses ``SimulationDiverged`` so the PR-2 supervisor treats it
+  exactly like a divergence (rollback + dt backoff + retry).
+
+Escalation is a HOST-side loop (each attempt re-traces eagerly with
+its own static solver geometry), so it lives at the driver/setup level
+— inside a jitted step the stats surface via the callback path and the
+driver escalates between chunks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional, Sequence
+
+from ibamr_tpu.utils.hierarchy_driver import SimulationDiverged
+
+
+class SolverBreakdown(SimulationDiverged):
+    """A solve escalated through its whole declared chain and still did
+    not converge. Subclasses :class:`SimulationDiverged` so the
+    supervisor's rollback-and-retry fires unchanged (a breakdown at
+    large dt is routinely cured by the dt backoff)."""
+
+    kind = "solver_breakdown"
+
+    def __init__(self, context: str, attempts, step: Optional[int] = None):
+        self.context = context
+        self.attempts = list(attempts)
+        self.step = -1 if step is None else step
+        self.bad_leaves: list = []
+        last = self.attempts[-1] if self.attempts else {}
+        RuntimeError.__init__(
+            self,
+            f"solver breakdown in {context!r}: escalation chain "
+            f"exhausted after {len(self.attempts)} attempts "
+            f"(last level {last.get('level')!r}, resnorm "
+            f"{last.get('resnorm')})")
+
+    def incident_payload(self) -> dict:
+        return {"context": self.context, "attempts": self.attempts}
+
+
+# ---------------------------------------------------------------------------
+# stats surfacing
+# ---------------------------------------------------------------------------
+
+def _is_tracer(x) -> bool:
+    import jax
+
+    return isinstance(x, jax.core.Tracer)
+
+
+def solve_stats_dict(sol, solver: str = "", level: str = "") -> dict:
+    """Host-side dict from an (already concrete) SolveResult-like."""
+    rec = {"iters": int(sol.iters), "resnorm": float(sol.resnorm),
+           "converged": bool(sol.converged)}
+    if solver:
+        rec["solver"] = solver
+    if level:
+        rec["level"] = level
+    return rec
+
+
+def record_solve_stats(sink, sol, solver: str = "",
+                       use_callback: bool = False,
+                       mirrors: Sequence = ()) -> None:
+    """Store ``{iters, resnorm, converged, solver}`` as
+    ``sink.last_solve_stats`` (and on every object in ``mirrors``).
+
+    Eager values are stored synchronously. Traced values (the solve is
+    running inside jit) are recorded through ``jax.debug.callback``
+    when ``use_callback`` is set — fired per execution, host-ordered,
+    no added device sync — and silently skipped otherwise, so jitted
+    and SPMD-sharded paths pay nothing unless the owner opted in.
+    """
+    sinks = (sink,) + tuple(m for m in mirrors if m is not None)
+    if not any(_is_tracer(v) for v in (sol.iters, sol.resnorm,
+                                       sol.converged)):
+        rec = solve_stats_dict(sol, solver)
+        for s in sinks:
+            s.last_solve_stats = rec
+        return
+    if not use_callback:
+        return
+    import jax
+
+    def _tap(iters, resnorm, converged):
+        rec = {"iters": int(iters), "resnorm": float(resnorm),
+               "converged": bool(converged)}
+        if solver:
+            rec["solver"] = solver
+        for s in sinks:
+            s.last_solve_stats = rec
+
+    jax.debug.callback(_tap, sol.iters, sol.resnorm, sol.converged)
+
+
+# ---------------------------------------------------------------------------
+# the declared escalation chain (the ENGINE_FALLBACKS shape)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class EscalationLevel:
+    """One link of a solve escalation chain. The scales multiply the
+    base solve's geometry; ``inner_scale`` deepens whatever inner
+    accuracy knob the owner exposes (preconditioner sweeps / inner
+    tolerance — the attempt_fn decides what it means)."""
+
+    name: str
+    restarts_scale: int = 1
+    m_scale: int = 1
+    maxiter_scale: int = 1
+    inner_scale: int = 1
+
+
+ESCALATION_LEVELS: Dict[str, EscalationLevel] = {
+    "base": EscalationLevel("base"),
+    "restarts_x4": EscalationLevel("restarts_x4", restarts_scale=4),
+    "deep_x4_inner_x2": EscalationLevel(
+        "deep_x4_inner_x2", restarts_scale=4, m_scale=2, inner_scale=2),
+}
+
+# name -> next link (None terminates), mirroring ENGINE_FALLBACKS: one
+# flat registry, chains derived by walking it, no cycles by inspection
+ESCALATION_FALLBACKS: Dict[str, Optional[str]] = {
+    "base": "restarts_x4",
+    "restarts_x4": "deep_x4_inner_x2",
+    "deep_x4_inner_x2": None,
+}
+
+
+def escalation_chain(name: str = "base"):
+    """The escalation order starting AT ``name`` (inclusive). Raises
+    KeyError for unknown level names."""
+    cur: Optional[str] = name
+    if cur not in ESCALATION_LEVELS:
+        raise KeyError(f"unknown escalation level {name!r}; known: "
+                       f"{sorted(ESCALATION_LEVELS)}")
+    chain = []
+    while cur is not None:
+        chain.append(ESCALATION_LEVELS[cur])
+        cur = ESCALATION_FALLBACKS[cur]
+    return chain
+
+
+def escalate_solve(attempt_fn: Callable, *, context: str = "solve",
+                   chain=None, on_incident: Optional[Callable] = None,
+                   step: Optional[int] = None):
+    """Walk the chain until an attempt converges.
+
+    ``attempt_fn(level: EscalationLevel, attempt: int) -> SolveResult``
+    runs one EAGER solve at that level's geometry. The first converged
+    attempt wins; level 0 converging returns its result with no extra
+    work (bitwise the plain solve). Escalations past level 0 are
+    reported to ``on_incident`` as one structured record::
+
+        {"event": "solver_escalation"|"solver_breakdown",
+         "kind": "solver_breakdown", "context": ...,
+         "recovered": bool, "level": <winning level or None>,
+         "attempts": [{level, iters, resnorm, converged}, ...]}
+
+    and an exhausted chain raises :class:`SolverBreakdown` carrying the
+    same attempts list.
+    """
+    chain = escalation_chain() if chain is None else list(chain)
+    if not chain:
+        raise ValueError("escalation chain must have at least one level")
+    attempts = []
+    for i, level in enumerate(chain):
+        sol = attempt_fn(level, i)
+        rec = solve_stats_dict(sol, level=level.name)
+        attempts.append(rec)
+        if rec["converged"]:
+            if i > 0 and on_incident is not None:
+                on_incident({"event": "solver_escalation",
+                             "kind": "solver_breakdown",
+                             "context": context, "recovered": True,
+                             "level": level.name, "attempts": attempts})
+            return sol
+    if on_incident is not None:
+        on_incident({"event": "solver_breakdown",
+                     "kind": "solver_breakdown", "context": context,
+                     "recovered": False, "level": None,
+                     "attempts": attempts})
+    raise SolverBreakdown(context, attempts, step=step)

@@ -125,14 +125,23 @@ def bicgstab(A: Operator, b: Pytree, x0: Optional[Pytree] = None,
     r0 = tree_sub(b, A(x0))
     rhat = r0
     one = jnp.asarray(1.0, dtype=jnp.result_type(*jax.tree_util.tree_leaves(b)))
+    rn0 = jnp.sqrt(tree_dot(r0, r0))
 
+    # Same finite-precision divergence guard as ``cg`` (round 4), which
+    # BiCGStab never received: its recurred residual is even less
+    # trustworthy than CG's (the stabilizer omega can all but vanish),
+    # so below the dtype floor the iterate wanders while the recurrence
+    # reports progress. Carry the best iterate; stop once the residual
+    # has grown far past the best; return the BEST iterate only when
+    # the solve did not converge — converged solves keep the exact
+    # pre-guard path (bitwise-identical result).
     def cond(st):
-        x, r, p, v, rho, alpha, omega, k = st
-        rn = jnp.sqrt(tree_dot(r, r))
-        return jnp.logical_and(k < maxiter, rn > stop)
+        x, r, p, v, rho, alpha, omega, k, rn, xb, rb = st
+        ok = jnp.logical_and(k < maxiter, rn > stop)
+        return jnp.logical_and(ok, rn <= 1e4 * rb)
 
     def body(st):
-        x, r, p, v, rho, alpha, omega, k = st
+        x, r, p, v, rho, alpha, omega, k, _, xb, rb = st
         rho_new = tree_dot(rhat, r)
         denom = jnp.where(rho * omega == 0, 1.0, rho * omega)
         beta = (rho_new / denom) * (alpha / jnp.where(omega == 0, 1.0, omega))
@@ -148,13 +157,23 @@ def bicgstab(A: Operator, b: Pytree, x0: Optional[Pytree] = None,
         omega = tree_dot(t, s) / jnp.where(tt == 0, 1.0, tt)
         x = tree_axpy(alpha, phat, tree_axpy(omega, shat, x))
         r = tree_axpy(-omega, t, s)
-        return (x, r, p, v, rho_new, alpha, omega, k + 1)
+        rn = jnp.sqrt(tree_dot(r, r))    # carried: cond reuses it
+        better = rn < rb
+        xb = jax.tree_util.tree_map(
+            lambda a_, b_: jnp.where(better, a_, b_), x, xb)
+        rb = jnp.minimum(rb, rn)
+        return (x, r, p, v, rho_new, alpha, omega, k + 1, rn, xb, rb)
 
     zeros = jax.tree_util.tree_map(jnp.zeros_like, b)
-    x, r, _, _, _, _, _, k = jax.lax.while_loop(
-        cond, body, (x0, r0, zeros, zeros, one, one, one, jnp.asarray(0)))
-    rn = jnp.sqrt(tree_dot(r, r))
-    return SolveResult(x=x, iters=k, resnorm=rn, converged=rn <= stop)
+    x, r, _, _, _, _, _, k, rn, xb, rb = jax.lax.while_loop(
+        cond, body, (x0, r0, zeros, zeros, one, one, one,
+                     jnp.asarray(0), rn0, x0, rn0))
+    converged = rn <= stop
+    use_best = jnp.logical_and(~converged, rb < rn)
+    x = jax.tree_util.tree_map(
+        lambda a_, b_: jnp.where(use_best, a_, b_), xb, x)
+    rn = jnp.where(use_best, rb, rn)
+    return SolveResult(x=x, iters=k, resnorm=rn, converged=converged)
 
 
 # ---------------------------------------------------------------------------

@@ -2,6 +2,9 @@ from ibamr_tpu.utils.input_db import InputDatabase, parse_input_file, parse_inpu
 from ibamr_tpu.utils.gridfunctions import CartGridFunction
 from ibamr_tpu.utils.timers import TimerManager, timer
 from ibamr_tpu.utils.metrics import MetricsLogger
+from ibamr_tpu.utils.health import HealthDegraded, HealthProbe
+from ibamr_tpu.utils.watchdog import (RunWatchdog, heartbeat_age,
+                                      read_heartbeat)
 
 __all__ = [
     "InputDatabase",
@@ -11,4 +14,9 @@ __all__ = [
     "TimerManager",
     "timer",
     "MetricsLogger",
+    "HealthDegraded",
+    "HealthProbe",
+    "RunWatchdog",
+    "heartbeat_age",
+    "read_heartbeat",
 ]

@@ -1,0 +1,271 @@
+"""In-flight health vitals: detect degradation BEFORE the NaN (PR 3).
+
+PR 2's recovery machinery only fires on the *loud* failure — a state
+leaf that already went non-finite. The expensive silent failure is the
+run that is still finite but already lost: velocity growing
+exponentially, CFL creeping past stability, divergence error
+compounding. By the time ``_finite_flag`` trips, the newest checkpoints
+may already hold garbage-but-finite states and the supervisor pays a
+full ``max_retries`` cycle to find a good one.
+
+:class:`HealthProbe` closes that gap. The jit side (:meth:`measure`)
+reduces the state to a small fixed vector of physics vitals INSIDE the
+driver's scan chunk, so the per-chunk host cost stays exactly one small
+device->host transfer (the same sync the old single finite bool paid —
+pinned by ``HierarchyDriver.trace_counts``). The host side
+(:meth:`classify` / :meth:`check`) applies thresholds, classifies the
+chunk OK / WARN / FATAL, and raises :class:`HealthDegraded` — a
+:class:`SimulationDiverged` *precursor* — on FATAL or on a sustained
+WARN streak, while the state is still finite and the rollback is cheap.
+
+Vitals vector schema (fixed order, ``VITALS_FIELDS``):
+
+====  ============  =====================================================
+idx   field         meaning
+====  ============  =====================================================
+0     ``finite``    1.0 iff every floating state leaf is all-finite
+1     ``max_u``     max |u| over the velocity components (0 if no vel)
+2     ``cfl``       realized advective CFL: max_u * dt / min(dx)
+3     ``div_norm``  max |div u| (0 when no divergence functional given)
+4     ``func``      caller-supplied energy/volume functional (NaN = none)
+====  ============  =====================================================
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+from ibamr_tpu.utils.hierarchy_driver import SimulationDiverged, _finite_flag
+
+OK = "ok"
+WARN = "warn"
+FATAL = "fatal"
+
+VITALS_FIELDS = ("finite", "max_u", "cfl", "div_norm", "func")
+
+
+class HealthDegraded(SimulationDiverged):
+    """Precursor divergence: the state is still FINITE but the vitals
+    crossed a FATAL threshold or sustained a WARN streak. Subclassing
+    :class:`SimulationDiverged` means the whole PR-2 recovery machinery
+    (``ResilientDriver`` rollback + dt backoff + incident record) fires
+    unchanged — but with a cheap recovery, because every checkpoint on
+    disk still predates any non-finite value."""
+
+    kind = "health_degraded"
+
+    def __init__(self, step: int, reasons, vitals: dict):
+        self.step = step
+        self.reasons = list(reasons)
+        self.vitals = dict(vitals)
+        self.bad_leaves: list = []      # nothing is non-finite (yet)
+        RuntimeError.__init__(
+            self,
+            f"health degraded by step {step}: {'; '.join(self.reasons)} "
+            f"(vitals {self.vitals}) — rolling back while the state is "
+            f"still finite")
+
+    def incident_payload(self) -> dict:
+        return {"reasons": self.reasons, "vitals": self.vitals}
+
+
+@dataclasses.dataclass
+class HealthProbe:
+    """Fused in-flight vitals probe + host-side triage.
+
+    Jit side: :meth:`measure(state, dt)` returns a fixed float32 vector
+    (``VITALS_FIELDS`` order) built from optional accessors — all must
+    be jit-traceable functions of the state:
+
+    - ``velocity_fn(state) -> tuple/list of arrays`` (default: the
+      state's ``u`` attribute when present);
+    - ``divergence_fn(state) -> array or scalar`` (max |.| is taken);
+    - ``functional_fn(state) -> scalar`` — the caller's conserved-ish
+      quantity (kinetic energy, phase volume, ...), the signal the
+      growth triage watches.
+
+    Host side: :meth:`check(vitals, step, dt)` classifies the chunk and
+    raises :class:`HealthDegraded` on FATAL, or after ``sustain``
+    consecutive WARN chunks. ``None`` thresholds are disabled. The
+    functional baseline is the first finite functional value observed
+    (reset only by :meth:`reset`), so "growth beyond a configured
+    factor" means growth over the run's OWN starting value, not an
+    absolute scale the caller would have to guess.
+    """
+
+    velocity_fn: Optional[Callable[[Any], Any]] = None
+    divergence_fn: Optional[Callable[[Any], Any]] = None
+    functional_fn: Optional[Callable[[Any], Any]] = None
+    min_dx: Optional[float] = None       # needed for the CFL vital
+    # thresholds (None = that check disabled)
+    max_u_warn: Optional[float] = None
+    max_u_fatal: Optional[float] = None
+    cfl_warn: Optional[float] = None
+    cfl_fatal: Optional[float] = None
+    div_warn: Optional[float] = None
+    div_fatal: Optional[float] = None
+    func_growth_warn: Optional[float] = None    # factor over baseline
+    func_growth_fatal: Optional[float] = None
+    sustain: int = 3                     # WARN chunks before escalation
+
+    def __post_init__(self):
+        if self.sustain < 1:
+            raise ValueError("sustain must be >= 1 (a WARN streak of "
+                             "zero chunks would fire immediately)")
+        self._warn_streak = 0
+        self._baseline_func: Optional[float] = None
+        self.history: List[dict] = []    # one record per classified chunk
+        self.last: Optional[dict] = None
+
+    # -- construction helpers ----------------------------------------------
+
+    @classmethod
+    def for_integrator(cls, integ, **kw) -> "HealthProbe":
+        """Probe wired to the framework's integrator conventions: MAC
+        velocity at ``state.u``, divergence via the shared stencils,
+        kinetic energy as the default functional. Any explicit kwarg
+        wins over the derived default."""
+        from ibamr_tpu.ops import stencils
+
+        grid = getattr(integ, "grid", None)
+        if grid is None:
+            ins = getattr(integ, "ins", None)
+            grid = getattr(ins, "grid", None)
+        if grid is not None:
+            kw.setdefault("min_dx", float(min(grid.dx)))
+            dx = grid.dx
+            kw.setdefault("divergence_fn",
+                          lambda s: stencils.divergence(s.u, dx))
+        if hasattr(integ, "kinetic_energy"):
+            kw.setdefault("functional_fn", integ.kinetic_energy)
+        return cls(**kw)
+
+    # -- jit side ------------------------------------------------------------
+
+    def measure(self, state, dt):
+        """Fixed-shape vitals vector (float32, len 5); fully traceable.
+        Meant to be called INSIDE the driver's jitted chunk so the whole
+        reduction fuses with the step scan."""
+        import jax.numpy as jnp
+
+        finite = _finite_flag(state).astype(jnp.float32)
+
+        vel = (self.velocity_fn(state) if self.velocity_fn is not None
+               else getattr(state, "u", None))
+        if vel is not None:
+            comps = vel if isinstance(vel, (tuple, list)) else (vel,)
+            max_u = jnp.asarray(0.0, jnp.float32)
+            for c in comps:
+                max_u = jnp.maximum(max_u,
+                                    jnp.max(jnp.abs(c)).astype(jnp.float32))
+        else:
+            max_u = jnp.asarray(0.0, jnp.float32)
+
+        if self.min_dx is not None:
+            cfl = max_u * jnp.asarray(dt, jnp.float32) \
+                / jnp.asarray(self.min_dx, jnp.float32)
+        else:
+            cfl = jnp.asarray(0.0, jnp.float32)
+
+        if self.divergence_fn is not None:
+            div = jnp.max(jnp.abs(self.divergence_fn(state)))
+            div = div.astype(jnp.float32)
+        else:
+            div = jnp.asarray(0.0, jnp.float32)
+
+        if self.functional_fn is not None:
+            func = jnp.asarray(self.functional_fn(state),
+                               jnp.float32).reshape(())
+        else:
+            func = jnp.asarray(jnp.nan, jnp.float32)
+
+        return jnp.stack([finite, max_u, cfl, div, func])
+
+    # -- host side -----------------------------------------------------------
+
+    @staticmethod
+    def unpack(vitals) -> dict:
+        v = np.asarray(vitals, dtype=np.float64).reshape(-1)
+        return {name: float(v[i]) for i, name in enumerate(VITALS_FIELDS)}
+
+    def classify(self, vitals, step: int, dt: float):
+        """Host-side triage of one chunk's vitals vector. Returns
+        ``(level, reasons, vit_dict)`` with level in {OK, WARN, FATAL}
+        and updates the WARN streak / functional baseline / history.
+        A non-finite chunk is the caller's business (the driver raises
+        plain :class:`SimulationDiverged` for it) and is reported FATAL
+        here for completeness."""
+        vit = self.unpack(vitals)
+        reasons: List[str] = []
+        level = OK
+
+        def _flag(lvl, msg):
+            nonlocal level
+            reasons.append(msg)
+            if lvl == FATAL or level == FATAL:
+                level = FATAL
+            else:
+                level = WARN
+
+        if vit["finite"] < 1.0:
+            _flag(FATAL, "non-finite state leaves")
+
+        for name, warn, fatal in (
+                ("max_u", self.max_u_warn, self.max_u_fatal),
+                ("cfl", self.cfl_warn, self.cfl_fatal),
+                ("div_norm", self.div_warn, self.div_fatal)):
+            val = vit[name]
+            if fatal is not None and val > fatal:
+                _flag(FATAL, f"{name}={val:.4g} > fatal {fatal:.4g}")
+            elif warn is not None and val > warn:
+                _flag(WARN, f"{name}={val:.4g} > warn {warn:.4g}")
+
+        func = vit["func"]
+        if math.isfinite(func):
+            if self._baseline_func is None:
+                self._baseline_func = func
+            base = self._baseline_func
+            scale = abs(base) if base != 0.0 else 1.0
+            growth = abs(func) / scale
+            vit["func_growth"] = growth
+            if (self.func_growth_fatal is not None
+                    and growth > self.func_growth_fatal):
+                _flag(FATAL, f"functional grew {growth:.3g}x over "
+                             f"baseline (fatal {self.func_growth_fatal:g}x)")
+            elif (self.func_growth_warn is not None
+                    and growth > self.func_growth_warn):
+                _flag(WARN, f"functional grew {growth:.3g}x over "
+                            f"baseline (warn {self.func_growth_warn:g}x)")
+        elif self.functional_fn is not None and vit["finite"] >= 1.0:
+            _flag(FATAL, "functional is non-finite")
+
+        self._warn_streak = self._warn_streak + 1 if level != OK else 0
+        rec = dict(vit, step=int(step), dt=float(dt), level=level,
+                   warn_streak=self._warn_streak, reasons=list(reasons))
+        self.last = rec
+        self.history.append(rec)
+        return level, reasons, vit
+
+    def check(self, vitals, step: int, dt: float) -> dict:
+        """Classify and ESCALATE: raises :class:`HealthDegraded` on a
+        FATAL chunk or once ``sustain`` consecutive chunks came back
+        WARN. Returns the host-side vitals record otherwise. The WARN
+        streak resets on raise, so a supervised retry starts from a
+        clean slate (the functional baseline persists — the retry
+        resumes the same trajectory)."""
+        level, reasons, vit = self.classify(vitals, step, dt)
+        fire = level == FATAL or (level == WARN
+                                  and self._warn_streak >= self.sustain)
+        if fire and vit["finite"] >= 1.0:
+            self._warn_streak = 0
+            raise HealthDegraded(step, reasons, vit)
+        return self.last
+
+    def reset(self):
+        """Forget streaks AND the functional baseline (a new run)."""
+        self._warn_streak = 0
+        self._baseline_func = None

@@ -22,14 +22,23 @@ the restart chain.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 class SimulationDiverged(RuntimeError):
-    """Raised when the state stops being finite; carries diagnostics."""
+    """Raised when the state stops being finite; carries diagnostics.
+
+    ``kind`` tags the incident-schema-v2 record the supervisor writes
+    (subclasses: ``health_degraded`` precursor in utils/health.py,
+    ``solver_breakdown`` in solvers/escalation.py);
+    ``incident_payload()`` contributes subclass-specific fields."""
+
+    kind = "divergence"
 
     def __init__(self, step: int, bad_leaves):
         self.step = step
@@ -39,6 +48,9 @@ class SimulationDiverged(RuntimeError):
             f"simulation diverged by step {step}: non-finite values in "
             f"state leaves [{names}] — no checkpoint written for the "
             f"broken state")
+
+    def incident_payload(self) -> dict:
+        return {}
 
 
 def _finite_flag(state) -> jnp.ndarray:
@@ -76,10 +88,32 @@ class RunConfig:
     cfl: Optional[float] = None       # recompute dt each chunk if set
 
     def __post_init__(self):
+        # Fail-fast input validation: a bad input file must die HERE
+        # with the offending field named, not produce a zero-length
+        # scan or a silent no-op run hours later.
+        if not (self.dt > 0):            # also rejects NaN dt
+            raise ValueError(
+                f"RunConfig.dt must be > 0, got {self.dt!r} (a non-"
+                f"positive or NaN timestep silently freezes the run)")
+        if self.num_steps < 0:
+            raise ValueError(
+                f"RunConfig.num_steps must be >= 0, got "
+                f"{self.num_steps!r}")
+        for name in ("viz_dump_interval", "restart_interval",
+                     "regrid_interval"):
+            val = getattr(self, name)
+            if val < 0:
+                raise ValueError(
+                    f"RunConfig.{name} must be >= 0 (0 = off), got "
+                    f"{val!r} — a negative cadence is a typo'd input "
+                    f"file, not a request")
         if self.health_interval < 1:
             raise ValueError(
                 "health_interval is the steps-per-chunk granularity and "
                 "must be >= 1 (the divergence guard cannot be disabled)")
+        if self.cfl is not None and not (self.cfl > 0):
+            raise ValueError(
+                f"RunConfig.cfl must be > 0 when set, got {self.cfl!r}")
 
 
 class HierarchyDriver:
@@ -95,6 +129,13 @@ class HierarchyDriver:
     - ``regrid_fn(state, step) -> state`` at the regrid cadence
       (host-side retagging — may rebuild sharded placement);
     - ``checkpoint_fn(state, step)`` at the restart cadence.
+
+    ``health_probe`` (a :class:`ibamr_tpu.utils.health.HealthProbe`)
+    upgrades the per-chunk finite bool to the fused vitals vector at
+    the SAME one-transfer-per-chunk cost: the probe's ``measure`` runs
+    inside the jitted chunk, its ``check`` triages on the host and
+    raises ``HealthDegraded`` (a ``SimulationDiverged`` precursor)
+    before any cadence callback sees the degraded state.
     """
 
     def __init__(self, integ, cfg: RunConfig,
@@ -104,7 +145,8 @@ class HierarchyDriver:
                  checkpoint_fn: Optional[Callable] = None,
                  step_fn: Optional[Callable] = None,
                  timer=None,
-                 timer_name: str = "HierarchyIntegrator::advanceHierarchy"):
+                 timer_name: str = "HierarchyIntegrator::advanceHierarchy",
+                 health_probe=None):
         self.integ = integ
         self.cfg = cfg
         self.viz_fn = viz_fn
@@ -113,6 +155,9 @@ class HierarchyDriver:
         self.checkpoint_fn = checkpoint_fn
         self.timer = timer                 # TimerManager: scopes ONLY the
         self.timer_name = timer_name       # jitted advance, not callbacks
+        self.health_probe = health_probe
+        self.last_vitals = None            # host dict of the last chunk
+        self.last_chunk_wall_s = None      # wall seconds incl. the sync
         self.history = []
         self._base_step = (step_fn if step_fn is not None
                            else integ.step)
@@ -141,6 +186,7 @@ class HierarchyDriver:
             # history, callbacks) for the cache entry's lifetime
             counts = self.trace_counts
             sigs = self._trace_sigs
+            probe = self.health_probe
 
             def chunk(state, dt):
                 # runs at TRACE time only: record the input signature;
@@ -160,6 +206,11 @@ class HierarchyDriver:
                     return base_step(s, dt), None
 
                 out, _ = jax.lax.scan(body, state, None, length=n)
+                # the vitals vector replaces the single finite bool at
+                # the SAME one-transfer-per-chunk cost: both fuse into
+                # the scan's output and cross to the host once
+                if probe is not None:
+                    return out, probe.measure(out, dt)
                 return out, _finite_flag(out)
 
             self._chunks[n] = jax.jit(chunk)
@@ -183,15 +234,28 @@ class HierarchyDriver:
             n = min(cfg.health_interval, cfg.num_steps - step)
             for i in cadences:               # land exactly on cadences
                 n = min(n, i - step % i)
+            probe = self.health_probe
+            t0 = time.perf_counter()
             if self.timer is not None:
                 with self.timer.scope(self.timer_name):
-                    state, finite = self._chunk(n)(state, dt)
-                    finite = bool(finite)    # device sync inside scope
+                    state, health = self._chunk(n)(state, dt)
+                    # one device sync per chunk (inside the scope):
+                    # either the finite bool or the fused vitals vector
+                    health = np.asarray(health)
             else:
-                state, finite = self._chunk(n)(state, dt)
-                finite = bool(finite)
+                state, health = self._chunk(n)(state, dt)
+                health = np.asarray(health)
+            self.last_chunk_wall_s = time.perf_counter() - t0
+            finite = bool(health[0] >= 1.0) if probe is not None \
+                else bool(health)
             if not finite:
                 raise SimulationDiverged(step + n, _bad_leaf_names(state))
+            if probe is not None:
+                # host-side triage; raises HealthDegraded (the
+                # SimulationDiverged precursor) BEFORE any cadence
+                # callback can checkpoint the degraded state
+                self.last_vitals = probe.check(health, step=step + n,
+                                               dt=dt)
             step += n
 
             if self.metrics_fn is not None:

@@ -83,7 +83,8 @@ class ResilientDriver:
                  max_retries: int = 3, dt_backoff: float = 0.5,
                  keep: int = 3, sharding_fn: Optional[Callable] = None,
                  handle_signals: bool = True,
-                 incident_log: Optional[str] = None):
+                 incident_log: Optional[str] = None,
+                 watchdog=None):
         if max_retries < 0:
             raise ValueError("max_retries must be >= 0")
         if not (0.0 < dt_backoff <= 1.0):
@@ -98,6 +99,12 @@ class ResilientDriver:
         self.incident_log = incident_log or os.path.join(
             checkpoint_dir, "incidents.jsonl")
         self.incidents = []           # in-memory mirror of the JSONL
+        # optional RunWatchdog (utils/watchdog.py): the supervisor
+        # feeds it a beat per chunk and points its incident sink here,
+        # so a stalled chunk lands in the same incidents.jsonl
+        self.watchdog = watchdog
+        if watchdog is not None and watchdog.on_incident is None:
+            watchdog.on_incident = self._record
         self.preempted = False
         self.preempt_signum: Optional[int] = None
         self._last: Optional[tuple] = None   # (state, step) post-chunk
@@ -150,6 +157,11 @@ class ResilientDriver:
             # per-chunk hook: remember the last HEALTHY state — the
             # driver raises on divergence before this runs
             self._last = (s, k)
+            if self.watchdog is not None:
+                self.watchdog.beat(
+                    step=k,
+                    last_chunk_wall_s=getattr(driver,
+                                              "last_chunk_wall_s", None))
             return user_metrics(s, k) if user_metrics is not None else None
 
         driver.checkpoint_fn = ckpt_fn
@@ -167,6 +179,8 @@ class ResilientDriver:
 
         retries = 0
         cur_state, cur_step = state, start_step
+        if self.watchdog is not None:
+            self.watchdog.start()
         try:
             while True:
                 try:
@@ -174,13 +188,20 @@ class ResilientDriver:
                     writer.wait()      # every interval durably on disk
                     return out
                 except SimulationDiverged as e:
+                    # incident schema v2: ``kind`` discriminates the
+                    # failure family (divergence | health_degraded |
+                    # solver_breakdown), subclass payloads ride along
+                    kind = getattr(e, "kind", "divergence")
+                    payload = e.incident_payload() \
+                        if hasattr(e, "incident_payload") else {}
                     dt_before = driver.cfg.dt
                     if retries >= self.max_retries:
-                        self._record({
-                            "event": "give_up", "step": e.step,
+                        self._record(dict(payload, **{
+                            "event": "give_up", "kind": kind,
+                            "step": e.step,
                             "bad_leaves": list(e.bad_leaves),
                             "retries": retries,
-                            "dt": dt_before})
+                            "dt": dt_before}))
                         raise
                     retries += 1
                     try:
@@ -190,15 +211,16 @@ class ResilientDriver:
                     cur_state, cur_step, ck = self._rollback(initial[0],
                                                              initial)
                     driver.cfg.dt = dt_before * self.dt_backoff
-                    self._record({
-                        "event": "divergence", "step": e.step,
+                    self._record(dict(payload, **{
+                        "event": "divergence", "kind": kind,
+                        "step": e.step,
                         "bad_leaves": list(e.bad_leaves),
                         "retry": retries,
                         "max_retries": self.max_retries,
                         "rollback_step": cur_step,
                         "from_checkpoint": ck is not None,
                         "dt_before": dt_before,
-                        "dt_after": driver.cfg.dt})
+                        "dt_after": driver.cfg.dt}))
         except PreemptionSignal as e:
             self.preempted = True
             self.preempt_signum = e.signum
@@ -215,6 +237,8 @@ class ResilientDriver:
                 "step": k, "checkpoint_step": k})
             return st
         finally:
+            if self.watchdog is not None:
+                self.watchdog.stop()
             driver.checkpoint_fn = user_ckpt
             driver.metrics_fn = user_metrics
             for sig, h in old_handlers.items():

@@ -227,6 +227,9 @@ SLOW_TESTS = {
     "test_dam_break_restart_continuation",
     # PR 2 (resilience): subprocess SIGKILL drill spawns 4 interpreters
     "test_kill_mid_write_loses_at_most_one_interval",
+    # PR 3 (silent failures): real-sleep stall drill — wall-clock
+    # timing-sensitive, so it rides the slow tier, not the dev loop
+    "test_watchdog_flags_stalled_supervised_run",
 }
 
 

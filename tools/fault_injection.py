@@ -40,6 +40,7 @@ import contextlib
 import json
 import os
 import tempfile
+import time
 
 import numpy as np
 
@@ -122,6 +123,90 @@ def nan_injector_step(step_fn, at_step: int, leaf_path: str = "u",
         if not hit:
             raise KeyError(f"no floating leaf path contains {leaf_path!r}")
         return out
+
+    return wrapped
+
+
+# ---------------------------------------------------------------------------
+# Silent-failure injectors (PR 3): finite-but-diverging growth, a
+# stagnating linear operator, and a slow host step — the three failure
+# shapes the vitals / escalation / watchdog layers each exist to catch
+# ---------------------------------------------------------------------------
+
+def growth_injector_step(step_fn, rate: float = 1.5,
+                         leaf_path: str = "u",
+                         dt_gate: float | None = None):
+    """Wrap ``step_fn(state, dt) -> state`` so every floating leaf
+    matching ``leaf_path`` is multiplied by ``rate`` per step — a
+    FINITE exponential blow-up, the silent failure the plain finite
+    flag cannot see until checkpoints already hold garbage. jit/scan
+    safe (the factor is a traced ``jnp.where``).
+
+    ``dt_gate`` arms the growth only while ``dt >= dt_gate``, so the
+    supervisor's dt backoff cures it — modelling an instability whose
+    growth rate a smaller timestep tames.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def wrapped(state, dt):
+        out = step_fn(state, dt)
+        fire = jnp.asarray(True) if dt_gate is None \
+            else jnp.asarray(dt) >= dt_gate
+        hit = []
+
+        def _grow(path, leaf):
+            key = jax.tree_util.keystr(path)
+            if leaf_path in key and hasattr(leaf, "dtype") \
+                    and jnp.issubdtype(leaf.dtype, jnp.floating):
+                hit.append(key)
+                factor = jnp.where(fire, jnp.asarray(rate, leaf.dtype),
+                                   jnp.asarray(1.0, leaf.dtype))
+                return leaf * factor
+            return leaf
+
+        out = jax.tree_util.tree_map_with_path(_grow, out)
+        if not hit:
+            raise KeyError(f"no floating leaf path contains {leaf_path!r}")
+        return out
+
+    return wrapped
+
+
+def stagnating_operator(A, direction=None):
+    """Wrap a pytree linear operator so it is SINGULAR along
+    ``direction`` (default: the all-ones pytree): the wrapper projects
+    the input off that direction before applying ``A``, so any rhs with
+    a component outside the crippled range leaves a residual floor no
+    Krylov iteration can pass — a deterministic stagnating solve (the
+    escalation chain walks, every level fails, ``SolverBreakdown``).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ibamr_tpu.solvers.krylov import tree_axpy, tree_dot
+
+    def wrapped(x):
+        e = direction if direction is not None \
+            else jax.tree_util.tree_map(jnp.ones_like, x)
+        coef = tree_dot(e, x) / tree_dot(e, e)
+        return A(tree_axpy(-coef, e, x))
+
+    return wrapped
+
+
+def slow_metrics(sleep_s: float, at_steps=None, metrics_fn=None):
+    """A ``metrics_fn`` wrapper that sleeps ``sleep_s`` on the host —
+    the watchdog drill's stalled chunk (from the outside a hung compile
+    / dead relay and a sleeping callback look identical: no beat).
+    ``at_steps`` limits the stall to the named post-chunk steps
+    (``None`` = every chunk)."""
+    at = None if at_steps is None else {int(s) for s in at_steps}
+
+    def wrapped(state, step):
+        if at is None or int(step) in at:
+            time.sleep(sleep_s)
+        return metrics_fn(state, step) if metrics_fn is not None else None
 
     return wrapped
 
@@ -340,11 +425,164 @@ def run_smoke(directory: str | None = None) -> dict:
             tmp.cleanup()
 
 
+def run_silent_smoke(directory: str | None = None) -> dict:
+    """Deterministic end-to-end SILENT-failure drill (PR 3, dryrun
+    path 17) exercising all three early-warning layers:
+
+    1. **health precursor** — a finite exponential velocity growth
+       (``growth_injector_step``, dt-gated) on a 16^2 INS run trips the
+       fused :class:`HealthProbe`'s functional-growth WARN streak; the
+       ResilientDriver rolls back and backs dt off BEFORE any
+       non-finite value ever materializes (every classified chunk must
+       report ``finite == 1``), and the run completes;
+    2. **solver escalation** — a restarted-GMRES-hostile diagonal
+       system fails at the base geometry and at restarts_x4, converges
+       at deep_x4_inner_x2 (the full declared chain walks, one
+       recovered ``solver_escalation`` incident); the same system
+       behind :func:`stagnating_operator` exhausts the chain and raises
+       ``SolverBreakdown`` with a structured incident;
+    3. **watchdog** — a slow host callback (``slow_metrics``) stalls a
+       supervised run long past the rolling chunk expectation; the
+       ResilientDriver-owned watchdog records a ``stall`` incident into
+       the same ``incidents.jsonl`` and the heartbeat file holds the
+       last REAL beat.
+
+    Raises on any failed expectation; returns a one-line JSON summary.
+    """
+    import jax.numpy as jnp
+
+    from ibamr_tpu.grid import StaggeredGrid
+    from ibamr_tpu.integrators.ins import INSStaggeredIntegrator
+    from ibamr_tpu.solvers.escalation import SolverBreakdown, escalate_solve
+    from ibamr_tpu.solvers.krylov import fgmres
+    from ibamr_tpu.utils.health import HealthProbe
+    from ibamr_tpu.utils.hierarchy_driver import HierarchyDriver, RunConfig
+    from ibamr_tpu.utils.supervisor import ResilientDriver
+    from ibamr_tpu.utils.watchdog import RunWatchdog, read_heartbeat
+
+    tmp = None
+    if directory is None:
+        tmp = tempfile.TemporaryDirectory(prefix="ibamr_silent_smoke_")
+        directory = tmp.name
+    try:
+        # -- 1. finite-blowup precursor: rollback before any NaN ------
+        g = StaggeredGrid(n=(16, 16), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+        integ = INSStaggeredIntegrator(g, rho=1.0, mu=0.05)
+        xf, yc = g.face_centers(0, jnp.float32)
+        xc, yf = g.face_centers(1, jnp.float32)
+        u = jnp.sin(2 * jnp.pi * xf) * jnp.cos(2 * jnp.pi * yc) + 0 * yc
+        v = -jnp.cos(2 * jnp.pi * xc) * jnp.sin(2 * jnp.pi * yf) + 0 * xc
+        st0 = integ.initialize(u0_arrays=(u, v))
+
+        dt0 = 1e-3
+        probe = HealthProbe.for_integrator(integ, func_growth_warn=8.0,
+                                           sustain=2)
+        cfg = RunConfig(dt=dt0, num_steps=12, restart_interval=4,
+                        health_interval=2)
+        drv = HierarchyDriver(
+            integ, cfg,
+            step_fn=growth_injector_step(integ.step, rate=1.5,
+                                         leaf_path="u",
+                                         dt_gate=dt0 * 0.99),
+            health_probe=probe)
+        health_dir = os.path.join(directory, "health")
+        sup = ResilientDriver(drv, health_dir, max_retries=2,
+                              dt_backoff=0.5, handle_signals=False)
+        out = sup.run(st0)
+        if int(out.k) != cfg.num_steps:
+            raise AssertionError(f"health drill stopped at {int(out.k)}")
+        if not bool(jnp.all(jnp.isfinite(out.u[0]))):
+            raise AssertionError("health drill finished non-finite")
+        if any(rec["finite"] < 1.0 for rec in probe.history):
+            raise AssertionError(
+                "a non-finite value materialized — the precursor fired "
+                "too late")
+        hd = [r for r in sup.incidents
+              if r["event"] == "divergence"
+              and r.get("kind") == "health_degraded"]
+        if len(hd) != 1 or hd[0]["rollback_step"] != 4:
+            raise AssertionError(f"unexpected incidents: {sup.incidents}")
+        if not hd[0].get("reasons"):
+            raise AssertionError("health incident carries no reasons")
+
+        # -- 2. solver escalation: recover, then exhaust --------------
+        w = jnp.logspace(0, 2, 48)          # restarted-GMRES-hostile
+        A = lambda x: w * x                 # noqa: E731
+        b = jnp.ones(48)
+
+        def attempt(level, _i):
+            return fgmres(A, b, m=8 * level.m_scale, tol=1e-4,
+                          restarts=1 * level.restarts_scale)
+
+        esc_incidents = []
+        sol = escalate_solve(attempt, context="silent_smoke_diag",
+                             on_incident=esc_incidents.append)
+        if not bool(sol.converged):
+            raise AssertionError("escalated solve did not converge")
+        if len(esc_incidents) != 1 \
+                or esc_incidents[0]["event"] != "solver_escalation" \
+                or not esc_incidents[0]["recovered"] \
+                or len(esc_incidents[0]["attempts"]) != 3:
+            raise AssertionError(f"unexpected escalation record: "
+                                 f"{esc_incidents}")
+
+        As = stagnating_operator(A)
+
+        def attempt_stag(level, _i):
+            return fgmres(As, b, m=8 * level.m_scale, tol=1e-4,
+                          restarts=1 * level.restarts_scale)
+
+        breakdown = None
+        try:
+            escalate_solve(attempt_stag, context="silent_smoke_stagnant",
+                           on_incident=esc_incidents.append, step=42)
+        except SolverBreakdown as e:
+            breakdown = e
+        if breakdown is None or breakdown.step != 42:
+            raise AssertionError("stagnating solve did not break down")
+        if esc_incidents[-1]["event"] != "solver_breakdown" \
+                or esc_incidents[-1]["recovered"]:
+            raise AssertionError(f"unexpected breakdown record: "
+                                 f"{esc_incidents[-1]}")
+
+        # -- 3. watchdog: the stalled chunk is an incident ------------
+        cfg2 = RunConfig(dt=dt0, num_steps=8, health_interval=2)
+        drv2 = HierarchyDriver(integ, cfg2)
+        drv2.run(st0, start_step=6)         # warm the chunk compile
+        drv2.metrics_fn = slow_metrics(1.2, at_steps={4})
+        wd_dir = os.path.join(directory, "wd")
+        wd = RunWatchdog(heartbeat_path=wd_dir, interval_s=0.05,
+                         stall_factor=3.0, min_stall_s=0.4)
+        sup2 = ResilientDriver(drv2, wd_dir, handle_signals=False,
+                               watchdog=wd)
+        sup2.run(st0)
+        stalls = [r for r in sup2.incidents if r["event"] == "stall"]
+        if not stalls or stalls[0].get("kind") != "stall":
+            raise AssertionError(f"no stall incident: {sup2.incidents}")
+        hb = read_heartbeat(os.path.join(wd_dir, "heartbeat.json"))
+        if hb is None or hb["step"] is None:
+            raise AssertionError(f"no usable heartbeat: {hb}")
+
+        return {"silent_smoke": "ok",
+                "health_rollback_step": hd[0]["rollback_step"],
+                "health_reasons": hd[0]["reasons"],
+                "escalation_recovered_level": esc_incidents[0]["level"],
+                "breakdown_attempts": len(breakdown.attempts),
+                "stall_incidents": len(stalls),
+                "heartbeat_step": hb["step"]}
+    finally:
+        if tmp is not None:
+            tmp.cleanup()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="deterministic fault-injection drills")
     ap.add_argument("--smoke", action="store_true",
                     help="run the end-to-end resilience drill")
+    ap.add_argument("--silent-smoke", action="store_true",
+                    help="run the silent-failure drill (health vitals "
+                         "+ solver escalation + watchdog)")
     ap.add_argument("--crash-child", metavar="DIR",
                     help="run the checkpoint-writer victim loop in DIR")
     ap.add_argument("--steps", type=int, default=40)
@@ -359,6 +597,9 @@ def main(argv=None) -> int:
         return 0
     if args.smoke:
         print(json.dumps(run_smoke(args.dir)), flush=True)
+        return 0
+    if args.silent_smoke:
+        print(json.dumps(run_silent_smoke(args.dir)), flush=True)
         return 0
     ap.print_help()
     return 2

@@ -25,6 +25,7 @@ import json
 import os
 import subprocess
 import sys
+import tempfile
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -49,6 +50,71 @@ def last_json_line(text: str):
     return None
 
 
+def run_bench_watched(cmd, f, env, timeout_s: float, hb_path: str,
+                      stall_after_s: float):
+    """Run the bench under heartbeat supervision.
+
+    The bench writes ``hb_path`` (its ``--heartbeat``); this loop polls
+    the file's age so a relay that drops MID-shootout surfaces as a
+    structured stall log line the moment the heartbeat goes stale —
+    instead of the old behavior (silence until the whole
+    ``--bench-timeout`` burned). A stall sustained past 3x
+    ``stall_after_s`` kills the bench early, returning the window to
+    the probe loop. Returns ``(returncode, stdout, stderr, stalled)``;
+    ``returncode`` is ``None`` when the bench was killed (stall or
+    timeout).
+    """
+    from ibamr_tpu.utils.watchdog import heartbeat_age
+
+    try:
+        os.unlink(hb_path)               # ages must not leak across runs
+    except OSError:
+        pass
+    # capture to FILES, not pipes: nobody drains a pipe while this loop
+    # sleeps, and a chatty bench stderr would fill the 64K buffer and
+    # deadlock the child mid-shootout
+    with tempfile.TemporaryFile(mode="w+") as fo, \
+            tempfile.TemporaryFile(mode="w+") as fe:
+        proc = subprocess.Popen(cmd, stdout=fo, stderr=fe, text=True,
+                                cwd=REPO, env=env)
+        t0 = time.time()
+        stalled = False
+        stall_armed = True
+        killed_reason = None
+        while proc.poll() is None:
+            if time.time() - t0 > timeout_s:
+                killed_reason = f"timeout after {timeout_s:.0f}s"
+                break
+            time.sleep(min(10.0, stall_after_s / 3.0))
+            age = heartbeat_age(hb_path)
+            if age is None:
+                continue                 # bench not far enough to beat yet
+            if age > stall_after_s:
+                stalled = True
+                if stall_armed:
+                    stall_armed = False
+                    log(f, "STALL " + json.dumps(
+                        {"event": "stall", "kind": "stall",
+                         "beat_age_s": round(age, 1),
+                         "threshold_s": stall_after_s,
+                         "elapsed_s": round(time.time() - t0, 1)}))
+                if age > 3.0 * stall_after_s:
+                    killed_reason = (f"heartbeat stale {age:.0f}s "
+                                     f"(> {3.0 * stall_after_s:.0f}s)")
+                    break
+            else:
+                stall_armed = True       # bench moved again: re-arm
+        rc = proc.poll()
+        if rc is None:
+            log(f, f"killing bench: {killed_reason}")
+            proc.kill()
+            proc.wait()
+        fo.seek(0)
+        fe.seek(0)
+        out, err = fo.read(), fe.read()
+    return rc, out, err, stalled
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--interval", type=float, default=240.0)
@@ -60,6 +126,9 @@ def main() -> int:
                     default=os.path.join(REPO, "relay_watch.log"))
     ap.add_argument("--max-captures", type=int, default=1)
     ap.add_argument("--max-hours", type=float, default=11.0)
+    ap.add_argument("--stall-after", type=float, default=300.0,
+                    help="bench heartbeat age (s) that counts as a "
+                         "stall; 3x this kills the bench early")
     args = ap.parse_args()
 
     from ibamr_tpu.utils.backend_guard import probe_accelerator
@@ -79,23 +148,21 @@ def main() -> int:
         log(f, f"probe: HEALTHY platform={plat} — launching bench shootout")
         env = dict(os.environ)
         env.pop("JAX_PLATFORMS", None)  # let the container default win
+        hb_path = args.out.replace(".json", "_heartbeat.json")
         t0 = time.time()
-        try:
-            r = subprocess.run(
-                [sys.executable, os.path.join(REPO, "bench.py"),
-                 "--stages", "64,128,256"],
-                capture_output=True, text=True, cwd=REPO, env=env,
-                timeout=args.bench_timeout)
-        except subprocess.TimeoutExpired:
-            log(f, f"bench TIMED OUT after {args.bench_timeout:.0f}s; "
-                   f"re-arming")
+        rc, out, err, stalled = run_bench_watched(
+            [sys.executable, os.path.join(REPO, "bench.py"),
+             "--stages", "64,128,256", "--heartbeat", hb_path],
+            f, env, args.bench_timeout, hb_path, args.stall_after)
+        if rc is None:
+            log(f, f"bench KILLED (stalled={stalled}); re-arming")
             time.sleep(args.interval)
             continue
         dtr = time.time() - t0
-        result = last_json_line(r.stdout or "")
-        log(f, f"bench rc={r.returncode} wall={dtr:.0f}s "
+        result = last_json_line(out or "")
+        log(f, f"bench rc={rc} wall={dtr:.0f}s stalled={stalled} "
                f"result={json.dumps(result) if result else 'NO JSON'}")
-        tail = "\n".join((r.stderr or "").strip().splitlines()[-30:])
+        tail = "\n".join((err or "").strip().splitlines()[-30:])
         log(f, "bench stderr tail:\n" + tail)
         if result is not None and result.get("platform") not in (None, "cpu"):
             with open(args.out, "w") as g:
