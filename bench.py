@@ -240,6 +240,14 @@ def run_engine_leg(jax, label, engine, n, n_lat, n_lon, args, t_start,
     stall history) and must land on the parent's platform; the rest
     run in-process. Shared by the flagship shootout and the mid-size
     compare so the guard policy cannot drift between them."""
+    if label == "fluid_bf16":
+        # mixed-precision FLUID leg: the best non-pallas transfer
+        # engine (packed_bf16) plus bf16/split-real spectral
+        # transforms — the round-6 lever aimed at the fluid_solve
+        # floor itself
+        return run_stage(jax, n, n_lat, n_lon, args.steps, args.warmup,
+                         args.dt, use_fast="packed_bf16",
+                         spectral_dtype="bf16")
     if label.startswith(("pallas", "hybrid")):
         # guarded child: these engines contain Pallas programs (the
         # relay's remote-compile service stalled on one in round 2)
@@ -315,24 +323,65 @@ def phase_breakdown(jax, integ, state, dt: float, iters: int = 10) -> dict:
     timeit("fluid_solve",
            jax.jit(lambda s, f: integ.ins.step(s, dt, f=f)),
            state.ins, f)
+    if getattr(integ.ins, "fused_stokes", None) is not None:
+        # spectral decomposition of the fluid substep: transform cost
+        # (the batched rfftn/irfftn pair) vs the diagonal k-space
+        # algebra between them — names WHICH half of the fluid floor
+        # the next lever must attack (transform-bound means only
+        # precision/sharding moves it; algebra-bound means fusion does)
+        from ibamr_tpu.solvers import spectral_plan
+
+        jnp_ = jax.numpy
+        dim = len(grid.n)
+        axes = tuple(range(1, dim + 1))
+        plan = spectral_plan.get_plan(grid.n, grid.dx, integ.ins.dtype)
+        alpha = integ.ins.rho / dt
+        beta = -0.5 * integ.ins.mu
+        spec = {}
+
+        def timeit_s(name, fn, *a):
+            res = fn(*a)
+            jax.block_until_ready(res)
+            t0 = _t.perf_counter()
+            for _ in range(iters):
+                res = fn(*a)
+            jax.block_until_ready(res)
+            spec[name] = round(1e3 * (_t.perf_counter() - t0) / iters, 3)
+            return res
+
+        x = jnp_.stack(state.ins.u)
+        uh = timeit_s("fwd_transform",
+                      jax.jit(lambda x: jnp_.fft.rfftn(x, axes=axes)), x)
+        outh = timeit_s("kspace_algebra",
+                        jax.jit(lambda uh: plan.kspace_algebra(
+                            uh, alpha, beta, (alpha, beta))), uh)
+        timeit_s("inv_transform",
+                 jax.jit(lambda oh: jnp_.fft.irfftn(
+                     oh, s=grid.n, axes=axes)), outh)
+        spec["transform_ms"] = round(spec["fwd_transform"]
+                                     + spec["inv_transform"], 3)
+        out["spectral"] = spec
     out["dominant"] = max(
-        (k for k in out if k not in ("dominant", "bucket_prep_per_step")),
+        (k for k in out
+         if k not in ("dominant", "bucket_prep_per_step", "spectral")),
         key=lambda k: out[k])
     return out
 
 
 def run_stage(jax, n: int, n_lat: int, n_lon: int, steps: int,
               warmup: int, dt: float, use_fast=None,
-              fast_opts=None) -> dict:
+              fast_opts=None, spectral_dtype=None) -> dict:
     """Build the shell config at one grid size and time the jitted step.
     ``fast_opts=(tile, cap)`` overrides the MXU engine geometry (the
-    cap/tile sweep)."""
+    cap/tile sweep); ``spectral_dtype="bf16"`` opts the fluid substep
+    into the mixed-precision transform path."""
     from ibamr_tpu.models.shell3d import build_shell_example
 
     integ, state = build_shell_example(
         n_cells=n, n_lat=n_lat, n_lon=n_lon,
         radius=0.25, aspect=1.2, stiffness=1.0, rest_length_factor=0.75,
-        mu=0.05, use_fast_interaction=use_fast)
+        mu=0.05, use_fast_interaction=use_fast,
+        spectral_dtype=spectral_dtype)
     if fast_opts is not None:
         from ibamr_tpu.ops.interaction_fast import FastInteraction
         tile, cap = fast_opts
@@ -344,9 +393,10 @@ def run_stage(jax, n: int, n_lat: int, n_lon: int, steps: int,
     # input buffers saves one full state allocation per step (~0.5 GB
     # of HBM traffic at 256^3). step_with_stats rides the refresh_hit
     # flag out beside the state (None when the engine has no
-    # slot-preserving half-step refresh).
-    step = jax.jit(lambda s, dt: integ.step_with_stats(s, dt),
-                   donate_argnums=0)
+    # slot-preserving half-step refresh). jitted_step caches the
+    # donated executable on the integrator (shared with any other
+    # caller wanting the same donation contract).
+    step = integ.jitted_step(donate=True, with_stats=True)
 
     def hard_sync(s):
         # block_until_ready proved unreliable over the axon relay after
@@ -401,6 +451,8 @@ def run_stage(jax, n: int, n_lat: int, n_lon: int, steps: int,
         "fast_path": {True: "mxu", False: "scatter",
                       None: "auto"}.get(use_fast, use_fast),
     }
+    if spectral_dtype is not None:
+        out["spectral_dtype"] = str(spectral_dtype)
     if refresh_hits is not None:
         # slot-preserving half-step refresh bookkeeping: hits took the
         # cheap re-gather, falls paid a full re-pack (drift bound blown)
@@ -574,7 +626,7 @@ def main():
             # terminable child (remote-compile stall history).
             for label in ("packed", "packed_bf16", "packed3",
                           "packed3_bf16", "pallas_packed",
-                          "hybrid_bf16"):
+                          "hybrid_bf16", "fluid_bf16"):
                 if time.perf_counter() - t_start > args.deadline:
                     errors.append(f"flagship[{label}]: skipped "
                                   "(deadline)")

@@ -150,6 +150,27 @@ class IBExplicitIntegrator:
         self.ins = ins
         self.ib = ib
         self.scheme = scheme
+        self._jitted_steps = {}
+
+    def jitted_step(self, donate: bool = True, with_stats: bool = False):
+        """Compiled step with whole-step buffer donation: the input
+        IBState's buffers (velocity, pressure, markers) are reused for
+        the output — fields update in place instead of allocating fresh
+        full-field HBM buffers each step. Cached per (donate,
+        with_stats), so repeated calls share one compiled executable.
+
+        Donation contract: after ``new = f(state, dt)`` the caller's
+        ``state`` buffers are DELETED — anyone retaining pre-step state
+        (rollback templates, trajectory recorders keeping live arrays)
+        must pass ``donate=False``."""
+        key = (bool(donate), bool(with_stats))
+        fn = self._jitted_steps.get(key)
+        if fn is None:
+            base = self.step_with_stats if with_stats else self.step
+            fn = jax.jit(base, donate_argnums=(0,)) if donate \
+                else jax.jit(base)
+            self._jitted_steps[key] = fn
+        return fn
 
     # -- state ---------------------------------------------------------------
     def initialize(self, X0, ins_state: Optional[INSState] = None,

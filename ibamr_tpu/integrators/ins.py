@@ -65,7 +65,8 @@ class INSStaggeredIntegrator:
                  mu: float = 0.01, convective_op_type: str = "centered",
                  dtype=jnp.float32,
                  wall_axes: Optional[Tuple[bool, ...]] = None,
-                 wall_tangential=None):
+                 wall_tangential=None,
+                 spectral_dtype=None):
         # reference input files spell these uppercase ("PPM", "CENTERED")
         convective_op_type = convective_op_type.lower()
         if convective_op_type not in ("centered", "upwind", "ppm", "cui",
@@ -83,6 +84,16 @@ class INSStaggeredIntegrator:
             raise ValueError(
                 f"wall_axes has {len(self.wall_axes)} entries for a "
                 f"{grid.dim}D grid")
+        # opt-in mixed-precision spectral transforms (bf16/split-real
+        # operands, f32 twiddle/accumulation); only the fused periodic
+        # path honors it — walls use fastdiag, where it has no meaning
+        from ibamr_tpu.solvers import spectral_plan
+        self.spectral_dtype = spectral_plan.canonical_spectral_dtype(
+            spectral_dtype)
+        if self.spectral_dtype is not None and any(self.wall_axes):
+            raise ValueError(
+                "spectral_dtype requires the fully-periodic fused "
+                f"spectral path; wall_axes={self.wall_axes}")
         self.wall_tangential = dict(wall_tangential or {})
         for key, val in self.wall_tangential.items():
             ok = (isinstance(key, tuple) and len(key) == 3
@@ -220,9 +231,13 @@ class INSStaggeredIntegrator:
             # fused spectral path: Helmholtz solve + projection +
             # pressure increment in one spectral round trip.
             # p_inc = (rho/dt) phi0 - (0.5 mu) lap(phi0)
+            # spectral_dtype is forwarded only when set, so swapped-in
+            # fused_stokes seams keep their plain signature
+            extra = ({"spectral_dtype": self.spectral_dtype}
+                     if self.spectral_dtype is not None else {})
             u_new, p_inc = self.fused_stokes(
                 tuple(rhs), dx, alpha=rho / dt, beta=-0.5 * mu,
-                pinc_coeffs=(rho / dt, -0.5 * mu))
+                pinc_coeffs=(rho / dt, -0.5 * mu), **extra)
             p_new = p + p_inc
         else:
             u_star = self.helmholtz_vel_solve(

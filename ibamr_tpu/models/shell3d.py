@@ -272,8 +272,9 @@ def build_shell_example(
         use_fast_interaction: Optional[bool] = None,
         dtype=None,
         input_db=None,
-        engine_fallback: bool = True) -> Tuple[IBExplicitIntegrator,
-                                               IBState]:
+        engine_fallback: bool = True,
+        spectral_dtype=None) -> Tuple[IBExplicitIntegrator,
+                                      IBState]:
     """Assemble the ex4-equivalent simulation (3D periodic unit box).
 
     ``use_fast_interaction``: True = bucketed-MXU spread/interp engine
@@ -314,6 +315,13 @@ def build_shell_example(
         mu = ins_db.get_float("mu", mu)
         convective_op_type = ins_db.get_string("convective_op_type",
                                                convective_op_type)
+        # spectral transform precision knob (reference-style):
+        # INSStaggeredHierarchyIntegrator { spectral_dtype = "bf16" }
+        # — bf16/split-real transform operands, f32 twiddle/
+        # accumulation; "f32" (default) is the full-precision path
+        spectral_dtype = ins_db.get_string(
+            "spectral_dtype",
+            spectral_dtype if spectral_dtype is not None else "f32")
         ib_db = input_db.get_database_with_default("IBMethod")
         kernel = ib_db.get_string("delta_fcn", kernel)
         # reference-style engine knob: IBMethod { transfer_engine =
@@ -349,7 +357,8 @@ def build_shell_example(
     grid = StaggeredGrid(n=n, x_lo=x_lo, x_up=x_up)
     ins = INSStaggeredIntegrator(grid, rho=rho, mu=mu,
                                  convective_op_type=convective_op_type,
-                                 dtype=dtype)
+                                 dtype=dtype,
+                                 spectral_dtype=spectral_dtype)
     center = tuple(0.5 * (lo + hi) for lo, hi in zip(x_lo, x_up))
     structure = make_spherical_shell(
         n_lat, n_lon, radius, center=center,

@@ -32,6 +32,7 @@ Centerings per axis:
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import Sequence, Tuple
 
@@ -87,6 +88,21 @@ def _periodic_symbol(n: int, h: float) -> np.ndarray:
     return (2.0 * np.cos(2.0 * math.pi * k) - 2.0) / (h * h)
 
 
+# plan-cached device-resident periodic axis plans: solver
+# re-construction (regrids, level rebuilds) stops recomputing the
+# symbol / eigendecomposition and every trace captures the SAME
+# constants (the 1-D analog of solvers.spectral_plan.get_plan)
+@functools.lru_cache(maxsize=64)
+def _periodic_fft_plan(n: int, h: float):
+    return ("fft", jnp.asarray(_periodic_symbol(n, h)))
+
+
+@functools.lru_cache(maxsize=64)
+def _periodic_eig_plan(n: int, h: float):
+    lam, V = np.linalg.eigh(laplacian_1d_periodic(n, h))
+    return ("eig", jnp.asarray(V), jnp.asarray(lam))
+
+
 def laplacian_1d_periodic(n: int, h: float) -> np.ndarray:
     """Circulant 1D Laplacian (symmetric; its eigh basis is a real
     orthogonal Fourier basis — the dense-transform alternative to the
@@ -116,10 +132,9 @@ class FastDiagSolver:
         for d, (axbc, cent) in enumerate(zip(bc.axes, self.centerings)):
             n, h = grid.n[d], grid.dx[d]
             if axbc.periodic and dense_periodic:
-                lam, V = np.linalg.eigh(laplacian_1d_periodic(n, h))
-                self.plans.append(("eig", jnp.asarray(V), jnp.asarray(lam)))
+                self.plans.append(_periodic_eig_plan(int(n), float(h)))
             elif axbc.periodic:
-                self.plans.append(("fft", jnp.asarray(_periodic_symbol(n, h))))
+                self.plans.append(_periodic_fft_plan(int(n), float(h)))
             elif cent == "cc":
                 lam, V = np.linalg.eigh(laplacian_1d_cc(n, h, axbc))
                 self.plans.append(("eig", jnp.asarray(V), jnp.asarray(lam)))

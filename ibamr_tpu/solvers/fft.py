@@ -51,14 +51,12 @@ def laplacian_symbol(shape: Sequence[int], dx: Sequence[float],
 def solve_poisson_periodic(rhs: jnp.ndarray, dx: Sequence[float]) -> jnp.ndarray:
     """Solve lap(p) = rhs on the periodic grid; returns the zero-mean
     solution (rhs mean is projected out — the periodic compatibility
-    condition)."""
-    sym = laplacian_symbol(rhs.shape, dx, rhs.dtype)
-    rhat = jnp.fft.rfftn(rhs)
-    # zero out the k=0 mode (symbol is 0 there): fixes the nullspace
-    sym_safe = jnp.where(sym == 0, 1.0, sym)
-    phat = jnp.where(sym == 0, 0.0, rhat / sym_safe)
-    p = jnp.fft.irfftn(phat, s=rhs.shape)
-    return p.astype(rhs.dtype)
+    condition). Symbol tables come from the hash-cons plan cache
+    (solvers.spectral_plan), so repeated traces/regrids share them."""
+    from ibamr_tpu.solvers import spectral_plan
+
+    plan = spectral_plan.get_plan(rhs.shape, dx, rhs.dtype)
+    return plan.solve_poisson(rhs)
 
 
 def solve_helmholtz_periodic(rhs: jnp.ndarray, dx: Sequence[float],
@@ -68,11 +66,10 @@ def solve_helmholtz_periodic(rhs: jnp.ndarray, dx: Sequence[float],
     For Crank-Nicolson viscous steps: alpha = rho/dt, beta = -mu/2.
     Requires alpha + beta*lam != 0 for all modes (true for alpha>0, beta<0).
     """
-    sym = laplacian_symbol(rhs.shape, dx, rhs.dtype)
-    rhat = jnp.fft.rfftn(rhs)
-    uhat = rhat / (alpha + beta * sym)
-    u = jnp.fft.irfftn(uhat, s=rhs.shape)
-    return u.astype(rhs.dtype)
+    from ibamr_tpu.solvers import spectral_plan
+
+    plan = spectral_plan.get_plan(rhs.shape, dx, rhs.dtype)
+    return plan.solve_helmholtz(rhs, alpha, beta)
 
 
 def solve_helmholtz_periodic_vel(rhs: Vel, dx: Sequence[float],
@@ -124,48 +121,38 @@ def _staggered_div_symbols(shape: Sequence[int], dx: Sequence[float],
 
 def helmholtz_project_periodic(rhs: Vel, dx: Sequence[float],
                                alpha: float, beta: float,
-                               pinc_coeffs: Tuple[float, float]
-                               ) -> Tuple[Vel, jnp.ndarray]:
-    """Fused spectral Stokes substep: one forward transform per MAC
-    component, then the Helmholtz inverse, the staggered Leray
-    projection, AND the pressure-increment assembly all as elementwise
-    spectral arithmetic, then one inverse transform per output — 7 big
-    transforms total instead of the 8 + three full-grid stencil passes
-    of the unfused helmholtz_vel_solve -> project -> laplacian_cc
-    pipeline (the projection-preconditioner collapse of SURVEY.md §3.3
-    taken to its fixed point; HBM traffic is the TPU bottleneck, so
-    fewer full-array passes is the whole game).
+                               pinc_coeffs: Tuple[float, float],
+                               spectral_dtype=None,
+                               filter_sym=None) -> Tuple[Vel, jnp.ndarray]:
+    """Fused spectral Stokes substep: ONE batched forward rfftn over
+    the stacked MAC components, then the Helmholtz inverse, the
+    staggered Leray projection, AND the pressure-increment assembly all
+    as elementwise spectral arithmetic, then ONE batched inverse irfftn
+    for the dim+1 outputs — 2 batched FFT calls total instead of the
+    8 single-field transforms + three full-grid stencil passes of the
+    unfused helmholtz_vel_solve -> project -> laplacian_cc pipeline
+    (the projection-preconditioner collapse of SURVEY.md §3.3 taken to
+    its fixed point; HBM traffic is the TPU bottleneck, so fewer
+    full-array passes is the whole game).
+
+    Round 6: delegates to the plan-cached k-space-resident substep in
+    solvers.spectral_plan — symbol tables are hash-consed per
+    ``(shape, dx, dtype)`` so regrids/solver re-construction stop
+    recomputing them; ``spectral_dtype="bf16"`` opts into the
+    mixed-precision transform path (bf16/split-real operands, f32
+    twiddle/accumulation); ``filter_sym`` applies a body-force spectral
+    filter inside the same transform pair.
 
     Returns ``(u_new, p_inc)`` with
     ``u_new = P (alpha + beta lap)^{-1} rhs`` (divergence-free to
-    roundoff) and ``p_inc = (a + b lap) phi0`` for
+    roundoff at full precision) and ``p_inc = (a + b lap) phi0`` for
     ``pinc_coeffs = (a, b)``, ``phi0 = lap^{-1} div u_star``."""
-    shape = rhs[0].shape
-    dim = len(shape)
-    rdtype = rhs[0].dtype
-    axes = tuple(range(1, dim + 1))
-    sym = laplacian_symbol(shape, dx, rdtype)
-    # ONE batched forward transform for all components (round 5: the
-    # 3 fwd + 4 inv single-field transforms become 2 batched FFT
-    # calls — fewer kernel launches/transpose passes on TPU, same
-    # spectra)
-    uh = jnp.fft.rfftn(jnp.stack(rhs), axes=axes)
-    cdtype = uh.dtype
-    denom = (alpha + beta * sym).astype(rdtype)
-    uh = uh / denom[None]
-    D = _staggered_div_symbols(shape, dx, cdtype)
-    divh = None
-    for d in range(dim):
-        t = D[d] * uh[d]
-        divh = t if divh is None else divh + t
-    sym_safe = jnp.where(sym == 0, 1.0, sym)
-    phih = jnp.where(sym == 0, 0.0, divh / sym_safe)
-    a, b = pinc_coeffs
-    outh = jnp.stack(
-        [uh[d] + jnp.conj(D[d]) * phih for d in range(dim)]
-        + [((a + b * sym) * phih).astype(cdtype)])
-    out = jnp.fft.irfftn(outh, s=shape, axes=axes).astype(rdtype)
-    return tuple(out[d] for d in range(dim)), out[dim]
+    from ibamr_tpu.solvers import spectral_plan
+
+    plan = spectral_plan.get_plan(rhs[0].shape, dx, rhs[0].dtype)
+    return plan.substep(rhs, alpha, beta, pinc_coeffs,
+                        spectral_dtype=spectral_dtype,
+                        filter_sym=filter_sym)
 
 
 def project_divergence_free(u: Vel, dx: Sequence[float],

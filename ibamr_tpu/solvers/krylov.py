@@ -240,6 +240,13 @@ def _fgmres_flat(Aop, b, x0, Mop, m, tol, atol, restarts):
         # observed as an f32 FGMRES that makes ZERO progress. True
         # breakdown columns (converged early) still fall below eps.
         y, *_ = jnp.linalg.lstsq(H, e1, rcond=float(jnp.finfo(dtype).eps))
+        # an exactly-zero restart residual (projecting an already
+        # div-free field, the zero-state initialize) makes H all-zero,
+        # and lstsq of an all-zero matrix returns NaN here (0/0 in the
+        # SVD-based solve); true breakdown columns can do the same. A
+        # non-finite y entry carries no descent information — drop it
+        # (keeping x unchanged along that direction is exact).
+        y = jnp.where(jnp.isfinite(y), y, jnp.zeros_like(y))
         x = x + Z.T @ y
         rn = jnp.linalg.norm(b - Aop(x))
         return x, rn, it + 1

@@ -1,0 +1,367 @@
+"""Spectral-plan layer: hash-cons-cached symbol tables + the k-space-
+resident fused fluid substep.
+
+Round-5 measurement (PERF.md, BENCH_TPU_NUMBERS.json rev 96498b2) put
+``fluid_solve`` at 39.3 ms — the dominant flagship phase once the
+transfer-side levers landed. The remaining structural waste was not in
+the transforms themselves (the fused substep already runs ONE batched
+rfftn and ONE batched irfftn) but around them:
+
+- every spectral solve recomputed its symbol tables (`laplacian_symbol`,
+  the staggered divergence symbols) per call/trace — regrids and solver
+  re-construction paid the rebuild over and over;
+- the transform operands were pinned to f32 with no opt-in cheaper
+  precision, even though bf16 operand compression is exactly the trade
+  the ``packed_bf16`` transfer engine already sells.
+
+A :class:`SpectralPlan` precomputes the tables ONCE per
+``(shape, dx, dtype, bc)`` and hash-conses them in a bounded LRU
+(:func:`get_plan`), device-resident, so every spectral solve — the
+fused substep, Poisson, Helmholtz, the all-periodic saddle solve —
+shares one set of constants. The fused :meth:`SpectralPlan.substep`
+performs the viscous Helmholtz solve, the staggered Leray projection,
+the pressure-increment assembly AND an optional body-force spectral
+filter as ONE batched forward rfftn -> diagonal k-space algebra -> ONE
+batched inverse irfftn. ``spectral_dtype`` opts into the mixed-precision
+transform path: bf16/split-real transform OPERANDS (the real input
+batch and the split-real spectral intermediate are rounded through the
+storage dtype) with f32 twiddle factors and f32 accumulation inside the
+transform — the accuracy contract is tolerance-pinned against the f64
+oracle in tests/test_spectral_plan.py, exactly like ``packed_bf16``.
+
+The default-precision path is BITWISE identical to the pre-plan
+implementation (same ops in the same order; the cached tables are built
+by the same ``fft.laplacian_symbol`` / ``fft._staggered_div_symbols``
+calls), so trajectories and restart files are unchanged.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Vel = Tuple[jnp.ndarray, ...]
+
+# -- spectral_dtype normalization -------------------------------------------
+
+_SPECTRAL_DTYPE_ALIASES = {
+    None: None, "none": None, "f32": None, "float32": None,
+    "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+}
+
+
+def canonical_spectral_dtype(spec):
+    """Normalize the ``spectral_dtype`` knob: ``None`` (full precision)
+    or ``jnp.bfloat16`` (compressed transform operands). Anything else
+    is a typo'd input file and raises."""
+    if isinstance(spec, str):
+        key = spec.lower()
+        if key in _SPECTRAL_DTYPE_ALIASES:
+            return _SPECTRAL_DTYPE_ALIASES[key]
+        raise ValueError(
+            f"spectral_dtype = {spec!r}: expected one of "
+            f"{sorted(k for k in _SPECTRAL_DTYPE_ALIASES if k)} or None")
+    if spec is None or spec is jnp.bfloat16:
+        return spec
+    if jnp.dtype(spec) == jnp.dtype(jnp.bfloat16):
+        return jnp.bfloat16
+    raise ValueError(f"spectral_dtype = {spec!r}: only bf16 operand "
+                     "compression is supported (None = full precision)")
+
+
+def _round_real(x: jnp.ndarray, sdtype) -> jnp.ndarray:
+    """Round a real transform operand through the storage dtype; the
+    transform itself still runs at f32 (f32 twiddle/accumulation)."""
+    return x.astype(sdtype).astype(jnp.float32)
+
+
+def _round_complex(z: jnp.ndarray, sdtype) -> jnp.ndarray:
+    """Split-real rounding of a spectral operand: the re/im planes are
+    rounded through the storage dtype independently (complex-bf16 does
+    not exist as a device type; split-real IS the storage layout)."""
+    re = jnp.real(z).astype(sdtype).astype(jnp.float32)
+    im = jnp.imag(z).astype(sdtype).astype(jnp.float32)
+    return jax.lax.complex(re, im)
+
+
+# -- the plan ----------------------------------------------------------------
+
+class SpectralPlan:
+    """Device-resident spectral symbol tables for one
+    ``(shape, dx, dtype, bc)`` and the solves that share them.
+
+    Construct via :func:`get_plan` (the hash-cons cache), not directly —
+    direct construction bypasses the LRU and recomputes the tables the
+    cache exists to share.
+    """
+
+    def __init__(self, shape: Sequence[int], dx: Sequence[float],
+                 dtype, bc: str = "periodic"):
+        if bc != "periodic":
+            raise ValueError(
+                f"SpectralPlan bc={bc!r}: only 'periodic' has a "
+                "diagonal spectral symbol (walls go through "
+                "solvers.fastdiag / solvers.stokes)")
+        # table builders live in solvers.fft (the canonical symbol
+        # definitions); imported lazily because fft delegates its fused
+        # substep back to this module
+        from ibamr_tpu.solvers import fft
+
+        self.shape = tuple(int(s) for s in shape)
+        self.dx = tuple(float(h) for h in dx)
+        self.bc = bc
+        self.dim = len(self.shape)
+        # batched-transform axes for a leading stack dimension
+        self.axes = tuple(range(1, self.dim + 1))
+        self.rdtype = jax.dtypes.canonicalize_dtype(dtype)
+        self.cdtype = jnp.complex128 if self.rdtype == jnp.float64 \
+            else jnp.complex64
+        # the tables: discrete-Laplacian symbol on the rfftn grid and
+        # the per-axis staggered divergence symbols. Built by the same
+        # fft.py code the unplanned solves used, so values are bitwise
+        # identical to a per-call rebuild. ensure_compile_time_eval:
+        # the first get_plan for a shape often fires INSIDE a jit
+        # trace — the tables must come out as concrete device arrays,
+        # not tracers, or the hash-cons cache would leak trace-scoped
+        # values into every later caller.
+        with jax.ensure_compile_time_eval():
+            self.sym = fft.laplacian_symbol(self.shape, self.dx,
+                                            self.rdtype)
+            self.D = fft._staggered_div_symbols(self.shape, self.dx,
+                                                self.cdtype)
+            if self.rdtype != jnp.float32:
+                # pre-materialized f32 views for the bf16 transform
+                # path (f32 twiddle/accumulation)
+                self._sym_f32 = self.sym.astype(jnp.float32)
+                self._D_f32 = tuple(d.astype(jnp.complex64)
+                                    for d in self.D)
+            else:
+                self._sym_f32 = self.sym
+                self._D_f32 = self.D
+
+    # -- table views ---------------------------------------------------------
+    def _tables(self, f32: bool):
+        """(sym, D) at the working precision: the plan's native dtype,
+        or the f32 view the bf16 transform path computes in."""
+        if not f32:
+            return self.sym, self.D
+        return self._sym_f32, self._D_f32
+
+    # -- fused substep (the tentpole) ----------------------------------------
+    def substep(self, rhs: Vel, alpha, beta,
+                pinc_coeffs: Tuple[float, float],
+                spectral_dtype=None,
+                filter_sym: Optional[jnp.ndarray] = None
+                ) -> Tuple[Vel, jnp.ndarray]:
+        """K-space-resident fused Stokes substep.
+
+        ONE batched forward rfftn over the stacked MAC rhs, then the
+        whole chain as diagonal spectral algebra — Helmholtz inverse
+        ``(alpha + beta lap)^{-1}``, optional body-force spectral
+        filter ``filter_sym`` (a real symbol multiplied into the rhs
+        spectrum: dealiasing masks, Gaussian force smoothing — zero
+        extra transforms), staggered Leray projection, and the
+        pressure-increment assembly ``p_inc = (a + b lap) phi0`` for
+        ``pinc_coeffs = (a, b)`` — then ONE batched inverse irfftn for
+        the ``dim + 1`` outputs.
+
+        ``spectral_dtype=jnp.bfloat16`` rounds the transform operands
+        (real input batch, split-real spectral intermediate) through
+        bf16 while all twiddle factors, k-space tables and accumulation
+        stay f32. Returns ``(u_new, p_inc)``; with the default
+        precision ``u_new`` is divergence-free to roundoff.
+        """
+        sdtype = canonical_spectral_dtype(spectral_dtype)
+        rdtype = self.rdtype
+        x = jnp.stack(rhs)
+        if sdtype is not None:
+            # bf16 transform operands, f32 twiddle/accumulation
+            x = _round_real(x.astype(jnp.float32), sdtype)
+        uh = jnp.fft.rfftn(x, axes=self.axes)
+        outh = self.kspace_algebra(uh, alpha, beta, pinc_coeffs,
+                                   f32=sdtype is not None,
+                                   filter_sym=filter_sym)
+        if sdtype is not None:
+            # split-real compression of the inverse-transform operand
+            outh = _round_complex(outh, sdtype)
+        out = jnp.fft.irfftn(outh, s=self.shape, axes=self.axes)
+        out = out.astype(rdtype)
+        return tuple(out[d] for d in range(self.dim)), out[self.dim]
+
+    def kspace_algebra(self, uh: jnp.ndarray, alpha, beta,
+                       pinc_coeffs: Tuple[float, float],
+                       f32: bool = False,
+                       filter_sym: Optional[jnp.ndarray] = None
+                       ) -> jnp.ndarray:
+        """The diagonal spectral algebra between the substep's two
+        transforms: ``uh`` is the stacked forward spectrum of the dim
+        MAC components; returns the stacked dim+1 inverse-transform
+        operand. Exposed separately so bench.py can time the
+        transform-vs-algebra split of the fluid phase."""
+        dim = self.dim
+        sym, D = self._tables(f32=f32)
+        wdtype = jnp.float32 if f32 else self.rdtype
+        cdtype = uh.dtype
+        if filter_sym is not None:
+            uh = uh * filter_sym.astype(wdtype)[None]
+        denom = (alpha + beta * sym).astype(wdtype)
+        uh = uh / denom[None]
+        divh = None
+        for d in range(dim):
+            t = D[d] * uh[d]
+            divh = t if divh is None else divh + t
+        sym_safe = jnp.where(sym == 0, 1.0, sym)
+        phih = jnp.where(sym == 0, 0.0, divh / sym_safe)
+        a, b = pinc_coeffs
+        return jnp.stack(
+            [uh[d] + jnp.conj(D[d]) * phih for d in range(dim)]
+            + [((a + b * sym) * phih).astype(cdtype)])
+
+    # -- the classic solves, sharing the cached tables -----------------------
+    def solve_poisson(self, rhs: jnp.ndarray) -> jnp.ndarray:
+        """lap(p) = rhs; zero-mean solution (k=0 mode discarded)."""
+        sym = self.sym
+        rhat = jnp.fft.rfftn(rhs)
+        sym_safe = jnp.where(sym == 0, 1.0, sym)
+        phat = jnp.where(sym == 0, 0.0, rhat / sym_safe)
+        p = jnp.fft.irfftn(phat, s=self.shape)
+        return p.astype(rhs.dtype)
+
+    def solve_helmholtz(self, rhs: jnp.ndarray, alpha, beta) -> jnp.ndarray:
+        """(alpha + beta lap) u = rhs (alpha + beta*lam != 0 required)."""
+        rhat = jnp.fft.rfftn(rhs)
+        uhat = rhat / (alpha + beta * self.sym)
+        u = jnp.fft.irfftn(uhat, s=self.shape)
+        return u.astype(rhs.dtype)
+
+    def solve_stokes_saddle(self, f_u: Vel, f_p: jnp.ndarray,
+                            alpha, mu) -> Tuple[Vel, jnp.ndarray]:
+        """Exact periodic saddle-point solve of
+
+            alpha*u - mu*lap(u) + grad(p) = f_u,    -div(u) = f_p
+
+        as one batched spectral pass (the all-periodic collapse of the
+        coupled Krylov solve in solvers.stokes): with A = alpha - mu*lam
+        and the staggered symbols D_d (gradient -conj(D_d)),
+
+            p_hat = (sum_d D_d f_hat_d + A f_hat_p) / lam     (0 at k=0)
+            u_hat_d = (f_hat_d + conj(D_d) p_hat) / A
+
+        Zero modes follow the periodic conventions: p is zero-mean; the
+        k=0 velocity mode is f_hat_d(0)/alpha (zeroed when alpha == 0 —
+        the steady zero-mean frame). ``alpha`` may be traced.
+        """
+        dim = self.dim
+        rdtype = self.rdtype
+        sym, D = self.sym, self.D
+        fh = jnp.fft.rfftn(jnp.stack(tuple(f_u) + (f_p,)),
+                           axes=self.axes)
+        A = (alpha - mu * sym).astype(rdtype)
+        divf = None
+        for d in range(dim):
+            t = D[d] * fh[d]
+            divf = t if divf is None else divf + t
+        sym_safe = jnp.where(sym == 0, 1.0, sym)
+        ph = jnp.where(sym == 0, 0.0, (divf + A * fh[dim]) / sym_safe)
+        A_safe = jnp.where(A == 0, 1.0, A)
+        uh = jnp.stack(
+            [jnp.where(A == 0, 0.0,
+                       (fh[d] + jnp.conj(D[d]) * ph) / A_safe)
+             for d in range(dim)] + [ph])
+        out = jnp.fft.irfftn(uh, s=self.shape, axes=self.axes)
+        out = out.astype(rdtype)
+        return tuple(out[d] for d in range(dim)), out[dim]
+
+
+# -- the hash-cons LRU cache -------------------------------------------------
+
+_CACHE_MAXSIZE = 16
+_cache: "OrderedDict[tuple, SpectralPlan]" = OrderedDict()
+_lock = threading.Lock()
+_stats = {"hits": 0, "misses": 0, "evictions": 0}
+
+
+def plan_key(shape: Sequence[int], dx: Sequence[float], dtype,
+             bc: str = "periodic") -> tuple:
+    return (tuple(int(s) for s in shape),
+            tuple(float(h) for h in dx),
+            jnp.dtype(jax.dtypes.canonicalize_dtype(dtype)).name,
+            bc)
+
+
+def get_plan(shape: Sequence[int], dx: Sequence[float], dtype,
+             bc: str = "periodic") -> SpectralPlan:
+    """Hash-cons a :class:`SpectralPlan`: one table build per distinct
+    ``(shape, dx, dtype, bc)``, LRU-bounded so a regrid loop (moving
+    fine windows, level rebuilds) cannot grow the cache without bound.
+    Device-resident: repeated jit traces capture the SAME arrays, so
+    solver re-construction stops recomputing symbol tables."""
+    key = plan_key(shape, dx, dtype, bc)
+    with _lock:
+        plan = _cache.get(key)
+        if plan is not None:
+            _stats["hits"] += 1
+            _cache.move_to_end(key)
+            return plan
+    # build outside the lock (table construction runs device code)
+    plan = SpectralPlan(shape, dx, dtype, bc)
+    with _lock:
+        # double-checked: a racing builder's plan wins LRU placement
+        existing = _cache.get(key)
+        if existing is not None:
+            _stats["hits"] += 1
+            _cache.move_to_end(key)
+            return existing
+        _stats["misses"] += 1
+        _cache[key] = plan
+        while len(_cache) > _CACHE_MAXSIZE:
+            _cache.popitem(last=False)
+            _stats["evictions"] += 1
+    return plan
+
+
+def plan_cache_stats() -> dict:
+    """{hits, misses, evictions, size, maxsize} — the observable the
+    cache-boundedness test pins."""
+    with _lock:
+        return dict(_stats, size=len(_cache), maxsize=_CACHE_MAXSIZE)
+
+
+def clear_plan_cache() -> None:
+    with _lock:
+        _cache.clear()
+        for k in _stats:
+            _stats[k] = 0
+
+
+# -- module-level conveniences ----------------------------------------------
+
+def spectral_substep(rhs: Vel, dx: Sequence[float], alpha, beta,
+                     pinc_coeffs: Tuple[float, float],
+                     spectral_dtype=None,
+                     filter_sym: Optional[jnp.ndarray] = None
+                     ) -> Tuple[Vel, jnp.ndarray]:
+    """Plan-cached fused fluid substep (see
+    :meth:`SpectralPlan.substep`); fetches/creates the plan for
+    ``rhs[0].shape``."""
+    plan = get_plan(rhs[0].shape, dx, rhs[0].dtype)
+    return plan.substep(rhs, alpha, beta, pinc_coeffs,
+                        spectral_dtype=spectral_dtype,
+                        filter_sym=filter_sym)
+
+
+def gaussian_filter_symbol(shape: Sequence[int], dx: Sequence[float],
+                           width: float, dtype=jnp.float32) -> jnp.ndarray:
+    """Spectral symbol of a discrete Gaussian smoother of standard
+    deviation ``width`` (grid units of length): exp(width^2/2 * lam)
+    with lam the discrete-Laplacian symbol (lam <= 0, so this is a pure
+    low-pass). Intended as ``filter_sym`` for the fused substep's
+    body-force smoothing — it rides the substep's existing transforms."""
+    from ibamr_tpu.solvers import fft
+
+    lam = fft.laplacian_symbol(shape, dx, jnp.float64)
+    return jnp.exp(0.5 * float(width) ** 2 * lam).astype(dtype)

@@ -203,6 +203,18 @@ class StaggeredStokesSolver:
         self.p_mg = PoissonMultigrid(self.n, self.p_bc, self.dx,
                                      dtype=dtype)
 
+        # all-periodic collapse: the saddle operator is exactly diagonal
+        # in k-space, so one batched spectral pass replaces the whole
+        # FGMRES + multigrid stack (SURVEY.md §3.3 taken to the coupled
+        # system). The plan is hash-consed per (n, dx, dtype) in
+        # solvers.spectral_plan; set ``self.spectral = None`` to force
+        # the Krylov path (e.g. for cross-validation).
+        self.spectral = None
+        if all(bc.periodic(e) for e in range(dim)):
+            from ibamr_tpu.solvers import spectral_plan
+            self.spectral = spectral_plan.get_plan(self.n, self.dx,
+                                                   dtype)
+
     # ------------------------------------------------------------------
     # homogeneous linear operator pieces
     # ------------------------------------------------------------------
@@ -459,6 +471,8 @@ class StaggeredStokesSolver:
         trace). Every solve records ``self.last_solve_stats``: eagerly
         when run outside jit, through ``jax.debug.callback`` when the
         solver was built with ``record_stats=True``."""
+        if self.spectral is not None:
+            return self._solve_spectral(rhs, alpha=alpha)
         if x0 is None:
             x0 = (tuple(jnp.zeros(s, dtype=self.dtype)
                         for s in self.shapes),
@@ -483,6 +497,32 @@ class StaggeredStokesSolver:
         return StokesSolveResult(u=u, p=p, iters=sol.iters,
                                  resnorm=sol.resnorm,
                                  converged=sol.converged)
+
+    def _solve_spectral(self, rhs, alpha=None) -> StokesSolveResult:
+        """Exact all-periodic saddle solve: one batched spectral pass
+        through the hash-consed plan, plus ONE operator apply for an
+        honest residual record (same |r|_2 <= tol*|b|_2 convention as
+        the FGMRES path, so escalation/vitals plumbing reads it
+        unchanged). ``alpha`` may be traced — the adaptive-dt contract
+        of :meth:`solve` is preserved."""
+        from ibamr_tpu.solvers.krylov import SolveResult
+
+        a = self.alpha if alpha is None else alpha
+        ru, rp = rhs
+        u, p = self.spectral.solve_stokes_saddle(ru, rp, a, self.mu)
+        Au, Ap = self.operator((u, p)) if alpha is None else \
+            self.operator((u, p), alpha=alpha)
+        rn2 = sum(jnp.sum((c - r) ** 2) for c, r in zip(Au, ru)) \
+            + jnp.sum((Ap - rp) ** 2)
+        bn2 = sum(jnp.sum(r ** 2) for r in ru) + jnp.sum(rp ** 2)
+        resnorm = jnp.sqrt(rn2)
+        converged = resnorm <= self.tol * jnp.sqrt(bn2)
+        sol = SolveResult(x=(u, p), iters=jnp.asarray(0, jnp.int32),
+                          resnorm=resnorm, converged=converged)
+        record_solve_stats(self, sol, solver="spectral",
+                           use_callback=self.record_stats)
+        return StokesSolveResult(u=u, p=p, iters=sol.iters,
+                                 resnorm=resnorm, converged=converged)
 
     def solve_escalated(self, rhs, x0=None, alpha=None, *, chain=None,
                         on_incident=None, step=None,

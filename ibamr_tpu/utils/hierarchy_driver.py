@@ -86,6 +86,12 @@ class RunConfig:
     health_interval: int = 10         # steps per jitted chunk (>= 1;
     #                                   the health check is not optional)
     cfl: Optional[float] = None       # recompute dt each chunk if set
+    donate: bool = False              # donate the chunk's input state
+    #   buffers (whole-step in-place update: no fresh HBM allocation
+    #   per chunk). OPT-IN because donation invalidates the caller's
+    #   pre-chunk state references — anything retaining the state it
+    #   passed to run() (rollback templates, resume copies) must leave
+    #   this off; ResilientDriver forces it off for exactly that reason.
 
     def __post_init__(self):
         # Fail-fast input validation: a bad input file must die HERE
@@ -213,7 +219,15 @@ class HierarchyDriver:
                     return out, probe.measure(out, dt)
                 return out, _finite_flag(out)
 
-            self._chunks[n] = jax.jit(chunk)
+            # whole-chunk buffer donation: the input state's buffers are
+            # reused for the output (velocity/pressure update in place
+            # instead of allocating fresh full-field buffers per chunk).
+            # Safe inside run(): callbacks only ever see the POST-chunk
+            # state, and the loop immediately rebinds ``state``.
+            if self.cfg.donate:
+                self._chunks[n] = jax.jit(chunk, donate_argnums=(0,))
+            else:
+                self._chunks[n] = jax.jit(chunk)
         return self._chunks[n]
 
     def run(self, state, start_step: int = 0):

@@ -90,6 +90,16 @@ class ResilientDriver:
         if not (0.0 < dt_backoff <= 1.0):
             raise ValueError("dt_backoff must be in (0, 1]")
         self.driver = driver
+        # rollback keeps PRE-chunk state references (the initial-state
+        # restore template, the preemption save of the last good state)
+        # that whole-chunk buffer donation would invalidate — a
+        # supervised driver must never donate. Forced off here rather
+        # than validated, so cfg presets that enable donation for the
+        # bare driver still work supervised; compiled chunks are reset
+        # because donation is baked into them at jit time.
+        if getattr(driver.cfg, "donate", False):
+            driver.cfg.donate = False
+            driver._chunks = {}
         self.directory = checkpoint_dir
         self.max_retries = max_retries
         self.dt_backoff = dt_backoff

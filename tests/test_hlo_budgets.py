@@ -1,0 +1,98 @@
+"""Fast-tier HLO/jaxpr budget regression (round 6).
+
+Pins the two structural guarantees the fused spectral substep makes at
+compile time, on a small grid so the tier runs in seconds:
+
+- the flagship IB step's jaxpr contains at most TWO batched ``fft``
+  primitives for the fluid substep (one forward rfftn, one inverse
+  irfftn) plus none smuggled in elsewhere, and
+- the optimized HLO of the full step contains ZERO scatter ops (the
+  round-5 gather-based force assembly + the k-space-resident solve
+  leave nothing to scatter).
+
+These are jaxpr/HLO censuses, not timings — backend-independent and
+safe for the CPU CI tier (CPU lowers lax.fft to a ducc custom-call, so
+the FFT census MUST run at the jaxpr level; the scatter census runs on
+the optimized HLO text).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ibamr_tpu.models.shell3d import build_shell_example
+
+
+def _subjaxprs(params):
+    for v in params.values():
+        if isinstance(v, jax.core.ClosedJaxpr):
+            yield v.jaxpr
+        elif isinstance(v, jax.core.Jaxpr):
+            yield v
+        elif isinstance(v, (tuple, list)):
+            for w in v:
+                if isinstance(w, jax.core.ClosedJaxpr):
+                    yield w.jaxpr
+                elif isinstance(w, jax.core.Jaxpr):
+                    yield w
+
+
+def count_fft(jaxpr) -> int:
+    n = 0
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "fft":
+            n += 1
+        for sub in _subjaxprs(eqn.params):
+            n += count_fft(sub)
+    return n
+
+
+def _build(n=32):
+    # explicit use_fast_interaction bypasses the auto-engine size
+    # eligibility gate so the fast tier exercises the flagship path
+    integ, st = build_shell_example(n_cells=n, n_lat=8, n_lon=16,
+                                    use_fast_interaction="packed")
+    return integ, st
+
+
+def test_step_jaxpr_fft_budget():
+    integ, st = _build()
+    assert integ.ins.fused_stokes is not None   # flagship fused path on
+    jaxpr = jax.make_jaxpr(lambda s: integ.step(s, 1e-3))(st)
+    n_fft = count_fft(jaxpr.jaxpr)
+    # one batched rfftn + one batched irfftn; anything more means the
+    # substep fell off the k-space-resident path (e.g. back to the
+    # chained per-field solves, which cost 8)
+    assert 1 <= n_fft <= 2, f"fft primitive count {n_fft}, budget 2"
+
+
+def test_step_jaxpr_fft_budget_chained_is_worse():
+    # the guard itself: disabling fusion must blow the budget, so the
+    # test above cannot pass vacuously
+    integ, st = _build(n=16)
+    integ.ins.fused_stokes = None
+    jaxpr = jax.make_jaxpr(lambda s: integ.step(s, 1e-3))(st)
+    assert count_fft(jaxpr.jaxpr) > 2
+
+
+def test_step_hlo_zero_scatter():
+    import sys
+    import os
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools"))
+    from hlo_cost_audit import hlo_op_counts
+
+    integ, st = _build(n=16)
+    compiled = jax.jit(lambda s: integ.step(s, 1e-3)).lower(st).compile()
+    ops = hlo_op_counts(compiled.as_text())
+    scatter = sum(v for k, v in ops.items() if k.startswith("scatter"))
+    assert scatter == 0, f"scatter ops leaked into the step HLO: {ops}"
+
+
+def test_bf16_step_same_fft_budget():
+    integ, st = build_shell_example(n_cells=16, n_lat=8, n_lon=16,
+                                    use_fast_interaction="packed",
+                                    spectral_dtype="bf16")
+    jaxpr = jax.make_jaxpr(lambda s: integ.step(s, 1e-3))(st)
+    # mixed precision changes operand dtypes, never transform count
+    assert 1 <= count_fft(jaxpr.jaxpr) <= 2

@@ -18,7 +18,11 @@ counts:
 
 Every leg runs in its own child process (the XLA CPU pipeline has a
 rare native-crash flake; an isolated leg loses one data point, not the
-artifact). Results land in ``HLO_COST_r05.json`` and feed PERF.md.
+artifact). Results land in ``HLO_COST_r06.json`` and feed PERF.md.
+Round 6 adds an FFT census per leg (batched-transform call count +
+per-transform bytes at the jaxpr primitive level) and the fluid trio
+(``fluid`` fused / ``fluid_chained`` pre-fusion / ``fluid_bf16``
+mixed-precision), pinning the spectral fusion by op count.
 
 Caveats (stated in the artifact): CPU-backend fusion/layout decisions
 differ from TPU in the details, so treat ratios between engines as the
@@ -79,7 +83,8 @@ def _leg_child(q, n, n_lat, n_lon, engine, piece):
         integ, state = build_shell_example(
             n_cells=n, n_lat=n_lat, n_lon=n_lon, radius=0.25,
             aspect=1.2, stiffness=1.0, rest_length_factor=0.75,
-            mu=0.05, use_fast_interaction=engine)
+            mu=0.05, use_fast_interaction=engine,
+            spectral_dtype="bf16" if piece == "fluid_bf16" else None)
         ib = integ.ib
         grid = integ.ins.grid
         dt = 5e-5
@@ -89,10 +94,50 @@ def _leg_child(q, n, n_lat, n_lon, engine, piece):
         if piece == "step":
             fn = jax.jit(lambda s: integ.step(s, dt))
             lowered = fn.lower(state)
-        elif piece == "fluid":
+        elif piece in ("fluid", "fluid_bf16", "fluid_chained"):
+            # fluid_bf16: the mixed-precision transform path (the
+            # integrator was built with spectral_dtype="bf16" above);
+            # fluid_chained: the PRE-fusion chain (separate Helmholtz
+            # solves -> projection -> pressure update) the fused
+            # substep replaced. These legs are the WHOLE ins.step
+            # (convective + rhs assembly dilute the substep delta);
+            # the substep* trio below isolates the solve itself — the
+            # ">= 20% lower fluid-phase bytes-accessed" evidence
+            if piece == "fluid_chained":
+                integ.ins.fused_stokes = None
             f = tuple(jnp.zeros_like(u) for u in state.ins.u)
             fn = jax.jit(lambda st, ff: integ.ins.step(st, dt, f=ff))
             lowered = fn.lower(state.ins, f)
+        elif piece in ("substep", "substep_bf16", "substep_chained"):
+            # the spectral solve in ISOLATION: Helmholtz + projection
+            # + pressure increment, holding the surrounding step fixed
+            from ibamr_tpu.ops import stencils
+            from ibamr_tpu.solvers import fft as _fft
+
+            ins = integ.ins
+            dx = grid.dx
+            alpha, beta = ins.rho / dt, -0.5 * ins.mu
+            rhs = state.ins.u
+            if piece == "substep_chained":
+                def sub(r):
+                    u_star = _fft.solve_helmholtz_periodic_vel(
+                        r, dx, alpha, beta)
+                    u_new, phi0 = _fft.project_divergence_free(
+                        u_star, dx)
+                    phi = alpha * phi0
+                    p_inc = phi + (beta / alpha) * stencils.laplacian(
+                        phi, dx)
+                    return u_new, p_inc
+            else:
+                sd = "bf16" if piece == "substep_bf16" else None
+
+                def sub(r):
+                    return _fft.helmholtz_project_periodic(
+                        r, dx, alpha=alpha, beta=beta,
+                        pinc_coeffs=(alpha, beta), spectral_dtype=sd)
+
+            fn = jax.jit(sub)
+            lowered = fn.lower(rhs)
         elif piece == "spread":
             F = jnp.zeros_like(X)
 
@@ -151,10 +196,29 @@ def _leg_child(q, n, n_lat, n_lon, engine, piece):
         # and their traced dtypes/shapes show exactly what occupancy
         # packing and bf16 compression do to them
         census = {"dot_lhs_bytes": 0, "dot_rhs_bytes": 0,
-                  "dot_out_bytes": 0, "dot_count": 0, "dot_flops": 0}
+                  "dot_out_bytes": 0, "dot_count": 0, "dot_flops": 0,
+                  # FFT census (round 6): batched-transform call count
+                  # and per-transform operand bytes, at the jaxpr
+                  # PRIMITIVE level — backend-independent (the CPU
+                  # backend lowers lax.fft to a ducc custom-call, so an
+                  # HLO-text opcode census cannot see it; the primitive
+                  # count is exactly the number of batched FFT calls
+                  # the TPU backend will also issue)
+                  "fft_ops": 0, "fft_bytes": 0, "fft_transforms": []}
 
         def _walk(jaxpr):
             for eqn in jaxpr.eqns:
+                if eqn.primitive.name == "fft":
+                    iv, ov = eqn.invars[0].aval, eqn.outvars[0].aval
+                    ib_, ob = (iv.size * iv.dtype.itemsize,
+                               ov.size * ov.dtype.itemsize)
+                    census["fft_ops"] += 1
+                    census["fft_bytes"] += ib_ + ob
+                    if len(census["fft_transforms"]) < 32:
+                        census["fft_transforms"].append({
+                            "kind": str(eqn.params.get("fft_type")),
+                            "in_shape": list(iv.shape),
+                            "in_bytes": ib_, "out_bytes": ob})
                 if eqn.primitive.name == "dot_general":
                     lhs, rhs = eqn.invars[0].aval, eqn.invars[1].aval
                     outv = eqn.outvars[0].aval
@@ -183,10 +247,13 @@ def _leg_child(q, n, n_lat, n_lon, engine, piece):
                 cj = jax.make_jaxpr(block)(u, X, F, mask)
             elif piece == "step":
                 cj = jax.make_jaxpr(lambda s: integ.step(s, dt))(state)
-            elif piece == "fluid":
+            elif piece in ("fluid", "fluid_bf16", "fluid_chained"):
                 cj = jax.make_jaxpr(
                     lambda st, ff: integ.ins.step(st, dt, f=ff))(
                         state.ins, f)
+            elif piece in ("substep", "substep_bf16",
+                           "substep_chained"):
+                cj = jax.make_jaxpr(sub)(rhs)
             elif piece == "refresh":
                 cj = jax.make_jaxpr(
                     lambda c, Xa, m: ib.refresh(c, Xa, m)[0])(
@@ -281,10 +348,15 @@ def main() -> int:
                     help="small cross-check size (0 disables)")
     ap.add_argument("--timeout", type=float, default=2400.0)
     ap.add_argument("--out", type=str,
-                    default=os.path.join(REPO, "HLO_COST_r05.json"))
+                    default=os.path.join(REPO, "HLO_COST_r06.json"))
     ap.add_argument("--engines", type=str, default="",
                     help="comma-separated engine subset (default all)")
+    ap.add_argument("--pieces", type=str, default="",
+                    help="comma-separated piece subset (default all); "
+                         "re-measured legs upsert into --out in place")
     args = ap.parse_args()
+    args.pieces = ({s.strip() for s in args.pieces.split(",")}
+                   if args.pieces else None)
     global ENGINES
     if args.engines:
         subset = {s.strip() for s in args.engines.split(",")}
@@ -310,9 +382,17 @@ def main() -> int:
             if label in ("packed", "packed3"):
                 pieces.append("step")
             if label == "packed":
-                pieces.append("fluid")
+                # the fluid trio (whole ins.step) plus the isolated
+                # substep trio (the solve alone): fused plan path vs
+                # the pre-fusion chain vs the bf16 transform path —
+                # the round-6 ">= 20% lower fluid-phase bytes" evidence
+                pieces.extend(["fluid", "fluid_chained", "fluid_bf16",
+                               "substep", "substep_chained",
+                               "substep_bf16"])
                 pieces.append("refresh")
             for piece in pieces:
+                if args.pieces and piece not in args.pieces:
+                    continue
                 legs.append((n, nla, nlo, label, eng, piece))
 
     # merge-don't-clobber: an --engines subset run must not destroy

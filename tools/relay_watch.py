@@ -7,7 +7,9 @@ Loop: probe the accelerator backend in a killable subprocess every
 
   1. ``bench.py`` (staged flagship shootout; stdout JSON captured to
      ``--out``), and
-  2. ``tools/microbench_transfer.py`` at 256^3 (per-engine legs),
+  2. ``tools/microbench_transfer.py`` at 256^3 (per-engine legs), and
+  3. ``tools/microbench_fluid.py`` at 256^3 (transform-vs-algebra
+     split of the fluid substep + the bf16 transform twin),
 
 then keep polling: if the relay was healthy but the bench failed to
 produce a TPU-platform JSON line (the relay can die mid-run), the
@@ -188,6 +190,27 @@ def main() -> int:
                     g.write(r2.stderr or "")
             except subprocess.TimeoutExpired:
                 log(f, "microbench timed out")
+            # fluid-phase decomposition while the window is still warm
+            # (round 6: transform-vs-algebra split + bf16 twin — the
+            # numbers PERF.md's fluid-floor verdict is updated from)
+            try:
+                r3 = subprocess.run(
+                    [sys.executable,
+                     os.path.join(REPO, "tools", "microbench_fluid.py"),
+                     "--n", "256", "--json"],
+                    capture_output=True, text=True, cwd=REPO, env=env,
+                    timeout=args.bench_timeout)
+                log(f, f"microbench_fluid rc={r3.returncode}\n"
+                       + "\n".join((r3.stdout or "").strip().splitlines()[-15:])
+                       + "\n--- stderr tail ---\n"
+                       + "\n".join((r3.stderr or "").strip().splitlines()[-10:]))
+                with open(args.out.replace(".json", "_microbench_fluid.txt"),
+                          "w") as g:
+                    g.write(r3.stdout or "")
+                    g.write("\n--- stderr ---\n")
+                    g.write(r3.stderr or "")
+            except subprocess.TimeoutExpired:
+                log(f, "microbench_fluid timed out")
         else:
             log(f, "bench ran but did not produce a TPU JSON line; re-arming")
             time.sleep(args.interval)
