@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 import traceback
@@ -370,11 +371,17 @@ def phase_breakdown(jax, integ, state, dt: float, iters: int = 10) -> dict:
 
 def run_stage(jax, n: int, n_lat: int, n_lon: int, steps: int,
               warmup: int, dt: float, use_fast=None,
-              fast_opts=None, spectral_dtype=None) -> dict:
+              fast_opts=None, spectral_dtype=None,
+              record_dir=None) -> dict:
     """Build the shell config at one grid size and time the jitted step.
     ``fast_opts=(tile, cap)`` overrides the MXU engine geometry (the
     cap/tile sweep); ``spectral_dtype="bf16"`` opts the fluid substep
-    into the mixed-precision transform path."""
+    into the mixed-precision transform path. ``record_dir`` arms a
+    flight recorder on the stage: the pre-run state is snapshotted
+    (host-side, before donation can invalidate it) and a non-finite
+    finish dumps a ``record_dir/incidents`` replay capsule carrying the
+    exact factory spec — ``tools/replay.py`` rebuilds the stage from it
+    offline (docs/RESILIENCE.md)."""
     from ibamr_tpu.models.shell3d import build_shell_example
 
     integ, state = build_shell_example(
@@ -382,6 +389,18 @@ def run_stage(jax, n: int, n_lat: int, n_lon: int, steps: int,
         radius=0.25, aspect=1.2, stiffness=1.0, rest_length_factor=0.75,
         mu=0.05, use_fast_interaction=use_fast,
         spectral_dtype=spectral_dtype)
+    recorder = None
+    if record_dir:
+        from ibamr_tpu.utils.flight_recorder import (FlightRecorder,
+                                                     factory_spec)
+        recorder = FlightRecorder(capacity=1, spec=factory_spec(
+            "ibamr_tpu.models.shell3d", "build_shell_example",
+            n_cells=n, n_lat=n_lat, n_lon=n_lon, radius=0.25,
+            aspect=1.2, stiffness=1.0, rest_length_factor=0.75,
+            mu=0.05, use_fast_interaction=use_fast,
+            spectral_dtype=spectral_dtype))
+        recorder.snapshot(state, step=0, dt=dt, length=warmup + steps,
+                          integ=integ)
     if fast_opts is not None:
         from ibamr_tpu.ops.interaction_fast import FastInteraction
         tile, cap = fast_opts
@@ -439,7 +458,14 @@ def run_stage(jax, n: int, n_lat: int, n_lon: int, steps: int,
 
     import numpy as np
     if not bool(np.isfinite(np.asarray(jax.device_get(state.X))).all()):
-        raise FloatingPointError(f"non-finite marker state at n={n}")
+        err = FloatingPointError(f"non-finite marker state at n={n}")
+        if recorder is not None:
+            cap = recorder.dump_incident(
+                directory=os.path.join(record_dir, "incidents"),
+                kind="divergence")
+            err.capsule = cap
+            log(f"[bench] n={n} diverged; replay capsule: {cap}")
+        raise err
 
     n_markers = int(state.X.shape[0])
     out = {
@@ -487,6 +513,11 @@ def main():
                     help="write a liveness heartbeat.json to this path "
                          "(or directory) so an external watcher can "
                          "tell a hung relay from a slow stage")
+    ap.add_argument("--record", type=str, default="",
+                    help="arm a flight recorder on every ramp stage; a "
+                         "diverged stage dumps a replay capsule under "
+                         "this directory (tools/replay.py re-executes "
+                         "it offline)")
     args = ap.parse_args()
 
     t_start = time.perf_counter()
@@ -596,7 +627,10 @@ def main():
                     # engines at the target size
                     stage = run_stage(jax, n, n_lat, n_lon, args.steps,
                                       args.warmup, args.dt,
-                                      use_fast=True)
+                                      use_fast=True,
+                                      record_dir=(os.path.join(
+                                          args.record, f"n{n}")
+                                          if args.record else None))
                 log(f"[bench] stage n={n}: {stage['steps_per_sec']} "
                     "steps/s")
                 if wd is not None:
@@ -790,6 +824,18 @@ def main():
     if wd is not None:
         wd.beat(step=len(result["stages"]) + 1)   # final liveness mark
         wd.stop()
+    if args.record:
+        # incidents = real stage failures; replays = capsules on disk a
+        # relay_watch/operator can hand straight to tools/replay.py
+        import glob
+        caps = sorted(os.path.dirname(m) for m in glob.glob(
+            os.path.join(args.record, "**", "manifest.json"),
+            recursive=True))
+        result["incidents"] = len(
+            [e for e in (result.get("error") or "").split("; ")
+             if e and "skipped" not in e])
+        result["replays"] = len(caps)
+        result["replay_capsules"] = caps
     print(json.dumps(result), flush=True)
 
 

@@ -166,6 +166,192 @@ def escalation_chain(name: str = "base"):
     return chain
 
 
+# ---------------------------------------------------------------------------
+# precision escalation (PR 5): the spectral_dtype chain + f64 shadow audit
+# ---------------------------------------------------------------------------
+
+class PrecisionDrift(SimulationDiverged):
+    """The strided f64 shadow audit found the mixed-precision fluid
+    substep drifting past its pinned bound: the state is finite and the
+    solver converged, but the fast path is lying. Subclasses
+    :class:`SimulationDiverged` so the supervisor's rollback machinery
+    fires — but the supervisor retries at the NEXT precision level
+    (``PRECISION_FALLBACKS``) instead of backing dt off, because the
+    cure is precision, not stability."""
+
+    kind = "precision_drift"
+
+    def __init__(self, step: int, *, drift: float, bound: float,
+                 spectral_dtype: str, div_drift: Optional[float] = None):
+        self.step = step
+        self.drift = float(drift)
+        self.bound = float(bound)
+        self.spectral_dtype = spectral_dtype
+        self.div_drift = None if div_drift is None else float(div_drift)
+        self.bad_leaves: list = []      # nothing is non-finite
+        RuntimeError.__init__(
+            self,
+            f"precision drift by step {step}: f64 shadow audit measured "
+            f"relative substep drift {self.drift:.4g} > bound "
+            f"{self.bound:.4g} at spectral_dtype={spectral_dtype!r} — "
+            f"the mixed-precision fast path is out of tolerance")
+
+    def incident_payload(self) -> dict:
+        return {"drift": self.drift, "bound": self.bound,
+                "spectral_dtype": self.spectral_dtype,
+                "div_drift": self.div_drift}
+
+
+# level name -> next link (None terminates): the ENGINE_FALLBACKS /
+# ESCALATION_FALLBACKS shape, applied to the spectral_dtype knob. The
+# names are exactly the canonical_spectral_dtype aliases, so a level
+# name can be assigned straight onto ``integ.spectral_dtype``.
+PRECISION_LEVELS = ("bf16", "f32", "f64")
+PRECISION_FALLBACKS: Dict[str, Optional[str]] = {
+    "bf16": "f32",
+    "f32": "f64",
+    "f64": None,
+}
+
+
+def precision_level_name(spectral_dtype) -> str:
+    """Map a canonical ``spectral_dtype`` knob value (None / jnp.bfloat16
+    / jnp.float64 or their string aliases) to its PRECISION_LEVELS name."""
+    import jax.numpy as jnp
+
+    from ibamr_tpu.solvers.spectral_plan import canonical_spectral_dtype
+
+    sd = canonical_spectral_dtype(spectral_dtype)
+    if sd is None:
+        return "f32"
+    if sd is jnp.bfloat16:
+        return "bf16"
+    return "f64"
+
+
+def precision_chain(name: str = "bf16"):
+    """The precision escalation order starting AT ``name`` (inclusive)."""
+    if name not in PRECISION_FALLBACKS:
+        raise KeyError(f"unknown precision level {name!r}; known: "
+                       f"{list(PRECISION_LEVELS)}")
+    chain, cur = [], name
+    while cur is not None:
+        chain.append(cur)
+        cur = PRECISION_FALLBACKS[cur]
+    return chain
+
+
+class ShadowAuditor:
+    """Strided f64 shadow audit of the fused spectral fluid substep.
+
+    Every ``every`` chunks, :meth:`maybe_audit` re-runs ONE
+    representative Stokes substep from the current velocity twice —
+    once at the integrator's configured ``spectral_dtype`` and once at
+    f64 via the existing :class:`~ibamr_tpu.solvers.spectral_plan
+    .SpectralPlan` — and compares the relative velocity drift (and the
+    post-projection divergence gap) against pinned bounds. A breach
+    raises :class:`PrecisionDrift`, which the supervisor answers with a
+    rollback and a retry at the next ``PRECISION_FALLBACKS`` level.
+
+    The audit is strided and OUTSIDE the jitted chunk (one extra
+    substep per ``every`` chunks, amortized to noise) so the hot path's
+    trace and transfer budget are untouched — pinned by the driver's
+    ``trace_counts`` in tests.
+
+    Default ``bound=0.02``: an order of magnitude above the pinned
+    natural bf16 substep drift (~3e-3 vs the f64 oracle,
+    tests/test_spectral_plan.py), so only a genuinely out-of-tolerance
+    fast path trips it.
+    """
+
+    def __init__(self, every: int = 8, bound: float = 0.02,
+                 div_bound: Optional[float] = None):
+        if every < 1:
+            raise ValueError("ShadowAuditor.every must be >= 1")
+        self.every = every
+        self.bound = float(bound)
+        self.div_bound = None if div_bound is None else float(div_bound)
+        self.chunks_seen = 0
+        self.audits = 0
+        self.history: list = []
+        self.last: Optional[dict] = None
+
+    def params(self) -> dict:
+        """JSON-safe audit configuration for the flight-recorder
+        fingerprint (what tools/replay.py re-arms the audit from)."""
+        return {"every": self.every, "bound": self.bound,
+                "div_bound": self.div_bound}
+
+    @staticmethod
+    def _fluid_parts(integ, state):
+        """(ins-like integrator, ins-like state) — unwraps one IB layer."""
+        ins = getattr(integ, "ins", None)
+        if ins is not None and hasattr(state, "ins"):
+            return ins, state.ins
+        return integ, state
+
+    def maybe_audit(self, integ, state, dt, step: int):
+        """Called by the driver once per chunk; audits every ``every``-th
+        call. Returns the audit record (or None off-cadence)."""
+        self.chunks_seen += 1
+        if self.chunks_seen % self.every:
+            return None
+        return self.audit(integ, state, dt, step=step)
+
+    def audit(self, integ, state, dt, step: int):
+        """One shadow audit; raises :class:`PrecisionDrift` on breach."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ibamr_tpu.ops import stencils
+        from ibamr_tpu.solvers.spectral_plan import get_plan
+
+        fluid, fstate = self._fluid_parts(integ, state)
+        sdtype = getattr(fluid, "spectral_dtype", None)
+        grid = fluid.grid
+        rho = float(getattr(fluid, "rho", 1.0))
+        mu = float(getattr(fluid, "mu", 0.0))
+        u = fstate.u
+        # representative single Stokes substep: backward-Euler viscous
+        # solve + Leray projection of rho/dt * u — the exact algebra the
+        # fused fast path runs each half-step, fed the live velocity
+        alpha = rho / float(dt)
+        beta = -0.5 * mu
+        rhs = tuple((c * alpha) for c in u)
+        plan = get_plan(rhs[0].shape, grid.dx, rhs[0].dtype)
+        fast_u, _ = plan.substep(rhs, alpha, beta, (alpha, beta),
+                                 spectral_dtype=sdtype)
+        plan64 = get_plan(rhs[0].shape, grid.dx, jnp.float64)
+        ref_u, _ = plan64.substep(
+            tuple(c.astype(plan64.rdtype) for c in rhs),
+            alpha, beta, (alpha, beta), spectral_dtype=None)
+        scale = max(float(jnp.max(jnp.abs(c))) for c in ref_u)
+        scale = max(scale, 1e-30)
+        drift = max(
+            float(jnp.max(jnp.abs(f.astype(plan64.rdtype)
+                                  - r.astype(plan64.rdtype))))
+            for f, r in zip(fast_u, ref_u)) / scale
+        div_fast = float(jnp.max(jnp.abs(
+            stencils.divergence(fast_u, grid.dx))))
+        div_ref = float(jnp.max(jnp.abs(
+            stencils.divergence(ref_u, grid.dx))))
+        div_drift = abs(div_fast - div_ref) / max(scale, 1e-30)
+        self.audits += 1
+        level = precision_level_name(sdtype)
+        rec = {"step": int(step), "spectral_dtype": level,
+               "drift": drift, "bound": self.bound,
+               "div_drift": div_drift, "div_bound": self.div_bound}
+        self.last = rec
+        self.history.append(rec)
+        breached = (np.isfinite(drift) and drift > self.bound) or \
+            (self.div_bound is not None and div_drift > self.div_bound)
+        if breached:
+            raise PrecisionDrift(step, drift=drift, bound=self.bound,
+                                 spectral_dtype=level,
+                                 div_drift=div_drift)
+        return rec
+
+
 def escalate_solve(attempt_fn: Callable, *, context: str = "solve",
                    chain=None, on_incident: Optional[Callable] = None,
                    step: Optional[int] = None):

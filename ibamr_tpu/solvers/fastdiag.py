@@ -36,6 +36,7 @@ import functools
 import math
 from typing import Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -93,14 +94,25 @@ def _periodic_symbol(n: int, h: float) -> np.ndarray:
 # symbol / eigendecomposition and every trace captures the SAME
 # constants (the 1-D analog of solvers.spectral_plan.get_plan)
 @functools.lru_cache(maxsize=64)
-def _periodic_fft_plan(n: int, h: float):
+def _periodic_fft_plan_impl(n: int, h: float, x64: bool):
     return ("fft", jnp.asarray(_periodic_symbol(n, h)))
 
 
+def _periodic_fft_plan(n: int, h: float):
+    # keyed on the x64 mode: the cached jnp array's dtype follows the
+    # mode at BUILD time, and a stale-mode plan would leak f64 (or f32)
+    # constants into every later trace (see spectral_plan.plan_key)
+    return _periodic_fft_plan_impl(n, h, bool(jax.config.jax_enable_x64))
+
+
 @functools.lru_cache(maxsize=64)
-def _periodic_eig_plan(n: int, h: float):
+def _periodic_eig_plan_impl(n: int, h: float, x64: bool):
     lam, V = np.linalg.eigh(laplacian_1d_periodic(n, h))
     return ("eig", jnp.asarray(V), jnp.asarray(lam))
+
+
+def _periodic_eig_plan(n: int, h: float):
+    return _periodic_eig_plan_impl(n, h, bool(jax.config.jax_enable_x64))
 
 
 def laplacian_1d_periodic(n: int, h: float) -> np.ndarray:

@@ -51,13 +51,21 @@ Vel = Tuple[jnp.ndarray, ...]
 _SPECTRAL_DTYPE_ALIASES = {
     None: None, "none": None, "f32": None, "float32": None,
     "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+    "f64": jnp.float64, "float64": jnp.float64,
 }
 
 
 def canonical_spectral_dtype(spec):
-    """Normalize the ``spectral_dtype`` knob: ``None`` (full precision)
-    or ``jnp.bfloat16`` (compressed transform operands). Anything else
-    is a typo'd input file and raises."""
+    """Normalize the ``spectral_dtype`` knob: ``None`` (native working
+    precision, f32 by convention), ``jnp.bfloat16`` (compressed
+    transform operands), or ``jnp.float64`` (escalated: the whole
+    substep runs on the f64 twin plan — the precision-escalation chain's
+    last link and the shadow audit's reference). Anything else is a
+    typo'd input file and raises.
+
+    Note: under a runtime without x64 enabled the f64 request
+    canonicalizes to f32 at plan-build time (jax's standard dtype
+    demotion) — the knob is then a no-op, not an error."""
     if isinstance(spec, str):
         key = spec.lower()
         if key in _SPECTRAL_DTYPE_ALIASES:
@@ -65,12 +73,15 @@ def canonical_spectral_dtype(spec):
         raise ValueError(
             f"spectral_dtype = {spec!r}: expected one of "
             f"{sorted(k for k in _SPECTRAL_DTYPE_ALIASES if k)} or None")
-    if spec is None or spec is jnp.bfloat16:
+    if spec is None or spec is jnp.bfloat16 or spec is jnp.float64:
         return spec
     if jnp.dtype(spec) == jnp.dtype(jnp.bfloat16):
         return jnp.bfloat16
+    if jnp.dtype(spec) == jnp.dtype(jnp.float64):
+        return jnp.float64
     raise ValueError(f"spectral_dtype = {spec!r}: only bf16 operand "
-                     "compression is supported (None = full precision)")
+                     "compression or f64 escalation is supported "
+                     "(None = native precision)")
 
 
 def _round_real(x: jnp.ndarray, sdtype) -> jnp.ndarray:
@@ -177,6 +188,23 @@ class SpectralPlan:
         """
         sdtype = canonical_spectral_dtype(spectral_dtype)
         rdtype = self.rdtype
+        if sdtype is jnp.float64:
+            # escalated precision: run the WHOLE substep on the f64
+            # twin plan (tables, transforms and algebra all at f64) and
+            # cast the outputs back to the caller's working dtype. This
+            # is the precision-escalation chain's last link and the
+            # shadow audit's reference path.
+            if rdtype == jnp.float64:
+                return self.substep(rhs, alpha, beta, pinc_coeffs,
+                                    spectral_dtype=None,
+                                    filter_sym=filter_sym)
+            plan64 = get_plan(self.shape, self.dx, jnp.float64, self.bc)
+            u64, p64 = plan64.substep(
+                tuple(c.astype(plan64.rdtype) for c in rhs),
+                alpha, beta, pinc_coeffs, spectral_dtype=None,
+                filter_sym=filter_sym)
+            return (tuple(c.astype(rdtype) for c in u64),
+                    p64.astype(rdtype))
         x = jnp.stack(rhs)
         if sdtype is not None:
             # bf16 transform operands, f32 twiddle/accumulation
@@ -287,10 +315,15 @@ _stats = {"hits": 0, "misses": 0, "evictions": 0}
 
 def plan_key(shape: Sequence[int], dx: Sequence[float], dtype,
              bc: str = "periodic") -> tuple:
+    # the x64 flag is part of the key: table BUILDERS run np/jnp math
+    # whose intermediate precision follows the mode, so two same-dtype
+    # plans built under different modes differ in the last ulp — enough
+    # to break tools/replay.py's bitwise pin when it re-executes a
+    # capsule under the recorded mode inside a long-lived process
     return (tuple(int(s) for s in shape),
             tuple(float(h) for h in dx),
             jnp.dtype(jax.dtypes.canonicalize_dtype(dtype)).name,
-            bc)
+            bc, bool(jax.config.jax_enable_x64))
 
 
 def get_plan(shape: Sequence[int], dx: Sequence[float], dtype,

@@ -3,6 +3,7 @@ from ibamr_tpu.utils.gridfunctions import CartGridFunction
 from ibamr_tpu.utils.timers import TimerManager, timer
 from ibamr_tpu.utils.metrics import MetricsLogger
 from ibamr_tpu.utils.health import HealthDegraded, HealthProbe
+from ibamr_tpu.utils.flight_recorder import (FlightRecorder, factory_spec)
 from ibamr_tpu.utils.watchdog import (RunWatchdog, heartbeat_age,
                                       read_heartbeat)
 
@@ -16,6 +17,8 @@ __all__ = [
     "MetricsLogger",
     "HealthDegraded",
     "HealthProbe",
+    "FlightRecorder",
+    "factory_spec",
     "RunWatchdog",
     "heartbeat_age",
     "read_heartbeat",

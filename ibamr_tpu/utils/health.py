@@ -28,7 +28,17 @@ idx   field         meaning
 2     ``cfl``       realized advective CFL: max_u * dt / min(dx)
 3     ``div_norm``  max |div u| (0 when no divergence functional given)
 4     ``func``      caller-supplied energy/volume functional (NaN = none)
+5     ``vol``       IB enclosed volume/area (``volume_fn``; NaN = none)
+6     ``budget``    momentum/KE budget term (``budget_fn``; NaN = none)
 ====  ============  =====================================================
+
+Slots 5–6 are the PR-5 physics-invariant sentinels: both are
+conserved-to-drift quantities, so their triage is RELATIVE drift over
+the run's own first finite value (``vol_drift_warn/fatal``,
+``budget_drift_warn/fatal``) — a leaking membrane or a momentum budget
+blowing up rolls back while every checkpoint is still healthy. They
+ride the SAME fused vitals vector, so the per-chunk cost stays one
+small device->host transfer (pinned via ``trace_counts``).
 """
 
 from __future__ import annotations
@@ -45,7 +55,8 @@ OK = "ok"
 WARN = "warn"
 FATAL = "fatal"
 
-VITALS_FIELDS = ("finite", "max_u", "cfl", "div_norm", "func")
+VITALS_FIELDS = ("finite", "max_u", "cfl", "div_norm", "func",
+                 "vol", "budget")
 
 
 class HealthDegraded(SimulationDiverged):
@@ -110,7 +121,18 @@ class HealthProbe:
     div_fatal: Optional[float] = None
     func_growth_warn: Optional[float] = None    # factor over baseline
     func_growth_fatal: Optional[float] = None
+    # PR-5 invariant sentinels (slots 5-6). Both are conserved-to-drift
+    # quantities; thresholds are RELATIVE drift |v - v0| / max(|v0|, eps)
+    # over the run's own first finite value.
+    volume_fn: Optional[Callable[[Any], Any]] = None
+    budget_fn: Optional[Callable[[Any], Any]] = None
+    vol_drift_warn: Optional[float] = None
+    vol_drift_fatal: Optional[float] = None
+    budget_drift_warn: Optional[float] = None
+    budget_drift_fatal: Optional[float] = None
     sustain: int = 3                     # WARN chunks before escalation
+
+    VITALS_FIELDS = VITALS_FIELDS        # schema, importable off the class
 
     def __post_init__(self):
         if self.sustain < 1:
@@ -118,6 +140,8 @@ class HealthProbe:
                              "zero chunks would fire immediately)")
         self._warn_streak = 0
         self._baseline_func: Optional[float] = None
+        self._baseline_vol: Optional[float] = None
+        self._baseline_budget: Optional[float] = None
         self.history: List[dict] = []    # one record per classified chunk
         self.last: Optional[dict] = None
 
@@ -127,27 +151,54 @@ class HealthProbe:
     def for_integrator(cls, integ, **kw) -> "HealthProbe":
         """Probe wired to the framework's integrator conventions: MAC
         velocity at ``state.u``, divergence via the shared stencils,
-        kinetic energy as the default functional. Any explicit kwarg
-        wins over the derived default."""
+        kinetic energy as the default functional, a momentum-magnitude
+        budget sentinel when a grid is available, and (for 2D IB
+        integrators) the enclosed marker polygon area as the volume
+        sentinel. Any explicit kwarg wins over the derived default."""
+        import jax.numpy as jnp
+
         from ibamr_tpu.ops import stencils
 
-        grid = getattr(integ, "grid", None)
-        if grid is None:
-            ins = getattr(integ, "ins", None)
-            grid = getattr(ins, "grid", None)
+        is_ib = (hasattr(integ, "ins") and hasattr(integ, "ib"))
+        ins = getattr(integ, "ins", None) if is_ib else integ
+        grid = getattr(ins, "grid", None)
+
+        def uget(s):
+            return s.ins.u if is_ib else s.u
+
         if grid is not None:
             kw.setdefault("min_dx", float(min(grid.dx)))
             dx = grid.dx
+            kw.setdefault("velocity_fn", uget)
             kw.setdefault("divergence_fn",
-                          lambda s: stencils.divergence(s.u, dx))
+                          lambda s: stencils.divergence(uget(s), dx))
+            # momentum/KE budget: cell_vol * rho * |sum_cells u| — an
+            # exactly conserved quantity of the periodic projected
+            # equations, so its drift is pure scheme/precision error
+            rho = float(getattr(ins, "rho", 1.0))
+            cv = float(getattr(grid, "cell_volume", 1.0))
+
+            def budget(s):
+                comps = uget(s)
+                mom = [jnp.sum(c) for c in comps]
+                return cv * rho * jnp.sqrt(sum(m * m for m in mom))
+            kw.setdefault("budget_fn", budget)
         if hasattr(integ, "kinetic_energy"):
             kw.setdefault("functional_fn", integ.kinetic_energy)
+        elif ins is not None and hasattr(ins, "kinetic_energy"):
+            kw.setdefault("functional_fn",
+                          lambda s: ins.kinetic_energy(s.ins)
+                          if is_ib else ins.kinetic_energy(s))
+        if is_ib and grid is not None and len(grid.dx) == 2:
+            from ibamr_tpu.integrators.ib import polygon_area
+            kw.setdefault("volume_fn", lambda s: polygon_area(s.X))
         return cls(**kw)
 
     # -- jit side ------------------------------------------------------------
 
     def measure(self, state, dt):
-        """Fixed-shape vitals vector (float32, len 5); fully traceable.
+        """Fixed-shape vitals vector (float32, ``len(VITALS_FIELDS)``);
+        fully traceable.
         Meant to be called INSIDE the driver's jitted chunk so the whole
         reduction fuses with the step scan."""
         import jax.numpy as jnp
@@ -183,14 +234,30 @@ class HealthProbe:
         else:
             func = jnp.asarray(jnp.nan, jnp.float32)
 
-        return jnp.stack([finite, max_u, cfl, div, func])
+        if self.volume_fn is not None:
+            vol = jnp.asarray(self.volume_fn(state),
+                              jnp.float32).reshape(())
+        else:
+            vol = jnp.asarray(jnp.nan, jnp.float32)
+
+        if self.budget_fn is not None:
+            budget = jnp.asarray(self.budget_fn(state),
+                                 jnp.float32).reshape(())
+        else:
+            budget = jnp.asarray(jnp.nan, jnp.float32)
+
+        return jnp.stack([finite, max_u, cfl, div, func, vol, budget])
 
     # -- host side -----------------------------------------------------------
 
     @staticmethod
     def unpack(vitals) -> dict:
+        """Vector -> named dict. Tolerates shorter (older-schema)
+        vectors: missing trailing slots read as NaN, so a v2 5-float
+        vitals record still unpacks."""
         v = np.asarray(vitals, dtype=np.float64).reshape(-1)
-        return {name: float(v[i]) for i, name in enumerate(VITALS_FIELDS)}
+        return {name: (float(v[i]) if i < v.size else float("nan"))
+                for i, name in enumerate(VITALS_FIELDS)}
 
     def classify(self, vitals, step: int, dt: float):
         """Host-side triage of one chunk's vitals vector. Returns
@@ -243,6 +310,29 @@ class HealthProbe:
         elif self.functional_fn is not None and vit["finite"] >= 1.0:
             _flag(FATAL, "functional is non-finite")
 
+        # invariant sentinels: relative drift over the run's own first
+        # finite value — a secular leak fires long before any NaN
+        for name, fn, base_attr, warn, fatal in (
+                ("vol", self.volume_fn, "_baseline_vol",
+                 self.vol_drift_warn, self.vol_drift_fatal),
+                ("budget", self.budget_fn, "_baseline_budget",
+                 self.budget_drift_warn, self.budget_drift_fatal)):
+            val = vit[name]
+            if math.isfinite(val):
+                if getattr(self, base_attr) is None:
+                    setattr(self, base_attr, val)
+                base = getattr(self, base_attr)
+                drift = abs(val - base) / max(abs(base), 1e-30)
+                vit[f"{name}_drift"] = drift
+                if fatal is not None and drift > fatal:
+                    _flag(FATAL, f"{name} drifted {drift:.3g} from "
+                                 f"baseline {base:.4g} (fatal {fatal:g})")
+                elif warn is not None and drift > warn:
+                    _flag(WARN, f"{name} drifted {drift:.3g} from "
+                                f"baseline {base:.4g} (warn {warn:g})")
+            elif fn is not None and vit["finite"] >= 1.0:
+                _flag(FATAL, f"{name} sentinel is non-finite")
+
         self._warn_streak = self._warn_streak + 1 if level != OK else 0
         rec = dict(vit, step=int(step), dt=float(dt), level=level,
                    warn_streak=self._warn_streak, reasons=list(reasons))
@@ -266,6 +356,8 @@ class HealthProbe:
         return self.last
 
     def reset(self):
-        """Forget streaks AND the functional baseline (a new run)."""
+        """Forget streaks AND every baseline (a new run)."""
         self._warn_streak = 0
         self._baseline_func = None
+        self._baseline_vol = None
+        self._baseline_budget = None

@@ -142,6 +142,19 @@ class HierarchyDriver:
     inside the jitted chunk, its ``check`` triages on the host and
     raises ``HealthDegraded`` (a ``SimulationDiverged`` precursor)
     before any cadence callback sees the degraded state.
+
+    ``recorder`` (a :class:`ibamr_tpu.utils.flight_recorder
+    .FlightRecorder`) snapshots the pre-chunk state to HOST memory
+    before every chunk. The snapshot happens BEFORE the jitted chunk
+    consumes the state, which is what makes recording compatible with
+    ``cfg.donate=True``: the donated chunk invalidates the device
+    buffers, but the ring holds independent host copies.
+
+    ``shadow_audit`` (a :class:`ibamr_tpu.solvers.escalation
+    .ShadowAuditor`) re-runs one fluid substep at f64 every N chunks
+    and raises ``PrecisionDrift`` when the configured
+    ``spectral_dtype`` path drifts past its pinned bound — BEFORE the
+    checkpoint cadence can persist a silently-drifted state.
     """
 
     def __init__(self, integ, cfg: RunConfig,
@@ -152,7 +165,9 @@ class HierarchyDriver:
                  step_fn: Optional[Callable] = None,
                  timer=None,
                  timer_name: str = "HierarchyIntegrator::advanceHierarchy",
-                 health_probe=None):
+                 health_probe=None,
+                 recorder=None,
+                 shadow_audit=None):
         self.integ = integ
         self.cfg = cfg
         self.viz_fn = viz_fn
@@ -162,6 +177,8 @@ class HierarchyDriver:
         self.timer = timer                 # TimerManager: scopes ONLY the
         self.timer_name = timer_name       # jitted advance, not callbacks
         self.health_probe = health_probe
+        self.recorder = recorder
+        self.shadow_audit = shadow_audit
         self.last_vitals = None            # host dict of the last chunk
         self.last_chunk_wall_s = None      # wall seconds incl. the sync
         self.history = []
@@ -249,6 +266,12 @@ class HierarchyDriver:
             for i in cadences:               # land exactly on cadences
                 n = min(n, i - step % i)
             probe = self.health_probe
+            if self.recorder is not None:
+                # host copy of the PRE-chunk state, taken before the
+                # (possibly donated) chunk invalidates its buffers
+                self.recorder.snapshot(state, step=step, dt=dt,
+                                       length=n, integ=self.integ,
+                                       cfg=cfg)
             t0 = time.perf_counter()
             if self.timer is not None:
                 with self.timer.scope(self.timer_name):
@@ -270,6 +293,12 @@ class HierarchyDriver:
                 # callback can checkpoint the degraded state
                 self.last_vitals = probe.check(health, step=step + n,
                                                dt=dt)
+            if self.shadow_audit is not None:
+                # strided f64 shadow audit; raises PrecisionDrift
+                # BEFORE the checkpoint cadence can persist a
+                # silently-drifted state
+                self.shadow_audit.maybe_audit(self.integ, state, dt,
+                                              step=step + n)
             step += n
 
             if self.metrics_fn is not None:

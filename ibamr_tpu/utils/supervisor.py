@@ -15,7 +15,17 @@ restore), or its host (SIGTERM/SIGINT preemption) finishes anyway:
   checkpoint of the last healthy post-chunk state, and return;
 - every recovery appends one structured JSONL record to
   ``incidents.jsonl`` (schema in docs/RESILIENCE.md) so operators see
-  what the run survived, not just that it finished.
+  what the run survived, not just that it finished;
+- **precision drift** (the f64 shadow audit tripping on a bf16/mixed
+  spectral path) -> roll back and retry at the NEXT
+  ``PRECISION_FALLBACKS`` level (bf16 -> f32 -> f64) with dt UNCHANGED
+  — the cure is precision, not stability — recorded as a
+  ``precision_escalation`` incident;
+- with a :class:`~ibamr_tpu.utils.flight_recorder.FlightRecorder`
+  wired (``recorder=`` here or on the driver), EVERY incident record
+  is **schema v3**: it carries a ``replay`` pointer to a dumped
+  ``incidents/<step>/replay.npz`` + manifest capsule that
+  ``tools/replay.py`` re-executes bitwise offline.
 
 The supervisor owns the checkpoint cadence: it installs an
 :class:`AsyncCheckpointWriter`-backed ``checkpoint_fn`` on the wrapped
@@ -84,7 +94,7 @@ class ResilientDriver:
                  keep: int = 3, sharding_fn: Optional[Callable] = None,
                  handle_signals: bool = True,
                  incident_log: Optional[str] = None,
-                 watchdog=None):
+                 watchdog=None, recorder=None):
         if max_retries < 0:
             raise ValueError("max_retries must be >= 0")
         if not (0.0 < dt_backoff <= 1.0):
@@ -115,6 +125,14 @@ class ResilientDriver:
         self.watchdog = watchdog
         if watchdog is not None and watchdog.on_incident is None:
             watchdog.on_incident = self._record
+        # optional FlightRecorder: installed onto the driver (pre-chunk
+        # host snapshots) so every incident record can carry a dumped
+        # replay capsule — incident schema v3
+        self.recorder = recorder if recorder is not None \
+            else getattr(driver, "recorder", None)
+        if self.recorder is not None \
+                and getattr(driver, "recorder", None) is None:
+            driver.recorder = self.recorder
         self.preempted = False
         self.preempt_signum: Optional[int] = None
         self._last: Optional[tuple] = None   # (state, step) post-chunk
@@ -124,6 +142,9 @@ class ResilientDriver:
     def _record(self, rec: dict) -> dict:
         rec = dict(rec)
         rec["time"] = time.time()
+        rec.setdefault("schema", 3)
+        if "replay" not in rec:
+            rec["replay"] = self._dump_replay(rec)
         self.incidents.append(rec)
         os.makedirs(os.path.dirname(self.incident_log) or ".",
                     exist_ok=True)
@@ -131,6 +152,50 @@ class ResilientDriver:
             f.write(json.dumps(rec) + "\n")
             f.flush()
         return rec
+
+    def _dump_replay(self, rec: dict) -> Optional[str]:
+        """Dump (or reuse) the replay capsule for one incident record;
+        returns the capsule directory or None (no recorder / empty
+        ring / dump failure — a failed dump must never mask the
+        incident itself)."""
+        if self.recorder is None:
+            return None
+        try:
+            return self.recorder.dump_incident(
+                directory=os.path.join(self.directory, "incidents"),
+                kind=rec.get("kind", rec.get("event", "incident")),
+                step=rec.get("step"), event=rec.get("event"),
+                driver=self.driver)
+        except Exception as exc:          # pragma: no cover - defensive
+            import warnings
+            warnings.warn(f"replay capsule dump failed: {exc!r}")
+            return None
+
+    # -- precision escalation -----------------------------------------------
+
+    def _escalate_precision(self, e) -> Optional[tuple]:
+        """Walk ``spectral_dtype`` one PRECISION_FALLBACKS link up the
+        chain on the wrapped integrator (unwrapping one IB layer).
+        Returns ``(before, after)`` level names, or None when the chain
+        is exhausted / the integrator has no spectral knob — the caller
+        then falls through to the plain dt-backoff recovery."""
+        from ibamr_tpu.solvers.escalation import (PRECISION_FALLBACKS,
+                                                  precision_level_name)
+        from ibamr_tpu.solvers.spectral_plan import canonical_spectral_dtype
+
+        integ = self.driver.integ
+        fluid = getattr(integ, "ins", integ)
+        if not hasattr(fluid, "spectral_dtype"):
+            return None
+        cur = precision_level_name(fluid.spectral_dtype)
+        nxt = PRECISION_FALLBACKS.get(cur)
+        if nxt is None:
+            return None
+        fluid.spectral_dtype = canonical_spectral_dtype(nxt)
+        # the spectral_dtype is baked into the compiled chunks at trace
+        # time — drop them so the retry traces the escalated path
+        self.driver._chunks = {}
+        return cur, nxt
 
     # -- rollback -----------------------------------------------------------
 
@@ -198,13 +263,20 @@ class ResilientDriver:
                     writer.wait()      # every interval durably on disk
                     return out
                 except SimulationDiverged as e:
-                    # incident schema v2: ``kind`` discriminates the
+                    # incident schema v3: ``kind`` discriminates the
                     # failure family (divergence | health_degraded |
-                    # solver_breakdown), subclass payloads ride along
+                    # solver_breakdown | precision_drift), subclass
+                    # payloads ride along, ``replay`` points at the
+                    # dumped capsule when a recorder is wired
                     kind = getattr(e, "kind", "divergence")
                     payload = e.incident_payload() \
                         if hasattr(e, "incident_payload") else {}
                     dt_before = driver.cfg.dt
+                    # dump the capsule NOW, while the driver's compiled
+                    # chunk and spectral_dtype still match the failing
+                    # execution (escalation below invalidates both)
+                    payload["replay"] = self._dump_replay(
+                        {"kind": kind, "step": e.step})
                     if retries >= self.max_retries:
                         self._record(dict(payload, **{
                             "event": "give_up", "kind": kind,
@@ -218,8 +290,25 @@ class ResilientDriver:
                         writer.wait()  # pending intervals land first
                     except Exception:
                         pass           # roll back to what's on disk
+                    esc = self._escalate_precision(e) \
+                        if kind == "precision_drift" else None
                     cur_state, cur_step, ck = self._rollback(initial[0],
                                                              initial)
+                    if esc is not None:
+                        # precision, not stability, is the problem: dt
+                        # stays put; the retry reruns the rolled-back
+                        # chunk at the escalated spectral_dtype
+                        self._record(dict(payload, **{
+                            "event": "precision_escalation",
+                            "kind": kind, "step": e.step,
+                            "retry": retries,
+                            "max_retries": self.max_retries,
+                            "rollback_step": cur_step,
+                            "from_checkpoint": ck is not None,
+                            "spectral_dtype_before": esc[0],
+                            "spectral_dtype_after": esc[1],
+                            "dt": dt_before}))
+                        continue
                     driver.cfg.dt = dt_before * self.dt_backoff
                     self._record(dict(payload, **{
                         "event": "divergence", "kind": kind,

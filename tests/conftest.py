@@ -96,6 +96,12 @@ SLOW_FILES = {
 }
 
 SLOW_TESTS = {
+    # PR 5 replay drills: end-to-end record -> escalate -> replay loops
+    # (multiple jitted-run compiles each; the kill-and-replay drill
+    # spawns a subprocess victim). Covered in CI by dryrun path 18.
+    "test_precision_escalation_end_to_end_drill",
+    "test_engine_override_verdict",
+    "test_cross_mesh_kill_and_replay",
     "test_window_tracks_advected_membrane",
     "test_window_regrid_3d_smoke",
     "test_oldroyd_b_steady_shear_analytic",

@@ -1,0 +1,473 @@
+"""Flight recorder + deterministic replay + precision escalation +
+invariant sentinels (PR 5).
+
+The contract under test: EVERY incident the supervisor records is
+replayable — a bounded pre-chunk ring (host copies, donation-safe)
+plus a run fingerprint is enough for ``tools/replay.py`` to re-execute
+the failing chunk BITWISE in a fresh context and classify what the
+failure depends on (engine, spectral precision, dt). On top of it:
+the strided f64 shadow audit that turns silent bf16 drift into a
+``PrecisionDrift`` incident the supervisor cures by walking
+``PRECISION_FALLBACKS`` (dt untouched), and the two new fused vitals
+slots (enclosed volume, momentum budget) that catch secular invariant
+leaks while every state leaf is still finite.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ibamr_tpu.grid import StaggeredGrid
+from ibamr_tpu.integrators.ins import INSStaggeredIntegrator
+from ibamr_tpu.solvers.escalation import (PRECISION_FALLBACKS,
+                                          PRECISION_LEVELS,
+                                          PrecisionDrift, ShadowAuditor,
+                                          precision_chain,
+                                          precision_level_name)
+from ibamr_tpu.utils.flight_recorder import (FlightRecorder,
+                                             describe_integrator,
+                                             factory_spec)
+from ibamr_tpu.utils.health import HealthDegraded, HealthProbe
+from ibamr_tpu.utils.hierarchy_driver import (HierarchyDriver, RunConfig,
+                                              SimulationDiverged)
+from ibamr_tpu.utils.supervisor import ResilientDriver
+from tools.fault_injection import (ACTIVE_INJECTORS, _bare_bf16_drift,
+                                   apply_recorded_injectors,
+                                   nan_injector_step, recorded,
+                                   volume_leak_injector)
+from tools.replay import (newest_capsule, read_incidents, replay)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _ins(n=16, mu=0.05, **kw):
+    g = StaggeredGrid(n=(n, n), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    return INSStaggeredIntegrator(g, rho=1.0, mu=mu, **kw)
+
+
+def _tg_state(integ, mean=0.0):
+    import math
+    g = integ.grid
+    dtype = integ.dtype
+    xf, yc = g.face_centers(0, dtype)
+    xc, yf = g.face_centers(1, dtype)
+    u = jnp.sin(2 * math.pi * xf) * jnp.cos(2 * math.pi * yc) \
+        + mean + 0 * yc
+    v = -jnp.cos(2 * math.pi * xc) * jnp.sin(2 * math.pi * yf) + 0 * xc
+    return integ.initialize(u0_arrays=(u, v))
+
+
+# ---------------------------------------------------------------------------
+# precision chain + shadow audit
+# ---------------------------------------------------------------------------
+
+def test_precision_chain_shape():
+    """PRECISION_FALLBACKS is the ESCALATION_FALLBACKS shape applied to
+    the spectral_dtype knob: linear bf16 -> f32 -> f64, names assignable
+    straight onto ``integ.spectral_dtype``."""
+    assert precision_chain("bf16") == list(PRECISION_LEVELS)
+    assert precision_chain("f32") == ["f32", "f64"]
+    assert PRECISION_FALLBACKS["f64"] is None
+    with pytest.raises(KeyError):
+        precision_chain("f16")
+    assert precision_level_name(None) == "f32"
+    assert precision_level_name("bf16") == "bf16"
+    assert precision_level_name(jnp.float64) == "f64"
+    # "f32" canonicalizes to None (native precision) and round-trips
+    assert precision_level_name("f32") == "f32"
+
+
+def test_shadow_audit_clean_vs_biased():
+    """The f64 shadow audit passes the NATURAL bf16 drift (~3e-3,
+    pinned an order of magnitude under the default bound) and trips
+    with a structured payload once the spectral rounding is biased."""
+    integ = _ins(spectral_dtype="bf16")
+    st = _tg_state(integ)
+    aud = ShadowAuditor(every=1, bound=0.02)
+    rec = aud.maybe_audit(integ, st, 1e-3, step=1)
+    assert rec is not None and rec["drift"] < 0.02
+    assert aud.audits == 1 and aud.last is rec
+
+    with _bare_bf16_drift(scale=0.35):
+        with pytest.raises(PrecisionDrift) as ei:
+            aud.audit(integ, st, 1e-3, step=7)
+    e = ei.value
+    assert e.kind == "precision_drift" and e.step == 7
+    payload = e.incident_payload()
+    assert payload["drift"] > payload["bound"]
+    assert payload["spectral_dtype"] == "bf16"
+    assert e.bad_leaves == []            # nothing is non-finite
+
+    # strided cadence: every=4 audits only every 4th chunk
+    aud4 = ShadowAuditor(every=4, bound=0.02)
+    hits = [aud4.maybe_audit(integ, st, 1e-3, step=i) is not None
+            for i in range(1, 9)]
+    assert hits == [False, False, False, True,
+                    False, False, False, True]
+
+
+def test_audit_rides_driver_without_retrace():
+    """Wired into the driver the audit runs OUTSIDE the jitted chunk:
+    one compiled trace per chunk shape, unchanged by auditing."""
+    integ = _ins(spectral_dtype="bf16")
+    st = _tg_state(integ)
+    cfg = RunConfig(dt=1e-3, num_steps=8, health_interval=2)
+    aud = ShadowAuditor(every=2, bound=0.5)   # loose: never trips
+    drv = HierarchyDriver(integ, cfg, shadow_audit=aud)
+    drv.run(st)
+    assert aud.audits == 2                    # 4 chunks, every=2
+    assert set(drv.trace_counts.values()) == {1}
+
+
+# ---------------------------------------------------------------------------
+# invariant sentinels (vitals slots 5-6)
+# ---------------------------------------------------------------------------
+
+def test_vitals_seven_slots_and_backward_unpack():
+    integ = _ins()
+    st = _tg_state(integ)
+    probe = HealthProbe.for_integrator(integ)
+    v = np.asarray(jax.jit(probe.measure)(st, 1e-3))
+    assert v.shape == (len(HealthProbe.VITALS_FIELDS),)
+    d = HealthProbe.unpack(v)
+    assert np.isnan(d["vol"])            # no volume sentinel on plain INS
+    assert np.isfinite(d["budget"])      # momentum budget is derived
+    # a v2 5-float vitals vector still unpacks: trailing slots read NaN
+    old = HealthProbe.unpack(np.ones(5, np.float32))
+    assert old["func"] == 1.0
+    assert np.isnan(old["vol"]) and np.isnan(old["budget"])
+
+
+def test_volume_sentinel_trips_on_membrane_leak():
+    """An injected secular membrane contraction (every leaf finite,
+    velocity/divergence unremarkable) is caught by the enclosed-volume
+    vitals slot, and the measured drift rides the HealthDegraded
+    incident payload."""
+    from ibamr_tpu.models.membrane2d import build_membrane_example
+
+    integ, st0 = build_membrane_example(n_cells=16, num_markers=32)
+    probe = HealthProbe.for_integrator(integ, vol_drift_fatal=0.05)
+    assert probe.volume_fn is not None   # auto-derived for 2D IB
+    cfg = RunConfig(dt=1e-4, num_steps=8, health_interval=2)
+    drv = HierarchyDriver(
+        integ, cfg,
+        step_fn=volume_leak_injector(integ.step, rate=0.05,
+                                     leaf_path="X"),
+        health_probe=probe)
+    with pytest.raises(HealthDegraded) as ei:
+        drv.run(st0)
+    e = ei.value
+    assert any("vol drifted" in r for r in e.reasons)
+    assert e.vitals["vol_drift"] > 0.05  # measured drift in the payload
+    assert e.incident_payload()["vitals"]["vol_drift"] > 0.05
+    assert set(drv.trace_counts.values()) == {1}   # sentinel is fused
+
+
+def test_budget_sentinel_trips_on_momentum_injection():
+    """The momentum-budget slot catches a finite amplification that
+    conserves nothing: with a mean flow, multiplying u inflates the
+    conserved net momentum and the relative-drift triage fires while
+    the state is still finite everywhere."""
+    from tools.fault_injection import growth_injector_step
+
+    integ = _ins()
+    st0 = _tg_state(integ, mean=0.5)
+    probe = HealthProbe.for_integrator(integ, budget_drift_fatal=0.1)
+    cfg = RunConfig(dt=1e-3, num_steps=8, health_interval=2)
+    drv = HierarchyDriver(
+        integ, cfg,
+        step_fn=growth_injector_step(integ.step, rate=1.2,
+                                     leaf_path="u"),
+        health_probe=probe)
+    with pytest.raises(HealthDegraded) as ei:
+        drv.run(st0)
+    assert any("budget drifted" in r for r in ei.value.reasons)
+    assert ei.value.vitals["finite"] == 1.0   # caught while finite
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: ring, fingerprint, donation safety, overhead
+# ---------------------------------------------------------------------------
+
+def test_recorder_ring_and_fingerprint():
+    integ = _ins(spectral_dtype="bf16")
+    st = _tg_state(integ)
+    cfg = RunConfig(dt=1e-3, num_steps=12, health_interval=2)
+    rec = FlightRecorder(capacity=3)
+    drv = HierarchyDriver(integ, cfg, recorder=rec)
+    drv.run(st)
+    assert len(rec.ring) == 3              # bounded ring: 6 chunks
+    assert [e.step for e in rec.ring] == [6, 8, 10]
+    entry = rec.entry_for_step(9)             # newest entry covering 9
+    assert entry.step == 8 and entry.covers(9)
+    assert isinstance(next(iter(entry.arrays.values())), np.ndarray)
+
+    with recorded("bf16_drift", scale=0.25):
+        fp = rec.fingerprint(driver=drv)
+    assert fp["spectral_dtype"] == "bf16"
+    assert fp["integrator"]["kind"] == "ins"
+    assert fp["injectors"] == {"bf16_drift": {"scale": 0.25}}
+    assert fp["jax_version"] == jax.__version__
+    assert fp["config_digest"] and fp["x64"] == bool(
+        jax.config.jax_enable_x64)
+    json.dumps(fp)                            # must be JSON-safe
+    assert ACTIVE_INJECTORS == {}             # context popped
+
+
+def test_recorder_survives_donated_chunks():
+    """Regression (satellite b): with whole-chunk donation the chunk
+    consumes the input buffers — the recorder must hold HOST copies
+    taken pre-chunk, the run must complete without touching deleted
+    buffers, and recording must not add a retrace."""
+    integ = _ins(spectral_dtype=None)
+    st = _tg_state(integ)
+    cfg = RunConfig(dt=1e-3, num_steps=8, health_interval=2, donate=True)
+    rec = FlightRecorder(capacity=4)
+    drv = HierarchyDriver(integ, cfg, recorder=rec)
+    out = drv.run(st)
+    assert int(out.k) == 8
+    assert set(drv.trace_counts.values()) == {1}
+    for entry in rec.ring:
+        for arr in entry.arrays.values():     # host copies, all live
+            assert isinstance(arr, np.ndarray)
+            assert np.isfinite(arr).all()
+    # the ring state is restorable even though the device buffers the
+    # snapshots were taken from are long donated away
+    restored = rec.restore(rec.ring[0])
+    assert int(restored.k) == rec.ring[0].step
+
+
+def test_recorder_overhead_under_two_percent():
+    """Snapshotting the pre-chunk state must stay amortized noise: the
+    recorder's own accounting vs the measured run wall, warm. The chunk
+    length matters — a snapshot is one host copy per chunk, so the test
+    uses production-shaped chunks (tens of steps), not the short chunks
+    other tests favor for speed."""
+    integ = _ins(n=128)
+    st = _tg_state(integ)
+    cfg = RunConfig(dt=1e-4, num_steps=192, health_interval=96)
+    rec = FlightRecorder(capacity=2)
+    drv = HierarchyDriver(integ, cfg, recorder=rec)
+    drv.run(st)                               # compile + first pass
+    o0 = rec.overhead_s
+    t0 = time.perf_counter()
+    drv.run(st)                               # warm measured pass
+    wall = time.perf_counter() - t0
+    overhead = rec.overhead_s - o0
+    assert overhead < 0.02 * wall, \
+        f"recorder overhead {overhead:.4f}s on {wall:.4f}s wall"
+
+
+# ---------------------------------------------------------------------------
+# capsule round-trip + verdicts
+# ---------------------------------------------------------------------------
+
+def _record_nan_capsule(directory):
+    integ = _ins()
+    st0 = _tg_state(integ)
+    cfg = RunConfig(dt=1e-3, num_steps=12, restart_interval=4,
+                    health_interval=2)
+    params = {"at_step": 6, "leaf_path": "u[0]"}
+    with recorded("nan", **params):
+        drv = HierarchyDriver(
+            integ, cfg,
+            step_fn=nan_injector_step(integ.step, **params),
+            recorder=FlightRecorder(capacity=4))
+        sup = ResilientDriver(drv, directory, max_retries=0,
+                              handle_signals=False)
+        with pytest.raises(SimulationDiverged):
+            sup.run(st0)
+    return sup
+
+
+def test_capsule_roundtrip_bitwise(tmp_path):
+    """The tentpole pin: a dumped capsule re-executes to the EXACT
+    recorded post-chunk digest (per-leaf CRC32s) in fresh traces, and
+    the incidents log is schema v3 with the replay pointer."""
+    sup = _record_nan_capsule(str(tmp_path))
+    rec = sup.incidents[-1]
+    assert rec["schema"] == 3 and rec["event"] == "give_up"
+    cap = rec["replay"]
+    assert cap and os.path.exists(os.path.join(cap, "replay.npz"))
+    manifest = json.load(open(os.path.join(cap, "manifest.json")))
+    assert manifest["incident"]["kind"] == "divergence"
+    assert manifest["chunk"] == {"start_step": 4, "length": 2,
+                                 "dt": 1e-3}
+    assert manifest["fingerprint"]["injectors"]["nan"]["at_step"] == 6
+    assert manifest["post"]["finite"] is False
+
+    res = replay(cap)
+    assert res["verdict"] == "reproduced"
+    assert res["bitwise"] and res["baseline_failed"]
+    # second incident on the same chunk reuses the capsule dir
+    assert newest_capsule(str(tmp_path)) == cap
+
+
+def test_replay_dt_scale_cures_but_stays_reproduced(tmp_path):
+    """A dt-scaled re-run that no longer fails is flagged
+    ``dt_dependent`` on a ``reproduced`` verdict — dt is a stability
+    knob, not a root-cause classification."""
+    sup = _record_nan_capsule(str(tmp_path))
+    cap = sup.incidents[-1]["replay"]
+    # the recorded injector is NOT dt-gated, so a dt-scaled run still
+    # hits it: override_failed stays true -> plain reproduced
+    res = replay(cap, dt_scale=0.5)
+    assert res["verdict"] == "reproduced"
+    assert res["override_failed"] is True
+
+
+@pytest.mark.slow
+def test_precision_escalation_end_to_end_drill():
+    """ISSUE acceptance drill (dryrun path 18): injected bf16 drift ->
+    shadow audit -> capsule -> bf16->f32 escalation with dt unchanged
+    -> completion; replay reproduces bitwise and classifies
+    ``precision_dependent`` under --override spectral_dtype=f64."""
+    from tools.fault_injection import run_replay_smoke
+
+    out = run_replay_smoke()
+    assert out["replay_smoke"] == "ok"
+    assert out["baseline_verdict"] == "reproduced"
+    assert out["override_verdict"] == "precision_dependent"
+    assert out["spectral_dtype_after"] == "f32"
+
+
+@pytest.mark.slow
+def test_engine_override_verdict(tmp_path):
+    """An engine-gated fault capsule: the baseline (scatter) replay
+    reproduces bitwise; swapping the transfer engine via --override
+    disarms it -> ``engine_dependent``."""
+    from ibamr_tpu.models.shell3d import build_shell_example
+
+    # 16^3: the smallest shell grid where the mxu engine actually
+    # builds (8^3 silently degrades to scatter, which would disarm
+    # nothing and make the override verdict vacuous)
+    kwargs = dict(n_cells=16, n_lat=6, n_lon=8,
+                  use_fast_interaction=False)
+    integ, st0 = build_shell_example(**kwargs)
+    cfg = RunConfig(dt=1e-4, num_steps=4, restart_interval=4,
+                    health_interval=2)
+    params = {"at_step": 2, "leaf_path": "X", "step_attr": "ins.k"}
+    with recorded("engine_nan", engine="scatter", **params):
+        drv = HierarchyDriver(
+            integ, cfg,
+            step_fn=nan_injector_step(integ.step, **params),
+            recorder=FlightRecorder(capacity=4, spec=factory_spec(
+                "ibamr_tpu.models.shell3d", "build_shell_example",
+                **kwargs)))
+        sup = ResilientDriver(drv, str(tmp_path), max_retries=0,
+                              handle_signals=False)
+        with pytest.raises(SimulationDiverged):
+            sup.run(st0)
+    cap = sup.incidents[-1]["replay"]
+    manifest = json.load(open(os.path.join(cap, "manifest.json")))
+    assert manifest["fingerprint"]["engine"] == "scatter"
+    assert manifest["fingerprint"]["engine_chain"] == ["scatter"]
+
+    base = replay(cap)
+    assert base["verdict"] == "reproduced" and base["bitwise"]
+    cured = replay(cap, overrides={"engine": "mxu"})
+    assert cured["verdict"] == "engine_dependent"
+    assert cured["override_failed"] is False
+
+
+@pytest.mark.slow
+def test_cross_mesh_kill_and_replay(tmp_path):
+    """Kill-and-replay drill: a 1-device victim records a capsule and
+    is SIGKILLed mid-linger; the orphaned capsule replays BITWISE on
+    this suite's 8-device mesh — capsules record unsharded host
+    arrays, so mesh shape is outside the reproduction contract."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + \
+        env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tools.fault_injection",
+         "--record-capsule", str(tmp_path)],
+        cwd=REPO_ROOT, env=env, stdout=subprocess.PIPE, text=True)
+    cap = None
+    try:
+        for line in proc.stdout:
+            if line.startswith("CAPSULE "):
+                cap = line.split(None, 1)[1].strip()
+                break
+    finally:
+        proc.send_signal(signal.SIGKILL)
+        proc.wait()
+    assert cap and os.path.exists(os.path.join(cap, "manifest.json"))
+    manifest = json.load(open(os.path.join(cap, "manifest.json")))
+    assert manifest["fingerprint"]["device_count"] == 1
+    assert jax.device_count() == 8            # the replay-side mesh
+    res = replay(cap)
+    assert res["verdict"] == "reproduced" and res["bitwise"]
+
+
+# ---------------------------------------------------------------------------
+# incident log schema v3 / v2 compatibility
+# ---------------------------------------------------------------------------
+
+def test_incidents_v3_backward_reads_v2_lines(tmp_path):
+    """A log that spans the schema upgrade parses uniformly: v2 lines
+    (no ``schema``/``replay``) read as schema=2 with replay=None."""
+    path = os.path.join(str(tmp_path), "incidents.jsonl")
+    v2 = {"event": "divergence", "step": 6, "retry": 1,
+          "rollback_step": 4, "dt": 1e-3, "time": 0.0}
+    v3 = {"event": "precision_escalation", "kind": "precision_drift",
+          "step": 2, "schema": 3, "replay": "/x/incidents/00000000",
+          "time": 1.0}
+    with open(path, "w") as f:
+        f.write(json.dumps(v2) + "\n\n")      # blank line tolerated
+        f.write(json.dumps(v3) + "\n")
+    recs = read_incidents(path)
+    assert [r["schema"] for r in recs] == [2, 3]
+    assert recs[0]["replay"] is None
+    assert recs[1]["replay"] == "/x/incidents/00000000"
+
+
+def test_recorded_injector_registry_and_replay_arming():
+    """The registry round-trip tools/replay.py depends on: ``recorded``
+    arms/pops, double-arm raises, unknown manifest names raise instead
+    of silently replaying clean."""
+    with recorded("nan", at_step=3, leaf_path="u"):
+        assert ACTIVE_INJECTORS["nan"]["at_step"] == 3
+        with pytest.raises(ValueError):
+            with recorded("nan", at_step=9):
+                pass
+    assert "nan" not in ACTIVE_INJECTORS
+    with pytest.raises(KeyError):
+        with apply_recorded_injectors({"warp_drive": {}}):
+            pass
+    # a recorded step fault re-arms through the returned wrapper
+    integ = _ins()
+    st = _tg_state(integ)
+    with apply_recorded_injectors(
+            {"nan": {"at_step": 1, "leaf_path": "u[0]"}}) as wrap:
+        stepped = wrap(integ.step)(st, 1e-3)
+    assert not bool(jnp.isfinite(stepped.u[0]).all())
+
+
+def test_describe_integrator_rebuild_roundtrip():
+    """The introspected ins spec is sufficient to rebuild an equivalent
+    integrator (the replay 'ins' path)."""
+    from tools.replay import rebuild
+
+    integ = _ins(spectral_dtype="bf16")
+    spec = describe_integrator(integ)
+    assert spec["kind"] == "ins" and spec["spectral_dtype"] == "bf16"
+    re_integ, template = rebuild(
+        {"fingerprint": {"integrator": spec}})
+    assert re_integ.grid.n == integ.grid.n
+    assert re_integ.spectral_dtype is integ.spectral_dtype
+    assert jax.tree_util.tree_structure(template) \
+        == jax.tree_util.tree_structure(integ.initialize())
+    # overriding precision at rebuild time walks the spectral knob
+    esc, _ = rebuild({"fingerprint": {"integrator": spec}},
+                     overrides={"spectral_dtype": "f32"})
+    assert esc.spectral_dtype is None         # f32 == native precision

@@ -603,7 +603,8 @@ def test_health_probe_measure_matches_state():
     probe = HealthProbe.for_integrator(integ)
     dt = 1e-3
     v = np.asarray(jax.jit(probe.measure)(st, dt))
-    assert v.shape == (5,) and v.dtype == np.float32
+    assert v.shape == (len(HealthProbe.VITALS_FIELDS),) \
+        and v.dtype == np.float32
     d = HealthProbe.unpack(v)
     assert d["finite"] == 1.0
     max_u = max(float(jnp.max(jnp.abs(c))) for c in st.u)

@@ -27,6 +27,18 @@ dryrun drill are built from:
   NaN recovery + corruption fallback + flaky-write retry) wired into
   ``__graft_entry__.dryrun_multichip`` as path 16 and exposed as
   ``python -m tools.fault_injection --smoke``.
+- :func:`bf16_drift_injector` / :func:`volume_leak_injector` (PR 5) —
+  the silent-precision and invariant-violation faults the flight
+  recorder + replay harness and the physics sentinels are drilled
+  against, plus the :data:`ACTIVE_INJECTORS` registry that makes an
+  injected fault part of the run fingerprint (so ``tools/replay.py``
+  reproduces it BITWISE in a fresh process).
+- :func:`run_replay_smoke` — record -> trip the shadow audit ->
+  precision-escalate -> replay bitwise -> classify, as dryrun path 18
+  and ``python -m tools.fault_injection --replay-smoke``.
+- :func:`record_capsule_drill` — the victim process for the
+  kill-and-replay drill: records a capsule, prints ``CAPSULE <dir>``
+  and lingers for the parent's SIGKILL.
 
 Everything here is deliberately boring and deterministic: no random
 fuzzing, every fault lands at a named step/byte so a failure
@@ -92,7 +104,8 @@ def nan_injector_step(step_fn, at_step: int, leaf_path: str = "u",
     out poisoned (NaN in every floating leaf matching ``leaf_path``)
     exactly when its step counter ``state.<step_attr>`` equals
     ``at_step`` — jit/scan-safe (the fault is a ``jnp.where`` on traced
-    values, not python control flow).
+    values, not python control flow). ``step_attr`` may be dotted
+    (``"ins.k"`` reaches the fluid counter inside a coupled IB state).
 
     ``dt_gate`` arms the fault only while ``dt >= dt_gate``: a
     supervised retry at backed-off dt then passes cleanly, modelling an
@@ -104,7 +117,9 @@ def nan_injector_step(step_fn, at_step: int, leaf_path: str = "u",
 
     def wrapped(state, dt):
         out = step_fn(state, dt)
-        k = getattr(out, step_attr)
+        k = out
+        for attr in step_attr.split("."):
+            k = getattr(k, attr)
         fire = jnp.asarray(k) == at_step
         if dt_gate is not None:
             fire = jnp.logical_and(fire, jnp.asarray(dt) >= dt_gate)
@@ -209,6 +224,157 @@ def slow_metrics(sleep_s: float, at_steps=None, metrics_fn=None):
         return metrics_fn(state, step) if metrics_fn is not None else None
 
     return wrapped
+
+
+# ---------------------------------------------------------------------------
+# Recorded injectors (PR 5): faults the flight recorder fingerprints so
+# tools/replay.py can RE-ARM them in a fresh process — without this, a
+# capsule of an injected failure would replay clean and read as
+# not_reproduced. ACTIVE_INJECTORS maps injector name -> JSON-safe
+# params for every currently-armed recorded fault.
+# ---------------------------------------------------------------------------
+
+ACTIVE_INJECTORS: dict = {}
+
+
+@contextlib.contextmanager
+def recorded(name: str, **params):
+    """Register an armed fault in ``ACTIVE_INJECTORS`` for the duration
+    of the block, so flight-recorder fingerprints (and therefore replay
+    capsules) carry it. The caller still applies the actual injector;
+    this context only makes it REPRODUCIBLE. Params must be JSON-safe
+    and sufficient for :func:`apply_recorded_injectors` to rebuild the
+    injector (see the per-name cases there)."""
+    if name in ACTIVE_INJECTORS:
+        raise ValueError(f"recorded injector {name!r} already armed")
+    ACTIVE_INJECTORS[name] = dict(params)
+    try:
+        yield params
+    finally:
+        ACTIVE_INJECTORS.pop(name, None)
+
+
+@contextlib.contextmanager
+def bf16_drift_injector(scale: float = 0.35):
+    """Deterministically bias the bf16 spectral path's split-real
+    operand rounding by ``(1 + scale)`` — k-space algebra corruption
+    that ONLY fires on the mixed-precision path (``_round_complex`` is
+    not called at f32/f64), so precision escalation or an
+    ``--override spectral_dtype=f64`` replay genuinely cures it. The
+    drift is smooth and finite: the plain finite flag never trips, only
+    the f64 shadow audit can see it. Registers itself in
+    ``ACTIVE_INJECTORS`` as ``bf16_drift``.
+
+    NOTE: the patch takes effect at TRACE time — jit executables
+    compiled before entering the context keep the clean rounding. Clear
+    relevant caches (or use fresh chunk shapes) when arming mid-process.
+    """
+    with _bare_bf16_drift(scale):
+        with recorded("bf16_drift", scale=float(scale)):
+            yield
+
+
+def volume_leak_injector(step_fn, rate: float = 0.01,
+                         leaf_path: str = "X",
+                         dt_gate: float | None = None):
+    """Wrap ``step_fn(state, dt) -> state`` so every floating leaf
+    matching ``leaf_path`` (default: the IB marker positions) is
+    contracted toward its centroid by ``rate`` per step — a secular
+    enclosed-volume drift (membrane leakage). The state stays finite
+    and smooth; only the volume sentinel (vitals slot 5) can see it.
+    jit/scan-safe; ``dt_gate`` arms the leak only while
+    ``dt >= dt_gate`` (the supervisor's backoff disarms it)."""
+    import jax
+    import jax.numpy as jnp
+
+    def wrapped(state, dt):
+        out = step_fn(state, dt)
+        fire = jnp.asarray(True) if dt_gate is None \
+            else jnp.asarray(dt) >= dt_gate
+        hit = []
+
+        def _leak(path, leaf):
+            key = jax.tree_util.keystr(path)
+            if leaf_path in key and hasattr(leaf, "dtype") \
+                    and jnp.issubdtype(leaf.dtype, jnp.floating) \
+                    and getattr(leaf, "ndim", 0) >= 1:
+                hit.append(key)
+                c = jnp.mean(leaf, axis=0, keepdims=True)
+                factor = jnp.where(fire,
+                                   jnp.asarray(1.0 - rate, leaf.dtype),
+                                   jnp.asarray(1.0, leaf.dtype))
+                return c + (leaf - c) * factor
+            return leaf
+
+        out = jax.tree_util.tree_map_with_path(_leak, out)
+        if not hit:
+            raise KeyError(f"no floating leaf path contains {leaf_path!r}")
+        return out
+
+    return wrapped
+
+
+@contextlib.contextmanager
+def apply_recorded_injectors(injectors: dict):
+    """Re-arm the faults a replay manifest recorded. Context-style
+    faults (``bf16_drift``) are entered for the block; step-level
+    faults yield through the returned ``wrap(step_fn)`` function, which
+    the replay harness applies to the rebuilt integrator's step. Param
+    vocabularies match what :func:`recorded` blocks in this module and
+    the tests register:
+
+    - ``bf16_drift``: {scale}
+    - ``nan``: {at_step, leaf_path, dt_gate} -> nan_injector_step
+    - ``growth``: {rate, leaf_path, dt_gate} -> growth_injector_step
+    - ``volume_leak``: {rate, leaf_path, dt_gate} -> volume_leak_injector
+
+    Unknown names raise: silently dropping a recorded fault would turn
+    every replay of it into a false ``not_reproduced``/"cured" verdict.
+    """
+    wrappers = []
+    with contextlib.ExitStack() as stack:
+        for name, params in (injectors or {}).items():
+            params = dict(params)
+            if name == "bf16_drift":
+                stack.enter_context(
+                    _bare_bf16_drift(scale=params.get("scale", 0.35)))
+            elif name == "nan":
+                wrappers.append(lambda fn, p=params:
+                                nan_injector_step(fn, **p))
+            elif name == "growth":
+                wrappers.append(lambda fn, p=params:
+                                growth_injector_step(fn, **p))
+            elif name == "volume_leak":
+                wrappers.append(lambda fn, p=params:
+                                volume_leak_injector(fn, **p))
+            else:
+                raise KeyError(
+                    f"replay manifest records unknown injector {name!r}")
+
+        def wrap(step_fn):
+            for w in wrappers:
+                step_fn = w(step_fn)
+            return step_fn
+
+        yield wrap
+
+
+@contextlib.contextmanager
+def _bare_bf16_drift(scale: float):
+    """bf16_drift patch WITHOUT the ACTIVE_INJECTORS registration
+    (replay must not re-record the fault it is re-arming)."""
+    from ibamr_tpu.solvers import spectral_plan as sp
+
+    orig = sp._round_complex
+
+    def biased(z, sdtype):
+        return orig(z, sdtype) * (1.0 + scale)
+
+    sp._round_complex = biased
+    try:
+        yield
+    finally:
+        sp._round_complex = orig
 
 
 # ---------------------------------------------------------------------------
@@ -575,6 +741,149 @@ def run_silent_smoke(directory: str | None = None) -> dict:
             tmp.cleanup()
 
 
+def _tg16_setup(spectral_dtype=None):
+    """Shared 16^2 Taylor-Green INS setup for the drills."""
+    import jax.numpy as jnp
+
+    from ibamr_tpu.grid import StaggeredGrid
+    from ibamr_tpu.integrators.ins import INSStaggeredIntegrator
+
+    g = StaggeredGrid(n=(16, 16), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    integ = INSStaggeredIntegrator(g, rho=1.0, mu=0.05,
+                                   spectral_dtype=spectral_dtype)
+    xf, yc = g.face_centers(0, jnp.float32)
+    xc, yf = g.face_centers(1, jnp.float32)
+    u = jnp.sin(2 * jnp.pi * xf) * jnp.cos(2 * jnp.pi * yc) + 0 * yc
+    v = -jnp.cos(2 * jnp.pi * xc) * jnp.sin(2 * jnp.pi * yf) + 0 * xc
+    return integ, integ.initialize(u0_arrays=(u, v))
+
+
+def run_replay_smoke(directory: str | None = None) -> dict:
+    """Deterministic end-to-end REPLAY drill (PR 5, dryrun path 18):
+
+    1. **precision escalation** — a 16^2 INS run at
+       ``spectral_dtype="bf16"`` with an injected spectral rounding
+       bias (:func:`bf16_drift_injector`) trips the per-chunk f64
+       :class:`~ibamr_tpu.solvers.escalation.ShadowAuditor` on the
+       FIRST chunk; the supervisor dumps a replay capsule, escalates
+       bf16 -> f32 with dt UNCHANGED, rolls back and completes — one
+       schema-v3 ``precision_escalation`` incident with a ``replay``
+       pointer;
+    2. **bitwise replay** — ``tools.replay`` re-executes the capsule
+       in-process (fresh traces): the baseline re-arms the recorded
+       injector and must match the recorded post-chunk digest bitwise
+       -> verdict ``reproduced``;
+    3. **classification** — the same capsule under
+       ``--override spectral_dtype=f64`` no longer drifts (the biased
+       bf16 rounding is never invoked on the escalated path) -> verdict
+       ``precision_dependent``.
+
+    Raises on any failed expectation; returns a one-line JSON summary.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ibamr_tpu.solvers.escalation import ShadowAuditor
+    from ibamr_tpu.utils.flight_recorder import FlightRecorder
+    from ibamr_tpu.utils.hierarchy_driver import HierarchyDriver, RunConfig
+    from ibamr_tpu.utils.supervisor import ResilientDriver
+    from tools.replay import replay
+
+    tmp = None
+    if directory is None:
+        tmp = tempfile.TemporaryDirectory(prefix="ibamr_replay_smoke_")
+        directory = tmp.name
+    try:
+        integ, st0 = _tg16_setup(spectral_dtype="bf16")
+        cfg = RunConfig(dt=1e-3, num_steps=8, restart_interval=4,
+                        health_interval=2)
+        drv = HierarchyDriver(integ, cfg,
+                              recorder=FlightRecorder(capacity=4),
+                              shadow_audit=ShadowAuditor(every=1,
+                                                         bound=0.02))
+        sup = ResilientDriver(drv, directory, max_retries=2,
+                              handle_signals=False)
+        with bf16_drift_injector(scale=0.35):
+            # the biased rounding must reach the RETRACED chunk
+            jax.clear_caches()
+            out = sup.run(st0)
+        if int(out.k) != cfg.num_steps:
+            raise AssertionError(f"replay drill stopped at {int(out.k)}")
+        if not bool(jnp.all(jnp.isfinite(out.u[0]))):
+            raise AssertionError("replay drill finished non-finite")
+        esc = [r for r in sup.incidents
+               if r["event"] == "precision_escalation"]
+        if len(esc) != 1:
+            raise AssertionError(f"unexpected incidents: {sup.incidents}")
+        rec = esc[0]
+        if rec.get("schema") != 3 or not rec.get("replay"):
+            raise AssertionError(f"incident is not replayable v3: {rec}")
+        if (rec["spectral_dtype_before"], rec["spectral_dtype_after"]) \
+                != ("bf16", "f32"):
+            raise AssertionError(f"unexpected escalation: {rec}")
+        if rec["dt"] != cfg.dt:
+            raise AssertionError("precision escalation must not back "
+                                 "dt off")
+
+        base = replay(rec["replay"])
+        if base["verdict"] != "reproduced" or not base["bitwise"]:
+            raise AssertionError(f"baseline replay: {base}")
+        cured = replay(rec["replay"],
+                       overrides={"spectral_dtype": "f64"})
+        if cured["verdict"] != "precision_dependent":
+            raise AssertionError(f"override replay: {cured}")
+
+        return {"replay_smoke": "ok",
+                "escalation_step": rec["step"],
+                "spectral_dtype_after": rec["spectral_dtype_after"],
+                "drift": rec.get("drift"),
+                "baseline_verdict": base["verdict"],
+                "override_verdict": cured["verdict"],
+                "capsule": rec["replay"]}
+    finally:
+        if tmp is not None:
+            tmp.cleanup()
+
+
+def record_capsule_drill(directory: str, linger: bool = True) -> str:
+    """Victim process for the cross-mesh kill-and-replay drill: run a
+    16^2 INS trajectory with a RECORDED NaN injection, let the
+    supervisor dump the divergence capsule, print ``CAPSULE <dir>`` (the
+    parent's kill marker) and linger until SIGKILL. The parent then
+    replays the orphaned capsule on a DIFFERENT device mesh and pins it
+    bitwise — capsules record unsharded host arrays, so mesh shape is
+    not part of the reproduction contract."""
+    from ibamr_tpu.utils.flight_recorder import FlightRecorder
+    from ibamr_tpu.utils.hierarchy_driver import (HierarchyDriver,
+                                                  RunConfig,
+                                                  SimulationDiverged)
+    from ibamr_tpu.utils.supervisor import ResilientDriver
+
+    integ, st0 = _tg16_setup()
+    cfg = RunConfig(dt=1e-3, num_steps=12, restart_interval=4,
+                    health_interval=2)
+    params = {"at_step": 6, "leaf_path": "u[0]"}
+    with recorded("nan", **params):
+        drv = HierarchyDriver(
+            integ, cfg,
+            step_fn=nan_injector_step(integ.step, **params),
+            recorder=FlightRecorder(capacity=4))
+        sup = ResilientDriver(drv, directory, max_retries=0,
+                              handle_signals=False)
+        try:
+            sup.run(st0)
+            raise AssertionError("injected NaN did not diverge the run")
+        except SimulationDiverged:
+            pass
+    cap = sup.incidents[-1].get("replay")
+    if not cap:
+        raise AssertionError(f"no capsule dumped: {sup.incidents}")
+    print(f"CAPSULE {cap}", flush=True)
+    while linger:
+        time.sleep(0.5)
+    return cap
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="deterministic fault-injection drills")
@@ -583,8 +892,13 @@ def main(argv=None) -> int:
     ap.add_argument("--silent-smoke", action="store_true",
                     help="run the silent-failure drill (health vitals "
                          "+ solver escalation + watchdog)")
+    ap.add_argument("--replay-smoke", action="store_true",
+                    help="run the record -> escalate -> replay drill")
     ap.add_argument("--crash-child", metavar="DIR",
                     help="run the checkpoint-writer victim loop in DIR")
+    ap.add_argument("--record-capsule", metavar="DIR",
+                    help="record a divergence capsule in DIR, print "
+                         "CAPSULE <dir> and linger for SIGKILL")
     ap.add_argument("--steps", type=int, default=40)
     ap.add_argument("--interval", type=int, default=5)
     ap.add_argument("--keep", type=int, default=3)
@@ -595,15 +909,27 @@ def main(argv=None) -> int:
         run_crash_child(args.crash_child, args.steps, args.interval,
                         keep=args.keep)
         return 0
+    if args.record_capsule:
+        record_capsule_drill(args.record_capsule)
+        return 0
     if args.smoke:
         print(json.dumps(run_smoke(args.dir)), flush=True)
         return 0
     if args.silent_smoke:
         print(json.dumps(run_silent_smoke(args.dir)), flush=True)
         return 0
+    if args.replay_smoke:
+        print(json.dumps(run_replay_smoke(args.dir)), flush=True)
+        return 0
     ap.print_help()
     return 2
 
 
 if __name__ == "__main__":
-    raise SystemExit(main())
+    # ``python -m tools.fault_injection`` executes this file as
+    # ``__main__`` — a SECOND module object from the canonical
+    # ``tools.fault_injection`` the flight recorder fingerprints
+    # ``ACTIVE_INJECTORS`` from. Delegate to the canonical import so
+    # ``recorded`` blocks land in the registry replays read.
+    import tools.fault_injection as _canonical
+    raise SystemExit(_canonical.main())

@@ -52,8 +52,22 @@ def last_json_line(text: str):
     return None
 
 
+def newest_replay_capsule(record_dir):
+    """Newest flight-recorder capsule under the bench's ``--record``
+    directory (or None): the pointer attached to stall/kill log lines
+    so the operator can hand the dead window straight to
+    ``python -m tools.replay``."""
+    if not record_dir:
+        return None
+    try:
+        from tools.replay import newest_capsule
+        return newest_capsule(record_dir)
+    except Exception:
+        return None
+
+
 def run_bench_watched(cmd, f, env, timeout_s: float, hb_path: str,
-                      stall_after_s: float):
+                      stall_after_s: float, record_dir: str = ""):
     """Run the bench under heartbeat supervision.
 
     The bench writes ``hb_path`` (its ``--heartbeat``); this loop polls
@@ -62,9 +76,11 @@ def run_bench_watched(cmd, f, env, timeout_s: float, hb_path: str,
     instead of the old behavior (silence until the whole
     ``--bench-timeout`` burned). A stall sustained past 3x
     ``stall_after_s`` kills the bench early, returning the window to
-    the probe loop. Returns ``(returncode, stdout, stderr, stalled)``;
-    ``returncode`` is ``None`` when the bench was killed (stall or
-    timeout).
+    the probe loop. With ``record_dir`` (the bench's ``--record``
+    directory) every stall/kill log line carries the newest replay
+    capsule dumped so far. Returns ``(returncode, stdout, stderr,
+    stalled)``; ``returncode`` is ``None`` when the bench was killed
+    (stall or timeout).
     """
     from ibamr_tpu.utils.watchdog import heartbeat_age
 
@@ -99,7 +115,8 @@ def run_bench_watched(cmd, f, env, timeout_s: float, hb_path: str,
                         {"event": "stall", "kind": "stall",
                          "beat_age_s": round(age, 1),
                          "threshold_s": stall_after_s,
-                         "elapsed_s": round(time.time() - t0, 1)}))
+                         "elapsed_s": round(time.time() - t0, 1),
+                         "replay": newest_replay_capsule(record_dir)}))
                 if age > 3.0 * stall_after_s:
                     killed_reason = (f"heartbeat stale {age:.0f}s "
                                      f"(> {3.0 * stall_after_s:.0f}s)")
@@ -108,7 +125,10 @@ def run_bench_watched(cmd, f, env, timeout_s: float, hb_path: str,
                 stall_armed = True       # bench moved again: re-arm
         rc = proc.poll()
         if rc is None:
-            log(f, f"killing bench: {killed_reason}")
+            cap = newest_replay_capsule(record_dir)
+            log(f, "killing bench: " + killed_reason
+                + (f"; newest replay capsule: {cap}" if cap
+                   else "; no replay capsule recorded"))
             proc.kill()
             proc.wait()
         fo.seek(0)
@@ -151,11 +171,14 @@ def main() -> int:
         env = dict(os.environ)
         env.pop("JAX_PLATFORMS", None)  # let the container default win
         hb_path = args.out.replace(".json", "_heartbeat.json")
+        record_dir = args.out.replace(".json", "_record")
         t0 = time.time()
         rc, out, err, stalled = run_bench_watched(
             [sys.executable, os.path.join(REPO, "bench.py"),
-             "--stages", "64,128,256", "--heartbeat", hb_path],
-            f, env, args.bench_timeout, hb_path, args.stall_after)
+             "--stages", "64,128,256", "--heartbeat", hb_path,
+             "--record", record_dir],
+            f, env, args.bench_timeout, hb_path, args.stall_after,
+            record_dir=record_dir)
         if rc is None:
             log(f, f"bench KILLED (stalled={stalled}); re-arming")
             time.sleep(args.interval)
