@@ -31,6 +31,7 @@ from __future__ import annotations
 import json
 import os
 import re
+import threading
 import zlib
 from typing import Any, Dict, Optional
 
@@ -156,18 +157,37 @@ def _fsync_dir(directory: str) -> None:
 def _atomic_write(path: str, write_fn) -> None:
     """Write via temp name + fsync + os.replace: the file at ``path``
     is always either absent, the old complete version, or the new
-    complete version — never torn."""
-    tmp = f"{path}.tmp.{os.getpid()}"
+    complete version — never torn. The temp name is pid- AND
+    thread-unique: two concurrent writers of the same path (the
+    sharded collision drill; an async writer racing a sync preemption
+    save) must each write their own temp, or they interleave into one
+    file and the LAST replace publishes torn bytes."""
+    _atomic_write_digest(path, write_fn)
+
+
+def _atomic_write_digest(path: str, write_fn):
+    """:func:`_atomic_write` that also returns ``(crc32, size)`` of the
+    written bytes — computed from the PRIVATE temp file BEFORE the
+    replace. Re-reading the published path after ``os.replace`` races
+    concurrent writers of the same path: the digest of whoever
+    replaced LAST would land in THIS writer's integrity record, and
+    that mixed record can pass whole-file verification while the
+    per-leaf digests disagree (caught by the sharded collision
+    drill)."""
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
     try:
         with open(tmp, "wb") as f:
             write_fn(f)
             f.flush()
             os.fsync(f.fileno())
+        crc = _file_crc(tmp)
+        size = os.path.getsize(tmp)
         os.replace(tmp, path)
     finally:
         if os.path.exists(tmp):
             os.remove(tmp)
     _fsync_dir(os.path.dirname(path) or ".")
+    return crc, size
 
 
 def _write_arrays(directory: str, arrays: Dict[str, np.ndarray],
@@ -175,18 +195,22 @@ def _write_arrays(directory: str, arrays: Dict[str, np.ndarray],
                   metadata: Optional[Dict[str, Any]], keep: int) -> str:
     os.makedirs(directory, exist_ok=True)
     fname = os.path.join(directory, f"restore.{step:08d}.npz")
-    _atomic_write(fname, lambda f: np.savez(f, **arrays))
+    npz_crc, npz_size = _atomic_write_digest(
+        fname, lambda f: np.savez(f, **arrays))
     meta = dict(metadata or {})
     meta["step"] = step
     meta["schema"] = schema
     # integrity record: per-leaf CRCs catch in-file tampering down to
     # the leaf; the whole-file digest makes verification a single
-    # sequential read. Written AFTER the npz replace, so a complete
-    # sidecar implies a complete array file (the commit marker).
+    # sequential read. The digest comes from the temp file BEFORE the
+    # replace (never re-read the published path: a concurrent writer's
+    # bytes could land there in between), and the sidecar is written
+    # AFTER the npz replace, so a complete sidecar implies a complete
+    # array file (the commit marker).
     meta["integrity"] = {
         "leaves": {k: _leaf_crc(v) for k, v in arrays.items()},
-        "npz_crc32": _file_crc(fname),
-        "npz_size": os.path.getsize(fname),
+        "npz_crc32": npz_crc,
+        "npz_size": npz_size,
     }
     payload = json.dumps(meta).encode()
     _atomic_write(fname.replace(".npz", ".json"),
@@ -247,9 +271,10 @@ def _all_steps(directory: str) -> list:
 
 def _prune(directory: str, keep: int) -> None:
     # stale temp files are debris from a killed writer (a *different*
-    # process: our own pid's temps are live in the async worker)
+    # process: our own pid's temps are live in the async worker);
+    # names are ``<path>.tmp.<pid>.<tid>`` (legacy debris may lack <tid>)
     for f in os.listdir(directory):
-        m = re.search(r"\.tmp\.(\d+)$", f)
+        m = re.search(r"\.tmp\.(\d+)(?:\.\d+)?$", f)
         if m and int(m.group(1)) != os.getpid():
             try:
                 os.remove(os.path.join(directory, f))
@@ -304,6 +329,17 @@ class AsyncCheckpointWriter:
     ONCE on the next ``save``/``wait`` and is then dropped (a
     checkpoint failure must not poison the rest of the run).
 
+    The pending queue is BOUNDED: each queued save pins a full host
+    copy of the state, so an unbounded burst of ``save`` calls against
+    a slow disk queues arbitrary host memory. At ``max_pending``
+    outstanding writes, ``overflow="block"`` (default) applies
+    backpressure — ``save`` waits for the oldest write to land first —
+    while ``overflow="drop"`` sheds the NEW save and counts it in
+    ``dropped_saves`` (checkpoints are periodic: a dropped one widens
+    the recovery interval, it cannot corrupt anything). Current
+    backlog is ``queue_depth()``, surfaced in the watchdog heartbeat
+    by :class:`~ibamr_tpu.utils.supervisor.ResilientDriver`.
+
     Usage::
 
         w = AsyncCheckpointWriter(rst_dir, keep=3)
@@ -313,13 +349,27 @@ class AsyncCheckpointWriter:
         w.wait()                   # drain before exit / restart
     """
 
-    def __init__(self, directory: str, keep: int = 3):
+    def __init__(self, directory: str, keep: int = 3,
+                 max_pending: int = 2, overflow: str = "block"):
         from concurrent.futures import ThreadPoolExecutor
 
+        if max_pending < 1:
+            raise ValueError("max_pending must be >= 1")
+        if overflow not in ("block", "drop"):
+            raise ValueError("overflow must be 'block' or 'drop'")
         self.directory = directory
         self.keep = keep
+        self.max_pending = max_pending
+        self.overflow = overflow
+        self.dropped_saves = 0
         self._exec = ThreadPoolExecutor(max_workers=1)
         self._pending = []
+
+    def queue_depth(self) -> int:
+        """Writes enqueued but not yet finished (each pins one host
+        copy of the state). Completed futures stay in ``_pending`` so
+        ``_raise_finished`` still surfaces their failures."""
+        return sum(1 for f in self._pending if not f.done())
 
     def _raise_finished(self):
         # drop completed futures FIRST so a raised failure is reported
@@ -347,7 +397,24 @@ class AsyncCheckpointWriter:
 
     def save(self, state: Any, step: int,
              metadata: Optional[Dict[str, Any]] = None):
+        """Gather and enqueue one checkpoint write. Returns the write
+        future, or ``None`` when the save was shed under
+        ``overflow="drop"`` backlog."""
         self._raise_finished()
+        if self.queue_depth() >= self.max_pending:
+            if self.overflow == "drop":
+                self.dropped_saves += 1
+                return None
+            # backpressure: the oldest pending write must land before
+            # this save may pin another host copy of the state; wait
+            # without .result() so _raise_finished surfaces a failure
+            # exactly once
+            import concurrent.futures as _cf
+            oldest = next((f for f in self._pending if not f.done()),
+                          None)
+            if oldest is not None:
+                _cf.wait([oldest])
+            self._raise_finished()
         arrays = _gather_arrays(state)      # sync: donation-safe
         schema = state_schema(state)
         fut = self._exec.submit(self._write_with_retry, self.directory,

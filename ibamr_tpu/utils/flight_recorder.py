@@ -264,6 +264,11 @@ class FlightRecorder:
             "device_count": jax.device_count(),
             "platform": jax.default_backend(),
             "mesh_shape": self.extra.get("mesh_shape"),
+            # sharded runs stamp the full mesh spec (shape, axis names,
+            # shard count) via ResilientDriver(mesh=...) so replay can
+            # rebuild the sharded program — or knowingly degrade when
+            # fewer devices are available than the incident ran on
+            "mesh": self.extra.get("mesh"),
             "x64": bool(jax.config.jax_enable_x64),
             # the framework threads no RNG through the run loop; the
             # slot exists so stochastic physics can stamp its keys via

@@ -87,6 +87,17 @@ class ResilientDriver:
     handle_signals:
         Install SIGTERM/SIGINT handlers for the duration of ``run``
         (main thread only; silently skipped elsewhere).
+    sharded:
+        Use the per-shard checkpoint format
+        (:mod:`ibamr_tpu.utils.checkpoint_sharded`) instead of the
+        single-host one: the cadence writer becomes an
+        :class:`~ibamr_tpu.utils.checkpoint_sharded.AsyncShardedWriter`
+        (no full-state host gather), rollback walks to the newest
+        VERIFIED sharded step, and the preemption save is sharded too.
+    mesh:
+        Recorded into sharded manifests and (via ``recorder.extra``)
+        into incident capsules, so ``tools/replay.py`` knows the mesh
+        a sharded incident ran on.
     """
 
     def __init__(self, driver, checkpoint_dir: str, *,
@@ -94,7 +105,8 @@ class ResilientDriver:
                  keep: int = 3, sharding_fn: Optional[Callable] = None,
                  handle_signals: bool = True,
                  incident_log: Optional[str] = None,
-                 watchdog=None, recorder=None):
+                 watchdog=None, recorder=None,
+                 sharded: bool = False, mesh=None):
         if max_retries < 0:
             raise ValueError("max_retries must be >= 0")
         if not (0.0 < dt_backoff <= 1.0):
@@ -133,6 +145,19 @@ class ResilientDriver:
         if self.recorder is not None \
                 and getattr(driver, "recorder", None) is None:
             driver.recorder = self.recorder
+        self.sharded = sharded
+        self.mesh = mesh
+        if self.recorder is not None and (sharded or mesh is not None):
+            # capsule fingerprints carry the mesh spec so replay can
+            # rebuild (or knowingly degrade) the sharded program
+            from ibamr_tpu.utils.checkpoint_sharded import _mesh_spec
+            self.recorder.extra.setdefault(
+                "mesh", _mesh_spec(mesh, None, 1))
+            if mesh is not None:
+                self.recorder.extra.setdefault(
+                    "mesh_shape", tuple(int(s)
+                                        for s in mesh.devices.shape))
+        self._writer = None           # live cadence writer during run()
         self.preempted = False
         self.preempt_signum: Optional[int] = None
         self._last: Optional[tuple] = None   # (state, step) post-chunk
@@ -199,14 +224,28 @@ class ResilientDriver:
 
     # -- rollback -----------------------------------------------------------
 
+    def _latest(self):
+        if self.sharded:
+            from ibamr_tpu.utils.checkpoint_sharded import \
+                latest_sharded_step
+            return latest_sharded_step(self.directory)
+        return latest_step(self.directory)
+
+    def _restore(self, template: Any):
+        if self.sharded:
+            from ibamr_tpu.utils.checkpoint_sharded import restore_sharded
+            return restore_sharded(self.directory, template,
+                                   sharding_fn=self.sharding_fn)
+        return restore_checkpoint(self.directory, template,
+                                  sharding_fn=self.sharding_fn)
+
     def _rollback(self, template: Any, initial: tuple):
         """(state, step) to resume from: newest verified checkpoint,
         else the initial state."""
-        step = latest_step(self.directory)
+        step = self._latest()
         if step is None:
             return initial[0], initial[1], None
-        state, k, _ = restore_checkpoint(self.directory, template,
-                                         sharding_fn=self.sharding_fn)
+        state, k, _ = self._restore(template)
         return state, k, k
 
     # -- main entry ---------------------------------------------------------
@@ -218,7 +257,14 @@ class ResilientDriver:
         driver = self.driver
         initial = (state, start_step)
         self._last = initial
-        writer = AsyncCheckpointWriter(self.directory, keep=self.keep)
+        if self.sharded:
+            from ibamr_tpu.utils.checkpoint_sharded import \
+                AsyncShardedWriter
+            writer = AsyncShardedWriter(self.directory, keep=self.keep,
+                                        mesh=self.mesh)
+        else:
+            writer = AsyncCheckpointWriter(self.directory, keep=self.keep)
+        self._writer = writer
 
         user_ckpt = driver.checkpoint_fn
         user_metrics = driver.metrics_fn
@@ -236,7 +282,8 @@ class ResilientDriver:
                 self.watchdog.beat(
                     step=k,
                     last_chunk_wall_s=getattr(driver,
-                                              "last_chunk_wall_s", None))
+                                              "last_chunk_wall_s", None),
+                    ckpt_queue_depth=writer.queue_depth())
             return user_metrics(s, k) if user_metrics is not None else None
 
         driver.checkpoint_fn = ckpt_fn
@@ -328,8 +375,15 @@ class ResilientDriver:
             except Exception:
                 pass
             st, k = self._last
-            save_checkpoint(self.directory, st, k, keep=self.keep,
-                            metadata={"preempted": True})
+            if self.sharded:
+                from ibamr_tpu.utils.checkpoint_sharded import \
+                    save_sharded_checkpoint
+                save_sharded_checkpoint(self.directory, st, k,
+                                        keep=self.keep, mesh=self.mesh,
+                                        metadata={"preempted": True})
+            else:
+                save_checkpoint(self.directory, st, k, keep=self.keep,
+                                metadata={"preempted": True})
             self._record({
                 "event": "preemption",
                 "signal": signal.Signals(e.signum).name,
@@ -346,3 +400,4 @@ class ResilientDriver:
                 writer.close()
             except Exception:
                 pass
+            self._writer = None

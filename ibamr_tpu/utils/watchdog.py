@@ -10,7 +10,8 @@ notices hours later.
 :class:`RunWatchdog` makes the silence observable from two directions:
 
 - **outward**: a daemon thread writes ``<dir>/heartbeat.json``
-  (``{step, steps_per_s, last_chunk_wall_s, time, pid}``) atomically at
+  (``{step, steps_per_s, last_chunk_wall_s, ckpt_queue_depth, time,
+  pid}``) atomically at
   a fixed cadence, so any EXTERNAL observer — ``tools/relay_watch.py``,
   an operator's ``watch cat`` — can distinguish "alive and computing"
   from "process gone/hung" by file staleness alone;
@@ -149,6 +150,7 @@ class RunWatchdog:
         self._step: Optional[int] = None
         self._prev_step: Optional[int] = None
         self._last_chunk_wall_s: Optional[float] = None
+        self._ckpt_queue_depth: Optional[int] = None
         self._ema_chunk_s: Optional[float] = None
         self._armed = True
         self.stalls: list = []          # one record per detected stall
@@ -156,7 +158,8 @@ class RunWatchdog:
     # -- producer side ------------------------------------------------------
 
     def beat(self, step: Optional[int] = None,
-             last_chunk_wall_s: Optional[float] = None) -> None:
+             last_chunk_wall_s: Optional[float] = None,
+             ckpt_queue_depth: Optional[int] = None) -> None:
         """Record liveness (call once per completed chunk). Also
         refreshes the heartbeat file immediately, so the file is never
         staler than the run's real progress; the daemon only keeps it
@@ -173,6 +176,12 @@ class RunWatchdog:
                 self._ema_chunk_s = w if self._ema_chunk_s is None else \
                     (1.0 - self.ema_alpha) * self._ema_chunk_s \
                     + self.ema_alpha * w
+            if ckpt_queue_depth is not None:
+                # async checkpoint backlog: a depth pinned at max means
+                # the writer can't keep up with the cadence — an
+                # external observer sees I/O pressure building BEFORE
+                # saves start dropping or the run starts blocking
+                self._ckpt_queue_depth = int(ckpt_queue_depth)
             self._armed = True          # re-arm: the run moved again
             payload = self._payload_locked()
         if self.heartbeat_path is not None:
@@ -188,6 +197,7 @@ class RunWatchdog:
                 / (self._last_beat - self._prev_beat)
         return {"step": self._step, "steps_per_s": sps,
                 "last_chunk_wall_s": self._last_chunk_wall_s,
+                "ckpt_queue_depth": self._ckpt_queue_depth,
                 "time": self._beat_walltime,
                 "written": time.time(), "pid": os.getpid()}
 

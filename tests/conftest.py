@@ -236,6 +236,20 @@ SLOW_TESTS = {
     # PR 3 (silent failures): real-sleep stall drill — wall-clock
     # timing-sensitive, so it rides the slow tier, not the dev loop
     "test_watchdog_flags_stalled_supervised_run",
+    # PR 6 (sharded checkpoints): subprocess kill drills — each spawns
+    # multiple interpreters; covered in CI by dryrun path 19
+    "test_sharded_kill_one_writer_loses_at_most_one_interval",
+    "test_sharded_smoke_drill_end_to_end",
+    # PR 6 re-tier (measured >= ~12 s by --durations on the
+    # single-core tier-1 box; the fast tier had crept to within ~30 s
+    # of the 870 s gate budget, so borderline runs timed out at ~93%
+    # — the "environment-specific" tier-1 flake)
+    "test_open_outlet_hydrostatic_quiescence",
+    "test_shell_engine_knob_and_step",
+    "test_walled_momentum_wall_shear_sign",
+    "test_hybrid_in_flagship_model",
+    "test_failed_engine_degrades_and_matches_fallback",
+    "test_hybrid_bf16_registry_name",
 }
 
 

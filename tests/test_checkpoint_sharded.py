@@ -1,0 +1,446 @@
+"""Sharded checkpointing (PR 6): per-shard verified writes, elastic
+N->M restore, bounded async writers, and the distributed-failure
+injector inventory.
+
+Oracles: the save path never materializes the global state on the
+host (pinned by counting every ``_fetch_shard`` block); a checkpoint
+written on N devices restores BITWISE on any M in {1, 2, 8} against
+the gather-restore oracle; every injected damage mode (corrupt shard,
+dropped shard, torn manifest, stale-manifest-newer-shards) flunks
+verification and falls back to the previous verified step; a SIGKILL
+mid-commit loses at most one checkpoint interval (subprocess drill,
+slow tier); fsck re-verifies both formats offline and exits nonzero
+on corruption.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ibamr_tpu.utils import checkpoint_sharded as cs
+from ibamr_tpu.utils.checkpoint import (AsyncCheckpointWriter,
+                                        CheckpointCorruptError,
+                                        save_checkpoint)
+from ibamr_tpu.utils import checkpoint as ckpt
+from ibamr_tpu.utils.checkpoint_sharded import (AsyncShardedWriter,
+                                                latest_sharded_step,
+                                                read_manifest,
+                                                restore_sharded,
+                                                save_sharded_checkpoint,
+                                                verify_sharded_checkpoint)
+from ibamr_tpu.utils.watchdog import RunWatchdog, read_heartbeat
+from tools.ckpt_fsck import audit, main as fsck_main
+from tools.fault_injection import (corrupt_checkpoint, corrupt_shard,
+                                   crash_state, drop_shard,
+                                   stale_manifest_shard, tear_manifest)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mesh(n):
+    devs = sorted(jax.devices(), key=lambda d: d.id)[:n]
+    return Mesh(np.array(devs), ("x",))
+
+
+def _host_state(seed=0, n=64):
+    rng = np.random.default_rng(seed)
+    return {"u": rng.standard_normal((n, n)),
+            "v": rng.standard_normal(n),
+            "k": np.int64(seed)}
+
+
+def _place(state, mesh):
+    # arrays shard over the mesh axis; scalars replicate
+    sh = NamedSharding(mesh, P("x"))
+    rep = NamedSharding(mesh, P())
+    return {k: jax.device_put(jnp.asarray(v),
+                              sh if np.ndim(v) >= 1 else rep)
+            for k, v in state.items()}
+
+
+def _assert_states_equal(got, want):
+    for key in want:
+        assert np.array_equal(np.asarray(got[key]),
+                              np.asarray(want[key])), key
+
+
+# ---------------------------------------------------------------------------
+# save / verify / restore on one mesh
+# ---------------------------------------------------------------------------
+
+def test_sharded_save_restore_bitwise_same_mesh(tmp_path, mesh8):
+    host = _host_state(1)
+    st = _place(host, mesh8)
+    save_sharded_checkpoint(str(tmp_path), st, 7, mesh=mesh8,
+                            metadata={"tag": "x"})
+    assert verify_sharded_checkpoint(str(tmp_path), 7)
+    assert latest_sharded_step(str(tmp_path)) == 7
+    man = read_manifest(str(tmp_path), 7)
+    assert man["mesh"]["n_shards"] == 8
+    assert tuple(man["mesh"]["shape"]) == (8,)
+    assert list(man["mesh"]["axis_names"]) == ["x"]
+    assert man["metadata"] == {"tag": "x"}
+    # one shard file per device, plus the manifest commit marker
+    sdir = cs._step_dir(str(tmp_path), 7)
+    shards = [f for f in os.listdir(sdir) if f.startswith("shard-")]
+    assert len(shards) == 8
+
+    got, k, _ = restore_sharded(str(tmp_path), _place(_host_state(2),
+                                                     mesh8))
+    assert k == 7
+    _assert_states_equal(got, host)
+    # same-mesh restore is a memcpy: placement matches the template
+    assert got["u"].sharding.device_set == st["u"].sharding.device_set
+
+
+def test_sharded_save_never_gathers_global_state(tmp_path, mesh8,
+                                                monkeypatch):
+    """The save path moves only per-device blocks to the host — never
+    a leaf's global array (the whole point of the sharded format)."""
+    host = _host_state(3)
+    st = _place(host, mesh8)
+    u_bytes = np.asarray(host["u"]).nbytes
+    fetched = []
+    orig = cs._fetch_shard
+
+    def counting(data):
+        arr = orig(data)
+        fetched.append(arr.nbytes)
+        return arr
+
+    monkeypatch.setattr(cs, "_fetch_shard", counting)
+    save_sharded_checkpoint(str(tmp_path), st, 5, mesh=mesh8)
+    assert fetched, "no shard fetches recorded"
+    assert max(fetched) <= u_bytes // 8, \
+        f"a fetch moved {max(fetched)} bytes (global u = {u_bytes})"
+    got, _, _ = restore_sharded(str(tmp_path),
+                                {k: np.asarray(v)
+                                 for k, v in host.items()})
+    _assert_states_equal(got, host)
+
+
+# ---------------------------------------------------------------------------
+# elastic N -> M restore matrix
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n_src", [1, 2, 8])
+@pytest.mark.parametrize("n_dst", [1, 2, 8])
+def test_elastic_restore_matrix(tmp_path, n_src, n_dst):
+    """A checkpoint written on n_src devices restores on n_dst devices
+    bitwise against the gather-restore oracle — the host arrays the
+    source state held. All 9 {1,2,8}x{1,2,8} pairs."""
+    host = _host_state(n_src * 10 + n_dst)
+    d = str(tmp_path)
+    save_sharded_checkpoint(d, _place(host, _mesh(n_src)), 3,
+                            mesh=_mesh(n_src))
+    man = read_manifest(d, 3)
+    assert man["mesh"]["n_shards"] == n_src
+
+    template = _place(_host_state(0), _mesh(n_dst))
+    got, k, _ = restore_sharded(d, template)
+    assert k == 3
+    _assert_states_equal(got, host)                 # bitwise oracle
+    for key in ("u", "v", "k"):
+        assert got[key].sharding.device_set == \
+            template[key].sharding.device_set, key
+    # host-template restore (no .sharding) lands plain numpy
+    got_np, _, _ = restore_sharded(
+        d, {k: np.asarray(v) for k, v in host.items()})
+    _assert_states_equal(got_np, host)
+    assert isinstance(got_np["u"], np.ndarray)
+
+
+# ---------------------------------------------------------------------------
+# damage inventory: every injector flunks verification, restore falls
+# back to the previous verified step
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("damage", [corrupt_shard, drop_shard,
+                                    stale_manifest_shard])
+def test_shard_damage_falls_back_to_previous_verified(tmp_path, mesh8,
+                                                      damage):
+    d = str(tmp_path)
+    old = _host_state(10)
+    new = _host_state(11)
+    save_sharded_checkpoint(d, _place(old, mesh8), 5, mesh=mesh8)
+    save_sharded_checkpoint(d, _place(new, mesh8), 10, mesh=mesh8)
+    damage(d, 10)
+    assert not verify_sharded_checkpoint(d, 10)
+    assert verify_sharded_checkpoint(d, 5)
+    assert latest_sharded_step(d) == 5              # never the damaged one
+    with pytest.warns(UserWarning):
+        got, k, _ = restore_sharded(
+            d, {k2: np.asarray(v) for k2, v in old.items()})
+    assert k == 5
+    _assert_states_equal(got, old)
+    with pytest.raises(CheckpointCorruptError):
+        restore_sharded(d, {k2: np.asarray(v) for k2, v in new.items()},
+                        step=10)
+
+
+def test_torn_manifest_never_selected(tmp_path, mesh8):
+    """A kill between the shard writes and the manifest commit leaves
+    a torn manifest: the step must be invisible to every verified-only
+    selector and an explicit restore of it must raise."""
+    d = str(tmp_path)
+    old = _host_state(20)
+    save_sharded_checkpoint(d, _place(old, mesh8), 5, mesh=mesh8)
+    save_sharded_checkpoint(d, _place(_host_state(21), mesh8), 10,
+                            mesh=mesh8)
+    tear_manifest(d, 10)
+    assert read_manifest(d, 10) is None
+    assert not verify_sharded_checkpoint(d, 10)
+    assert latest_sharded_step(d) == 5
+    assert latest_sharded_step(d, verified_only=False) == 10
+    with pytest.warns(UserWarning):
+        got, k, _ = restore_sharded(
+            d, {k2: np.asarray(v) for k2, v in old.items()})
+    assert k == 5
+    _assert_states_equal(got, old)
+    with pytest.raises(CheckpointCorruptError):
+        restore_sharded(d, {k2: np.asarray(v) for k2, v in old.items()},
+                        step=10)
+
+
+# ---------------------------------------------------------------------------
+# bounded async writers
+# ---------------------------------------------------------------------------
+
+def test_async_sharded_writer_commits_in_order(tmp_path, mesh8):
+    d = str(tmp_path)
+    states = {s: _host_state(s) for s in (5, 10, 15)}
+    w = AsyncShardedWriter(d, keep=3, max_pending=1, mesh=mesh8)
+    try:
+        for s in (5, 10, 15):
+            w.save(_place(states[s], mesh8), s)
+        w.wait()
+    finally:
+        w.close()
+    assert w.dropped_saves == 0
+    for s in (5, 10, 15):
+        assert verify_sharded_checkpoint(d, s), s
+    got, k, _ = restore_sharded(
+        d, {k2: np.asarray(v) for k2, v in states[15].items()})
+    assert k == 15
+    _assert_states_equal(got, states[15])
+
+
+def test_async_sharded_writer_drop_overflow(tmp_path, mesh8,
+                                            monkeypatch):
+    monkeypatch.setenv(cs._COMMIT_DELAY_ENV, "0.2")
+    d = str(tmp_path)
+    w = AsyncShardedWriter(d, keep=0, max_pending=1, overflow="drop",
+                           mesh=mesh8)
+    try:
+        for s in range(1, 6):
+            w.save(_place(_host_state(s), mesh8), s)
+        depth = w.queue_depth()
+        assert depth <= 1
+        w.wait()
+    finally:
+        w.close()
+    assert w.dropped_saves >= 1
+    assert latest_sharded_step(d) is not None
+
+
+def test_async_single_host_writer_bounded_queue(tmp_path, monkeypatch):
+    """The single-host writer sheds (or blocks) instead of queueing
+    unbounded host copies, and surfaces the backlog via
+    ``queue_depth``."""
+    import time
+
+    d = str(tmp_path)
+    orig = ckpt._write_arrays
+
+    def slow_write(*a, **kw):
+        time.sleep(0.2)
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(ckpt, "_write_arrays", slow_write)
+    w = AsyncCheckpointWriter(d, keep=0, max_pending=1, overflow="drop")
+    try:
+        for s in range(1, 6):
+            w.save({"u": np.full((8,), float(s))}, s)
+        assert w.queue_depth() <= 1
+        w.wait()
+    finally:
+        w.close()
+    assert w.dropped_saves >= 1
+    assert ckpt.latest_step(d) is not None
+
+    # block mode: nothing dropped, every save lands
+    w2 = AsyncCheckpointWriter(d, keep=0, max_pending=1,
+                               overflow="block")
+    try:
+        for s in range(10, 13):
+            w2.save({"u": np.full((8,), float(s))}, s)
+        w2.wait()
+    finally:
+        w2.close()
+    assert w2.dropped_saves == 0
+    assert ckpt.latest_step(d) == 12
+    with pytest.raises(ValueError):
+        AsyncCheckpointWriter(d, max_pending=0)
+    with pytest.raises(ValueError):
+        AsyncCheckpointWriter(d, overflow="panic")
+
+
+def test_watchdog_heartbeat_reports_queue_depth(tmp_path):
+    wd = RunWatchdog(heartbeat_path=str(tmp_path), interval_s=60.0)
+    wd.beat(step=3, last_chunk_wall_s=0.5, ckpt_queue_depth=2)
+    hb = read_heartbeat(wd.heartbeat_path)
+    assert hb is not None
+    assert hb["ckpt_queue_depth"] == 2
+    assert hb["step"] == 3
+
+
+# ---------------------------------------------------------------------------
+# offline fsck
+# ---------------------------------------------------------------------------
+
+def test_fsck_audits_both_formats_and_repairs(tmp_path, mesh8):
+    """fsck re-verifies every digest of both formats, exits nonzero on
+    corruption, and --repair quarantines (never deletes) the damaged
+    steps while leaving the newest verified one untouched."""
+    d = str(tmp_path)
+    # sharded steps 5 (good) and 10 (corrupted)
+    save_sharded_checkpoint(d, _place(_host_state(1), mesh8), 5,
+                            mesh=mesh8)
+    save_sharded_checkpoint(d, _place(_host_state(2), mesh8), 10,
+                            mesh=mesh8)
+    corrupt_shard(d, 10)
+    # nested single-host dir: step 3 good, step 6 corrupted
+    sub = os.path.join(d, "nested")
+    os.makedirs(sub)
+    save_checkpoint(sub, {"u": np.arange(8.0)}, 3)
+    save_checkpoint(sub, {"u": np.arange(8.0) + 1}, 6)
+    corrupt_checkpoint(sub, 6)
+
+    rep = audit(d)
+    assert not rep["clean"]
+    assert rep["counts"]["corrupt"] == 2
+    assert rep["counts"]["verified"] >= 2
+    assert fsck_main([d, "-q"]) == 1
+
+    assert fsck_main([d, "--repair", "-q"]) == 1
+    rep2 = audit(d)
+    assert rep2["clean"]
+    assert fsck_main([d, "-q"]) == 0
+    # the newest verified steps survived repair, bitwise
+    assert verify_sharded_checkpoint(d, 5)
+    assert ckpt.verify_checkpoint(sub, 3)
+    # the damaged steps were MOVED, not deleted
+    assert os.path.isdir(os.path.join(d, "quarantine", "sharded.00000010"))
+    assert os.path.exists(os.path.join(sub, "quarantine",
+                                       "restore.00000006.npz"))
+
+
+def test_fsck_repair_spares_last_candidate(tmp_path, mesh8):
+    """A directory where EVERY step is damaged keeps its newest
+    candidate: fsck must never shorten the recovery chain to zero."""
+    d = str(tmp_path)
+    save_sharded_checkpoint(d, _place(_host_state(1), mesh8), 5,
+                            mesh=mesh8)
+    tear_manifest(d, 5)
+    assert fsck_main([d, "--repair", "-q"]) == 1
+    assert os.path.isdir(cs._step_dir(d, 5))        # spared in place
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL-one-writer subprocess drill (slow tier)
+# ---------------------------------------------------------------------------
+
+def _spawn_sharded_crash_child(d, steps=40, interval=5, n_devices=8):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    # widen the shard-writes -> manifest-commit window so the kill
+    # reliably lands mid-commit in at least one cycle
+    env["IBAMR_SHARDED_COMMIT_DELAY_S"] = "0.05"
+    return subprocess.Popen(
+        [sys.executable, "-m", "tools.fault_injection",
+         "--sharded-crash-child", str(d), "--steps", str(steps),
+         "--interval", str(interval), "--n-devices", str(n_devices)],
+        cwd=REPO_ROOT, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True, bufsize=1)
+
+
+def test_sharded_kill_one_writer_loses_at_most_one_interval(tmp_path):
+    """SIGKILL the sharded checkpoint writer the instant a save lands,
+    two crash cycles: after every kill the newest VERIFIED sharded
+    step is no older than the last acknowledged save, restores bitwise
+    against the closed-form trajectory on the full 8-device run
+    directory AND on a 1-device mesh (the elastic acceptance pin).
+    Then the child runs to completion from the wreckage."""
+    d = str(tmp_path)
+    last_acked = 0
+    for cycle in range(2):
+        p = _spawn_sharded_crash_child(d)
+        acked = None
+        try:
+            for line in p.stdout:
+                if line.startswith("SAVED"):
+                    acked = int(line.split()[1])
+                    if acked > last_acked:
+                        break
+                elif line.startswith("DONE"):
+                    break
+        finally:
+            p.kill()
+            p.wait()
+        assert acked is not None and acked > last_acked, \
+            f"cycle {cycle}: child made no progress"
+        last_acked = acked
+        ls = latest_sharded_step(d)
+        assert ls is not None and ls >= acked       # <= 1 interval lost
+        want = crash_state(ls)
+        got, k, man = restore_sharded(
+            d, {k2: np.asarray(v) for k2, v in want.items()}, step=ls)
+        assert k == ls
+        assert np.array_equal(np.asarray(got["u"]), want["u"])
+        assert man["mesh"]["n_shards"] == 8
+        # elastic: the same run directory restores bitwise on 1 device
+        got1, k1, _ = restore_sharded(d, _place(want, _mesh(1)),
+                                      step=ls)
+        assert k1 == ls
+        assert np.array_equal(np.asarray(got1["u"]), want["u"])
+
+    p = _spawn_sharded_crash_child(d)
+    out, _ = p.communicate(timeout=300)
+    assert p.returncode == 0, out
+    assert "DONE" in out
+    assert latest_sharded_step(d) == 40
+    want = crash_state(40)
+    got, k, _ = restore_sharded(
+        d, {k2: np.asarray(v) for k2, v in want.items()})
+    assert k == 40
+    assert np.array_equal(np.asarray(got["u"]), want["u"])
+
+
+def test_sharded_smoke_drill_end_to_end(tmp_path):
+    """The full dryrun path-19 drill in a subprocess: no-gather audit,
+    elastic N->1, the four damage injectors, the concurrent-writer
+    collision, supervised sharded rollback, and the fsck gate."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.fault_injection",
+         "--sharded-smoke", "--dir", str(tmp_path)],
+        cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+        timeout=600)
+    assert out.returncode == 0, out.stdout + out.stderr
+    rep = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rep["sharded_smoke"] == "ok"
+    assert rep["rollback_step"] == 4
+    # the collision race has two acceptable endings, both asserted
+    # inside the drill: verified-and-bitwise-one-writer, or
+    # detected-corrupt (never a verified mix of the two writers)
+    assert rep["collision_verified"] in (True, False)
+    assert rep["fsck_quarantined"] >= 4

@@ -39,6 +39,17 @@ dryrun drill are built from:
 - :func:`record_capsule_drill` — the victim process for the
   kill-and-replay drill: records a capsule, prints ``CAPSULE <dir>``
   and lingers for the parent's SIGKILL.
+- :func:`corrupt_shard` / :func:`drop_shard` / :func:`tear_manifest` /
+  :func:`stale_manifest_shard` (PR 6) — the on-disk failure modes a
+  DISTRIBUTED writer adds: one shard of many damaged or lost, a torn
+  commit marker, a shard rewritten after its manifest committed.
+- :func:`run_sharded_crash_child` — the sharded SIGKILL-mid-commit
+  victim loop (per-shard writes + manifest commit, closed-form
+  trajectory, ``SAVED`` markers), and :func:`run_sharded_smoke` — the
+  end-to-end sharded-checkpoint drill (no-gather save audit, elastic
+  restore, damage inventory, concurrent-writer collision, supervised
+  sharded rollback, ``tools.ckpt_fsck`` gate) wired as dryrun path 19
+  and ``python -m tools.fault_injection --sharded-smoke``.
 
 Everything here is deliberately boring and deterministic: no random
 fuzzing, every fault lands at a named step/byte so a failure
@@ -884,6 +895,385 @@ def record_capsule_drill(directory: str, linger: bool = True) -> str:
     return cap
 
 
+# ---------------------------------------------------------------------------
+# Sharded-checkpoint damage (PR 6): the on-disk failure modes a
+# DISTRIBUTED writer adds to the single-host inventory — one shard of
+# many damaged, a torn commit marker, a shard rewritten after commit
+# ---------------------------------------------------------------------------
+
+def _shard_path(directory: str, step: int, shard: int) -> str:
+    from ibamr_tpu.utils.checkpoint_sharded import _shard_name, _step_dir
+
+    return os.path.join(_step_dir(directory, step), _shard_name(shard))
+
+
+def corrupt_shard(directory: str, step: int, shard: int = 0,
+                  offset: int | None = None) -> str:
+    """Flip one byte of ONE shard file without changing its size — the
+    single-device bitrot/bad-disk mode. Only the manifest's whole-file
+    CRC for that shard can catch it; the other N-1 shards stay
+    perfect, which is exactly why verification must be per-shard."""
+    path = _shard_path(directory, step, shard)
+    size = os.path.getsize(path)
+    pos = size // 2 if offset is None else offset
+    with open(path, "r+b") as f:
+        f.seek(pos)
+        b = f.read(1)
+        f.seek(pos)
+        f.write(bytes([b[0] ^ 0xFF]))
+    return path
+
+
+def drop_shard(directory: str, step: int, shard: int = 0) -> str:
+    """Delete ONE shard file of a committed step — the lost-host mode:
+    the writer on that host died after the manifest committed, or its
+    local disk was reclaimed. The manifest still names the shard, so
+    verification flunks the step."""
+    path = _shard_path(directory, step, shard)
+    os.remove(path)
+    return path
+
+
+def tear_manifest(directory: str, step: int) -> str:
+    """Replace a step's manifest with a truncated (invalid-JSON)
+    prefix — what a NON-atomic manifest writer killed mid-write would
+    leave. With the atomic protocol this state is only reachable by
+    injection, which is the point: the reader must treat it exactly
+    like the no-manifest uncommitted case."""
+    from ibamr_tpu.utils.checkpoint_sharded import _step_dir
+
+    path = os.path.join(_step_dir(directory, step), "manifest.json")
+    with open(path) as f:
+        payload = f.read()
+    with open(path, "w") as f:
+        f.write(payload[: max(1, len(payload) // 2)].rstrip("}"))
+    return path
+
+
+def stale_manifest_shard(directory: str, step: int,
+                         shard: int = 0) -> str:
+    """Rewrite ONE shard file AFTER the manifest committed (arrays
+    scaled by 2 — a valid npz, wrong bytes): the
+    stale-manifest-newer-shards mode a restarted writer racing an old
+    step leaves behind. The shard parses fine; only the manifest's
+    recorded digest exposes that manifest and shard no longer describe
+    the same checkpoint."""
+    path = _shard_path(directory, step, shard)
+    with np.load(path) as z:
+        arrays = {k: np.asarray(z[k]) * 2 for k in z.files}
+    with open(path, "wb") as f:
+        np.savez(f, **arrays)
+    return path
+
+
+def run_sharded_crash_child(directory: str, num_steps: int,
+                            interval: int, keep: int = 3,
+                            n_devices: int = 8) -> int:
+    """The sharded SIGKILL-mid-commit victim: the same closed-form
+    :func:`crash_state` trajectory as :func:`run_crash_child`, but the
+    state is sharded over an ``n_devices`` 1-D mesh and every
+    checkpoint goes through :func:`save_sharded_checkpoint` — so the
+    parent's kill lands between shard writes and the manifest commit
+    (widen the window with ``IBAMR_SHARDED_COMMIT_DELAY_S``). Resumes
+    from the newest VERIFIED sharded step; prints the same
+    ``START``/``SAVED <k>``/``DONE`` markers.
+
+    Requires f64 (the parent verifies restored leaves bitwise against
+    the f64 closed form) — the CLI entry enables x64 before any jax
+    compute."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from ibamr_tpu.utils.checkpoint_sharded import (latest_sharded_step,
+                                                    restore_sharded,
+                                                    save_sharded_checkpoint)
+
+    devs = sorted(jax.devices(), key=lambda d: d.id)[:n_devices]
+    mesh = Mesh(np.array(devs), ("x",))
+    sh = NamedSharding(mesh, P("x"))
+    rep = NamedSharding(mesh, P())
+
+    def place(d):
+        return {"u": jax.device_put(jnp.asarray(d["u"]), sh),
+                "k": jax.device_put(jnp.asarray(d["k"]), rep)}
+
+    start = latest_sharded_step(directory)
+    if start is None:
+        start, u = 0, crash_state(0)["u"]
+    else:
+        state, start, _ = restore_sharded(
+            directory, place(crash_state(start)), step=start)
+        u = np.asarray(state["u"])
+    print(f"START {start}", flush=True)
+    for k in range(start + 1, num_steps + 1):
+        u = np.cos(u) * 0.9 + 0.01 * k
+        if k % interval == 0:
+            save_sharded_checkpoint(
+                directory, place({"u": u, "k": np.int64(k)}), k,
+                keep=keep, mesh=mesh)
+            print(f"SAVED {k}", flush=True)
+    print("DONE", flush=True)
+    return num_steps
+
+
+def run_sharded_smoke(directory: str | None = None) -> dict:
+    """Deterministic end-to-end SHARDED-checkpoint drill (PR 6, dryrun
+    path 19), on however many devices this process has (>= 2 for the
+    sharding to mean anything; the dryrun runs it on the virtual
+    8-device mesh):
+
+    1. **no-gather save + verified roundtrip** — a mesh-sharded state
+       saves through :func:`save_sharded_checkpoint` with every
+       device->host transfer audited to be shard-sized (never the
+       global array), verifies, and restores bitwise onto the SAME
+       mesh;
+    2. **elastic restore** — the same step restores bitwise onto ONE
+       device (N->1) from the manifest's recorded layout;
+    3. **damage inventory** — single-shard byte flip, dropped shard,
+       torn manifest, and a stale-manifest-newer-shard rewrite each
+       flunk verification; ``latest_sharded_step``/``restore_sharded``
+       fall back to the previous verified step, never silently
+       restoring damage;
+    4. **concurrent-writer collision** — two threads commit the SAME
+       step simultaneously; the atomic per-file protocol guarantees
+       the step afterwards either verifies AND restores bitwise to one
+       writer's state, or is detected as unverified — never a silent
+       mix of the two;
+    5. **supervised sharded rollback** — a dt-gated NaN injector
+       diverges a sharded INS run under
+       ``ResilientDriver(sharded=True)``: rollback restores the newest
+       VERIFIED sharded step through the elastic path and the run
+       completes, with the divergence incident recording the mesh spec
+       in its capsule fingerprint;
+    6. **fsck gate** — ``tools.ckpt_fsck`` audits the drill directory:
+       it must flag the damaged steps (nonzero exit) and pass clean
+       after ``--repair`` quarantines them.
+
+    Raises on any failed expectation; returns a one-line JSON summary.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from ibamr_tpu.utils import checkpoint_sharded as cs
+    from tools import ckpt_fsck
+
+    tmp = None
+    if directory is None:
+        tmp = tempfile.TemporaryDirectory(prefix="ibamr_sharded_smoke_")
+        directory = tmp.name
+    try:
+        n_dev = min(8, jax.device_count())
+        devs = sorted(jax.devices(), key=lambda d: d.id)[:n_dev]
+        mesh = Mesh(np.array(devs), ("x",))
+        sh = NamedSharding(mesh, P("x"))
+
+        n = 64
+        base = np.linspace(-1.0, 1.0, n * n, dtype=np.float32)
+        host = {"u": base.reshape(n, n), "k": np.int64(7)}
+        state = {"u": jax.device_put(jnp.asarray(host["u"]), sh),
+                 "k": jax.device_put(jnp.asarray(host["k"]),
+                                     NamedSharding(mesh, P()))}
+
+        # -- 1. no-gather save: audit every device->host transfer -----
+        ckdir = os.path.join(directory, "ck")
+        global_bytes = host["u"].nbytes
+        fetched: list = []
+        orig_fetch = cs._fetch_shard
+
+        def counting_fetch(data):
+            arr = orig_fetch(data)
+            fetched.append(arr.nbytes)
+            return arr
+
+        cs._fetch_shard = counting_fetch
+        try:
+            cs.save_sharded_checkpoint(ckdir, state, 10, mesh=mesh)
+        finally:
+            cs._fetch_shard = orig_fetch
+        grid_fetches = [b for b in fetched if b >= global_bytes]
+        if n_dev > 1 and grid_fetches:
+            raise AssertionError(
+                f"sharded save fetched a global-sized array "
+                f"({grid_fetches} bytes vs {global_bytes} global) — "
+                f"the gather is back on the save path")
+        if not cs.verify_sharded_checkpoint(ckdir, 10):
+            raise AssertionError("fresh sharded step failed verify")
+
+        r, got, _ = cs.restore_sharded(ckdir, state)
+        if got != 10 or not np.array_equal(np.asarray(r["u"]),
+                                           host["u"]):
+            raise AssertionError("same-mesh sharded restore not bitwise")
+
+        # -- 2. elastic N->1 ------------------------------------------
+        one = devs[0]
+        tmpl1 = {"u": jax.device_put(jnp.asarray(host["u"]), one),
+                 "k": jax.device_put(jnp.asarray(host["k"]), one)}
+        r1, _, _ = cs.restore_sharded(ckdir, tmpl1)
+        if not np.array_equal(np.asarray(r1["u"]), host["u"]):
+            raise AssertionError("elastic N->1 restore not bitwise")
+
+        # -- 3. damage inventory --------------------------------------
+        damaged = {}
+        for step, damage in ((20, corrupt_shard), (30, drop_shard),
+                             (40, tear_manifest),
+                             (50, stale_manifest_shard)):
+            cs.save_sharded_checkpoint(ckdir, state, step, mesh=mesh,
+                                       keep=0)
+            if damage is tear_manifest:
+                damage(ckdir, step)
+            else:
+                damage(ckdir, step, shard=n_dev - 1)
+            if cs.verify_sharded_checkpoint(ckdir, step):
+                raise AssertionError(
+                    f"{damage.__name__} went undetected at step {step}")
+            damaged[damage.__name__] = step
+        if cs.latest_sharded_step(ckdir) != 10:
+            raise AssertionError(
+                f"latest_sharded_step did not fall back to 10: "
+                f"{cs.latest_sharded_step(ckdir)}")
+        import warnings
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            _, fell_back, _ = cs.restore_sharded(ckdir, state)
+        if fell_back != 10:
+            raise AssertionError("restore_sharded did not fall back")
+
+        # -- 4. concurrent-writer collision ---------------------------
+        import threading
+        coll = os.path.join(directory, "collision")
+        other = {"u": jax.device_put(jnp.asarray(host["u"] + 1.0), sh),
+                 "k": state["k"]}
+        errs: list = []
+
+        def write(st):
+            try:
+                cs.save_sharded_checkpoint(coll, st, 60, mesh=mesh)
+            except Exception as e:      # pragma: no cover - diagnostic
+                errs.append(e)
+
+        ts = [threading.Thread(target=write, args=(s,))
+              for s in (state, other)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        if errs:
+            raise AssertionError(f"collision writers raised: {errs}")
+        collided_verified = cs.verify_sharded_checkpoint(coll, 60)
+        if collided_verified:
+            rc, _, _ = cs.restore_sharded(coll, state)
+            ru = np.asarray(rc["u"])
+            if not (np.array_equal(ru, host["u"])
+                    or np.array_equal(ru, host["u"] + 1.0)):
+                raise AssertionError(
+                    "collision produced a verified FRANKENSTEIN step — "
+                    "a mix of two writers' shards passed verification")
+        else:
+            # the manifest writer lost a shard-file race, so the step
+            # is a detectable mix — the OTHER acceptable outcome. fsck
+            # must flag it; --repair then deliberately spares a sole
+            # damaged candidate (never delete the last one), so drop
+            # the drill dir once detection is confirmed or the
+            # clean-gate below could never pass.
+            if ckpt_fsck.audit(coll)["clean"]:
+                raise AssertionError(
+                    "collision step failed verification but fsck "
+                    "called the tree clean")
+            import shutil
+            shutil.rmtree(coll)
+
+        # -- 5. supervised sharded rollback ---------------------------
+        from ibamr_tpu.grid import StaggeredGrid
+        from ibamr_tpu.integrators.ins import INSStaggeredIntegrator
+        from ibamr_tpu.parallel.mesh import (make_sharded_ins_step,
+                                             place_state)
+        from ibamr_tpu.utils.flight_recorder import FlightRecorder
+        from ibamr_tpu.utils.hierarchy_driver import (HierarchyDriver,
+                                                      RunConfig)
+        from ibamr_tpu.utils.supervisor import ResilientDriver
+
+        g = StaggeredGrid(n=(16, 16), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+        integ = INSStaggeredIntegrator(g, rho=1.0, mu=0.05)
+        xf, yc = g.face_centers(0, jnp.float32)
+        xc, yf = g.face_centers(1, jnp.float32)
+        u0 = jnp.sin(2 * jnp.pi * xf) * jnp.cos(2 * jnp.pi * yc) + 0 * yc
+        v0 = -jnp.cos(2 * jnp.pi * xc) * jnp.sin(2 * jnp.pi * yf) + 0 * xc
+        mesh2 = Mesh(np.array(devs[:min(2, n_dev)]), ("x",))
+        st0 = place_state(integ.initialize(u0_arrays=(u0, v0)), g, mesh2)
+
+        dt0 = 1e-3
+        cfg = RunConfig(dt=dt0, num_steps=12, restart_interval=4,
+                        health_interval=2)
+        sup_dir = os.path.join(directory, "supervised")
+        drv = HierarchyDriver(
+            integ, cfg,
+            step_fn=nan_injector_step(
+                make_sharded_ins_step(integ, mesh2), at_step=6,
+                leaf_path="u[0]", dt_gate=dt0 * 0.99),
+            recorder=FlightRecorder(capacity=4))
+        sup = ResilientDriver(drv, sup_dir, max_retries=2,
+                              dt_backoff=0.5, handle_signals=False,
+                              sharded=True, mesh=mesh2)
+        out = sup.run(st0)
+        if int(out.k) != cfg.num_steps:
+            raise AssertionError(
+                f"supervised sharded run stopped at {int(out.k)}")
+        if not bool(jnp.all(jnp.isfinite(out.u[0]))):
+            raise AssertionError("supervised sharded run non-finite")
+        div = [r for r in sup.incidents if r["event"] == "divergence"]
+        if len(div) != 1 or div[0]["rollback_step"] != 4:
+            raise AssertionError(f"unexpected incidents: {sup.incidents}")
+        if not cs._all_sharded_steps(sup_dir):
+            raise AssertionError("supervised run wrote no sharded steps")
+        import glob as _glob
+        if _glob.glob(os.path.join(sup_dir, "restore.*.npz")):
+            raise AssertionError(
+                "sharded supervision wrote single-host checkpoints")
+        if div[0].get("replay"):
+            with open(os.path.join(div[0]["replay"],
+                                   "manifest.json")) as f:
+                cap_mesh = json.load(f)["fingerprint"].get("mesh")
+            if not cap_mesh or cap_mesh.get("n_shards") \
+                    != int(np.prod(mesh2.devices.shape)):
+                raise AssertionError(
+                    f"capsule fingerprint lacks the mesh spec: "
+                    f"{cap_mesh}")
+
+        # -- 6. fsck gate ---------------------------------------------
+        rep = ckpt_fsck.audit(directory)
+        n_bad = rep["counts"]["torn"] + rep["counts"]["corrupt"]
+        if rep["clean"] or n_bad < len(damaged):
+            raise AssertionError(
+                f"fsck missed damage: {rep['counts']} vs {damaged}")
+        rc = ckpt_fsck.main([directory, "--repair", "-q"])
+        if rc != 1:
+            raise AssertionError(f"fsck --repair exit {rc}, expected 1")
+        rep2 = ckpt_fsck.audit(directory)
+        if not rep2["clean"]:
+            raise AssertionError(
+                f"tree not clean after repair: {rep2['counts']}")
+        if ckpt_fsck.main([directory, "-q"]) != 0:
+            raise AssertionError("fsck exit nonzero on repaired tree")
+        if cs.latest_sharded_step(ckdir) != 10:
+            raise AssertionError("repair touched the verified step")
+
+        return {"sharded_smoke": "ok", "n_devices": n_dev,
+                "shard_fetches": len(fetched),
+                "max_fetch_bytes": max(fetched),
+                "global_bytes": global_bytes,
+                "damage_detected": damaged,
+                "collision_verified": bool(collided_verified),
+                "rollback_step": div[0]["rollback_step"],
+                "fsck_quarantined": n_bad}
+    finally:
+        if tmp is not None:
+            tmp.cleanup()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="deterministic fault-injection drills")
@@ -896,6 +1286,15 @@ def main(argv=None) -> int:
                     help="run the record -> escalate -> replay drill")
     ap.add_argument("--crash-child", metavar="DIR",
                     help="run the checkpoint-writer victim loop in DIR")
+    ap.add_argument("--sharded-crash-child", metavar="DIR",
+                    help="run the SHARDED checkpoint-writer victim loop "
+                         "in DIR (forces the CPU backend with "
+                         "--n-devices virtual devices and x64)")
+    ap.add_argument("--sharded-smoke", action="store_true",
+                    help="run the sharded-checkpoint drill (no-gather "
+                         "save, elastic restore, damage inventory, "
+                         "collision, supervised rollback, fsck gate)")
+    ap.add_argument("--n-devices", type=int, default=8)
     ap.add_argument("--record-capsule", metavar="DIR",
                     help="record a divergence capsule in DIR, print "
                          "CAPSULE <dir> and linger for SIGKILL")
@@ -908,6 +1307,24 @@ def main(argv=None) -> int:
     if args.crash_child:
         run_crash_child(args.crash_child, args.steps, args.interval,
                         keep=args.keep)
+        return 0
+    if args.sharded_crash_child:
+        # the victim must never touch the TPU relay, and the parent
+        # verifies its f64 closed-form trajectory bitwise — pin the
+        # CPU backend and x64 BEFORE any jax compute
+        from ibamr_tpu.utils.backend_guard import force_cpu
+        jax = force_cpu(args.n_devices)
+        jax.config.update("jax_enable_x64", True)
+        run_sharded_crash_child(args.sharded_crash_child, args.steps,
+                                args.interval, keep=args.keep,
+                                n_devices=args.n_devices)
+        return 0
+    if args.sharded_smoke:
+        # same backend pin as the crash child: the drill needs the
+        # virtual CPU mesh, never the relay
+        from ibamr_tpu.utils.backend_guard import force_cpu
+        force_cpu(args.n_devices)
+        print(json.dumps(run_sharded_smoke(args.dir)), flush=True)
         return 0
     if args.record_capsule:
         record_capsule_drill(args.record_capsule)

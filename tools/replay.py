@@ -38,7 +38,13 @@ Usage::
 
 Cross-mesh: capsules record UNSHARDED host arrays, so a capsule
 recorded on one device replays on any mesh size (pinned by
-tests/test_replay.py on the CPU virtual 8-device mesh).
+tests/test_replay.py on the CPU virtual 8-device mesh). Capsules from
+SHARDED runs additionally carry the mesh spec in their fingerprint
+(stamped by ``ResilientDriver(sharded=True, mesh=...)``): the default
+replay still runs them on 1 device, while ``--sharded`` re-executes
+the recorded sharded program — degrading to a failure-reproduction pin
+(``mesh_degraded``) when fewer devices are available than the incident
+ran on.
 """
 
 from __future__ import annotations
@@ -187,13 +193,35 @@ def state_from_capsule(manifest: dict, arrays: dict, template):
 # chunk execution + failure classification
 # ---------------------------------------------------------------------------
 
-def execute_chunk(integ, state, dt: float, length: int, step_wrap=None):
+def rebuild_mesh(mesh_spec: dict):
+    """The recorded device mesh, rebuilt on THIS process's devices —
+    same axis shape and names, devices in id order (the shard-index
+    convention of ``checkpoint_sharded``). Raises :class:`ReplayError`
+    when fewer devices are available than the incident ran on."""
+    import jax
+    from jax.sharding import Mesh
+
+    shape = tuple(int(s) for s in mesh_spec["shape"])
+    need = int(np.prod(shape))
+    devs = sorted(jax.devices(), key=lambda d: d.id)
+    if len(devs) < need:
+        raise ReplayError(
+            f"capsule was recorded on a {shape} mesh ({need} devices); "
+            f"only {len(devs)} available")
+    names = mesh_spec.get("axis_names") or \
+        [f"ax{i}" for i in range(len(shape))]
+    return Mesh(np.array(devs[:need]).reshape(shape), tuple(names))
+
+
+def execute_chunk(integ, state, dt: float, length: int, step_wrap=None,
+                  step_fn=None):
     """Re-execute the failing chunk: the same jitted
     ``lax.scan(step, ...)`` the driver compiled, minus the cadence
-    machinery. Returns the post-chunk state."""
+    machinery. ``step_fn`` substitutes a prebuilt step (the sharded
+    one) for ``integ.step``. Returns the post-chunk state."""
     import jax
 
-    step = integ.step
+    step = integ.step if step_fn is None else step_fn
     if step_wrap is not None:
         step = step_wrap(step)
 
@@ -275,7 +303,7 @@ def _x64_scope(manifest):
     return enable_x64() if rec else disable_x64()
 
 
-def _run_once(manifest, arrays, overrides, dt_scale):
+def _run_once(manifest, arrays, overrides, dt_scale, sharded=False):
     import jax
 
     from tools.fault_injection import apply_recorded_injectors
@@ -300,10 +328,20 @@ def _run_once(manifest, arrays, overrides, dt_scale):
         jax.clear_caches()
         integ, template = rebuild(manifest, overrides)
         state = state_from_capsule(manifest, arrays, template)
+        step_fn = None
+        if sharded:
+            # re-execute the SAME sharded program the incident ran:
+            # rebuild the recorded mesh, re-place the capsule state
+            # under the spatial sharding, and scan the sharded step
+            from ibamr_tpu.parallel.mesh import (make_sharded_step,
+                                                 place_state)
+            mesh = rebuild_mesh(manifest["fingerprint"]["mesh"])
+            state = place_state(state, integ.grid, mesh)
+            step_fn = make_sharded_step(integ, mesh)
         dt = float(manifest["chunk"]["dt"]) * float(dt_scale)
         post = execute_chunk(integ, state, dt,
                              int(manifest["chunk"]["length"]),
-                             step_wrap=wrap)
+                             step_wrap=wrap, step_fn=step_fn)
         crcs = digest_state(post)
         failed = chunk_failed(manifest, integ, post, dt)
     return {"leaf_crcs": crcs, "failed": failed,
@@ -319,17 +357,46 @@ def _norm_engine(label) -> str:
 
 
 def replay(capsule_dir: str, overrides: dict | None = None,
-           dt_scale: float = 1.0) -> dict:
+           dt_scale: float = 1.0, sharded: bool = False) -> dict:
     """Full replay: baseline bitwise pin, optional substitution run,
     structured verdict. See the module docstring for the verdict
-    vocabulary."""
+    vocabulary.
+
+    ``sharded=True`` re-executes on the RECORDED mesh (the fingerprint
+    carries the mesh spec of a sharded run). When fewer devices are
+    available than the incident ran on, the replay degrades to the
+    single-device program with ``mesh_degraded: true`` and the bitwise
+    pin relaxes to the failure-reproduction pin — a cross-mesh digest
+    mismatch there says nothing about the incident. The DEFAULT
+    (``sharded=False``) replays any capsule on one device: capsule
+    arrays are unsharded host copies, the cross-mesh guarantee."""
     manifest, arrays = load_capsule(capsule_dir)
     recorded_post = manifest.get("post")
+    mesh_spec = (manifest.get("fingerprint") or {}).get("mesh")
+    mesh_degraded = False
+    use_sharded = False
+    if sharded:
+        if not mesh_spec or int(mesh_spec.get("n_shards", 1)) <= 1:
+            raise ReplayError(
+                "sharded replay requested but the capsule records no "
+                "multi-device mesh (was the run supervised with "
+                "ResilientDriver(sharded=True, mesh=...)?)")
+        import jax
+        need = int(np.prod([int(s) for s in mesh_spec["shape"]]))
+        if jax.device_count() >= need:
+            use_sharded = True
+        else:
+            mesh_degraded = True
 
-    base = _run_once(manifest, arrays, overrides=None, dt_scale=1.0)
+    base = _run_once(manifest, arrays, overrides=None, dt_scale=1.0,
+                     sharded=use_sharded)
     if recorded_post and recorded_post.get("leaf_crcs"):
         bitwise = base["leaf_crcs"] == {
             k: int(v) for k, v in recorded_post["leaf_crcs"].items()}
+        if not bitwise and mesh_degraded:
+            # the recorded digest belongs to the sharded program we
+            # could not rebuild — pin failure reproduction instead
+            bitwise = base["failed"]
     else:
         # no recorded digest (e.g. a stall capsule): fall back to the
         # weaker failure-reproduction pin
@@ -344,11 +411,14 @@ def replay(capsule_dir: str, overrides: dict | None = None,
         "dt_scale": float(dt_scale),
         "override_failed": None,
         "dt_dependent": None,
+        "recorded_mesh": mesh_spec,
+        "sharded_replay": use_sharded,
+        "mesh_degraded": mesh_degraded,
     }
     has_sub = bool(overrides) or dt_scale != 1.0
     if has_sub:
         sub = _run_once(manifest, arrays, overrides=overrides,
-                        dt_scale=dt_scale)
+                        dt_scale=dt_scale, sharded=use_sharded)
         result["override_failed"] = bool(sub["failed"])
 
     if not bitwise:
@@ -393,6 +463,11 @@ def main(argv=None) -> int:
                          "spectral_dtype=…, or a factory kwarg)")
     ap.add_argument("--dt-scale", type=float, default=1.0,
                     help="re-run the chunk at dt * SCALE")
+    ap.add_argument("--sharded", action="store_true",
+                    help="re-execute on the capsule's recorded device "
+                         "mesh (degrades to 1 device with a "
+                         "failure-reproduction pin when fewer devices "
+                         "are available)")
     ap.add_argument("--json", action="store_true",
                     help="print the full result dict as JSON")
     args = ap.parse_args(argv)
@@ -405,7 +480,7 @@ def main(argv=None) -> int:
         overrides[key.strip()] = val.strip()
 
     result = replay(args.capsule, overrides=overrides or None,
-                    dt_scale=args.dt_scale)
+                    dt_scale=args.dt_scale, sharded=args.sharded)
     if args.json:
         print(json.dumps(result, indent=1))
     else:
