@@ -201,6 +201,55 @@ def cpu_sharded_reference(timeout_s: float = 300.0, n: int = 32,
         f"cpu sharded reference hung > {timeout_s:.0f}s", "cpu sharded")
 
 
+def _fleet_child(q, B, n, n_lat, n_lon, steps, dt):
+    """Child body: aggregate throughput of B ensemble lanes through ONE
+    vmapped chunk vs the same lanes run one at a time (PR 7 fleet
+    mode), on a single virtual CPU device so the signal is
+    relay-independent like the sharded reference."""
+    try:
+        import sys as _sys
+        _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from ibamr_tpu.utils.backend_guard import force_cpu
+
+        jax = force_cpu(1)
+        enable_compile_cache(jax)
+        from ibamr_tpu.utils.hierarchy_driver import RunConfig
+        from tools.fleet import build_fleet, run_fleet, run_sequential
+
+        cfg = RunConfig(dt=dt, num_steps=steps, health_interval=4)
+        integ, lane_states, stacked = build_fleet(
+            n, n_lat, n_lon, 0.05, B, 0.01, None)
+        summary, _ = run_fleet(integ, stacked, cfg, B)
+        seq = run_sequential(integ, lane_states, cfg)
+        out = {"lanes": B, "n": n, "markers": n_lat * n_lon,
+               "steps": steps,
+               "aggregate_steps_per_s":
+                   summary["aggregate_steps_per_s"],
+               "lanes_quarantined": summary["lanes_quarantined"],
+               "sequential_steps_per_s":
+                   seq["aggregate_steps_per_s"]}
+        if seq["aggregate_steps_per_s"] > 0:
+            out["fleet_speedup"] = round(
+                summary["aggregate_steps_per_s"]
+                / seq["aggregate_steps_per_s"], 3)
+        q.put(out)
+    except Exception as e:  # noqa: BLE001 - report, parent decides
+        q.put({"error": f"{type(e).__name__}: {e}"})
+
+
+def fleet_reference(B: int = 8, timeout_s: float = 600.0, n: int = 32,
+                    n_lat: int = 16, n_lon: int = 16, steps: int = 8,
+                    dt: float = 1e-3):
+    """Vmapped-ensemble throughput signal (PR 7): B lanes of the small
+    shell stepped as one lane-batched fleet vs sequentially, in a
+    TERMINABLE child. Small fixed shape — a bounded smoke-timing whose
+    quarantine count doubles as a fleet-health regression check (a
+    healthy run must report 0)."""
+    return _run_guarded_child(
+        _fleet_child, (B, n, n_lat, n_lon, steps, dt), timeout_s,
+        f"fleet leg hung > {timeout_s:.0f}s", "fleet")
+
+
 def cpu_sharded_reference_with_trend(n_devices: int = 8):
     """The n=32 smoke leg PLUS a larger n=48 leg, with the
     speedup-vs-size trend (round 5, VERDICT round 4 weak #3: the
@@ -513,6 +562,10 @@ def main():
                     help="write a liveness heartbeat.json to this path "
                          "(or directory) so an external watcher can "
                          "tell a hung relay from a slow stage")
+    ap.add_argument("--fleet", type=int, default=0,
+                    help="also time a B-lane vmapped ensemble of the "
+                         "small shell vs the same lanes sequentially "
+                         "(0 disables)")
     ap.add_argument("--record", type=str, default="",
                     help="arm a flight recorder on every ramp stage; a "
                          "diverged stage dumps a replay capsule under "
@@ -544,6 +597,7 @@ def main():
         "mxu_vs_scatter": None,
         "phases": None,
         "cpu_sharded_ref": None,
+        "fleet": None,
         "error": None,
     }
     orig_steps, orig_deadline = args.steps, args.deadline
@@ -812,6 +866,23 @@ def main():
         except Exception as e:
             result["cpu_sharded_ref"] = {"error": f"{type(e).__name__}: "
                                                   f"{e}"}
+
+        if args.fleet:
+            # ensemble-throughput leg (PR 7): like the sharded ref this
+            # runs on a virtual CPU device in a child, so it lands in
+            # every round's artifact regardless of the relay's health
+            try:
+                remaining = args.deadline - (time.perf_counter()
+                                             - t_start)
+                if remaining < 30.0:
+                    result["fleet"] = {
+                        "error": "skipped (deadline exhausted)"}
+                else:
+                    result["fleet"] = fleet_reference(
+                        B=args.fleet, timeout_s=min(600.0, remaining))
+                log(f"[bench] fleet: {result['fleet']}")
+            except Exception as e:
+                result["fleet"] = {"error": f"{type(e).__name__}: {e}"}
 
         if errors:
             msg = "; ".join(errors)

@@ -36,6 +36,7 @@ import zlib
 from typing import Any, Dict, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 
@@ -192,7 +193,8 @@ def _atomic_write_digest(path: str, write_fn):
 
 def _write_arrays(directory: str, arrays: Dict[str, np.ndarray],
                   schema: Dict[str, Any], step: int,
-                  metadata: Optional[Dict[str, Any]], keep: int) -> str:
+                  metadata: Optional[Dict[str, Any]], keep: int,
+                  lanes: Optional[int] = None) -> str:
     os.makedirs(directory, exist_ok=True)
     fname = os.path.join(directory, f"restore.{step:08d}.npz")
     npz_crc, npz_size = _atomic_write_digest(
@@ -212,6 +214,17 @@ def _write_arrays(directory: str, arrays: Dict[str, np.ndarray],
         "npz_crc32": npz_crc,
         "npz_size": npz_size,
     }
+    if lanes is not None:
+        # lane-axis extension (fleet checkpoints): per-lane CRC32 of
+        # every lane-stacked leaf's rows, so one corrupt lane's slice
+        # is diagnosed (and every OTHER lane stays restorable via
+        # restore_lane) instead of condemning the whole step
+        meta["integrity"]["lanes"] = {
+            "count": int(lanes),
+            "leaves": {k: [_leaf_crc(v[i]) for i in range(int(lanes))]
+                       for k, v in arrays.items()
+                       if v.ndim >= 1 and v.shape[0] == int(lanes)},
+        }
     payload = json.dumps(meta).encode()
     _atomic_write(fname.replace(".npz", ".json"),
                   lambda f: f.write(payload))
@@ -254,10 +267,15 @@ def verify_checkpoint(directory: str, step: int) -> bool:
 
 def save_checkpoint(directory: str, state: Any, step: int,
                     metadata: Optional[Dict[str, Any]] = None,
-                    keep: int = 3) -> str:
-    """Serialize a state pytree. Returns the checkpoint file path."""
+                    keep: int = 3,
+                    lanes: Optional[int] = None) -> str:
+    """Serialize a state pytree. Returns the checkpoint file path.
+    ``lanes`` (fleet runs) records per-lane leaf CRCs in the sidecar so
+    :func:`restore_lane` can salvage healthy lanes from a step whose
+    file is damaged elsewhere."""
     return _write_arrays(directory, _gather_arrays(state),
-                         state_schema(state), step, metadata, keep)
+                         state_schema(state), step, metadata, keep,
+                         lanes=lanes)
 
 
 def _all_steps(directory: str) -> list:
@@ -350,7 +368,8 @@ class AsyncCheckpointWriter:
     """
 
     def __init__(self, directory: str, keep: int = 3,
-                 max_pending: int = 2, overflow: str = "block"):
+                 max_pending: int = 2, overflow: str = "block",
+                 lanes: Optional[int] = None):
         from concurrent.futures import ThreadPoolExecutor
 
         if max_pending < 1:
@@ -361,6 +380,7 @@ class AsyncCheckpointWriter:
         self.keep = keep
         self.max_pending = max_pending
         self.overflow = overflow
+        self.lanes = lanes
         self.dropped_saves = 0
         self._exec = ThreadPoolExecutor(max_workers=1)
         self._pending = []
@@ -381,7 +401,7 @@ class AsyncCheckpointWriter:
 
     @staticmethod
     def _write_with_retry(directory, arrays, schema, step, metadata,
-                          keep):
+                          keep, lanes=None):
         # one retry before surfacing: a transient fs hiccup (NFS blip,
         # ENOSPC race with the pruner) must not cost the interval —
         # the atomic-replace protocol makes the retry idempotent.
@@ -390,10 +410,10 @@ class AsyncCheckpointWriter:
         # attempts.
         try:
             return _write_arrays(directory, arrays, schema, step,
-                                 metadata, keep)
+                                 metadata, keep, lanes=lanes)
         except Exception:
             return _write_arrays(directory, arrays, schema, step,
-                                 metadata, keep)
+                                 metadata, keep, lanes=lanes)
 
     def save(self, state: Any, step: int,
              metadata: Optional[Dict[str, Any]] = None):
@@ -419,7 +439,7 @@ class AsyncCheckpointWriter:
         schema = state_schema(state)
         fut = self._exec.submit(self._write_with_retry, self.directory,
                                 arrays, schema, step, metadata,
-                                self.keep)
+                                self.keep, self.lanes)
         self._pending.append(fut)
         return fut
 
@@ -528,3 +548,92 @@ def _load_step(directory: str, step: int, template: Any, sharding_fn):
             new_leaves.append(arr)
     state = jax.tree_util.tree_unflatten(treedef, new_leaves)
     return state, step, metadata
+
+
+def restore_lane(directory: str, template: Any, lane: int,
+                 step: Optional[int] = None):
+    """Restore ONE lane's slice from a lane-axis checkpoint into
+    ``template`` (the current lane-stacked fleet state).
+
+    Only lane ``lane``'s rows are touched — every other lane's rows of
+    ``template`` are returned bitwise-untouched, which is what makes a
+    per-lane rollback safe for the healthy lanes. Verification is
+    per-lane: the sidecar's ``integrity.lanes`` record (written when
+    checkpoints are saved with ``lanes=``) lets a step whose file is
+    corrupt in ANOTHER lane's rows still serve this lane, so one bad
+    lane's corruption cannot widen its neighbours' recovery interval.
+    Pre-lane sidecars (no ``integrity.lanes``) fall back to whole-leaf
+    CRCs.
+
+    Walks newest -> oldest (or only ``step`` when given) and returns
+    ``(patched_state, checkpoint_step)``; ``None`` when no checkpoint
+    can vouch for this lane (caller falls back to the initial state).
+    """
+    if not os.path.isdir(directory):
+        return None
+    steps = [step] if step is not None else \
+        list(reversed(_all_steps(directory)))
+    import warnings
+
+    for s in steps:
+        try:
+            return _load_lane_step(directory, s, template, lane), s
+        except (CheckpointCorruptError, KeyError, ValueError,
+                OSError) as e:
+            warnings.warn(
+                f"restore_lane: skipping step {s} for lane {lane}: {e}")
+    return None
+
+
+def _load_lane_step(directory: str, step: int, template: Any,
+                    lane: int):
+    fname = os.path.join(directory, f"restore.{step:08d}.npz")
+    if not os.path.exists(fname):
+        raise FileNotFoundError(fname)
+    meta = _read_sidecar(directory, step)
+    if meta is None:
+        raise CheckpointCorruptError(
+            "sidecar missing or unparseable (torn write)")
+    integ = meta.get("integrity") or {}
+    lane_rec = integ.get("lanes")
+    if lane_rec is not None and lane >= int(lane_rec.get("count", 0)):
+        raise ValueError(
+            f"lane {lane} out of range for fleet of "
+            f"{lane_rec.get('count')}")
+    lane_crcs = (lane_rec or {}).get("leaves", {})
+    leaf_crcs = integ.get("leaves", {})
+
+    data = np.load(fname)
+    paths_and_leaves, treedef = \
+        jax.tree_util.tree_flatten_with_path(template)
+    new_leaves = []
+    for path, leaf in paths_and_leaves:
+        key = _path_str(path)
+        if key not in data:
+            raise KeyError(f"checkpoint {fname} missing leaf {key!r}")
+        arr = data[key]
+        if arr.shape != tuple(np.shape(leaf)):
+            raise ValueError(
+                f"checkpoint {fname}: leaf {key!r} shape {arr.shape} "
+                f"!= fleet state shape {tuple(np.shape(leaf))}")
+        if arr.ndim < 1 or lane >= arr.shape[0]:
+            raise ValueError(
+                f"checkpoint {fname}: leaf {key!r} has no lane {lane}")
+        sl = arr[lane]
+        if key in lane_crcs:
+            rec = lane_crcs[key]
+            if lane >= len(rec) or _leaf_crc(np.asarray(sl)) != \
+                    int(rec[lane]):
+                raise CheckpointCorruptError(
+                    f"checkpoint {fname}: lane {lane} of leaf {key!r} "
+                    f"fails its recorded per-lane CRC32")
+        elif key in leaf_crcs and _leaf_crc(arr) != leaf_crcs[key]:
+            # pre-lane sidecar: the whole leaf must verify
+            raise CheckpointCorruptError(
+                f"checkpoint {fname}: leaf {key!r} fails its recorded "
+                f"CRC32 and carries no per-lane record")
+        tgt_dtype = getattr(leaf, "dtype", None)
+        if tgt_dtype is not None and sl.dtype != tgt_dtype:
+            sl = sl.astype(tgt_dtype)
+        new_leaves.append(jnp.asarray(leaf).at[lane].set(sl))
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)

@@ -132,14 +132,17 @@ def factory_spec(module: str, name: str, **kwargs) -> dict:
 
 @dataclasses.dataclass
 class ChunkSnapshot:
-    """One ring entry: the host copy of the state ENTERING a chunk."""
+    """One ring entry: the host copy of the state ENTERING a chunk.
+    Fleet chunks store the per-lane dt VECTOR and lane-alive mask
+    (host copies); solo chunks keep the scalar dt and ``alive=None``."""
     step: int
-    dt: float
+    dt: Any                           # float, or (B,) ndarray in fleet mode
     length: int
     paths: List[str]                  # leaf order for unflatten
     arrays: Dict[str, np.ndarray]     # path -> host copy
     treedef: Any
     wall_time: float
+    alive: Optional[np.ndarray] = None
 
     def covers(self, step: Optional[int]) -> bool:
         return (step is None
@@ -181,11 +184,12 @@ class FlightRecorder:
 
     # -- recording -----------------------------------------------------------
 
-    def snapshot(self, state, *, step: int, dt: float, length: int,
-                 integ=None, cfg=None) -> None:
+    def snapshot(self, state, *, step: int, dt, length: int,
+                 integ=None, cfg=None, alive=None) -> None:
         """Host-copy the pre-chunk state into the ring. Called by the
         driver BEFORE the (possibly donated) chunk consumes ``state`` —
-        the copy is what makes recording donation-safe."""
+        the copy is what makes recording donation-safe. Fleet chunks
+        pass the (B,) per-lane dt vector and lane-alive mask."""
         import jax
 
         t0 = time.perf_counter()
@@ -195,10 +199,13 @@ class FlightRecorder:
             key = _path_str(path)
             paths.append(key)
             arrays[key] = np.asarray(jax.device_get(leaf))
+        dt_val = float(dt) if np.ndim(dt) == 0 \
+            else np.array(dt, dtype=np.float64)
         self.ring.append(ChunkSnapshot(
-            step=int(step), dt=float(dt), length=int(length),
+            step=int(step), dt=dt_val, length=int(length),
             paths=paths, arrays=arrays, treedef=treedef,
-            wall_time=time.time()))
+            wall_time=time.time(),
+            alive=None if alive is None else np.array(alive, dtype=bool)))
         if integ is not None:
             self._integ = integ
         if cfg is not None:
@@ -308,57 +315,104 @@ class FlightRecorder:
     def dump_incident(self, *, directory: str, kind: str,
                       step: Optional[int] = None,
                       event: Optional[str] = None,
-                      driver=None) -> Optional[str]:
+                      driver=None,
+                      lane: Optional[int] = None) -> Optional[str]:
         """Write ``<directory>/<step>/replay.npz`` + ``manifest.json``
         for the newest ring entry covering ``step``. Returns the
         capsule directory (or None when the ring is empty). A second
         incident landing on the same chunk reuses the existing capsule
-        (the state is identical; only the first dump pays)."""
+        (the state is identical; only the first dump pays).
+
+        ``lane`` (fleet runs) slices the lane-stacked snapshot down to
+        that lane's rows: the capsule is SINGLE-LANE (``-L<k>`` suffix
+        on the directory), carries a ``lane`` manifest record with the
+        original ``lane_index``/``fleet_size``, and replays unbatched —
+        ``tools/replay.py`` re-executes it as a B=1 fleet chunk, the
+        bitwise-equal solo form of the failing lane."""
         entry = self.entry_for_step(step)
         if entry is None:
             return None
-        cap_dir = os.path.join(directory, f"{entry.step:08d}")
+        fleet = np.ndim(entry.dt) > 0
+        suffix = "" if lane is None else f"-L{lane:03d}"
+        cap_dir = os.path.join(directory, f"{entry.step:08d}{suffix}")
         manifest_path = os.path.join(cap_dir, "manifest.json")
         if os.path.exists(manifest_path):
             return cap_dir
         os.makedirs(cap_dir, exist_ok=True)
         npz_path = os.path.join(cap_dir, "replay.npz")
-        _atomic_write(npz_path, lambda f: np.savez(f, **entry.arrays))
+        if lane is not None:
+            arrays = {k: np.ascontiguousarray(v[lane])
+                      for k, v in entry.arrays.items()}
+            chunk_dt = float(entry.dt[lane]) if fleet \
+                else float(entry.dt)
+        else:
+            arrays = entry.arrays
+            chunk_dt = [float(v) for v in entry.dt] if fleet \
+                else entry.dt
+        _atomic_write(npz_path, lambda f: np.savez(f, **arrays))
         post = None
         if driver is not None and kind != "stall":
             # a stalled chunk may hang again on re-execution — replay
             # of a stall capsule is interactive business, not dump-time
-            post = self._post_digest(entry, driver)
+            post = self._post_digest(entry, driver, lane=lane)
         manifest = {
             "capsule_schema": CAPSULE_SCHEMA,
             "incident": {"kind": kind, "event": event,
                          "step": step},
             "chunk": {"start_step": entry.step, "length": entry.length,
-                      "dt": entry.dt},
+                      "dt": chunk_dt},
             "state_file": "replay.npz",
             "leaf_order": entry.paths,
-            "pre_leaf_crcs": {k: _leaf_crc(entry.arrays[k])
+            "pre_leaf_crcs": {k: _leaf_crc(arrays[k])
                               for k in entry.paths},
             "post": post,
             "fingerprint": self.fingerprint(driver),
             "time": time.time(),
         }
+        if lane is not None:
+            fleet_size = (len(entry.dt) if fleet else
+                          getattr(driver, "lanes", None))
+            manifest["lane"] = {"index": int(lane),
+                                "fleet_size": None if fleet_size is None
+                                else int(fleet_size)}
+        elif fleet:
+            manifest["fleet"] = {
+                "size": len(entry.dt),
+                "alive": None if entry.alive is None
+                else [bool(a) for a in entry.alive]}
         _atomic_write(manifest_path,
                       lambda f: f.write(json.dumps(
                           manifest, indent=1).encode()))
         self.dumps.append(cap_dir)
         return cap_dir
 
-    def _post_digest(self, entry: ChunkSnapshot, driver) -> Optional[dict]:
+    def _post_digest(self, entry: ChunkSnapshot, driver,
+                     lane: Optional[int] = None) -> Optional[dict]:
         """Per-leaf CRC32s + vitals of the state the recorded chunk
         produces, via ONE re-execution through the driver's own
         compiled chunk (cold path: incidents are rare by construction).
+        For a lane capsule the digest is of the LANE'S slice of the
+        fleet re-execution — bitwise what a B=1 replay must reproduce.
         None when re-execution itself fails."""
         try:
+            import jax.numpy as jnp
+
             state = self.restore(entry)
-            out, health = driver._chunk(entry.length)(state, entry.dt)
+            if np.ndim(entry.dt) > 0:
+                alive = entry.alive if entry.alive is not None \
+                    else np.ones(len(entry.dt), dtype=bool)
+                out, health = driver._chunk(entry.length)(
+                    state, jnp.asarray(entry.dt), jnp.asarray(alive))
+            else:
+                out, health = driver._chunk(entry.length)(state, entry.dt)
+            if lane is not None:
+                import jax
+                out = jax.tree_util.tree_map(lambda l: l[lane], out)
+                h = np.asarray(health)
+                vit = h[:, lane] if h.ndim == 2 else h[lane:lane + 1]
+            else:
+                vit = np.asarray(health).reshape(-1)
             arrays = _gather_arrays(out)
-            vit = np.asarray(health).reshape(-1)
             return {
                 "leaf_crcs": {k: _leaf_crc(v) for k, v in arrays.items()},
                 "vitals": [float(v) for v in vit],

@@ -57,6 +57,15 @@ FATAL = "fatal"
 
 VITALS_FIELDS = ("finite", "max_u", "cfl", "div_norm", "func",
                  "vol", "budget")
+QUARANTINED = "quarantined"
+
+
+def _new_triage_ctx() -> dict:
+    """One triage context: WARN streak + per-run baselines. The solo
+    probe owns one; a fleet probe owns one PER LANE so a drifting lane
+    cannot poison its neighbours' baselines."""
+    return {"warn_streak": 0, "baseline_func": None,
+            "baseline_vol": None, "baseline_budget": None}
 
 
 class HealthDegraded(SimulationDiverged):
@@ -138,12 +147,49 @@ class HealthProbe:
         if self.sustain < 1:
             raise ValueError("sustain must be >= 1 (a WARN streak of "
                              "zero chunks would fire immediately)")
-        self._warn_streak = 0
-        self._baseline_func: Optional[float] = None
-        self._baseline_vol: Optional[float] = None
-        self._baseline_budget: Optional[float] = None
+        # triage state lives in per-context dicts so the SAME threshold
+        # logic serves the solo run (one context, exposed through the
+        # legacy attribute names below) and the fleet (one context per
+        # lane: independent baselines and WARN streaks)
+        self._solo_ctx = _new_triage_ctx()
+        self._lane_ctx: Optional[List[dict]] = None
         self.history: List[dict] = []    # one record per classified chunk
         self.last: Optional[dict] = None
+        self.last_lanes: Optional[List[dict]] = None
+
+    # legacy attribute views of the solo triage context (tests and
+    # callers poke these directly)
+    @property
+    def _warn_streak(self):
+        return self._solo_ctx["warn_streak"]
+
+    @_warn_streak.setter
+    def _warn_streak(self, v):
+        self._solo_ctx["warn_streak"] = v
+
+    @property
+    def _baseline_func(self):
+        return self._solo_ctx["baseline_func"]
+
+    @_baseline_func.setter
+    def _baseline_func(self, v):
+        self._solo_ctx["baseline_func"] = v
+
+    @property
+    def _baseline_vol(self):
+        return self._solo_ctx["baseline_vol"]
+
+    @_baseline_vol.setter
+    def _baseline_vol(self, v):
+        self._solo_ctx["baseline_vol"] = v
+
+    @property
+    def _baseline_budget(self):
+        return self._solo_ctx["baseline_budget"]
+
+    @_baseline_budget.setter
+    def _baseline_budget(self, v):
+        self._solo_ctx["baseline_budget"] = v
 
     # -- construction helpers ----------------------------------------------
 
@@ -254,8 +300,16 @@ class HealthProbe:
     def unpack(vitals) -> dict:
         """Vector -> named dict. Tolerates shorter (older-schema)
         vectors: missing trailing slots read as NaN, so a v2 5-float
-        vitals record still unpacks."""
-        v = np.asarray(vitals, dtype=np.float64).reshape(-1)
+        vitals record still unpacks. A lane-batched (7, B) matrix
+        unpacks to per-field (B,) arrays — one column per lane —
+        without disturbing the rank-1 paths."""
+        v = np.asarray(vitals, dtype=np.float64)
+        if v.ndim == 2:
+            B = v.shape[1]
+            return {name: (v[i].copy() if i < v.shape[0]
+                           else np.full(B, np.nan))
+                    for i, name in enumerate(VITALS_FIELDS)}
+        v = v.reshape(-1)
         return {name: (float(v[i]) if i < v.size else float("nan"))
                 for i, name in enumerate(VITALS_FIELDS)}
 
@@ -267,6 +321,18 @@ class HealthProbe:
         plain :class:`SimulationDiverged` for it) and is reported FATAL
         here for completeness."""
         vit = self.unpack(vitals)
+        level, reasons = self._triage(vit, self._solo_ctx)
+        self._warn_streak = self._warn_streak + 1 if level != OK else 0
+        rec = dict(vit, step=int(step), dt=float(dt), level=level,
+                   warn_streak=self._warn_streak, reasons=list(reasons))
+        self.last = rec
+        self.history.append(rec)
+        return level, reasons, vit
+
+    def _triage(self, vit: dict, ctx: dict):
+        """Threshold logic over one unpacked vitals dict against one
+        triage context (baselines mutate in place). Streak accounting
+        belongs to the caller — solo and per-lane policies differ."""
         reasons: List[str] = []
         level = OK
 
@@ -293,9 +359,9 @@ class HealthProbe:
 
         func = vit["func"]
         if math.isfinite(func):
-            if self._baseline_func is None:
-                self._baseline_func = func
-            base = self._baseline_func
+            if ctx["baseline_func"] is None:
+                ctx["baseline_func"] = func
+            base = ctx["baseline_func"]
             scale = abs(base) if base != 0.0 else 1.0
             growth = abs(func) / scale
             vit["func_growth"] = growth
@@ -312,16 +378,16 @@ class HealthProbe:
 
         # invariant sentinels: relative drift over the run's own first
         # finite value — a secular leak fires long before any NaN
-        for name, fn, base_attr, warn, fatal in (
-                ("vol", self.volume_fn, "_baseline_vol",
+        for name, fn, base_key, warn, fatal in (
+                ("vol", self.volume_fn, "baseline_vol",
                  self.vol_drift_warn, self.vol_drift_fatal),
-                ("budget", self.budget_fn, "_baseline_budget",
+                ("budget", self.budget_fn, "baseline_budget",
                  self.budget_drift_warn, self.budget_drift_fatal)):
             val = vit[name]
             if math.isfinite(val):
-                if getattr(self, base_attr) is None:
-                    setattr(self, base_attr, val)
-                base = getattr(self, base_attr)
+                if ctx[base_key] is None:
+                    ctx[base_key] = val
+                base = ctx[base_key]
                 drift = abs(val - base) / max(abs(base), 1e-30)
                 vit[f"{name}_drift"] = drift
                 if fatal is not None and drift > fatal:
@@ -333,12 +399,70 @@ class HealthProbe:
             elif fn is not None and vit["finite"] >= 1.0:
                 _flag(FATAL, f"{name} sentinel is non-finite")
 
-        self._warn_streak = self._warn_streak + 1 if level != OK else 0
-        rec = dict(vit, step=int(step), dt=float(dt), level=level,
-                   warn_streak=self._warn_streak, reasons=list(reasons))
-        self.last = rec
-        self.history.append(rec)
-        return level, reasons, vit
+        return level, reasons
+
+    def check_lanes(self, vitals, step: int, dt, alive=None) -> List[dict]:
+        """Per-lane triage of a fleet chunk's (7, B) vitals matrix.
+
+        Unlike :meth:`check` this NEVER raises — returning lane
+        verdicts is the whole point of fleet triage (one bad lane must
+        not abort B-1 healthy ones). Each live lane is triaged against
+        its OWN context (independent baselines + WARN streaks); the
+        record's ``fire`` bool is the per-lane equivalent of
+        :meth:`check`'s raise (FATAL, or a sustained WARN streak, while
+        the lane is still finite). Dead lanes (``alive[b]`` false) are
+        skipped with level ``quarantined`` — their frozen rows are the
+        last good state, not a new fault. The driver converts fired
+        lanes into a :class:`~ibamr_tpu.utils.hierarchy_driver
+        .LaneFault` for the supervisor."""
+        v = np.asarray(vitals, dtype=np.float64)
+        if v.ndim != 2:
+            raise ValueError(
+                f"check_lanes expects a (len(VITALS_FIELDS), B) vitals "
+                f"matrix, got shape {v.shape}")
+        B = v.shape[1]
+        if self._lane_ctx is None or len(self._lane_ctx) != B:
+            self._lane_ctx = [_new_triage_ctx() for _ in range(B)]
+        dtv = np.asarray(dt, dtype=np.float64).reshape(-1)
+        if dtv.size == 1 and B > 1:
+            dtv = np.full(B, float(dtv[0]))
+        out: List[dict] = []
+        for b in range(B):
+            if alive is not None and not bool(alive[b]):
+                out.append({"lane": b, "step": int(step),
+                            "level": QUARANTINED, "fire": False,
+                            "reasons": [], "warn_streak": 0})
+                continue
+            vit = self.unpack(v[:, b])
+            ctx = self._lane_ctx[b]
+            level, reasons = self._triage(vit, ctx)
+            ctx["warn_streak"] = (ctx["warn_streak"] + 1
+                                  if level != OK else 0)
+            fire = (level == FATAL
+                    or (level == WARN
+                        and ctx["warn_streak"] >= self.sustain))
+            fire = bool(fire and vit["finite"] >= 1.0)
+            if fire:
+                # mirror check(): a fired lane restarts its streak so
+                # a supervised retry starts from a clean slate
+                ctx["warn_streak"] = 0
+            out.append(dict(vit, lane=b, step=int(step),
+                            dt=float(dtv[b]), level=level,
+                            warn_streak=ctx["warn_streak"],
+                            reasons=list(reasons), fire=fire))
+        self.last_lanes = out
+        self.history.append({"step": int(step), "fleet": True,
+                             "lanes": [{"lane": r["lane"],
+                                        "level": r["level"],
+                                        "fire": r.get("fire", False)}
+                                       for r in out]})
+        return out
+
+    def reset_lane(self, lane: int):
+        """Fresh triage context for one lane (after a per-lane rollback
+        or quarantine restore): the restored slice re-baselines."""
+        if self._lane_ctx is not None and 0 <= lane < len(self._lane_ctx):
+            self._lane_ctx[lane] = _new_triage_ctx()
 
     def check(self, vitals, step: int, dt: float) -> dict:
         """Classify and ESCALATE: raises :class:`HealthDegraded` on a
@@ -357,7 +481,5 @@ class HealthProbe:
 
     def reset(self):
         """Forget streaks AND every baseline (a new run)."""
-        self._warn_streak = 0
-        self._baseline_func = None
-        self._baseline_vol = None
-        self._baseline_budget = None
+        self._solo_ctx = _new_triage_ctx()
+        self._lane_ctx = None

@@ -53,6 +53,51 @@ class SimulationDiverged(RuntimeError):
         return {}
 
 
+class LaneFault(SimulationDiverged):
+    """One or more lanes of a fleet chunk went bad; the REST of the
+    fleet advanced normally and that progress must not be thrown away.
+
+    Carries the post-chunk lane-stacked state (healthy lanes' progress)
+    so the supervisor can patch only the failing lanes' slices and
+    resume from ``step`` — rolling back B-1 healthy lanes for one bad
+    lane is exactly the failure mode fleet execution exists to avoid.
+    """
+
+    kind = "lane_fault"
+
+    def __init__(self, step: int, lanes, lane_reasons: dict,
+                 vitals, fleet_size: int, state=None,
+                 bad_leaves: Optional[dict] = None):
+        self.lanes = list(lanes)
+        self.lane_reasons = dict(lane_reasons)
+        self.vitals = vitals
+        self.fleet_size = int(fleet_size)
+        self.state = state                 # post-chunk stacked state
+        self.lane_bad_leaves = dict(bad_leaves or {})
+        # SimulationDiverged's bad_leaves carries the union for callers
+        # that only know the base class
+        union = sorted({leaf for ls in self.lane_bad_leaves.values()
+                        for leaf in ls})
+        RuntimeError.__init__(
+            self,
+            f"lane fault at step {step}: lanes {self.lanes} of "
+            f"{self.fleet_size} failed "
+            f"({ {k: v for k, v in self.lane_reasons.items()} })")
+        self.step = step
+        self.bad_leaves = union
+
+    def incident_payload(self) -> dict:
+        vit = self.vitals
+        return {
+            "lanes": self.lanes,
+            "lane_reasons": self.lane_reasons,
+            "fleet_size": self.fleet_size,
+            "lane_bad_leaves": self.lane_bad_leaves,
+            "vitals": (np.asarray(vit).tolist()
+                       if vit is not None else None),
+        }
+
+
 def _finite_flag(state) -> jnp.ndarray:
     leaves = jax.tree_util.tree_leaves(state)
     flags = [jnp.all(jnp.isfinite(l)) for l in leaves
@@ -62,6 +107,21 @@ def _finite_flag(state) -> jnp.ndarray:
     for f in flags:
         out = jnp.logical_and(out, f)
     return out
+
+
+def _finite_flag_lanes(state) -> jnp.ndarray:
+    """Per-lane finite flags for a lane-stacked state: (B,) float
+    vector, 1.0 where every floating leaf of that lane is finite."""
+    leaves = jax.tree_util.tree_leaves(state)
+    out = None
+    for l in leaves:
+        if hasattr(l, "dtype") and jnp.issubdtype(l.dtype, jnp.floating):
+            axes = tuple(range(1, l.ndim))
+            f = jnp.all(jnp.isfinite(l), axis=axes)
+            out = f if out is None else jnp.logical_and(out, f)
+    if out is None:
+        raise ValueError("state has no floating leaves")
+    return out.astype(jnp.float32)
 
 
 def _bad_leaf_names(state) -> list:
@@ -167,7 +227,9 @@ class HierarchyDriver:
                  timer_name: str = "HierarchyIntegrator::advanceHierarchy",
                  health_probe=None,
                  recorder=None,
-                 shadow_audit=None):
+                 shadow_audit=None,
+                 lanes: Optional[int] = None,
+                 fleet_step_wrap: Optional[Callable] = None):
         self.integ = integ
         self.cfg = cfg
         self.viz_fn = viz_fn
@@ -200,6 +262,29 @@ class HierarchyDriver:
         # benign re-trace of a known signature leaves unchanged.
         self.trace_counts = {}
         self._trace_sigs = {}
+        # ---- fleet (lane-batched) mode -------------------------------
+        # lanes=B runs B independent scenarios through ONE vmapped
+        # chunk: state leaves carry a leading lane axis, dt becomes a
+        # (B,) vector and a (B,) lane-alive mask freezes quarantined
+        # lanes in-graph. Both are TRACED arguments — per-lane dt
+        # backoff and quarantine never retrace.
+        if lanes is not None and lanes < 1:
+            raise ValueError(f"lanes must be >= 1, got {lanes!r}")
+        if lanes is not None and cfg.cfl is not None:
+            raise ValueError(
+                "cfg.cfl adaptive dt is not supported in fleet mode — "
+                "lanes carry independent per-lane dt (driver.lane_dt)")
+        self.lanes = lanes
+        self.fleet_step_wrap = fleet_step_wrap
+        if lanes is not None:
+            # host mirrors of the traced per-lane knobs; the supervisor
+            # mutates these between chunks (rollback backoff,
+            # quarantine) without triggering a retrace
+            self.lane_dt = np.full(lanes, float(cfg.dt), dtype=float)
+            self.lane_alive = np.ones(lanes, dtype=bool)
+        else:
+            self.lane_dt = None
+            self.lane_alive = None
 
     def _chunk(self, n: int):
         if n not in self._chunks:
@@ -210,6 +295,10 @@ class HierarchyDriver:
             counts = self.trace_counts
             sigs = self._trace_sigs
             probe = self.health_probe
+            lanes = self.lanes
+            if lanes is not None:
+                self._chunks[n] = self._build_fleet_chunk(n)
+                return self._chunks[n]
 
             def chunk(state, dt):
                 # runs at TRACE time only: record the input signature;
@@ -247,6 +336,102 @@ class HierarchyDriver:
                 self._chunks[n] = jax.jit(chunk)
         return self._chunks[n]
 
+    def _build_fleet_chunk(self, n: int):
+        """The lane-batched chunk: ``chunk(state, dt_vec, alive)``.
+
+        One ``lax.scan`` over a vmapped step; quarantined lanes are
+        frozen in-graph by selecting their PRE-step rows after every
+        step (``jnp.where`` on the lane-alive mask — no retrace, no
+        host round-trip). The bitwise contract: this chunk is
+        batch-size invariant (lane k of B lanes == the same lane run at
+        B=1; pinned by tests/test_fleet.py), which is what makes B=1
+        runs the solo reference and single-lane capsules replayable."""
+        base_step = self._base_step
+        counts = self.trace_counts
+        sigs = self._trace_sigs
+        probe = self.health_probe
+        lanes = self.lanes
+        wrap = self.fleet_step_wrap
+
+        stacked_step = jax.vmap(base_step, in_axes=(0, 0))
+        if wrap is not None:
+            # lane-targeted fault injection wraps the STACKED step: a
+            # per-lane injector needs the lane axis in view
+            stacked_step = wrap(stacked_step)
+        if probe is not None:
+            measure_lanes = jax.vmap(probe.measure, in_axes=(0, 0))
+
+        def chunk(state, dt, alive):
+            # trace-time signature record; the lane count is an
+            # explicit element so the no-retrace contract is testable
+            # per (B, chunk length)
+            sig = (
+                int(lanes),
+                tuple((tuple(l.shape), str(l.dtype))
+                      for l in jax.tree_util.tree_leaves(state)
+                      if hasattr(l, "shape")),
+                (tuple(dt.shape), str(dt.dtype)),
+                (tuple(alive.shape), str(alive.dtype)))
+            sigs.setdefault(n, set()).add(sig)
+            counts[n] = len(sigs[n])
+
+            def body(s, _):
+                new = stacked_step(s, dt)
+                # freeze dead lanes at their pre-step rows; healthy
+                # lanes pass through bitwise (select, not arithmetic)
+                frozen = jax.tree_util.tree_map(
+                    lambda nl, ol: jnp.where(
+                        alive.reshape((lanes,) + (1,) * (nl.ndim - 1)),
+                        nl, ol),
+                    new, s)
+                return frozen, None
+
+            out, _ = jax.lax.scan(body, state, None, length=n)
+            if probe is not None:
+                # (B, 7) per-lane vitals -> (7, B); still ONE host
+                # transfer per chunk
+                return out, jnp.transpose(measure_lanes(out, dt))
+            return out, _finite_flag_lanes(out)
+
+        if self.cfg.donate:
+            return jax.jit(chunk, donate_argnums=(0,))
+        return jax.jit(chunk)
+
+    def _triage_fleet(self, state, health, step: int):
+        """Host-side per-lane triage of a fleet chunk's vitals.
+
+        ``health`` is the (7, B) vitals matrix (probe) or the (B,)
+        finite vector. Dead (quarantined) lanes are skipped — their
+        frozen rows are the last good state, not a new fault. Any LIVE
+        lane that went non-finite or triaged FATAL raises
+        :class:`LaneFault` carrying the post-chunk state; the
+        supervisor patches only the failing lanes and resumes."""
+        probe = self.health_probe
+        alive = self.lane_alive
+        B = self.lanes
+        finite = (health[0] >= 1.0) if probe is not None \
+            else (health >= 1.0)
+        bad = [i for i in range(B) if alive[i] and not bool(finite[i])]
+        reasons = {i: ["non_finite"] for i in bad}
+        if probe is not None:
+            verdicts = probe.check_lanes(health, step=step,
+                                         dt=self.lane_dt, alive=alive)
+            self.last_vitals = verdicts
+            for i, v in enumerate(verdicts):
+                if i in reasons or not alive[i]:
+                    continue
+                if v.get("fire"):
+                    bad.append(i)
+                    reasons[i] = list(v.get("reasons") or [])
+        if bad:
+            from ibamr_tpu.utils.lanes import lane_slice
+            bad_leaves = {}
+            for i in bad:
+                if not bool(finite[i]):
+                    bad_leaves[i] = _bad_leaf_names(lane_slice(state, i))
+            raise LaneFault(step, sorted(bad), reasons, health, B,
+                            state=state, bad_leaves=bad_leaves)
+
     def run(self, state, start_step: int = 0):
         """Advance to ``cfg.num_steps``; returns the final state."""
         cfg = self.cfg
@@ -266,34 +451,50 @@ class HierarchyDriver:
             for i in cadences:               # land exactly on cadences
                 n = min(n, i - step % i)
             probe = self.health_probe
+            fleet = self.lanes is not None
+            if fleet:
+                snap_dt = self.lane_dt.copy()
+                snap_alive = self.lane_alive.copy()
+                chunk_args = (jnp.asarray(self.lane_dt),
+                              jnp.asarray(self.lane_alive))
+            else:
+                snap_dt, snap_alive = dt, None
+                chunk_args = (dt,)
             if self.recorder is not None:
                 # host copy of the PRE-chunk state, taken before the
                 # (possibly donated) chunk invalidates its buffers
-                self.recorder.snapshot(state, step=step, dt=dt,
+                self.recorder.snapshot(state, step=step, dt=snap_dt,
                                        length=n, integ=self.integ,
-                                       cfg=cfg)
+                                       cfg=cfg, alive=snap_alive)
             t0 = time.perf_counter()
             if self.timer is not None:
                 with self.timer.scope(self.timer_name):
-                    state, health = self._chunk(n)(state, dt)
+                    state, health = self._chunk(n)(state, *chunk_args)
                     # one device sync per chunk (inside the scope):
                     # either the finite bool or the fused vitals vector
                     health = np.asarray(health)
             else:
-                state, health = self._chunk(n)(state, dt)
+                state, health = self._chunk(n)(state, *chunk_args)
                 health = np.asarray(health)
             self.last_chunk_wall_s = time.perf_counter() - t0
-            finite = bool(health[0] >= 1.0) if probe is not None \
-                else bool(health)
-            if not finite:
-                raise SimulationDiverged(step + n, _bad_leaf_names(state))
-            if probe is not None:
-                # host-side triage; raises HealthDegraded (the
-                # SimulationDiverged precursor) BEFORE any cadence
-                # callback can checkpoint the degraded state
-                self.last_vitals = probe.check(health, step=step + n,
-                                               dt=dt)
-            if self.shadow_audit is not None:
+            if fleet:
+                # per-lane triage; raises LaneFault (carrying the
+                # post-chunk state so healthy-lane progress survives)
+                # BEFORE any cadence callback sees a poisoned lane
+                self._triage_fleet(state, health, step + n)
+            else:
+                finite = bool(health[0] >= 1.0) if probe is not None \
+                    else bool(health)
+                if not finite:
+                    raise SimulationDiverged(step + n,
+                                             _bad_leaf_names(state))
+                if probe is not None:
+                    # host-side triage; raises HealthDegraded (the
+                    # SimulationDiverged precursor) BEFORE any cadence
+                    # callback can checkpoint the degraded state
+                    self.last_vitals = probe.check(health, step=step + n,
+                                                   dt=dt)
+            if self.shadow_audit is not None and not fleet:
                 # strided f64 shadow audit; raises PrecisionDrift
                 # BEFORE the checkpoint cadence can persist a
                 # silently-drifted state

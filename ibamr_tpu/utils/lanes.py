@@ -1,0 +1,75 @@
+"""Lane-stacked state pytrees for fleet (ensemble) execution.
+
+A *fleet* runs B independent scenarios of one scenario family through a
+single compiled executable: every leaf of the integrator state gains a
+leading lane axis and the step function is ``jax.vmap``-ed over it
+(ROADMAP item 2). The helpers here are the only place the lane axis
+convention lives:
+
+- lane axis is ALWAYS axis 0 of every leaf;
+- every lane shares one treedef (parameter sweeps vary *values*, not
+  shapes — heterogeneous shapes belong in separate shape buckets);
+- slicing a lane out (``lane_slice``) produces a state bitwise equal to
+  that lane's rows, so single-lane incident capsules and per-lane
+  checkpoint restores are exact.
+
+Bitwise contract (pinned by tests/test_fleet.py): the lane-batched
+chunk is *batch-size invariant* — lane k of a B-lane chunk is bitwise
+identical to the same scenario run through a B=1 chunk of the same
+length. The B=1 fleet run is therefore the "solo run" reference for
+every bitwise claim; the classic unbatched ``lax.scan`` chunk compiles
+to a differently-fused program and may differ by ULPs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def stack_lanes(states):
+    """Stack per-lane state pytrees into one lane-batched pytree
+    (lane axis 0 on every leaf). All states must share one treedef."""
+    if not states:
+        raise ValueError("stack_lanes needs at least one lane state")
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack([jnp.asarray(l) for l in leaves],
+                                  axis=0),
+        *states)
+
+
+def fleet_size(state) -> int:
+    """B — the lane count of a lane-stacked state (leading axis of the
+    first leaf; every leaf agrees by construction)."""
+    leaves = jax.tree_util.tree_leaves(state)
+    if not leaves:
+        raise ValueError("empty state pytree")
+    return int(leaves[0].shape[0])
+
+
+def lane_slice(state, k: int):
+    """Lane ``k``'s unbatched state — bitwise the rows of lane k."""
+    return jax.tree_util.tree_map(lambda l: l[k], state)
+
+
+def set_lane(state, k: int, lane_state):
+    """Lane-stacked state with lane ``k``'s rows replaced by
+    ``lane_state`` (unbatched). Other lanes' rows are copied bitwise —
+    a per-lane rollback must never perturb healthy lanes."""
+    return jax.tree_util.tree_map(
+        lambda l, v: l.at[k].set(jnp.asarray(v, dtype=l.dtype)),
+        state, lane_state)
+
+
+def broadcast_lane(lane_state, n: int):
+    """A B=n fleet of identical copies of one unbatched state."""
+    return jax.tree_util.tree_map(
+        lambda l: jnp.broadcast_to(
+            jnp.asarray(l)[None], (n,) + jnp.asarray(l).shape).copy(),
+        lane_state)
+
+
+def lane_mask_shape(mask: jnp.ndarray, leaf: jnp.ndarray) -> jnp.ndarray:
+    """Reshape a (B,) lane mask for broadcasting against a lane-stacked
+    leaf: (B, 1, ..., 1) with the leaf's rank."""
+    return mask.reshape((mask.shape[0],) + (1,) * (leaf.ndim - 1))

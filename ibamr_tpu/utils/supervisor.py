@@ -45,8 +45,8 @@ from typing import Any, Callable, Optional
 
 from ibamr_tpu.utils.checkpoint import (AsyncCheckpointWriter,
                                         latest_step, restore_checkpoint,
-                                        save_checkpoint)
-from ibamr_tpu.utils.hierarchy_driver import SimulationDiverged
+                                        restore_lane, save_checkpoint)
+from ibamr_tpu.utils.hierarchy_driver import LaneFault, SimulationDiverged
 
 
 class PreemptionSignal(BaseException):
@@ -106,11 +106,14 @@ class ResilientDriver:
                  handle_signals: bool = True,
                  incident_log: Optional[str] = None,
                  watchdog=None, recorder=None,
-                 sharded: bool = False, mesh=None):
+                 sharded: bool = False, mesh=None,
+                 quarantine_threshold: float = 0.5):
         if max_retries < 0:
             raise ValueError("max_retries must be >= 0")
         if not (0.0 < dt_backoff <= 1.0):
             raise ValueError("dt_backoff must be in (0, 1]")
+        if not (0.0 < quarantine_threshold <= 1.0):
+            raise ValueError("quarantine_threshold must be in (0, 1]")
         self.driver = driver
         # rollback keeps PRE-chunk state references (the initial-state
         # restore template, the preemption save of the last good state)
@@ -161,6 +164,14 @@ class ResilientDriver:
         self.preempted = False
         self.preempt_signum: Optional[int] = None
         self._last: Optional[tuple] = None   # (state, step) post-chunk
+        # ---- fleet (lane-batched) supervision ------------------------
+        # per-lane retry budgets: one bad lane burns only its own
+        # retries; quarantine_threshold is the give-up knob — when more
+        # than this fraction of lanes is quarantined (or every lane is
+        # dead) the fleet run is no longer worth the trace and
+        # HealthDegraded surfaces
+        self.quarantine_threshold = quarantine_threshold
+        self._lane_retries: dict = {}
 
     # -- incident records ---------------------------------------------------
 
@@ -178,11 +189,13 @@ class ResilientDriver:
             f.flush()
         return rec
 
-    def _dump_replay(self, rec: dict) -> Optional[str]:
+    def _dump_replay(self, rec: dict,
+                     lane: Optional[int] = None) -> Optional[str]:
         """Dump (or reuse) the replay capsule for one incident record;
         returns the capsule directory or None (no recorder / empty
         ring / dump failure — a failed dump must never mask the
-        incident itself)."""
+        incident itself). ``lane`` slices a fleet snapshot down to a
+        single-lane capsule."""
         if self.recorder is None:
             return None
         try:
@@ -190,7 +203,7 @@ class ResilientDriver:
                 directory=os.path.join(self.directory, "incidents"),
                 kind=rec.get("kind", rec.get("event", "incident")),
                 step=rec.get("step"), event=rec.get("event"),
-                driver=self.driver)
+                driver=self.driver, lane=lane)
         except Exception as exc:          # pragma: no cover - defensive
             import warnings
             warnings.warn(f"replay capsule dump failed: {exc!r}")
@@ -248,6 +261,105 @@ class ResilientDriver:
         state, k, _ = self._restore(template)
         return state, k, k
 
+    # -- fleet (lane-batched) recovery --------------------------------------
+
+    def _lane_beat_fields(self) -> dict:
+        """Per-lane fields for the watchdog heartbeat (empty dict for a
+        solo run, keeping the beat schema unchanged)."""
+        driver = self.driver
+        if getattr(driver, "lanes", None) is None:
+            return {}
+        alive = driver.lane_alive
+        quarantined = int((~alive).sum())
+        retrying = sum(1 for ln, r in self._lane_retries.items()
+                       if r > 0 and alive[ln])
+        return {"lanes_ok": int(driver.lanes) - quarantined - retrying,
+                "lanes_quarantined": quarantined,
+                "lanes_retrying": retrying}
+
+    def _recover_lanes(self, e: LaneFault, initial: tuple):
+        """Per-lane rollback / quarantine for a :class:`LaneFault`.
+
+        ``e.state`` is the post-chunk stacked state: healthy lanes'
+        progress SURVIVES — only the failing lanes' rows are rewritten,
+        each from the newest checkpoint that vouches for that lane
+        (falling back to the lane's initial slice). A lane with retry
+        budget left gets its own dt backed off and runs again; an
+        exhausted lane is quarantined — restored rows, then frozen
+        in-graph by the lane-alive mask, so the fleet keeps its one
+        compiled trace. Raises :class:`HealthDegraded` only when every
+        lane is dead or more than ``quarantine_threshold`` of the fleet
+        is quarantined.
+
+        Returns ``(patched_state, resume_step)``.
+        """
+        from ibamr_tpu.utils.health import HealthDegraded
+        from ibamr_tpu.utils.lanes import lane_slice, set_lane
+
+        driver = self.driver
+        B = int(driver.lanes)
+        state = e.state
+        try:
+            self._writer.wait()    # pending intervals land before we
+        except Exception:          # decide which checkpoint is newest
+            pass
+        probe = getattr(driver, "health_probe", None)
+        for lane in e.lanes:
+            retries = self._lane_retries.get(lane, 0)
+            reasons = e.lane_reasons.get(lane, [])
+            # capsule FIRST, while the failing lane's rows are still
+            # the failing bytes (the restore below rewrites them)
+            replay = self._dump_replay(
+                {"kind": e.kind, "step": e.step}, lane=lane)
+            restored = restore_lane(self.directory, state, lane) \
+                if not self.sharded else None
+            if restored is not None:
+                state, ck = restored
+                rollback_step, from_ck = int(ck), True
+            else:
+                state = set_lane(state, lane,
+                                 lane_slice(initial[0], lane))
+                rollback_step, from_ck = initial[1], False
+            base = {"kind": e.kind, "step": e.step, "lane": lane,
+                    "fleet_size": B, "reasons": reasons,
+                    "bad_leaves": sorted(
+                        e.lane_bad_leaves.get(lane, [])),
+                    "rollback_step": rollback_step,
+                    "from_checkpoint": from_ck, "replay": replay}
+            if retries < self.max_retries:
+                self._lane_retries[lane] = retries + 1
+                dt_before = float(driver.lane_dt[lane])
+                driver.lane_dt[lane] = dt_before * self.dt_backoff
+                if probe is not None:
+                    probe.reset_lane(lane)
+                self._record(dict(base, **{
+                    "event": "lane_rollback",
+                    "retry": retries + 1,
+                    "max_retries": self.max_retries,
+                    "dt_before": dt_before,
+                    "dt_after": float(driver.lane_dt[lane])}))
+            else:
+                driver.lane_alive[lane] = False
+                self._record(dict(base, **{
+                    "event": "lane_quarantine",
+                    "retries": retries,
+                    "max_retries": self.max_retries}))
+        quarantined = int((~driver.lane_alive).sum())
+        if quarantined >= B or \
+                quarantined / B > self.quarantine_threshold:
+            self._record({
+                "event": "fleet_give_up", "kind": e.kind,
+                "step": e.step, "fleet_size": B,
+                "lanes_quarantined": quarantined,
+                "quarantine_threshold": self.quarantine_threshold,
+                "replay": None})
+            raise HealthDegraded(
+                e.step,
+                [f"{quarantined}/{B} lanes quarantined "
+                 f"(threshold {self.quarantine_threshold})"],
+                {"fleet_size": B, "lanes_quarantined": quarantined})
+        return state, e.step
+
     # -- main entry ---------------------------------------------------------
 
     def run(self, state, start_step: int = 0):
@@ -263,7 +375,9 @@ class ResilientDriver:
             writer = AsyncShardedWriter(self.directory, keep=self.keep,
                                         mesh=self.mesh)
         else:
-            writer = AsyncCheckpointWriter(self.directory, keep=self.keep)
+            writer = AsyncCheckpointWriter(
+                self.directory, keep=self.keep,
+                lanes=getattr(driver, "lanes", None))
         self._writer = writer
 
         user_ckpt = driver.checkpoint_fn
@@ -283,7 +397,8 @@ class ResilientDriver:
                     step=k,
                     last_chunk_wall_s=getattr(driver,
                                               "last_chunk_wall_s", None),
-                    ckpt_queue_depth=writer.queue_depth())
+                    ckpt_queue_depth=writer.queue_depth(),
+                    **self._lane_beat_fields())
             return user_metrics(s, k) if user_metrics is not None else None
 
         driver.checkpoint_fn = ckpt_fn
@@ -309,6 +424,15 @@ class ResilientDriver:
                     out = driver.run(cur_state, start_step=cur_step)
                     writer.wait()      # every interval durably on disk
                     return out
+                except LaneFault as e:
+                    # fleet mode: one bad lane must not sink the fleet
+                    # — recovery is PER LANE (rollback + dt backoff,
+                    # then quarantine) and the healthy lanes' post-
+                    # chunk progress is kept; the run resumes at the
+                    # failing chunk's END, never re-running healthy
+                    # lanes. _recover_lanes raises HealthDegraded when
+                    # the fleet itself is no longer viable.
+                    cur_state, cur_step = self._recover_lanes(e, initial)
                 except SimulationDiverged as e:
                     # incident schema v3: ``kind`` discriminates the
                     # failure family (divergence | health_degraded |

@@ -11,7 +11,8 @@ notices hours later.
 
 - **outward**: a daemon thread writes ``<dir>/heartbeat.json``
   (``{step, steps_per_s, last_chunk_wall_s, ckpt_queue_depth, time,
-  pid}``) atomically at
+  pid}``, plus ``lanes_ok``/``lanes_quarantined``/``lanes_retrying``
+  on fleet runs) atomically at
   a fixed cadence, so any EXTERNAL observer — ``tools/relay_watch.py``,
   an operator's ``watch cat`` — can distinguish "alive and computing"
   from "process gone/hung" by file staleness alone;
@@ -151,6 +152,11 @@ class RunWatchdog:
         self._prev_step: Optional[int] = None
         self._last_chunk_wall_s: Optional[float] = None
         self._ckpt_queue_depth: Optional[int] = None
+        # fleet triage counters (PR 7): None until the first fleet beat,
+        # so solo heartbeats keep their historical schema
+        self._lanes_ok: Optional[int] = None
+        self._lanes_quarantined: Optional[int] = None
+        self._lanes_retrying: Optional[int] = None
         self._ema_chunk_s: Optional[float] = None
         self._armed = True
         self.stalls: list = []          # one record per detected stall
@@ -159,7 +165,10 @@ class RunWatchdog:
 
     def beat(self, step: Optional[int] = None,
              last_chunk_wall_s: Optional[float] = None,
-             ckpt_queue_depth: Optional[int] = None) -> None:
+             ckpt_queue_depth: Optional[int] = None,
+             lanes_ok: Optional[int] = None,
+             lanes_quarantined: Optional[int] = None,
+             lanes_retrying: Optional[int] = None) -> None:
         """Record liveness (call once per completed chunk). Also
         refreshes the heartbeat file immediately, so the file is never
         staler than the run's real progress; the daemon only keeps it
@@ -182,6 +191,12 @@ class RunWatchdog:
                 # external observer sees I/O pressure building BEFORE
                 # saves start dropping or the run starts blocking
                 self._ckpt_queue_depth = int(ckpt_queue_depth)
+            if lanes_ok is not None:
+                self._lanes_ok = int(lanes_ok)
+            if lanes_quarantined is not None:
+                self._lanes_quarantined = int(lanes_quarantined)
+            if lanes_retrying is not None:
+                self._lanes_retrying = int(lanes_retrying)
             self._armed = True          # re-arm: the run moved again
             payload = self._payload_locked()
         if self.heartbeat_path is not None:
@@ -195,11 +210,18 @@ class RunWatchdog:
                 and self._step > self._prev_step):
             sps = (self._step - self._prev_step) \
                 / (self._last_beat - self._prev_beat)
-        return {"step": self._step, "steps_per_s": sps,
-                "last_chunk_wall_s": self._last_chunk_wall_s,
-                "ckpt_queue_depth": self._ckpt_queue_depth,
-                "time": self._beat_walltime,
-                "written": time.time(), "pid": os.getpid()}
+        payload = {"step": self._step, "steps_per_s": sps,
+                   "last_chunk_wall_s": self._last_chunk_wall_s,
+                   "ckpt_queue_depth": self._ckpt_queue_depth,
+                   "time": self._beat_walltime,
+                   "written": time.time(), "pid": os.getpid()}
+        if self._lanes_ok is not None:
+            # fleet run: the external observer sees lane triage in the
+            # same file it already watches for staleness
+            payload["lanes_ok"] = self._lanes_ok
+            payload["lanes_quarantined"] = self._lanes_quarantined
+            payload["lanes_retrying"] = self._lanes_retrying
+        return payload
 
     # -- detector -----------------------------------------------------------
 

@@ -244,6 +244,11 @@ SLOW_TESTS = {
     # single-core tier-1 box; the fast tier had crept to within ~30 s
     # of the 870 s gate budget, so borderline runs timed out at ~93%
     # — the "environment-specific" tier-1 flake)
+    # PR 7 (fleet): the subprocess drill spawns an interpreter for the
+    # B=8 shell fleet (covered in CI by dryrun path 20); the capsule
+    # test compiles two shell fleet chunks plus an unbatched replay
+    "test_fleet_smoke_drill_end_to_end",
+    "test_sliced_capsule_replays_bitwise",
     "test_open_outlet_hydrostatic_quiescence",
     "test_shell_engine_knob_and_step",
     "test_walled_momentum_wall_shear_sign",

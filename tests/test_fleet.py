@@ -1,0 +1,402 @@
+"""Fleet mode (PR 7): vmapped ensemble lanes with per-lane triage,
+quarantine, rollback, and lane-sliced incident capsules.
+
+The contract under test is LANE ISOLATION (docs/RESILIENCE.md):
+
+- lane k of a B-lane fleet is bitwise the state it would hold run
+  alone (a B=1 fleet is THE solo reference — the masked vmapped chunk
+  is batch-size invariant);
+- a poisoned lane's fault never perturbs the other lanes' bits, and
+  recovery (rollback, dt backoff, quarantine) costs the bad lane at
+  most one checkpoint interval while the healthy lanes never stop;
+- the whole episode — backoff'd dt vectors, flipped alive masks — runs
+  through ONE compiled trace per (B, chunk length);
+- the per-lane checkpoint sidecar CRCs make a lane-corrupt step
+  PARTIALLY restorable (``restore_lane``, ``ckpt_fsck`` "partial"),
+  and a lane-sliced capsule replays bitwise unbatched.
+"""
+
+import json
+import math
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ibamr_tpu.grid import StaggeredGrid
+from ibamr_tpu.integrators.ins import INSStaggeredIntegrator
+from ibamr_tpu.utils.checkpoint import restore_lane, save_checkpoint
+from ibamr_tpu.utils.health import HealthDegraded, HealthProbe
+from ibamr_tpu.utils.hierarchy_driver import HierarchyDriver, RunConfig
+from ibamr_tpu.utils.lanes import lane_slice, stack_lanes
+from ibamr_tpu.utils.supervisor import ResilientDriver
+from ibamr_tpu.utils.watchdog import RunWatchdog
+from tools.fault_injection import lane_nan_injector
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _ins(n=16, mu=0.01):
+    g = StaggeredGrid(n=(n, n), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    return INSStaggeredIntegrator(g, rho=1.0, mu=mu, dtype=jnp.float64)
+
+
+def _tg_state(integ, amp=1.0):
+    g = integ.grid
+    xf, yc = g.face_centers(0, jnp.float64)
+    xc, yf = g.face_centers(1, jnp.float64)
+    u = amp * jnp.sin(2 * math.pi * xf) * jnp.cos(2 * math.pi * yc) \
+        + 0 * yc
+    v = -amp * jnp.cos(2 * math.pi * xc) * jnp.sin(2 * math.pi * yf) \
+        + 0 * xc
+    return integ.initialize(u0_arrays=(u, v))
+
+
+def _lane_states(integ, B):
+    """B distinct Taylor-Green lanes (per-lane amplitude)."""
+    return [_tg_state(integ, amp=1.0 + 0.05 * i) for i in range(B)]
+
+
+def _bitwise_equal(a, b) -> bool:
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    return all(np.asarray(x).tobytes() == np.asarray(y).tobytes()
+               for x, y in zip(la, lb))
+
+
+def _solo_run(integ, st, num_steps, dt, health_interval=2):
+    """THE solo reference: the same lane as a B=1 masked fleet."""
+    drv = HierarchyDriver(
+        integ, RunConfig(dt=dt, num_steps=num_steps,
+                         health_interval=health_interval), lanes=1)
+    return lane_slice(drv.run(stack_lanes([st])), 0)
+
+
+# ---------------------------------------------------------------------------
+# batch-size invariance: lane k of B == the same lane alone
+# ---------------------------------------------------------------------------
+
+def test_lane_of_fleet_matches_solo_bitwise():
+    integ = _ins()
+    B, steps, dt = 3, 4, 1e-3
+    states = _lane_states(integ, B)
+    drv = HierarchyDriver(
+        integ, RunConfig(dt=dt, num_steps=steps, health_interval=2),
+        lanes=B)
+    fleet_final = drv.run(stack_lanes(states))
+    for i in range(B):
+        solo = _solo_run(integ, states[i], steps, dt)
+        assert _bitwise_equal(lane_slice(fleet_final, i), solo), \
+            f"lane {i} of B={B} differs from its solo run"
+
+
+def test_fleet_rejects_bad_lane_configs():
+    integ = _ins()
+    with pytest.raises(ValueError, match="lanes"):
+        HierarchyDriver(integ, RunConfig(dt=1e-3, num_steps=2), lanes=0)
+    with pytest.raises(ValueError, match="cfl"):
+        HierarchyDriver(integ, RunConfig(dt=1e-3, num_steps=2, cfl=0.5),
+                        lanes=2)
+
+
+# ---------------------------------------------------------------------------
+# quarantine: one bad lane must not sink (or even touch) the fleet
+# ---------------------------------------------------------------------------
+
+def test_quarantine_leaves_healthy_lanes_bitwise_untouched(tmp_path):
+    integ = _ins()
+    B, BAD, steps, dt = 4, 1, 8, 1e-3
+    states = _lane_states(integ, B)
+    inj = dict(at_step=4, lane=BAD, fleet_size=B, leaf_path="u[0]",
+               step_attr="k")
+    drv = HierarchyDriver(
+        integ, RunConfig(dt=dt, num_steps=steps, health_interval=2,
+                         restart_interval=2),
+        lanes=B, fleet_step_wrap=lambda s: lane_nan_injector(s, **inj))
+    sup = ResilientDriver(drv, str(tmp_path), max_retries=0,
+                          handle_signals=False)
+    final = sup.run(stack_lanes(states))
+
+    assert not drv.lane_alive[BAD]
+    assert all(drv.lane_alive[i] for i in range(B) if i != BAD)
+    quar = [r for r in sup.incidents if r.get("event")
+            == "lane_quarantine"]
+    assert len(quar) == 1 and quar[0]["lane"] == BAD
+    # the quarantined lane was restored (finite) and frozen at the
+    # rollback step's state
+    bad = lane_slice(final, BAD)
+    assert all(np.isfinite(np.asarray(l)).all()
+               for l in jax.tree_util.tree_leaves(bad))
+    assert int(np.asarray(bad.k)) == quar[0]["rollback_step"]
+    # healthy lanes: full progress, bitwise equal to their CLEAN solo
+    # runs — the poisoned lane's NaNs and the flipped alive mask never
+    # touched their bits
+    for i in range(B):
+        if i == BAD:
+            continue
+        got = lane_slice(final, i)
+        assert int(np.asarray(got.k)) == steps
+        assert _bitwise_equal(got, _solo_run(integ, states[i], steps,
+                                             dt)), \
+            f"healthy lane {i} perturbed by lane {BAD}'s fault"
+    # the whole episode (fault, quarantine restore, resumed chunks)
+    # reused one trace per chunk length
+    assert all(v == 1 for v in drv.trace_counts.values()), \
+        drv.trace_counts
+
+
+def test_fleet_gives_up_past_quarantine_threshold(tmp_path):
+    integ = _ins()
+    B, steps, dt = 2, 8, 1e-3
+    states = _lane_states(integ, B)
+
+    def poison_all(s):
+        for lane in range(B):
+            s = lane_nan_injector(s, at_step=2, lane=lane, fleet_size=B,
+                                  leaf_path="u[0]", step_attr="k")
+        return s
+
+    drv = HierarchyDriver(
+        integ, RunConfig(dt=dt, num_steps=steps, health_interval=2,
+                         restart_interval=2),
+        lanes=B, fleet_step_wrap=poison_all)
+    sup = ResilientDriver(drv, str(tmp_path), max_retries=0,
+                          handle_signals=False)
+    with pytest.raises(HealthDegraded, match="lanes quarantined"):
+        sup.run(stack_lanes(states))
+    assert any(r.get("event") == "fleet_give_up"
+               for r in sup.incidents)
+
+
+# ---------------------------------------------------------------------------
+# per-lane rollback: dt backoff cures a marginal lane in place
+# ---------------------------------------------------------------------------
+
+def test_per_lane_rollback_loses_at_most_one_interval(tmp_path):
+    integ = _ins()
+    B, BAD, steps, dt = 3, 1, 8, 1e-3
+    states = _lane_states(integ, B)
+    # dt-gated poison: fires at k==4 only at full dt, so ONE rollback
+    # with dt backoff cures the lane in place (no quarantine)
+    inj = dict(at_step=4, lane=BAD, fleet_size=B, leaf_path="u[0]",
+               step_attr="k", dt_gate=dt)
+    drv = HierarchyDriver(
+        integ, RunConfig(dt=dt, num_steps=steps, health_interval=2,
+                         restart_interval=2),
+        lanes=B, fleet_step_wrap=lambda s: lane_nan_injector(s, **inj))
+    sup = ResilientDriver(drv, str(tmp_path), max_retries=1,
+                          dt_backoff=0.5, handle_signals=False)
+    final = sup.run(stack_lanes(states))
+
+    rolls = [r for r in sup.incidents if r.get("event")
+             == "lane_rollback"]
+    assert len(rolls) == 1 and rolls[0]["lane"] == BAD
+    assert rolls[0]["from_checkpoint"] and rolls[0]["rollback_step"] == 2
+    assert not any(r.get("event") == "lane_quarantine"
+                   for r in sup.incidents)
+    assert all(drv.lane_alive)
+    # only the bad lane's dt backed off; only it lost the rollback gap
+    assert drv.lane_dt[BAD] == pytest.approx(0.5 * dt)
+    for i in range(B):
+        k = int(np.asarray(lane_slice(final, i).k))
+        if i == BAD:
+            # fault at step 4, newest checkpoint at step 2: the lane
+            # re-stepped from 2 — exactly one interval behind at the end
+            assert k == steps - 2
+        else:
+            assert k == steps
+            assert drv.lane_dt[i] == pytest.approx(dt)
+            assert _bitwise_equal(lane_slice(final, i),
+                                  _solo_run(integ, states[i], steps, dt))
+    assert all(v == 1 for v in drv.trace_counts.values()), \
+        drv.trace_counts
+
+
+# ---------------------------------------------------------------------------
+# trace economy: dt backoff and mask flips are traced arguments
+# ---------------------------------------------------------------------------
+
+def test_one_trace_signature_per_chunk_length():
+    integ = _ins()
+    B, dt = 4, 1e-3
+    states = _lane_states(integ, B)
+    drv = HierarchyDriver(
+        integ, RunConfig(dt=dt, num_steps=4, health_interval=2),
+        lanes=B)
+    drv.run(stack_lanes(states))
+    assert drv.trace_counts == {2: 1}
+    # new per-lane dt values and a dead lane are VALUE changes of
+    # traced arguments, not new signatures
+    drv.lane_dt[0] = 0.25 * dt
+    drv.lane_alive[2] = False
+    drv.run(stack_lanes(states))
+    assert drv.trace_counts == {2: 1}
+
+
+# ---------------------------------------------------------------------------
+# lane-aware health plumbing
+# ---------------------------------------------------------------------------
+
+def test_unpack_accepts_lane_matrix_and_stays_compatible():
+    B = 5
+    mat = np.arange(7 * B, dtype=np.float64).reshape(7, B)
+    d = HealthProbe.unpack(mat)
+    for name in HealthProbe.VITALS_FIELDS:
+        assert np.asarray(d[name]).shape == (B,)
+    assert np.array_equal(d[HealthProbe.VITALS_FIELDS[0]], mat[0])
+    # rank-1 (solo) and short older-schema vectors still unpack
+    solo = HealthProbe.unpack(np.arange(7.0))
+    assert solo[HealthProbe.VITALS_FIELDS[3]] == 3.0
+    old = HealthProbe.unpack(np.arange(5.0))
+    assert np.isnan(old[HealthProbe.VITALS_FIELDS[6]])
+
+
+def test_watchdog_heartbeat_carries_lane_triage(tmp_path):
+    hb = str(tmp_path / "hb.json")
+    wd = RunWatchdog(heartbeat_path=hb)
+    wd.beat(step=3)
+    payload = json.load(open(hb))
+    assert "lanes_ok" not in payload          # solo schema unchanged
+    wd.beat(step=4, lanes_ok=6, lanes_quarantined=1, lanes_retrying=1)
+    payload = json.load(open(hb))
+    assert payload["lanes_ok"] == 6
+    assert payload["lanes_quarantined"] == 1
+    assert payload["lanes_retrying"] == 1
+
+
+# ---------------------------------------------------------------------------
+# per-lane checkpoint slices: restore_lane + fsck "partial"
+# ---------------------------------------------------------------------------
+
+def _lane_stacked_state(B, seed):
+    rng = np.random.default_rng(seed)
+    return {"u": rng.standard_normal((B, 6, 6)),
+            "p": rng.standard_normal((B, 4))}
+
+
+def _corrupt_lane_slice(directory, step, lane, key="u"):
+    fname = os.path.join(directory, f"restore.{step:08d}.npz")
+    z = dict(np.load(fname))
+    z[key][lane] = z[key][lane] + 1.0
+    np.savez(fname, **z)
+
+
+def test_restore_lane_verifies_slice_and_falls_back(tmp_path):
+    d = str(tmp_path)
+    B, BAD = 4, 2
+    for step in (2, 4):
+        save_checkpoint(d, _lane_stacked_state(B, seed=step), step,
+                        lanes=B)
+    _corrupt_lane_slice(d, 4, BAD)
+    template = _lane_stacked_state(B, seed=0)
+
+    # healthy lane: newest step serves it (per-lane CRC verifies even
+    # though the FILE digest no longer does)
+    got = restore_lane(d, template, 0)
+    assert got is not None
+    state, step = got
+    assert step == 4
+    assert np.array_equal(np.asarray(state["u"])[0],
+                          _lane_stacked_state(B, seed=4)["u"][0])
+    # only the requested lane's slice was patched into the template
+    assert np.array_equal(np.asarray(state["u"])[1], template["u"][1])
+
+    # corrupt lane: newest step's slice fails its CRC -> falls back to
+    # the older verified step
+    with pytest.warns(UserWarning):
+        got = restore_lane(d, template, BAD)
+    assert got is not None
+    state, step = got
+    assert step == 2
+    assert np.array_equal(np.asarray(state["u"])[BAD],
+                          _lane_stacked_state(B, seed=2)["u"][BAD])
+
+
+def test_ckpt_fsck_flags_lane_corrupt_step_partial(tmp_path):
+    from tools.ckpt_fsck import audit, repair_dir
+
+    d = str(tmp_path)
+    B, BAD = 4, 1
+    for step in (2, 4):
+        save_checkpoint(d, _lane_stacked_state(B, seed=step), step,
+                        lanes=B)
+    _corrupt_lane_slice(d, 4, BAD)
+
+    report = audit(d)
+    assert not report["clean"]
+    assert report["counts"]["partial"] == 1
+    assert report["counts"]["corrupt"] == 0
+    (dir_rep,) = report["dirs"]
+    rec = next(r for r in dir_rep["steps"] if r["step"] == 4)
+    assert rec["status"] == "partial"
+    assert rec["lanes"]["lanes_bad"] == [BAD]
+    assert BAD not in rec["lanes"]["lanes_ok"]
+    # partial is not fully verified: the older intact step stays newest
+    assert dir_rep["newest_verified"] == 2
+    # repair never quarantines a partial step — its intact lanes are
+    # restore_lane's source after a lane fault
+    assert repair_dir(dir_rep) == []
+    assert os.path.exists(os.path.join(d, "restore.00000004.npz"))
+
+
+# ---------------------------------------------------------------------------
+# lane-sliced capsule replay + the end-to-end drill (slow tier)
+# ---------------------------------------------------------------------------
+
+def test_sliced_capsule_replays_bitwise(tmp_path):
+    """A fleet incident's capsule is ONE lane, replayable unbatched."""
+    from ibamr_tpu.models.shell3d import build_shell_example
+    from ibamr_tpu.utils.flight_recorder import (FlightRecorder,
+                                                 factory_spec)
+    from tools.fault_injection import recorded
+    from tools.replay import replay
+
+    kwargs = dict(n_cells=16, n_lat=8, n_lon=8, mu=0.05,
+                  dtype="float64")
+    integ, st0 = build_shell_example(**kwargs)
+    B, BAD, dt = 2, 1, 1e-3
+    states = [st0, st0._replace(ins=st0.ins._replace(
+        u=tuple(c * 1.01 + 1e-4 for c in st0.ins.u)))]
+    inj = dict(at_step=2, lane=BAD, fleet_size=B, leaf_path="u[0]",
+               step_attr="ins.k")
+    with recorded("lane_nan", **inj):
+        drv = HierarchyDriver(
+            integ, RunConfig(dt=dt, num_steps=4, health_interval=2,
+                             restart_interval=2),
+            lanes=B,
+            fleet_step_wrap=lambda s: lane_nan_injector(s, **inj),
+            recorder=FlightRecorder(capacity=4, spec=factory_spec(
+                "ibamr_tpu.models.shell3d", "build_shell_example",
+                **kwargs)))
+        sup = ResilientDriver(drv, str(tmp_path / "ck"),
+                              max_retries=0, handle_signals=False)
+        sup.run(stack_lanes(states))
+
+    quar = [r for r in sup.incidents
+            if r.get("event") == "lane_quarantine"]
+    assert len(quar) == 1 and quar[0]["replay"]
+    cap = quar[0]["replay"]
+    manifest = json.load(open(os.path.join(cap, "manifest.json")))
+    assert manifest["lane"] == {"index": BAD, "fleet_size": B}
+    res = replay(cap)
+    assert res["verdict"] == "reproduced", res
+
+
+def test_fleet_smoke_drill_end_to_end(tmp_path):
+    """The CI drill (dryrun path 20) in a subprocess: B=8 shell fleet,
+    NaN in one lane, rollback + backoff + quarantine, healthy lanes
+    bitwise vs solo, sliced capsule replayed ``reproduced``."""
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.fault_injection", "--fleet-smoke",
+         "--dir", str(tmp_path / "drill")],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=900)
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["fleet_smoke"] == "ok"
+    assert out["replay_verdict"] == "reproduced"
+    assert out["lane_quarantines"] == 1

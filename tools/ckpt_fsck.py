@@ -18,8 +18,12 @@ device:
   to the leaf;
 - reports per step: ``verified``, ``torn`` (no/torn commit marker —
   what a killed writer leaves), ``corrupt`` (marker present, digest
-  mismatch / missing shard), and whether the step is ``prunable``
-  (an older-than-newest-verified step the pruner may reclaim);
+  mismatch / missing shard), ``partial`` (a LANE-STACKED fleet
+  checkpoint whose damage is confined to some lanes' slices — the
+  per-lane CRCs in the PR-7 sidecar prove the other lanes' slices are
+  intact, so ``restore_lane`` can still serve them), and whether the
+  step is ``prunable`` (an older-than-newest-verified step the pruner
+  may reclaim);
 - ``--repair`` QUARANTINES corrupt/torn steps (renames into
   ``<dir>/quarantine/``, never deletes) so a resuming run stops
   re-walking them; the newest verified step is never touched, and a
@@ -62,6 +66,42 @@ def _leaf_crcs_of_npz(path: str) -> dict:
         return {k: ckpt._leaf_crc(z[k]) for k in z.files}
 
 
+def _lane_audit(fname: str, integ: dict):
+    """Per-lane re-verification of a DAMAGED lane-stacked step.
+
+    The PR-7 fleet sidecar records one CRC32 per lane slice of every
+    lane-stacked leaf (``integrity.lanes.leaves``). When the whole-file
+    or whole-leaf digests fail, those per-lane digests tell the
+    operator WHICH lanes' slices are still intact — the difference
+    between a dead checkpoint and one ``restore_lane`` can still serve
+    for B-1 lanes. Returns ``{count, lanes_ok, lanes_bad}``, or
+    ``None`` when the damage is not lane-attributable (no lane record,
+    unparseable file, missing/reshaped leaf)."""
+    lanes = integ.get("lanes") or {}
+    count = int(lanes.get("count", 0))
+    lane_leaves = lanes.get("leaves") or {}
+    if count < 1 or not lane_leaves:
+        return None
+    bad: set = set()
+    try:
+        with np.load(fname) as z:
+            for key, crcs in lane_leaves.items():
+                if key not in z.files:
+                    return None          # structural, not lane-local
+                arr = z[key]
+                if (arr.ndim < 1 or arr.shape[0] != count
+                        or len(crcs) != count):
+                    return None
+                for i in range(count):
+                    if ckpt._leaf_crc(arr[i]) != int(crcs[i]):
+                        bad.add(i)
+    except Exception:
+        return None
+    return {"count": count,
+            "lanes_ok": [i for i in range(count) if i not in bad],
+            "lanes_bad": sorted(bad)}
+
+
 def audit_single_step(directory: str, step: int) -> dict:
     """One ``restore.<step>`` checkpoint, re-verified from bytes."""
     rec = {"format": "single", "step": step, "status": "verified",
@@ -100,6 +140,18 @@ def audit_single_step(directory: str, step: int) -> dict:
                     rec["problems"].append(f"leaf {k!r} CRC32 mismatch")
     if rec["problems"]:
         rec["status"] = "corrupt"
+        lanes = _lane_audit(fname, integ)
+        if lanes is not None and lanes["lanes_bad"] \
+                and lanes["lanes_ok"]:
+            # damage confined to some lanes' slices of a fleet
+            # checkpoint: the step is PARTIALLY restorable, and saying
+            # only "corrupt" would hide the B-1 recoverable lanes
+            rec["status"] = "partial"
+            rec["lanes"] = lanes
+            rec["problems"].append(
+                f"lane slices {lanes['lanes_bad']} corrupt; lanes "
+                f"{lanes['lanes_ok']} verify per-lane "
+                f"(restore_lane-servable)")
     return rec
 
 
@@ -185,7 +237,7 @@ def audit_dir(directory: str) -> dict:
 
 def _counts(steps) -> dict:
     c = {"verified": 0, "legacy": 0, "torn": 0, "corrupt": 0,
-         "prunable": 0}
+         "partial": 0, "prunable": 0}
     for r in steps:
         c[r["status"]] += 1
         if r.get("prunable"):
@@ -194,13 +246,15 @@ def _counts(steps) -> dict:
 
 
 def audit(root: str) -> dict:
-    """Audit a whole run tree. ``clean`` is False iff any torn or
-    corrupt step exists anywhere under ``root``."""
+    """Audit a whole run tree. ``clean`` is False iff any torn,
+    corrupt, or partial step exists anywhere under ``root`` (a partial
+    step is damage too — just lane-attributed damage)."""
     dirs = [audit_dir(d) for d in _checkpoint_dirs(root)]
     total = _counts([r for d in dirs for r in d["steps"]])
     return {"root": os.path.abspath(root), "dirs": dirs,
             "counts": total,
-            "clean": total["torn"] == 0 and total["corrupt"] == 0}
+            "clean": (total["torn"] == 0 and total["corrupt"] == 0
+                      and total["partial"] == 0)}
 
 
 # ---------------------------------------------------------------------------
@@ -221,6 +275,8 @@ def repair_dir(dir_report: dict) -> list:
     the newest verified step, and — when NO step verified — leaves the
     newest damaged candidate in place (the run-time fallback may still
     salvage leaves from it; an empty directory salvages nothing).
+    ``partial`` steps are NEVER quarantined: their intact lane slices
+    are exactly what ``restore_lane`` needs after a lane fault.
     Returns the quarantined step records."""
     directory = dir_report["directory"]
     bad = [r for r in dir_report["steps"]
@@ -284,12 +340,13 @@ def main(argv=None) -> int:
                   + (f", {c['legacy']} legacy" if c["legacy"] else "")
                   + (f", {c['torn']} torn" if c["torn"] else "")
                   + (f", {c['corrupt']} corrupt" if c["corrupt"] else "")
+                  + (f", {c['partial']} partial" if c["partial"] else "")
                   + (f", {c['prunable']} prunable"
                      if c["prunable"] else "")
                   + (f" (newest verified: {d['newest_verified']})"
                      if d["newest_verified"] is not None else ""))
             for r in d["steps"]:
-                if r["status"] in ("torn", "corrupt"):
+                if r["status"] in ("torn", "corrupt", "partial"):
                     tag = " [quarantined]" if r.get("quarantined") else ""
                     print(f"  {r['format']} step {r['step']}: "
                           f"{r['status']}{tag} — "

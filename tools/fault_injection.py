@@ -50,6 +50,12 @@ dryrun drill are built from:
   restore, damage inventory, concurrent-writer collision, supervised
   sharded rollback, ``tools.ckpt_fsck`` gate) wired as dryrun path 19
   and ``python -m tools.fault_injection --sharded-smoke``.
+- :func:`lane_nan_injector` / :func:`lane_drift_injector` (PR 7) —
+  faults confined to ONE lane of a vmapped fleet chunk, and
+  :func:`run_fleet_smoke` — the end-to-end lane-quarantine drill (one
+  poisoned lane, per-lane rollback + dt backoff, quarantine, healthy
+  lanes bitwise untouched, sliced-capsule replay) wired as dryrun
+  path 20 and ``python -m tools.fault_injection --fleet-smoke``.
 
 Everything here is deliberately boring and deterministic: no random
 fuzzing, every fault lands at a named step/byte so a failure
@@ -325,6 +331,105 @@ def volume_leak_injector(step_fn, rate: float = 0.01,
     return wrapped
 
 
+# ---------------------------------------------------------------------------
+# Lane-targeted injectors (PR 7): faults that poison exactly ONE lane of
+# a vmapped fleet chunk — the failure shape the lane-quarantine and
+# per-lane-rollback machinery exists to contain. They wrap the STACKED
+# (already-vmapped) step, so the fire condition can address lanes.
+# ---------------------------------------------------------------------------
+
+def lane_nan_injector(stacked_step, at_step: int, lane: int,
+                      fleet_size: int, leaf_path: str = "u",
+                      dt_gate: float | None = None,
+                      step_attr: str = "k"):
+    """Wrap a STACKED ``step_fn(state, dt_vec) -> state`` (every leaf
+    lane-stacked, dt a (B,) vector) so exactly lane ``lane``'s rows of
+    every floating leaf matching ``leaf_path`` come out NaN when that
+    lane's step counter equals ``at_step`` — jit/scan/vmap-safe (the
+    fault is a ``jnp.where`` on traced values). Other lanes' rows pass
+    through BITWISE untouched (``jnp.where`` is elementwise), which is
+    what the healthy-lanes-unperturbed drill assertion pins.
+
+    ``dt_gate`` arms the fault only while the LANE'S dt is
+    ``>= dt_gate``: a per-lane dt backoff then cures it. Without the
+    gate the injector re-fires on every per-lane retry, driving the
+    lane to retry exhaustion and quarantine — the drill's second act.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    lane_ids = jnp.arange(int(fleet_size))
+
+    def wrapped(state, dt):
+        out = stacked_step(state, dt)
+        k = out
+        for attr in step_attr.split("."):
+            k = getattr(k, attr)
+        fire = jnp.logical_and(lane_ids == lane,
+                               jnp.asarray(k) == at_step)
+        if dt_gate is not None:
+            fire = jnp.logical_and(fire, jnp.asarray(dt) >= dt_gate)
+        hit = []
+
+        def _poison(path, leaf):
+            key = jax.tree_util.keystr(path)
+            if leaf_path in key and hasattr(leaf, "dtype") \
+                    and jnp.issubdtype(leaf.dtype, jnp.floating):
+                hit.append(key)
+                m = fire.reshape((int(fleet_size),)
+                                 + (1,) * (leaf.ndim - 1))
+                return jnp.where(m, jnp.asarray(jnp.nan, leaf.dtype),
+                                 leaf)
+            return leaf
+
+        out = jax.tree_util.tree_map_with_path(_poison, out)
+        if not hit:
+            raise KeyError(f"no floating leaf path contains {leaf_path!r}")
+        return out
+
+    return wrapped
+
+
+def lane_drift_injector(stacked_step, rate: float = 1.5, lane: int = 0,
+                        fleet_size: int = 1, leaf_path: str = "u",
+                        dt_gate: float | None = None):
+    """Wrap a STACKED step so lane ``lane``'s rows of every floating
+    leaf matching ``leaf_path`` are multiplied by ``rate`` per step — a
+    FINITE exponential blow-up confined to one lane, the silent failure
+    only the per-lane vitals triage (``HealthProbe.check_lanes``) can
+    attribute to the right lane. ``dt_gate`` arms the drift only while
+    the lane's dt is ``>= dt_gate`` (per-lane backoff cures it)."""
+    import jax
+    import jax.numpy as jnp
+
+    lane_ids = jnp.arange(int(fleet_size))
+
+    def wrapped(state, dt):
+        out = stacked_step(state, dt)
+        fire = lane_ids == lane
+        if dt_gate is not None:
+            fire = jnp.logical_and(fire, jnp.asarray(dt) >= dt_gate)
+        hit = []
+
+        def _grow(path, leaf):
+            key = jax.tree_util.keystr(path)
+            if leaf_path in key and hasattr(leaf, "dtype") \
+                    and jnp.issubdtype(leaf.dtype, jnp.floating):
+                hit.append(key)
+                m = fire.reshape((int(fleet_size),)
+                                 + (1,) * (leaf.ndim - 1))
+                return leaf * jnp.where(m, jnp.asarray(rate, leaf.dtype),
+                                        jnp.asarray(1.0, leaf.dtype))
+            return leaf
+
+        out = jax.tree_util.tree_map_with_path(_grow, out)
+        if not hit:
+            raise KeyError(f"no floating leaf path contains {leaf_path!r}")
+        return out
+
+    return wrapped
+
+
 @contextlib.contextmanager
 def apply_recorded_injectors(injectors: dict):
     """Re-arm the faults a replay manifest recorded. Context-style
@@ -338,6 +443,10 @@ def apply_recorded_injectors(injectors: dict):
     - ``nan``: {at_step, leaf_path, dt_gate} -> nan_injector_step
     - ``growth``: {rate, leaf_path, dt_gate} -> growth_injector_step
     - ``volume_leak``: {rate, leaf_path, dt_gate} -> volume_leak_injector
+    - ``lane_nan`` / ``lane_drift``: lane-targeted faults; the wrap
+      applies to the STACKED step (replay of a lane capsule builds a
+      B=1 fleet chunk and transforms ``lane``/``fleet_size`` before
+      calling this — see ``tools.replay._lane_injectors``)
 
     Unknown names raise: silently dropping a recorded fault would turn
     every replay of it into a false ``not_reproduced``/"cured" verdict.
@@ -358,6 +467,12 @@ def apply_recorded_injectors(injectors: dict):
             elif name == "volume_leak":
                 wrappers.append(lambda fn, p=params:
                                 volume_leak_injector(fn, **p))
+            elif name == "lane_nan":
+                wrappers.append(lambda fn, p=params:
+                                lane_nan_injector(fn, **p))
+            elif name == "lane_drift":
+                wrappers.append(lambda fn, p=params:
+                                lane_drift_injector(fn, **p))
             else:
                 raise KeyError(
                     f"replay manifest records unknown injector {name!r}")
@@ -1274,6 +1389,157 @@ def run_sharded_smoke(directory: str | None = None) -> dict:
             tmp.cleanup()
 
 
+def run_fleet_smoke(directory: str | None = None,
+                    fleet_size: int = 8, bad_lane: int = 5) -> dict:
+    """Deterministic end-to-end FLEET drill (PR 7, dryrun path 20): a
+    B-lane vmapped ensemble of the 32^3 IB shell where ONE lane is
+    poisoned mid-run, supervised by the lane-granular recovery loop.
+
+    1. **one bad lane, one compiled trace** — B perturbed copies of the
+       shell scenario step through a single vmapped chunk; an un-gated
+       ``lane_nan_injector`` NaNs lane ``bad_lane`` at its 4th step.
+       The driver's per-lane triage raises ``LaneFault`` naming exactly
+       that lane;
+    2. **per-lane rollback, then quarantine** — the supervisor restores
+       ONLY the bad lane's slice from the newest verified lane-axis
+       checkpoint and backs off that lane's dt (one ``lane_rollback``
+       incident); the un-gated fault re-fires, retries exhaust, and the
+       lane is QUARANTINED — restored rows frozen in-graph by the
+       lane-alive mask (one ``lane_quarantine`` incident). The fleet
+       completes; the whole recovery retraces NOTHING (one trace
+       signature per chunk length);
+    3. **healthy lanes untouched** — every surviving lane's final state
+       is BITWISE identical to the same scenario run solo (a B=1 fleet
+       chunk — the batch-size-invariance contract);
+    4. **lane-sliced capsule** — the rollback incident's capsule is
+       single-lane; ``tools.replay`` re-executes it unbatched (B=1,
+       injector re-armed onto lane 0) and must match the recorded
+       post-chunk digest bitwise -> verdict ``reproduced``.
+
+    Raises on any failed expectation; returns a one-line JSON summary.
+    Needs x64 (bitwise pins are f64) — enabled here if not already.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ibamr_tpu.models.shell3d import build_shell_example
+    from ibamr_tpu.utils.flight_recorder import (FlightRecorder,
+                                                 factory_spec)
+    from ibamr_tpu.utils.hierarchy_driver import HierarchyDriver, RunConfig
+    from ibamr_tpu.utils.lanes import lane_slice, stack_lanes
+    from ibamr_tpu.utils.supervisor import ResilientDriver
+    from tools.replay import replay
+
+    if not jax.config.jax_enable_x64:
+        jax.config.update("jax_enable_x64", True)
+
+    B, BAD = int(fleet_size), int(bad_lane)
+    tmp = None
+    if directory is None:
+        tmp = tempfile.TemporaryDirectory(prefix="ibamr_fleet_smoke_")
+        directory = tmp.name
+    try:
+        kwargs = dict(n_cells=32, n_lat=16, n_lon=16, mu=0.05,
+                      dtype="float64")
+        integ, st0 = build_shell_example(**kwargs)
+        # heterogeneous fleet: per-lane initial-velocity perturbation
+        lane_states = [st0._replace(ins=st0.ins._replace(
+            u=tuple(c * (1.0 + 0.01 * i) + 1e-4 * (i + 1)
+                    for c in st0.ins.u))) for i in range(B)]
+        fleet0 = stack_lanes(lane_states)
+
+        dt0 = 1e-3
+        cfg = RunConfig(dt=dt0, num_steps=8, restart_interval=2,
+                        health_interval=2)
+        inj = dict(at_step=4, lane=BAD, fleet_size=B,
+                   leaf_path="u[0]", step_attr="ins.k")
+        with recorded("lane_nan", **inj):
+            drv = HierarchyDriver(
+                integ, cfg, lanes=B,
+                fleet_step_wrap=lambda s: lane_nan_injector(s, **inj),
+                recorder=FlightRecorder(capacity=4, spec=factory_spec(
+                    "ibamr_tpu.models.shell3d", "build_shell_example",
+                    **kwargs)))
+            sup = ResilientDriver(drv, directory, max_retries=1,
+                                  dt_backoff=0.5, handle_signals=False)
+            out = sup.run(fleet0)
+
+        k = np.asarray(out.ins.k)
+        healthy = [i for i in range(B) if i != BAD]
+        if any(int(k[i]) != cfg.num_steps for i in healthy):
+            raise AssertionError(f"healthy lanes did not finish: {k}")
+        if drv.lane_alive[BAD]:
+            raise AssertionError("bad lane was never quarantined")
+        bad_u = np.asarray(out.ins.u[0][BAD])
+        if not np.isfinite(bad_u).all():
+            raise AssertionError(
+                "quarantined lane holds non-finite rows — the restore "
+                "before freeze did not land")
+        if float(drv.lane_dt[BAD]) != dt0 * 0.5:
+            raise AssertionError(
+                f"bad lane dt not backed off once: {drv.lane_dt}")
+        if any(float(d) != dt0 for i, d in enumerate(drv.lane_dt)
+               if i != BAD):
+            raise AssertionError("a healthy lane's dt was touched")
+        rolls = [r for r in sup.incidents
+                 if r["event"] == "lane_rollback"]
+        quars = [r for r in sup.incidents
+                 if r["event"] == "lane_quarantine"]
+        if len(rolls) != 1 or len(quars) != 1:
+            raise AssertionError(f"unexpected incidents: "
+                                 f"{[r['event'] for r in sup.incidents]}")
+        if rolls[0]["lane"] != BAD or quars[0]["lane"] != BAD:
+            raise AssertionError("incidents name the wrong lane")
+        if not rolls[0]["from_checkpoint"]:
+            raise AssertionError("rollback did not come from a "
+                                 "verified checkpoint")
+        # the recovery must never retrace: one signature per length
+        if any(c != 1 for c in drv.trace_counts.values()):
+            raise AssertionError(f"fleet recovery retraced: "
+                                 f"{drv.trace_counts}")
+
+        # -- 3. healthy lanes bitwise equal to solo (B=1) runs --------
+        ref_cfg = RunConfig(dt=dt0, num_steps=8, health_interval=2)
+        for i in healthy:
+            ref_drv = HierarchyDriver(integ, ref_cfg, lanes=1)
+            ref = ref_drv.run(stack_lanes([lane_states[i]]))
+            got = jax.tree_util.tree_leaves(lane_slice(out, i))
+            want = jax.tree_util.tree_leaves(lane_slice(ref, 0))
+            if any(np.asarray(a).tobytes() != np.asarray(b).tobytes()
+                   for a, b in zip(got, want)):
+                raise AssertionError(
+                    f"healthy lane {i} is not bitwise equal to its "
+                    f"solo run — the quarantine machinery perturbed a "
+                    f"lane it had no business touching")
+
+        # -- 4. the lane-sliced capsule replays bitwise ---------------
+        cap = rolls[0].get("replay")
+        if not cap:
+            raise AssertionError(f"rollback incident has no capsule: "
+                                 f"{rolls[0]}")
+        manifest = json.load(open(os.path.join(cap, "manifest.json")))
+        if manifest.get("lane", {}).get("index") != BAD \
+                or manifest.get("lane", {}).get("fleet_size") != B:
+            raise AssertionError(f"capsule lane record wrong: "
+                                 f"{manifest.get('lane')}")
+        res = replay(cap)
+        if res["verdict"] != "reproduced" or not res["bitwise"]:
+            raise AssertionError(f"lane capsule replay: {res}")
+
+        return {"fleet_smoke": "ok", "fleet_size": B, "bad_lane": BAD,
+                "healthy_final_step": cfg.num_steps,
+                "bad_lane_final_step": int(k[BAD]),
+                "lane_rollbacks": len(rolls),
+                "lane_quarantines": len(quars),
+                "trace_counts": {str(n): c for n, c
+                                 in drv.trace_counts.items()},
+                "capsule": cap,
+                "replay_verdict": res["verdict"]}
+    finally:
+        if tmp is not None:
+            tmp.cleanup()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="deterministic fault-injection drills")
@@ -1294,6 +1560,11 @@ def main(argv=None) -> int:
                     help="run the sharded-checkpoint drill (no-gather "
                          "save, elastic restore, damage inventory, "
                          "collision, supervised rollback, fsck gate)")
+    ap.add_argument("--fleet-smoke", action="store_true",
+                    help="run the lane-quarantine fleet drill (vmapped "
+                         "ensemble, one poisoned lane, per-lane "
+                         "rollback -> quarantine, sliced-capsule "
+                         "replay)")
     ap.add_argument("--n-devices", type=int, default=8)
     ap.add_argument("--record-capsule", metavar="DIR",
                     help="record a divergence capsule in DIR, print "
@@ -1325,6 +1596,14 @@ def main(argv=None) -> int:
         from ibamr_tpu.utils.backend_guard import force_cpu
         force_cpu(args.n_devices)
         print(json.dumps(run_sharded_smoke(args.dir)), flush=True)
+        return 0
+    if args.fleet_smoke:
+        # the drill is vmap-parallel, not device-parallel — one CPU
+        # device suffices; f64 bitwise pins need x64 before any compute
+        from ibamr_tpu.utils.backend_guard import force_cpu
+        jax = force_cpu(1)
+        jax.config.update("jax_enable_x64", True)
+        print(json.dumps(run_fleet_smoke(args.dir)), flush=True)
         return 0
     if args.record_capsule:
         record_capsule_drill(args.record_capsule)

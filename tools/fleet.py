@@ -1,0 +1,242 @@
+"""Fleet runner: B ensemble lanes through ONE vmapped trace (PR 7).
+
+Runs ``--lanes B`` independently-perturbed instances of the 3-D shell
+as a lane-stacked fleet: every state leaf carries a leading lane axis,
+the chunk is ONE ``jax.vmap``-ped scan shared by all lanes, dt is a
+(B,) vector and a (B,) lane-alive mask freezes quarantined lanes
+in-graph — so B scenarios cost ONE compile and one host transfer per
+chunk instead of B of each. Under ``ResilientDriver`` supervision a
+lane that goes bad is rolled back alone (its slice restored from the
+newest verified checkpoint, its dt backed off), and quarantined after
+retry exhaustion — the other B-1 lanes never stop stepping.
+
+Prints ONE JSON line (last line of stdout) with per-lane status
+(steps completed, alive, dt, retries) and aggregate steps/s; progress
+goes to stderr. ``--sequential`` also runs each lane alone as a B=1
+fleet (the bitwise solo reference — docs/RESILIENCE.md "Lane
+isolation") and reports the aggregate-vs-sequential speedup.
+
+Examples::
+
+    python tools/fleet.py --lanes 8 --steps 16 --dir /tmp/fleet
+    python tools/fleet.py --lanes 64 --n 32 --sequential
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def perturb_lane(state, i: int, scale: float = 0.01):
+    """Lane i's initial condition: the base state with a deterministic
+    per-lane velocity perturbation (relative scale + a tiny absolute
+    offset so lane 0 still differs from the unperturbed base)."""
+    ins = state.ins
+    u = tuple(c * (1.0 + scale * i) + 1e-4 * scale * (i + 1)
+              for c in ins.u)
+    return state._replace(ins=ins._replace(u=u))
+
+
+def lane_steps(state, lane: int):
+    """Steps completed by one lane (the per-lane fluid step counter)."""
+    import numpy as np
+    k = state.ins.k if hasattr(state, "ins") else state.k
+    return int(np.asarray(k)[lane])
+
+
+def build_fleet(n, n_lat, n_lon, mu, lanes, perturb, dtype):
+    from ibamr_tpu.models.shell3d import build_shell_example
+    from ibamr_tpu.utils.lanes import stack_lanes
+
+    integ, st0 = build_shell_example(n_cells=n, n_lat=n_lat,
+                                     n_lon=n_lon, mu=mu, dtype=dtype)
+    lane_states = [perturb_lane(st0, i, perturb) for i in range(lanes)]
+    return integ, lane_states, stack_lanes(lane_states)
+
+
+def run_fleet(integ, stacked, cfg, lanes, directory=None,
+              max_retries=2, dt_backoff=0.5, quarantine_threshold=0.5,
+              heartbeat=None):
+    """One supervised fleet run; returns (summary dict, final state)."""
+    from ibamr_tpu.utils.health import HealthProbe
+    from ibamr_tpu.utils.hierarchy_driver import HierarchyDriver
+    from ibamr_tpu.utils.supervisor import ResilientDriver
+
+    probe = HealthProbe.for_integrator(integ)
+    drv = HierarchyDriver(integ, cfg, lanes=lanes, health_probe=probe)
+    wd = None
+    if heartbeat:
+        from ibamr_tpu.utils.watchdog import RunWatchdog
+        wd = RunWatchdog(heartbeat_path=heartbeat, interval_s=5.0,
+                         min_stall_s=300.0)
+    t0 = time.perf_counter()
+    if directory:
+        sup = ResilientDriver(drv, directory, max_retries=max_retries,
+                              dt_backoff=dt_backoff,
+                              quarantine_threshold=quarantine_threshold,
+                              handle_signals=False, watchdog=wd,
+                              incident_log=os.path.join(
+                                  directory, "incidents.jsonl"))
+        final = sup.run(stacked)
+        incidents = list(sup.incidents)
+    else:
+        if wd is not None:
+            wd.start()
+        try:
+            final = drv.run(stacked)
+        finally:
+            if wd is not None:
+                wd.stop()
+        incidents = []
+    wall = time.perf_counter() - t0
+
+    per_lane = []
+    total_steps = 0
+    for i in range(lanes):
+        k = lane_steps(final, i)
+        total_steps += k
+        per_lane.append({
+            "lane": i,
+            "steps": k,
+            "alive": bool(drv.lane_alive[i]),
+            "dt": float(drv.lane_dt[i]),
+        })
+    quarantined = sum(1 for rec in per_lane if not rec["alive"])
+    backed_off = sum(1 for rec in per_lane
+                     if rec["dt"] != float(cfg.dt))
+    summary = {
+        "lanes": lanes,
+        "num_steps": cfg.num_steps,
+        "wall_s": round(wall, 3),
+        # aggregate throughput: lane-steps actually completed across
+        # the whole fleet per wall second (compile included — both
+        # legs of the sequential comparison pay it once)
+        "aggregate_steps_per_s": round(total_steps / wall, 3),
+        "lanes_quarantined": quarantined,
+        "lanes_backed_off": backed_off,
+        "trace_counts": dict(drv.trace_counts),
+        "incidents": [r.get("event") for r in incidents],
+        "per_lane": per_lane,
+    }
+    return summary, final
+
+
+def run_sequential(integ, lane_states, cfg):
+    """Each lane alone as a B=1 fleet (the bitwise solo reference),
+    back to back; returns aggregate steps/s over all lanes. The B=1
+    trace is shared across lanes (identical signature), so compile is
+    paid once here too — the comparison isolates the batching win."""
+    from ibamr_tpu.utils.hierarchy_driver import HierarchyDriver
+    from ibamr_tpu.utils.lanes import stack_lanes
+
+    t0 = time.perf_counter()
+    total = 0
+    drv = HierarchyDriver(integ, cfg, lanes=1)
+    for st in lane_states:
+        final = drv.run(stack_lanes([st]))
+        total += lane_steps(final, 0)
+        # fresh per-lane dt/alive for the next lane; the compiled
+        # chunk survives on the driver
+        drv.lane_dt[0] = float(cfg.dt)
+        drv.lane_alive[0] = True
+    wall = time.perf_counter() - t0
+    return {"wall_s": round(wall, 3),
+            "aggregate_steps_per_s": round(total / wall, 3)}
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="vmapped ensemble fleet runner")
+    ap.add_argument("--lanes", type=int, default=8,
+                    help="fleet size B (8 and 64 are the reference "
+                         "points)")
+    ap.add_argument("--n", type=int, default=32, help="cells/axis")
+    ap.add_argument("--n-lat", type=int, default=16)
+    ap.add_argument("--n-lon", type=int, default=16)
+    ap.add_argument("--mu", type=float, default=0.05)
+    ap.add_argument("--steps", type=int, default=16)
+    ap.add_argument("--dt", type=float, default=1e-3)
+    ap.add_argument("--health-interval", type=int, default=4)
+    ap.add_argument("--restart-interval", type=int, default=8)
+    ap.add_argument("--perturb", type=float, default=0.01,
+                    help="per-lane initial-velocity perturbation scale")
+    ap.add_argument("--dir", type=str, default="",
+                    help="checkpoint + incident directory (enables "
+                         "per-lane rollback/quarantine supervision)")
+    ap.add_argument("--max-retries", type=int, default=2)
+    ap.add_argument("--dt-backoff", type=float, default=0.5)
+    ap.add_argument("--quarantine-threshold", type=float, default=0.5)
+    ap.add_argument("--heartbeat", type=str, default="",
+                    help="heartbeat.json path (carries lanes_ok/"
+                         "lanes_quarantined/lanes_retrying)")
+    ap.add_argument("--sequential", action="store_true",
+                    help="also run every lane alone (B=1) and report "
+                         "the speedup")
+    ap.add_argument("--x64", action="store_true",
+                    help="run the fleet in float64")
+    args = ap.parse_args()
+
+    result = {"lanes": args.lanes, "error": None}
+    try:
+        from ibamr_tpu.utils.backend_guard import init_backend_with_retry
+
+        jax, platform, backend_err = init_backend_with_retry(
+            retries=1, delay=2.0)
+        result["platform"] = platform
+        if args.x64:
+            jax.config.update("jax_enable_x64", True)
+        from ibamr_tpu.utils.hierarchy_driver import RunConfig
+
+        cfg = RunConfig(dt=args.dt, num_steps=args.steps,
+                        health_interval=args.health_interval,
+                        restart_interval=(args.restart_interval
+                                          if args.dir else 0))
+        log(f"[fleet] building {args.lanes} lanes of the "
+            f"{args.n}^3 shell ({args.n_lat * args.n_lon} markers)")
+        integ, lane_states, stacked = build_fleet(
+            args.n, args.n_lat, args.n_lon, args.mu, args.lanes,
+            args.perturb, "float64" if args.x64 else None)
+        summary, _ = run_fleet(
+            integ, stacked, cfg, args.lanes,
+            directory=args.dir or None, max_retries=args.max_retries,
+            dt_backoff=args.dt_backoff,
+            quarantine_threshold=args.quarantine_threshold,
+            heartbeat=args.heartbeat or None)
+        result.update(summary)
+        log(f"[fleet] {args.lanes} lanes x {args.steps} steps: "
+            f"{summary['aggregate_steps_per_s']} lane-steps/s "
+            f"({summary['lanes_quarantined']} quarantined)")
+        if args.sequential:
+            cfg_solo = RunConfig(dt=args.dt, num_steps=args.steps,
+                                 health_interval=args.health_interval)
+            seq = run_sequential(integ, lane_states, cfg_solo)
+            result["sequential"] = seq
+            if seq["aggregate_steps_per_s"] > 0:
+                result["fleet_speedup"] = round(
+                    summary["aggregate_steps_per_s"]
+                    / seq["aggregate_steps_per_s"], 3)
+            log(f"[fleet] sequential: {seq['aggregate_steps_per_s']} "
+                f"lane-steps/s -> speedup "
+                f"{result.get('fleet_speedup')}")
+    except Exception as e:  # noqa: BLE001 - the JSON line must land
+        import traceback
+        result["error"] = (f"{type(e).__name__}: {e}\n"
+                           + traceback.format_exc()[-1200:])
+    print(json.dumps(result), flush=True)
+    return 0 if result["error"] is None else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

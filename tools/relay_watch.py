@@ -176,7 +176,7 @@ def main() -> int:
         rc, out, err, stalled = run_bench_watched(
             [sys.executable, os.path.join(REPO, "bench.py"),
              "--stages", "64,128,256", "--heartbeat", hb_path,
-             "--record", record_dir],
+             "--record", record_dir, "--fleet", "8"],
             f, env, args.bench_timeout, hb_path, args.stall_after,
             record_dir=record_dir)
         if rc is None:

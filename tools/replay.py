@@ -45,6 +45,14 @@ replay still runs them on 1 device, while ``--sharded`` re-executes
 the recorded sharded program — degrading to a failure-reproduction pin
 (``mesh_degraded``) when fewer devices are available than the incident
 ran on.
+
+Lane capsules (fleet runs): a capsule whose manifest carries a
+``lane`` record is a SINGLE lane sliced out of a lane-batched fleet
+chunk. It replays as a B=1 fleet chunk (vmapped step + freeze mask —
+the program shape whose lanes are batch-size invariant), with recorded
+``lane_nan``/``lane_drift`` injectors transformed onto lane 0; the
+bitwise pin is against the recorded lane-sliced digest, independent of
+the original fleet size.
 """
 
 from __future__ import annotations
@@ -236,6 +244,43 @@ def execute_chunk(integ, state, dt: float, length: int, step_wrap=None,
     return chunk(state, dt)
 
 
+def execute_lane_chunk(integ, state, dt: float, length: int,
+                       step_wrap=None):
+    """Re-execute a LANE capsule's chunk as a B=1 fleet chunk: vmapped
+    step, per-lane dt vector, lane-alive freeze mask — the same program
+    shape :meth:`HierarchyDriver._build_fleet_chunk` compiles, which is
+    the bitwise solo reference for any lane of any fleet (the
+    batch-size-invariance contract in ``ibamr_tpu.utils.lanes``). The
+    classic unbatched scan is NOT used here: it fuses differently and
+    drifts by ULPs from the fleet execution the digest was recorded
+    from. ``step_wrap`` (re-armed lane injectors, already transformed
+    to lane 0 of a size-1 fleet) wraps the STACKED step."""
+    import jax
+    import jax.numpy as jnp
+
+    stacked = jax.tree_util.tree_map(lambda l: jnp.asarray(l)[None],
+                                     state)
+    vstep = jax.vmap(integ.step, in_axes=(0, 0))
+    if step_wrap is not None:
+        vstep = step_wrap(vstep)
+
+    @jax.jit
+    def chunk(s, d, alive):
+        def body(x, _):
+            new = vstep(x, d)
+            frozen = jax.tree_util.tree_map(
+                lambda nl, ol: jnp.where(
+                    alive.reshape((1,) + (1,) * (nl.ndim - 1)), nl, ol),
+                new, x)
+            return frozen, None
+
+        out, _ = jax.lax.scan(body, s, None, length=length)
+        return out
+
+    out = chunk(stacked, jnp.asarray([dt]), jnp.ones(1, dtype=bool))
+    return jax.tree_util.tree_map(lambda l: l[0], out)
+
+
 def digest_state(post_state) -> dict:
     from ibamr_tpu.utils.checkpoint import _gather_arrays, _leaf_crc
 
@@ -310,6 +355,10 @@ def _run_once(manifest, arrays, overrides, dt_scale, sharded=False):
 
     injectors = dict(manifest["fingerprint"].get("injectors") or {})
     engine = effective_engine(manifest, overrides)
+    lane_rec = manifest.get("lane")
+    if lane_rec is not None and sharded:
+        raise ReplayError("lane capsules replay unbatched (B=1); "
+                          "--sharded does not apply")
     # engine-gated faults arm only when the effective engine matches
     armed = {}
     for name, params in injectors.items():
@@ -320,6 +369,16 @@ def _run_once(manifest, arrays, overrides, dt_scale, sharded=False):
                     and _norm_engine(gate) != _norm_engine(engine):
                 continue
             armed["nan"] = p
+        elif name in ("lane_nan", "lane_drift") and lane_rec is not None:
+            # lane capsule: a fault aimed at THIS lane re-arms onto
+            # lane 0 of the B=1 replay fleet; a fault aimed at any
+            # OTHER lane could never fire here and is dropped
+            p = dict(params)
+            if int(p.get("lane", -1)) != int(lane_rec["index"]):
+                continue
+            p["lane"] = 0
+            p["fleet_size"] = 1
+            armed[name] = p
         else:
             armed[name] = params
     with apply_recorded_injectors(armed) as wrap, _x64_scope(manifest):
@@ -328,20 +387,25 @@ def _run_once(manifest, arrays, overrides, dt_scale, sharded=False):
         jax.clear_caches()
         integ, template = rebuild(manifest, overrides)
         state = state_from_capsule(manifest, arrays, template)
-        step_fn = None
-        if sharded:
-            # re-execute the SAME sharded program the incident ran:
-            # rebuild the recorded mesh, re-place the capsule state
-            # under the spatial sharding, and scan the sharded step
-            from ibamr_tpu.parallel.mesh import (make_sharded_step,
-                                                 place_state)
-            mesh = rebuild_mesh(manifest["fingerprint"]["mesh"])
-            state = place_state(state, integ.grid, mesh)
-            step_fn = make_sharded_step(integ, mesh)
         dt = float(manifest["chunk"]["dt"]) * float(dt_scale)
-        post = execute_chunk(integ, state, dt,
-                             int(manifest["chunk"]["length"]),
-                             step_wrap=wrap, step_fn=step_fn)
+        if lane_rec is not None:
+            post = execute_lane_chunk(integ, state, dt,
+                                      int(manifest["chunk"]["length"]),
+                                      step_wrap=wrap)
+        else:
+            step_fn = None
+            if sharded:
+                # re-execute the SAME sharded program the incident ran:
+                # rebuild the recorded mesh, re-place the capsule state
+                # under the spatial sharding, and scan the sharded step
+                from ibamr_tpu.parallel.mesh import (make_sharded_step,
+                                                     place_state)
+                mesh = rebuild_mesh(manifest["fingerprint"]["mesh"])
+                state = place_state(state, integ.grid, mesh)
+                step_fn = make_sharded_step(integ, mesh)
+            post = execute_chunk(integ, state, dt,
+                                 int(manifest["chunk"]["length"]),
+                                 step_wrap=wrap, step_fn=step_fn)
         crcs = digest_state(post)
         failed = chunk_failed(manifest, integ, post, dt)
     return {"leaf_crcs": crcs, "failed": failed,
