@@ -1,0 +1,24 @@
+"""Graph contracts: static analysis of the compiled artifacts (PR 8).
+
+Every performance and robustness property the TPU hot path depends on —
+zero scatters in the force assembly, two batched FFTs in the fused
+spectral substep, no host transfers inside the scan, donation actually
+honored by the compiled module, no silent dtype widenings — is a
+*global invariant of the compiled graph*, not of any one source file.
+This package audits the graphs themselves:
+
+- :mod:`~ibamr_tpu.analysis.graph_census` — pure census functions over
+  a traced jaxpr / compiled HLO module (op classes, FFT/dot traffic,
+  dtype-promotion census, host-transfer census, donation audit);
+- :mod:`~ibamr_tpu.analysis.contracts` — the registry of named
+  hot-path artifacts and their budgets (``GRAPH_BUDGETS.json``),
+  consumed by ``tools/graph_audit.py`` (the CI drift gate) and
+  ``tests/test_graph_contracts.py`` (the tier-1 pin);
+- :mod:`~ibamr_tpu.analysis.jit_lint` — an AST-level linter for
+  jit-unsafety in the source itself (traced branches, host casts on
+  tracers, wall-clock/RNG capture, mutable defaults), with an inline
+  ``# jitlint: ok(<rule>): <why>`` waiver syntax.
+
+See docs/ANALYSIS.md for the contract inventory and the budget-update
+workflow.
+"""

@@ -1,0 +1,351 @@
+"""The artifact registry and its budgets (``GRAPH_BUDGETS.json``).
+
+A *contract* pins one named compiled artifact — the solo step, the
+fused spectral substep per dtype, each spread/interp engine, the
+driver's scanned chunk, the lane-masked fleet chunk, the donated step,
+the per-lane capsule fetch — to the budget-comparable slice of its
+:func:`~ibamr_tpu.analysis.graph_census.graph_census`. Budgets live in
+``GRAPH_BUDGETS.json`` at the repo root and are versioned with the
+code: a refactor that adds a scatter, un-fuses an FFT, sneaks a host
+transfer into the scan, widens a dtype, or silently drops donation
+fails the gate (``tools/graph_audit.py``, exit 2) and the tier-1 pin
+(``tests/test_graph_contracts.py``) on the same counting rules.
+
+Measurement runs under ``jax.experimental.disable_x64()`` so the
+numbers are the PRODUCTION (x64-off) graph regardless of caller
+config — the pytest conftest enables x64 globally, and budgets must
+not depend on which harness measured them.
+
+Update workflow (see docs/ANALYSIS.md): change code, run
+``python tools/graph_audit.py`` — exit 0 means no drift, exit 1 means
+you improved a budgeted metric (run with ``--tighten`` to ratchet the
+budget down and commit the diff), exit 2 names the regressed metrics.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional, Tuple
+
+from ibamr_tpu.analysis.graph_census import (
+    BUDGET_MAX_METRICS,
+    BUDGET_MIN_METRICS,
+    budget_metrics,
+    graph_census,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+BUDGET_PATH = os.path.join(REPO_ROOT, "GRAPH_BUDGETS.json")
+
+# shared flagship-miniature shape: big enough that every structural
+# feature of the graph exists (buckets, packing, scan, probe fusion),
+# small enough that the whole registry compiles in seconds on CPU.
+_N, _N_LAT, _N_LON = 16, 8, 16
+_DT = 5e-5
+
+
+def _shell(engine="packed", spectral_dtype=None):
+    from ibamr_tpu.models.shell3d import build_shell_example
+
+    return build_shell_example(
+        n_cells=_N, n_lat=_N_LAT, n_lon=_N_LON, radius=0.25,
+        aspect=1.2, stiffness=1.0, rest_length_factor=0.75, mu=0.05,
+        use_fast_interaction=engine, spectral_dtype=spectral_dtype)
+
+
+def _unwrap(jitted):
+    """The raw python callable behind a ``jax.jit`` wrapper, so the
+    census controls jit/donation itself instead of nesting pjit."""
+    return getattr(jitted, "__wrapped__", jitted)
+
+
+# ---------------------------------------------------------------------------
+# artifact builders — each returns (fn, args, donate_argnums)
+# ---------------------------------------------------------------------------
+
+def _build_solo_step(spectral_dtype=None):
+    integ, state = _shell(spectral_dtype=spectral_dtype)
+    return (lambda s: integ.step(s, _DT)), (state,), ()
+
+
+def _build_fused_substep(spectral_dtype=None):
+    from ibamr_tpu.solvers import fft as _fft
+
+    integ, state = _shell(spectral_dtype=spectral_dtype)
+    ins = integ.ins
+    dx = ins.grid.dx
+    alpha, beta = ins.rho / _DT, -0.5 * ins.mu
+
+    def sub(rhs):
+        return _fft.helmholtz_project_periodic(
+            rhs, dx, alpha=alpha, beta=beta,
+            pinc_coeffs=(alpha, beta), spectral_dtype=spectral_dtype)
+
+    return sub, (state.ins.u,), ()
+
+
+def _build_transfer(engine, piece):
+    import jax.numpy as jnp
+
+    integ, state = _shell(engine=engine)
+    ib = integ.ib
+    grid = integ.ins.grid
+    X, mask = state.X, state.mask
+    if piece == "spread":
+        F = jnp.zeros_like(X)
+
+        def spread(Xa, Fa, m):
+            ctx = ib.prepare(Xa, m)
+            return ib.spread_force(Fa, grid, Xa, m, ctx=ctx)
+
+        return spread, (X, F, mask), ()
+    u = state.ins.u
+
+    def interp(ua, Xa, m):
+        ctx = ib.prepare(Xa, m)
+        return ib.interpolate_velocity(ua, grid, Xa, m, ctx=ctx)
+
+    return interp, (u, X, mask), ()
+
+
+def _driver(integ, lanes=None, donate=False):
+    from ibamr_tpu.utils.health import HealthProbe
+    from ibamr_tpu.utils.hierarchy_driver import HierarchyDriver, RunConfig
+
+    cfg = RunConfig(dt=_DT, num_steps=4, health_interval=2,
+                    donate=donate)
+    return HierarchyDriver(integ, cfg, lanes=lanes,
+                           health_probe=HealthProbe.for_integrator(integ))
+
+
+def _build_solo_chunk():
+    # the driver's scanned chunk WITH the fused health probe — the
+    # scan body is where a stray host transfer would be catastrophic
+    # (one D2H per step instead of one per chunk)
+    integ, state = _shell()
+    drv = _driver(integ)
+    chunk = _unwrap(drv._chunk(4))
+    return chunk, (state, _DT), ()
+
+
+def _build_donated_chunk():
+    # cfg.donate=True chunk: the whole-step in-place update. The budget
+    # pins donated_args >= 1 — donation is a REQUEST; this artifact is
+    # where it is verified against the compiled alias table.
+    integ, state = _shell()
+    drv = _driver(integ, donate=True)
+    chunk = _unwrap(drv._chunk(4))
+    return chunk, (state, _DT), (0,)
+
+
+def _build_fleet_chunk():
+    import jax.numpy as jnp
+
+    from ibamr_tpu.utils import lanes as _lanes
+
+    integ, state = _shell()
+    drv = _driver(integ, lanes=2)
+    chunk = _unwrap(drv._chunk(2))
+    stacked = _lanes.stack_lanes([state, state])
+    dt_vec = jnp.full((2,), _DT, dtype=jnp.float32)
+    alive = jnp.ones((2,), dtype=bool)
+    return chunk, (stacked, dt_vec, alive), ()
+
+
+def _build_donated_step():
+    # IBExplicitIntegrator.jitted_step(donate=True) unwrapped: verifies
+    # the integrator-level donation request actually aliases buffers
+    integ, state = _shell()
+    step = _unwrap(integ.jitted_step(donate=True))
+    return step, (state, _DT), (0,)
+
+
+def _build_lane_fetch():
+    # the per-lane capsule/rollback fetch graph: lane_slice of a
+    # 2-lane stacked state (must be a pure gather-free slice — zero
+    # scatters, zero FFTs, zero host ops)
+    from ibamr_tpu.utils import lanes as _lanes
+
+    integ, state = _shell()
+    stacked = _lanes.stack_lanes([state, state])
+    return (lambda st: _lanes.lane_slice(st, 0)), (stacked,), ()
+
+
+def _build_open_channel_step():
+    # open-boundary stabilized-PPM step: the non-periodic code path
+    # (saddle Stokes + boundary-band upwind blending). First-wave
+    # finding lived here (_stab_mask hard-coded f64); the budget pins
+    # the path dtype-clean from now on.
+    from ibamr_tpu.integrators.ins_open import INSOpenIntegrator
+    from ibamr_tpu.solvers.stokes import channel_bc
+
+    io = INSOpenIntegrator(
+        (_N, _N), (1.0 / _N, 1.0 / _N), channel_bc(2), mu=0.05,
+        dt=_DT, bdry={(0, 0, 0): 1.0},
+        convective_op_type="stabilized_ppm")
+    state = io.initialize()
+    return (lambda s: io.step(s)), (state,), ()
+
+
+def _build_solo_step_256():
+    from ibamr_tpu.models.shell3d import build_shell_example
+
+    integ, state = build_shell_example(
+        n_cells=256, n_lat=316, n_lon=316, radius=0.25, aspect=1.2,
+        stiffness=1.0, rest_length_factor=0.75, mu=0.05,
+        use_fast_interaction="packed")
+    return (lambda s: integ.step(s, _DT)), (state,), ()
+
+
+@dataclass(frozen=True)
+class Artifact:
+    """One named compiled artifact under contract."""
+    name: str
+    build: Callable[[], Tuple]        # () -> (fn, args, donate_argnums)
+    heavy: bool = False               # flagship-scale: slow-tier / --heavy
+    notes: str = ""
+
+
+ARTIFACTS: Dict[str, Artifact] = {
+    a.name: a for a in (
+        Artifact("solo_step", _build_solo_step,
+                 notes="full coupled IB step, packed engine, f32"),
+        Artifact("solo_step_bf16",
+                 lambda: _build_solo_step(spectral_dtype="bf16"),
+                 notes="full step with bf16 spectral transforms"),
+        Artifact("fused_substep", _build_fused_substep,
+                 notes="k-space-resident Helmholtz+projection substep "
+                       "(<= 2 batched FFTs is the fusion pin)"),
+        Artifact("fused_substep_bf16",
+                 lambda: _build_fused_substep(spectral_dtype="bf16"),
+                 notes="mixed-precision substep; bf16 rounding converts "
+                       "are budgeted, widenings are not"),
+        Artifact("spread_packed",
+                 lambda: _build_transfer("packed", "spread"),
+                 notes="occupancy-packed force spread (zero scatters)"),
+        Artifact("interp_packed",
+                 lambda: _build_transfer("packed", "interp"),
+                 notes="occupancy-packed velocity interp"),
+        Artifact("spread_mxu",
+                 lambda: _build_transfer(True, "spread"),
+                 notes="dense one-hot MXU spread (zero scatters)"),
+        Artifact("interp_mxu",
+                 lambda: _build_transfer(True, "interp"),
+                 notes="dense one-hot MXU interp"),
+        Artifact("solo_chunk", _build_solo_chunk,
+                 notes="driver scan chunk + fused health probe; "
+                       "host_transfers_in_scan == 0 is the pin"),
+        Artifact("donated_chunk", _build_donated_chunk,
+                 notes="cfg.donate=True chunk; donated_args >= 1 "
+                       "verifies whole-chunk buffer donation"),
+        Artifact("fleet_chunk", _build_fleet_chunk,
+                 notes="2-lane vmapped chunk with lane-freeze select"),
+        Artifact("donated_step", _build_donated_step,
+                 notes="integrator jitted_step(donate=True); verified "
+                       "against the compiled alias table"),
+        Artifact("lane_fetch", _build_lane_fetch,
+                 notes="per-lane capsule fetch (lane_slice) — zero "
+                       "scatter/fft/host budget"),
+        Artifact("open_channel_step", _build_open_channel_step,
+                 notes="open-boundary stabilized-PPM step (saddle "
+                       "Stokes); dtype-clean pin after the f64 "
+                       "stab-mask finding"),
+        Artifact("solo_step_256", _build_solo_step_256, heavy=True,
+                 notes="flagship 256^3 coupled step (slow tier; "
+                       "graph_audit --heavy)"),
+    )
+}
+
+
+def measure_artifact(name: str) -> dict:
+    """Build + census one artifact under x64-off (production mode).
+
+    Returns the flat budget-comparable metric dict. Caller chooses the
+    backend; the CI gate runs this in a ``JAX_PLATFORMS=cpu`` child."""
+    from jax.experimental import disable_x64
+
+    art = ARTIFACTS[name]
+    with disable_x64():
+        fn, args, donate = art.build()
+        census = graph_census(fn, args, donate_argnums=donate)
+    return budget_metrics(census)
+
+
+# ---------------------------------------------------------------------------
+# budget load / diff
+# ---------------------------------------------------------------------------
+
+def load_budgets(path: Optional[str] = None) -> dict:
+    with open(path or BUDGET_PATH) as f:
+        doc = json.load(f)
+    return doc.get("artifacts", {})
+
+
+@dataclass
+class Drift:
+    """Per-artifact diff of measured metrics against the budget."""
+    name: str
+    regressions: Dict[str, Tuple[int, int]] = field(default_factory=dict)
+    improvements: Dict[str, Tuple[int, int]] = field(default_factory=dict)
+    missing: Tuple[str, ...] = ()     # budgeted metric absent in census
+
+    @property
+    def clean(self) -> bool:
+        return not (self.regressions or self.improvements or self.missing)
+
+
+def diff_budget(name: str, measured: dict, budget: dict) -> Drift:
+    """Compare one artifact's measured metrics to its budget.
+
+    Max metrics regress UP (measured > budget) and improve DOWN; the
+    min metrics (``donated_args``) regress DOWN — a refactor that
+    silently drops donation is a regression even though every other
+    counter stays flat."""
+    d = Drift(name)
+    missing = []
+    for metric, bound in budget.items():
+        if metric not in measured:
+            missing.append(metric)
+            continue
+        got = int(measured[metric])
+        bound = int(bound)
+        if metric in BUDGET_MIN_METRICS:
+            if got < bound:
+                d.regressions[metric] = (got, bound)
+            elif got > bound:
+                d.improvements[metric] = (got, bound)
+        elif metric in BUDGET_MAX_METRICS:
+            if got > bound:
+                d.regressions[metric] = (got, bound)
+            elif got < bound:
+                d.improvements[metric] = (got, bound)
+        # unknown metrics in the budget file are a budget-file bug:
+        # surface as missing rather than silently passing
+        else:
+            missing.append(metric)
+    d.missing = tuple(missing)
+    return d
+
+
+def report_drift(drifts) -> str:
+    """Human-readable drift report (one block per non-clean artifact)."""
+    lines = []
+    for d in drifts:
+        if d.clean:
+            continue
+        lines.append(f"[{d.name}]")
+        for m, (got, bound) in sorted(d.regressions.items()):
+            word = ("dropped below floor"
+                    if m in BUDGET_MIN_METRICS else "exceeds budget")
+            lines.append(f"  REGRESSED  {m}: {got} {word} {bound}")
+        for m, (got, bound) in sorted(d.improvements.items()):
+            lines.append(
+                f"  improved   {m}: {got} (budget {bound}) — run "
+                f"tools/graph_audit.py --tighten to ratchet")
+        for m in d.missing:
+            lines.append(f"  MISSING    {m}: not measurable / unknown "
+                         f"metric — budget file and census disagree")
+    return "\n".join(lines)

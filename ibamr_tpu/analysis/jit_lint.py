@@ -1,0 +1,523 @@
+"""AST-level jit-safety linter for ``ibamr_tpu/``.
+
+The graph censuses (:mod:`~ibamr_tpu.analysis.graph_census`) audit the
+artifacts we KNOW to lower; this linter audits the source for the
+mistakes that prevent lowering or silently poison it — the classic
+jit-unsafety patterns:
+
+- ``traced-branch``: Python ``if``/``while`` on a traced value inside
+  a known-traced scope (a ``TracerBoolConversionError`` at best, a
+  trace-time-frozen branch at worst). Structural tests (``is None``,
+  ``isinstance``, ``hasattr``, ``callable``, ``len``, ``.shape`` /
+  ``.ndim`` / ``.dtype`` access) are trace-time-static and exempt.
+- ``tracer-cast``: ``float()`` / ``int()`` / ``bool()`` / ``.item()``
+  / ``.tolist()`` / ``np.asarray()`` / ``np.array()`` on a traced
+  value — a forced host sync (or a trace error) in the hot path.
+- ``time-capture``: ``time.*`` / ``random.*`` / ``np.random.*`` calls
+  inside a traced scope — the value freezes at trace time and silently
+  replays from the executable cache forever after.
+- ``mutable-default``: mutable default argument on a traced function —
+  the default is evaluated once and shared across every trace.
+
+A *known-traced scope* is a function that is (a) decorated with
+``jit`` / ``jax.jit`` / ``partial(jax.jit, ...)``, (b) passed by name
+or as a lambda to a tracing entry point (``jax.jit``, ``vmap``,
+``pmap``, ``grad``, ``checkpoint`` / ``remat``, ``lax.scan`` /
+``while_loop`` / ``cond`` / ``switch`` / ``fori_loop`` / ``map``,
+``custom_vjp``) within its enclosing function, or (c) nested inside a
+traced scope (it runs at trace time). Method references like
+``jax.jit(self.step)`` are intentionally out of scope for the AST pass
+— the graph censuses cover those paths at lowering time.
+
+Waiver syntax (inline, same line or the line directly above)::
+
+    x = float(eps)  # jitlint: ok(tracer-cast): eps is a static config scalar
+
+The justification after the colon is REQUIRED — a bare waiver is
+itself reported (``bad-waiver``) and cannot be waived. The report
+carries a waiver inventory so every exemption stays auditable.
+
+CLI: ``python -m ibamr_tpu.analysis.jit_lint [paths...] [--json]``.
+Exit 0 when no unwaived findings, 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import io
+import json
+import os
+import re
+import sys
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+RULES = ("traced-branch", "tracer-cast", "time-capture",
+         "mutable-default", "bad-waiver")
+
+# decorators that make the decorated def a traced scope
+_JIT_DECOS = {"jit", "filter_jit"}
+# call targets whose function-valued args become traced scopes
+_TRACE_ENTRY = {"jit", "vmap", "pmap", "grad", "value_and_grad",
+                "checkpoint", "remat", "custom_vjp", "custom_jvp",
+                "scan", "while_loop", "cond", "switch", "fori_loop",
+                "map", "associated_scan", "associative_scan"}
+# attribute / call results that are trace-time STATIC even on a tracer
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding",
+                 "itemsize", "weak_type"}
+_STATIC_CALLS = {"isinstance", "hasattr", "callable", "len", "getattr",
+                 "type", "str", "repr", "id", "format"}
+_CAST_CALLS = {"float", "int", "bool", "complex"}
+_CAST_METHODS = {"item", "tolist", "__float__", "__int__", "__bool__"}
+_NUMPY_ALIASES = {"np", "numpy", "onp"}
+_CLOCK_FUNCS = {"time", "perf_counter", "monotonic", "process_time",
+                "time_ns", "perf_counter_ns", "monotonic_ns"}
+
+_WAIVER_RE = re.compile(
+    r"#\s*jitlint:\s*ok\(([a-z-]+)\)(?::\s*(\S.*))?")
+
+
+@dataclass
+class Finding:
+    path: str
+    line: int
+    rule: str
+    message: str
+    waived: bool = False
+
+    def to_dict(self) -> dict:
+        return {"path": self.path, "line": self.line,
+                "rule": self.rule, "message": self.message,
+                "waived": self.waived}
+
+
+@dataclass
+class Waiver:
+    path: str
+    line: int
+    rule: str
+    reason: Optional[str]
+    used: bool = False
+
+    def to_dict(self) -> dict:
+        return {"path": self.path, "line": self.line,
+                "rule": self.rule, "reason": self.reason,
+                "used": self.used}
+
+
+def _dotted(node) -> str:
+    """``a.b.c`` for Name/Attribute chains, '' otherwise."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _call_basename(call: ast.Call) -> str:
+    """Last path component of a call target (``jax.lax.scan``->scan)."""
+    d = _dotted(call.func)
+    return d.rsplit(".", 1)[-1] if d else ""
+
+
+def _stmt_exprs(st):
+    """A statement's OWN expressions (not those of nested statements)."""
+    for name, value in ast.iter_fields(st):
+        if name in ("body", "orelse", "finalbody", "handlers"):
+            continue
+        for v in (value if isinstance(value, list) else [value]):
+            if isinstance(v, ast.expr):
+                yield v
+
+
+class _TaintNames(ast.NodeVisitor):
+    """Names referenced by an expression, minus trace-time-static
+    subexpressions (``x.shape``, ``isinstance(x, ...)``, ...)."""
+
+    def __init__(self):
+        self.names: Set[str] = set()
+
+    def visit_Name(self, node):
+        self.names.add(node.id)
+
+    def visit_Attribute(self, node):
+        if node.attr in _STATIC_ATTRS:
+            return                      # x.shape is static: stop here
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        if _call_basename(node) in _STATIC_CALLS:
+            return                      # len(x)/isinstance(x,..) static
+        self.generic_visit(node)
+
+    def visit_Compare(self, node):
+        # `x is None` / `x is not None` are structural, not value tests
+        if (len(node.ops) == 1
+                and isinstance(node.ops[0], (ast.Is, ast.IsNot))
+                and isinstance(node.comparators[0], ast.Constant)
+                and node.comparators[0].value is None):
+            return
+        self.generic_visit(node)
+
+
+def _expr_taint(expr, tainted: Set[str]) -> bool:
+    v = _TaintNames()
+    v.visit(expr)
+    return bool(v.names & tainted)
+
+
+class _FnScope:
+    """One function-ish scope (FunctionDef / AsyncFunctionDef / Lambda)
+    with its parent link and the set of callee names it passes into
+    tracing entry points."""
+
+    def __init__(self, node, parent: Optional["_FnScope"]):
+        self.node = node
+        self.parent = parent
+        self.traced_callees: Set[str] = set()
+        self.traced_lambdas: Set[int] = set()   # id() of Lambda nodes
+        self.jit_decorated = False
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                base = deco.func if isinstance(deco, ast.Call) else deco
+                name = _dotted(base).rsplit(".", 1)[-1]
+                if name in _JIT_DECOS:
+                    self.jit_decorated = True
+                if (isinstance(deco, ast.Call)
+                        and name in ("partial", "wraps")):
+                    for a in deco.args:
+                        if _dotted(a).rsplit(".", 1)[-1] in _JIT_DECOS:
+                            self.jit_decorated = True
+
+    def is_traced(self) -> bool:
+        if self.jit_decorated:
+            return True
+        p = self.parent
+        if p is None:
+            return False
+        if isinstance(self.node, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)) \
+                and self.node.name in p.traced_callees:
+            return True
+        if isinstance(self.node, ast.Lambda) \
+                and id(self.node) in p.traced_lambdas:
+            return True
+        return p.is_traced()            # trace-time nested scope
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path: str, relpath: str):
+        self.path = path
+        self.relpath = relpath
+        self.findings: List[Finding] = []
+        self.scopes: Dict[int, _FnScope] = {}
+        self.stack: List[_FnScope] = []
+
+    # -- pass 1: scope graph + traced-callee marking -------------------
+    def _enter(self, node):
+        parent = self.stack[-1] if self.stack else None
+        sc = _FnScope(node, parent)
+        self.scopes[id(node)] = sc
+        self.stack.append(sc)
+
+    def visit_FunctionDef(self, node):
+        self._enter(node)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        self._enter(node)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    def visit_Call(self, node):
+        if self.stack and _call_basename(node) in _TRACE_ENTRY:
+            sc = self.stack[-1]
+            for a in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(a, ast.Name):
+                    sc.traced_callees.add(a.id)
+                elif isinstance(a, ast.Lambda):
+                    sc.traced_lambdas.add(id(a))
+        self.generic_visit(node)
+
+    # -- pass 2 driver -------------------------------------------------
+    def lint(self, tree):
+        self.visit(tree)                # pass 1
+        for sc in self.scopes.values():
+            if sc.is_traced():
+                self._lint_traced_scope(sc)
+            if isinstance(sc.node, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                self._check_mutable_defaults(sc)
+
+    def _emit(self, line, rule, msg):
+        self.findings.append(Finding(self.relpath, line, rule, msg))
+
+    # -- rules ---------------------------------------------------------
+    def _check_mutable_defaults(self, sc):
+        if not sc.is_traced():
+            return
+        node = sc.node
+        defaults = list(node.args.defaults) + [
+            d for d in node.args.kw_defaults if d is not None]
+        for d in defaults:
+            mutable = isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                isinstance(d, ast.Call)
+                and _call_basename(d) in ("list", "dict", "set"))
+            if mutable:
+                self._emit(
+                    d.lineno, "mutable-default",
+                    f"traced function '{node.name}' has a mutable "
+                    f"default argument — evaluated once, shared by "
+                    f"every trace")
+
+    def _params(self, node) -> Set[str]:
+        a = node.args
+        names = [p.arg for p in
+                 a.posonlyargs + a.args + a.kwonlyargs]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return {n for n in names if n not in ("self", "cls")}
+
+    def _lint_traced_scope(self, sc):
+        node = sc.node
+        tainted = self._params(node)
+        if isinstance(node, ast.Lambda):
+            self._check_exprs(node.body, tainted)
+            return
+        self._walk_stmts(node.body, tainted)
+
+    def _walk_stmts(self, stmts, tainted):
+        # statement-order taint propagation + rule checks, without
+        # descending into nested defs/lambdas (they are linted as
+        # their own scopes — their params shadow the outer taint)
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            if isinstance(st, (ast.If, ast.While)) \
+                    and _expr_taint(st.test, tainted):
+                kw = "if" if isinstance(st, ast.If) else "while"
+                self._emit(
+                    st.lineno, "traced-branch",
+                    f"Python `{kw}` on a traced value in a traced "
+                    f"scope — use lax.cond/select or hoist the test "
+                    f"to trace time")
+            for expr in _stmt_exprs(st):
+                self._check_exprs(expr, tainted)
+            # propagate taint through simple assignments / for targets
+            if isinstance(st, (ast.Assign, ast.AugAssign,
+                               ast.AnnAssign)) \
+                    and getattr(st, "value", None) is not None \
+                    and _expr_taint(st.value, tainted):
+                targets = (st.targets if isinstance(st, ast.Assign)
+                           else [st.target])
+                for t in targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            tainted.add(n.id)
+            elif isinstance(st, ast.For) \
+                    and _expr_taint(st.iter, tainted):
+                for n in ast.walk(st.target):
+                    if isinstance(n, ast.Name):
+                        tainted.add(n.id)
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(st, attr, None)
+                if isinstance(sub, list):
+                    self._walk_stmts(
+                        [s for s in sub if isinstance(s, ast.stmt)],
+                        tainted)
+            for h in getattr(st, "handlers", []) or []:
+                self._walk_stmts(h.body, tainted)
+
+    def _check_exprs(self, expr, tainted):
+        # walk one expression, skipping Lambda subtrees (own scopes)
+        stack = [expr]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, ast.Call):
+                self._check_call(n, tainted)
+            for c in ast.iter_child_nodes(n):
+                if not isinstance(c, ast.Lambda):
+                    stack.append(c)
+
+    def _check_call(self, call, tainted):
+        base = _call_basename(call)
+        dotted = _dotted(call.func)
+        root = dotted.split(".", 1)[0] if dotted else ""
+
+        # tracer-cast: float(x)/int(x)/bool(x) on a tainted expr
+        if base in _CAST_CALLS and dotted == base and call.args:
+            if _expr_taint(call.args[0], tainted):
+                self._emit(call.lineno, "tracer-cast",
+                           f"`{base}()` on a traced value forces a "
+                           f"host sync (or a TracerConversionError)")
+        # tracer-cast: np.asarray/np.array on a tainted expr
+        if root in _NUMPY_ALIASES and base in ("asarray", "array") \
+                and call.args and _expr_taint(call.args[0], tainted):
+            self._emit(call.lineno, "tracer-cast",
+                       f"`{dotted}()` on a traced value pulls the "
+                       f"buffer to host inside the traced scope")
+        # tracer-cast: x.item()/x.tolist() on a tainted receiver
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr in _CAST_METHODS \
+                and _expr_taint(call.func.value, tainted):
+            self._emit(call.lineno, "tracer-cast",
+                       f"`.{call.func.attr}()` on a traced value "
+                       f"forces a host sync inside the traced scope")
+        # time-capture: wall clock / host RNG frozen at trace time
+        if root == "time" and base in _CLOCK_FUNCS:
+            self._emit(call.lineno, "time-capture",
+                       f"`{dotted}()` in a traced scope freezes at "
+                       f"trace time and replays from the executable "
+                       f"cache")
+        if (root == "random"
+                or dotted.startswith(tuple(
+                    f"{a}.random." for a in _NUMPY_ALIASES))):
+            self._emit(call.lineno, "time-capture",
+                       f"`{dotted}()` host RNG in a traced scope "
+                       f"freezes at trace time — use jax.random with "
+                       f"an explicit key")
+
+
+def _collect_waivers(relpath: str, source: str) -> List[Waiver]:
+    # scan COMMENT tokens, not raw lines: a waiver shown inside a
+    # docstring (e.g. this module's own syntax example) must stay inert
+    out = []
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        comments = [(t.start[0], t.string) for t in tokens
+                    if t.type == tokenize.COMMENT]
+    except (tokenize.TokenError, IndentationError):
+        comments = [(i, line) for i, line in
+                    enumerate(source.splitlines(), start=1)
+                    if line.lstrip().startswith("#")]
+    for lineno, text in comments:
+        m = _WAIVER_RE.search(text)
+        if m:
+            out.append(Waiver(relpath, lineno, m.group(1),
+                              (m.group(2) or "").strip() or None))
+    return out
+
+
+def lint_file(path: str, relpath: Optional[str] = None) -> Tuple[
+        List[Finding], List[Waiver]]:
+    relpath = relpath or path
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return ([Finding(relpath, e.lineno or 0, "bad-waiver",
+                         f"file does not parse: {e.msg}")], [])
+    linter = _Linter(path, relpath)
+    linter.lint(tree)
+    waivers = _collect_waivers(relpath, source)
+
+    # bad-waiver: missing justification or unknown rule name
+    findings = linter.findings
+    for w in waivers:
+        if w.rule not in RULES:
+            findings.append(Finding(
+                relpath, w.line, "bad-waiver",
+                f"waiver names unknown rule '{w.rule}'"))
+        elif not w.reason:
+            findings.append(Finding(
+                relpath, w.line, "bad-waiver",
+                "waiver carries no justification — write "
+                "`# jitlint: ok(<rule>): <why this is safe>`"))
+
+    # apply waivers (same line or the line directly above the finding)
+    by_key = {}
+    for w in waivers:
+        if w.rule in RULES and w.reason:
+            by_key.setdefault((w.rule, w.line), w)
+    for f in findings:
+        if f.rule == "bad-waiver":
+            continue                    # not waivable
+        w = by_key.get((f.rule, f.line)) or by_key.get(
+            (f.rule, f.line - 1))
+        if w is not None:
+            f.waived = True
+            w.used = True
+    return findings, waivers
+
+
+def lint_paths(paths) -> dict:
+    """Lint every ``.py`` under ``paths``; returns the report dict."""
+    files = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__",)]
+                files.extend(os.path.join(dirpath, fn)
+                             for fn in sorted(filenames)
+                             if fn.endswith(".py"))
+        elif p.endswith(".py"):
+            files.append(p)
+    findings: List[Finding] = []
+    waivers: List[Waiver] = []
+    root = os.getcwd()
+    for path in files:
+        rel = os.path.relpath(path, root)
+        fs, ws = lint_file(path, rel)
+        findings.extend(fs)
+        waivers.extend(ws)
+    active = [f for f in findings if not f.waived]
+    return {
+        "files_scanned": len(files),
+        "findings": [f.to_dict() for f in findings],
+        "active_findings": len(active),
+        "waived_findings": len(findings) - len(active),
+        "waivers": [w.to_dict() for w in waivers],
+    }
+
+
+def format_report(report: dict) -> str:
+    lines = [f"jit-lint: {report['files_scanned']} files, "
+             f"{report['active_findings']} finding(s), "
+             f"{report['waived_findings']} waived"]
+    for f in report["findings"]:
+        if f["waived"]:
+            continue
+        lines.append(f"  {f['path']}:{f['line']}: [{f['rule']}] "
+                     f"{f['message']}")
+    ws = [w for w in report["waivers"] if w["used"]]
+    if ws:
+        lines.append("waiver inventory:")
+        for w in ws:
+            lines.append(f"  {w['path']}:{w['line']}: ok({w['rule']}) "
+                         f"— {w['reason']}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="jit-safety linter for ibamr_tpu")
+    ap.add_argument("paths", nargs="*",
+                    default=[os.path.join(
+                        os.path.dirname(os.path.dirname(
+                            os.path.dirname(os.path.abspath(
+                                __file__)))), "ibamr_tpu")])
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    report = lint_paths(args.paths)
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(format_report(report))
+    return 1 if report["active_findings"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -185,8 +185,11 @@ class INSOpenIntegrator:
         cells (the reference's stabilized-PPM boundary band)."""
         s = self.solver
         n_e = shape[e]
-        idx = jnp.arange(n_e, dtype=jnp.float64)
-        chi = jnp.zeros((n_e,), dtype=jnp.float64)
+        # the solver's working dtype, NOT a hard-coded f64: the ramp
+        # values are exact in f32, and requesting f64 with x64 disabled
+        # warns and silently truncates (graph-audit first-wave finding)
+        idx = jnp.arange(n_e, dtype=s.dtype)
+        chi = jnp.zeros((n_e,), dtype=s.dtype)
         band = float(max(self.stab_band, 1))
         if not s.bc.periodic(e):
             chi = jnp.maximum(chi, jnp.clip(1.0 - idx / band, 0.0, 1.0))

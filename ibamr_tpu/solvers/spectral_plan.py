@@ -396,5 +396,9 @@ def gaussian_filter_symbol(shape: Sequence[int], dx: Sequence[float],
     body-force smoothing — it rides the substep's existing transforms."""
     from ibamr_tpu.solvers import fft
 
-    lam = fft.laplacian_symbol(shape, dx, jnp.float64)
+    # widest AVAILABLE float (f64 only when x64 is enabled): asking for
+    # f64 outright warns and truncates under the production x64-off
+    # config (graph-audit first-wave finding)
+    wide = jax.dtypes.canonicalize_dtype(jnp.float64)
+    lam = fft.laplacian_symbol(shape, dx, wide)
     return jnp.exp(0.5 * float(width) ** 2 * lam).astype(dtype)

@@ -1,0 +1,279 @@
+"""Unit tests for the census primitives themselves (PR 8 satellite):
+each counter exercised against tiny hand-built programs — a known
+scatter, a known convert chain, a donated vs non-donated jit, a
+debug callback inside a scan body — with NO child processes. The
+counters must be trustworthy in isolation before the contract gate
+(tests/test_graph_contracts.py) leans on them.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ibamr_tpu.analysis import graph_census as gc
+from ibamr_tpu.analysis.contracts import Drift, diff_budget
+from ibamr_tpu.analysis.jit_lint import lint_file
+
+
+# ---------------------------------------------------------------------------
+# HLO-text censuses
+# ---------------------------------------------------------------------------
+
+def test_hlo_op_counts_strips_quoted_metadata():
+    text = '\n'.join([
+        '  %x = f32[8]{0} add(f32[8]{0} %a, f32[8]{0} %b), '
+        'metadata={op_name="jit(scatter)(fake)"}',
+        '  %y = f32[8]{0} scatter(f32[8]{0} %x, s32[1]{0} %i, '
+        'f32[1]{0} %v)',
+        '  no assignment on this line',
+    ])
+    counts = gc.hlo_op_counts(text)
+    assert counts == {"add": 1, "scatter": 1}
+
+
+def test_known_scatter_is_counted():
+    # primitive-level census: the XLA CPU scatter expander rewrites
+    # small scatters into while-loops before the optimized HLO, so the
+    # jaxpr primitive count is the non-vacuous zero-scatter observable
+    # on this backend (see scatter_gather_census docstring)
+    def f(x, idx, v):
+        return x.at[idx].add(v)
+
+    x = jnp.zeros(16, jnp.float32)
+    idx = jnp.array([3, 7], jnp.int32)
+    v = jnp.ones(2, jnp.float32)
+    cj = jax.make_jaxpr(f)(x, idx, v)
+    cen = gc.scatter_gather_census(cj.jaxpr)
+    assert cen["scatter_prims"] == 1
+    # gather counted too, and a scatter-free program counts zero
+    cj2 = jax.make_jaxpr(lambda a, i: a[i] * 2.0)(x, idx)
+    cen2 = gc.scatter_gather_census(cj2.jaxpr)
+    assert cen2["scatter_prims"] == 0
+    assert cen2["gather_prims"] == 1
+
+
+# ---------------------------------------------------------------------------
+# jaxpr censuses
+# ---------------------------------------------------------------------------
+
+def test_fft_census_counts_batched_transforms():
+    def f(x):
+        h = jnp.fft.rfftn(x)
+        return jnp.fft.irfftn(h, s=x.shape)
+
+    cj = jax.make_jaxpr(f)(jnp.ones((8, 8), jnp.float32))
+    cen = gc.fft_census(cj.jaxpr)
+    assert cen["fft_ops"] == 2
+    kinds = {t["kind"] for t in cen["fft_transforms"]}
+    assert len(kinds) == 2              # one forward, one inverse
+
+
+def test_convert_census_flags_widening_not_bf16_rounding():
+    def f(x):
+        good = x.astype(jnp.bfloat16).astype(jnp.float32)   # rounding
+        bad = x.astype(jnp.float64).astype(jnp.float32)     # roundtrip
+        return good + bad.astype(jnp.float32)
+
+    cj = jax.make_jaxpr(f)(jnp.ones(4, jnp.float32))
+    cen = gc.convert_census(cj.jaxpr)
+    # exactly one f32->f64 widening, exactly one f32->f64->f32
+    # roundtrip; the deliberate f32->bf16->f32 rounding is NOT flagged
+    assert cen["f64_widenings"] == 1
+    assert cen["roundtrip_chains"] == 1
+    sites = cen["widening_sites"]
+    assert sites and sites[0]["dst"] == "float64"
+
+
+def test_convert_census_clean_program():
+    cj = jax.make_jaxpr(lambda x: x * 2.0 + 1.0)(
+        jnp.ones(4, jnp.float32))
+    cen = gc.convert_census(cj.jaxpr)
+    assert cen["f64_widenings"] == 0
+    assert cen["roundtrip_chains"] == 0
+
+
+def test_host_transfer_census_sees_callback_inside_scan():
+    def noisy(x):
+        def body(c, _):
+            jax.debug.callback(lambda v: None, c)
+            return c + 1.0, None
+        out, _ = jax.lax.scan(body, x, None, length=3)
+        return out
+
+    cj = jax.make_jaxpr(noisy)(jnp.float32(0.0))
+    cen = gc.host_transfer_census(cj.jaxpr)
+    assert cen["host_transfers"] == 1
+    assert cen["host_transfers_in_scan"] == 1
+
+    def gated(x):
+        def body(c, _):
+            return c + 1.0, None
+        out, _ = jax.lax.scan(body, x, None, length=3)
+        jax.debug.callback(lambda v: None, out)    # OUTSIDE the scan
+        return out
+
+    cen2 = gc.host_transfer_census(jax.make_jaxpr(gated)(
+        jnp.float32(0.0)).jaxpr)
+    assert cen2["host_transfers"] == 1
+    assert cen2["host_transfers_in_scan"] == 0
+
+
+def test_dot_census_counts_contraction():
+    a = jnp.ones((4, 8), jnp.float32)
+    b = jnp.ones((8, 2), jnp.float32)
+    cen = gc.dot_census(jax.make_jaxpr(jnp.matmul)(a, b).jaxpr)
+    assert cen["dot_count"] == 1
+    assert cen["dot_flops"] == 2 * 4 * 2 * 8
+
+
+# ---------------------------------------------------------------------------
+# donation audit
+# ---------------------------------------------------------------------------
+
+def test_donation_census_donated_vs_not():
+    x = jnp.ones((8, 8), jnp.float32)
+    y = jnp.ones((8, 8), jnp.float32)
+
+    def f(a, b):
+        return a * 2.0 + b
+
+    donated = jax.jit(f, donate_argnums=(0,)).lower(x, y).compile()
+    plain = jax.jit(f).lower(x, y).compile()
+    assert gc.donation_census(donated.as_text())["donated_args"] >= 1
+    assert gc.donation_census(plain.as_text())["donated_args"] == 0
+
+
+def test_graph_census_composite_and_budget_metrics():
+    cen = gc.graph_census(
+        lambda a, b: a * 2.0 + b,
+        (jnp.ones((8, 8), jnp.float32), jnp.ones((8, 8), jnp.float32)),
+        donate_argnums=(0,))
+    m = gc.budget_metrics(cen)
+    assert m["donated_args"] >= 1
+    assert m["scatter_ops"] == 0 and m["fft_ops"] == 0
+    assert set(m) == set(gc.BUDGET_MAX_METRICS
+                         + gc.BUDGET_MIN_METRICS)
+
+
+# ---------------------------------------------------------------------------
+# budget diff semantics (pure python — no jax)
+# ---------------------------------------------------------------------------
+
+def test_diff_budget_directions():
+    budget = {"scatter_ops": 0, "fft_ops": 2, "donated_args": 11}
+    # clean
+    d = diff_budget("a", {"scatter_ops": 0, "fft_ops": 2,
+                          "donated_args": 11}, budget)
+    assert d.clean
+    # max metric regresses UP, min metric regresses DOWN
+    d = diff_budget("a", {"scatter_ops": 1, "fft_ops": 2,
+                          "donated_args": 3}, budget)
+    assert set(d.regressions) == {"scatter_ops", "donated_args"}
+    # improvements: fewer ffts, more donated args
+    d = diff_budget("a", {"scatter_ops": 0, "fft_ops": 1,
+                          "donated_args": 12}, budget)
+    assert not d.regressions
+    assert set(d.improvements) == {"fft_ops", "donated_args"}
+    # a budgeted metric the census cannot measure is NOT a silent pass
+    d = diff_budget("a", {"fft_ops": 2}, {"fft_ops": 2, "bogus": 0})
+    assert d.missing == ("bogus",)
+    assert not d.clean
+
+
+# ---------------------------------------------------------------------------
+# jit-lint rules on synthetic sources (no jax tracing involved)
+# ---------------------------------------------------------------------------
+
+_BAD_SRC = '''
+import time, random
+import numpy as np
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+@jax.jit
+def f(x, y):
+    if x > 0:
+        y = y + 1
+    v = float(x)
+    t = time.perf_counter()
+    return y + v + t
+
+@partial(jax.jit, static_argnums=(1,))
+def g(x, n, acc=[]):
+    z = x * 2
+    return np.asarray(z)
+
+def outer(xs):
+    def body(c, x):
+        while c.sum() > 0:
+            c = c - 1
+        return c, x.item()
+    return jax.lax.scan(body, xs[0], xs)
+
+def host_side(x):
+    # NOT a traced scope: none of these may be flagged
+    if x > 0:
+        return float(x)
+    return np.asarray(x)
+'''
+
+_OK_SRC = '''
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def f(x, mask=None):
+    if mask is None:
+        mask = jnp.ones_like(x)
+    if x.ndim == 3:
+        x = x.sum(axis=0)
+    return x * mask
+
+@jax.jit
+def waived(x):
+    v = float(x)  # jitlint: ok(tracer-cast): x is a concrete python scalar by contract
+    return v
+'''
+
+
+def _lint_src(tmp_path, src, name="mod.py"):
+    p = tmp_path / name
+    p.write_text(src)
+    return lint_file(str(p), name)
+
+
+def test_jit_lint_catches_each_rule(tmp_path):
+    findings, _ = _lint_src(tmp_path, _BAD_SRC)
+    rules = sorted(f.rule for f in findings if not f.waived)
+    assert rules.count("traced-branch") == 2      # if in f, while in body
+    assert rules.count("tracer-cast") == 3        # float, asarray, .item
+    assert rules.count("time-capture") == 1
+    assert rules.count("mutable-default") == 1
+    # the host-side function contributes nothing
+    lines = {f.line for f in findings}
+    assert all(l < _BAD_SRC.count("\n") - 4 or True for l in lines)
+    host_findings = [f for f in findings
+                     if "host_side" in _BAD_SRC.splitlines()[
+                         f.line - 1]]
+    assert not host_findings
+
+
+def test_jit_lint_exemptions_and_waivers(tmp_path):
+    findings, waivers = _lint_src(tmp_path, _OK_SRC)
+    active = [f for f in findings if not f.waived]
+    assert active == []                 # is-None + .ndim tests exempt
+    used = [w for w in waivers if w.used]
+    assert len(used) == 1 and used[0].rule == "tracer-cast"
+
+
+def test_jit_lint_rejects_bare_waiver(tmp_path):
+    src = ('import jax\n\n@jax.jit\ndef f(x):\n'
+           '    return float(x)  # jitlint: ok(tracer-cast)\n')
+    findings, _ = _lint_src(tmp_path, src)
+    rules = sorted(f.rule for f in findings if not f.waived)
+    # the waiver is malformed: the finding stays AND the bare waiver
+    # is itself reported
+    assert "tracer-cast" in rules
+    assert "bad-waiver" in rules

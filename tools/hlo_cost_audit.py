@@ -42,7 +42,6 @@ import argparse
 import json
 import multiprocessing as mp
 import os
-import re
 import sys
 import time
 
@@ -50,25 +49,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
-def hlo_op_counts(text: str) -> dict:
-    """Opcode census of an optimized-HLO dump (``compiled.as_text()``).
-
-    Quoted metadata (op_name/source strings) can contain anything,
-    including op-like tokens — strip quoted spans per line BEFORE
-    matching, then take the first ``opcode(`` token on the RHS of each
-    ``=`` assignment. Backend-independent: the census runs on whatever
-    module the caller compiled. tests/test_forces_hlo.py uses it to pin
-    the zero-scatter force-assembly guarantee.
-    """
-    counts: dict = {}
-    for line in text.splitlines():
-        if "=" not in line:
-            continue
-        rhs = re.sub(r'"[^"]*"', '""', line.split("=", 1)[1])
-        m = re.search(r"\b([a-z][a-z0-9_.-]*)\s*\(", rhs)
-        if m:
-            counts[m.group(1)] = counts.get(m.group(1), 0) + 1
-    return counts
+# The census primitives now live in ibamr_tpu.analysis.graph_census
+# (PR 8): ONE set of counting rules shared by this bench artifact, the
+# CI drift gate (tools/graph_audit.py) and the tier-1 contract tests.
+# Re-exported here because tests/test_forces_hlo.py and
+# tests/test_hlo_budgets.py import it from this module.
+from ibamr_tpu.analysis.graph_census import hlo_op_counts  # noqa: E402,F401
 
 
 def _leg_child(q, n, n_lat, n_lon, engine, piece):
@@ -190,53 +176,22 @@ def _leg_child(q, n, n_lat, n_lon, engine, piece):
         else:
             raise ValueError(piece)
 
-        # contraction census: backend-independent operand bytes of
-        # every dot_general in the traced program — the (B,cap,P) /
-        # (B,cap,nz) einsum operands ARE the claimed dominant traffic,
-        # and their traced dtypes/shapes show exactly what occupancy
-        # packing and bf16 compression do to them
+        # contraction + FFT censuses: the SHARED counting rules from
+        # ibamr_tpu.analysis.graph_census (dot_census: operand bytes of
+        # every dot_general — the (B,cap,P)/(B,cap,nz) einsum operands
+        # ARE the claimed dominant traffic; fft_census: batched FFT
+        # call count + per-transform bytes at the jaxpr PRIMITIVE level
+        # — the CPU backend lowers lax.fft to a ducc custom-call, so an
+        # HLO-text opcode census cannot see it)
+        from ibamr_tpu.analysis.graph_census import dot_census, fft_census
+
         census = {"dot_lhs_bytes": 0, "dot_rhs_bytes": 0,
                   "dot_out_bytes": 0, "dot_count": 0, "dot_flops": 0,
-                  # FFT census (round 6): batched-transform call count
-                  # and per-transform operand bytes, at the jaxpr
-                  # PRIMITIVE level — backend-independent (the CPU
-                  # backend lowers lax.fft to a ducc custom-call, so an
-                  # HLO-text opcode census cannot see it; the primitive
-                  # count is exactly the number of batched FFT calls
-                  # the TPU backend will also issue)
                   "fft_ops": 0, "fft_bytes": 0, "fft_transforms": []}
 
         def _walk(jaxpr):
-            for eqn in jaxpr.eqns:
-                if eqn.primitive.name == "fft":
-                    iv, ov = eqn.invars[0].aval, eqn.outvars[0].aval
-                    ib_, ob = (iv.size * iv.dtype.itemsize,
-                               ov.size * ov.dtype.itemsize)
-                    census["fft_ops"] += 1
-                    census["fft_bytes"] += ib_ + ob
-                    if len(census["fft_transforms"]) < 32:
-                        census["fft_transforms"].append({
-                            "kind": str(eqn.params.get("fft_type")),
-                            "in_shape": list(iv.shape),
-                            "in_bytes": ib_, "out_bytes": ob})
-                if eqn.primitive.name == "dot_general":
-                    lhs, rhs = eqn.invars[0].aval, eqn.invars[1].aval
-                    outv = eqn.outvars[0].aval
-                    census["dot_lhs_bytes"] += (
-                        lhs.size * lhs.dtype.itemsize)
-                    census["dot_rhs_bytes"] += (
-                        rhs.size * rhs.dtype.itemsize)
-                    census["dot_out_bytes"] += (
-                        outv.size * outv.dtype.itemsize)
-                    dims = eqn.params["dimension_numbers"][0]
-                    contracted = 1
-                    for ax in dims[0]:
-                        contracted *= lhs.shape[ax]
-                    census["dot_flops"] += 2 * outv.size * contracted
-                    census["dot_count"] += 1
-                for sub in eqn.params.values():
-                    if hasattr(sub, "jaxpr"):
-                        _walk(sub.jaxpr)
+            census.update(fft_census(jaxpr))
+            census.update(dot_census(jaxpr))
 
         try:
             if piece == "spread":

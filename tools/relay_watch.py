@@ -234,6 +234,25 @@ def main() -> int:
                     g.write(r3.stderr or "")
             except subprocess.TimeoutExpired:
                 log(f, "microbench_fluid timed out")
+            # stamp the graph-contract state of the captured code rev
+            # (PR 8): the audit's children force the CPU backend
+            # themselves, so this costs no relay time — it just rides
+            # the same capture so the bench numbers and the compiled-
+            # graph census land as one auditable pair
+            try:
+                r4 = subprocess.run(
+                    [sys.executable,
+                     os.path.join(REPO, "tools", "graph_audit.py"),
+                     "--json"],
+                    capture_output=True, text=True, cwd=REPO, env=env,
+                    timeout=args.bench_timeout)
+                log(f, f"graph_audit rc={r4.returncode}\n"
+                       + "\n".join((r4.stdout or "").strip().splitlines()[-5:]))
+                with open(args.out.replace(".json", "_graph_audit.json"),
+                          "w") as g:
+                    g.write(r4.stdout or "")
+            except subprocess.TimeoutExpired:
+                log(f, "graph_audit timed out")
         else:
             log(f, "bench ran but did not produce a TPU JSON line; re-arming")
             time.sleep(args.interval)
