@@ -283,6 +283,36 @@ def cpu_sharded_reference_with_trend(n_devices: int = 8):
     return out
 
 
+def git_short_rev() -> str:
+    """The repo's short commit hash (``norev`` outside git): profile
+    captures are named ``<stage>_<rev>`` so two revisions' traces of
+    the same stage sit side by side in one TensorBoard logdir."""
+    try:
+        import subprocess
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__))).stdout
+        return out.strip() or "norev"
+    except Exception:
+        return "norev"
+
+
+def stage_profile_dir(args, label: str, rev: str) -> str:
+    """Capture dir for one stage under ``--profile-stages``, or ``""``
+    (no capture). ``--profile-stages`` is a comma-separated list of
+    fnmatch globs over stage labels — ramp stages are ``n<size>``
+    (``n256``), flagship legs their engine label (``packed*``)."""
+    import fnmatch
+    if not args.profile or not args.profile_stages:
+        return ""
+    pats = [p.strip() for p in args.profile_stages.split(",")
+            if p.strip()]
+    if any(fnmatch.fnmatch(label, p) for p in pats):
+        return os.path.join(args.profile, f"{label}_{rev}")
+    return ""
+
+
 def run_engine_leg(jax, label, engine, n, n_lat, n_lon, args, t_start,
                    platform):
     """One transfer-engine leg at size ``n``: pallas engines run in a
@@ -558,6 +588,12 @@ def main():
                     help="capture a jax device profile of the final "
                          "stage into this directory (TensorBoard/"
                          "Perfetto viewable)")
+    ap.add_argument("--profile-stages", type=str, default="",
+                    help="comma-separated fnmatch globs over stage "
+                         "labels ('n256,packed*'); each matching ramp "
+                         "stage (n<size>) or flagship leg captures its "
+                         "device profile into <--profile>/<label>_"
+                         "<gitrev>/ instead of only the final stage")
     ap.add_argument("--heartbeat", type=str, default="",
                     help="write a liveness heartbeat.json to this path "
                          "(or directory) so an external watcher can "
@@ -598,9 +634,17 @@ def main():
         "phases": None,
         "cpu_sharded_ref": None,
         "fleet": None,
+        "profiles": [],
         "error": None,
     }
     orig_steps, orig_deadline = args.steps, args.deadline
+    profile_rev = git_short_rev() if args.profile_stages else "norev"
+
+    def profile_dir_for(label: str) -> str:
+        d = stage_profile_dir(args, label, profile_rev)
+        if d:
+            result["profiles"].append(d)
+        return d
 
     try:
         from ibamr_tpu.utils.backend_guard import init_backend_with_retry
@@ -672,7 +716,10 @@ def main():
                 from ibamr_tpu.utils.timers import profile_trace
 
                 t_stage = time.perf_counter()
-                with profile_trace(args.profile if n == args.n else ""):
+                with profile_trace(
+                        profile_dir_for(f"n{n}")
+                        if args.profile_stages
+                        else (args.profile if n == args.n else "")):
                     # the ramp pins the BUCKETED-MXU engine: it has been
                     # the staged baseline since round 1, and keeping it
                     # preserves the longitudinal r1/r3/r5 comparison now
@@ -721,9 +768,11 @@ def main():
                     continue
                 try:
                     t_leg = time.perf_counter()
-                    st = run_engine_leg(jax, label, label, args.n,
-                                        args.n_lat, args.n_lon, args,
-                                        t_start, platform)
+                    from ibamr_tpu.utils.timers import profile_trace
+                    with profile_trace(profile_dir_for(label)):
+                        st = run_engine_leg(jax, label, label, args.n,
+                                            args.n_lat, args.n_lon,
+                                            args, t_start, platform)
                     st["platform"] = platform
                     log(f"[bench] flagship {label}: "
                         f"{st['steps_per_sec']} steps/s")

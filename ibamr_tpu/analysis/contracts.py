@@ -155,6 +155,67 @@ def _build_fleet_chunk():
     return chunk, (stacked, dt_vec, alive), ()
 
 
+def _attach_contract_ledger():
+    """Attach a live run ledger for a telemetry-on artifact build. The
+    ledger stays attached THROUGH the census trace (detached and closed
+    by :func:`measure_artifact`'s finally), so the chunk is lowered in
+    exactly the configuration a supervised run uses — if telemetry ever
+    leaks a ``jax.debug.callback``/``io_callback`` into the traced
+    chunk, ``host_transfers_in_scan`` catches it here."""
+    import tempfile
+
+    from ibamr_tpu import obs
+
+    path = os.path.join(tempfile.mkdtemp(prefix="obs-contract-"),
+                        "ledger.jsonl")
+    obs.attach(obs.RunLedger(path))
+
+
+def _build_solo_chunk_telemetry():
+    # the solo chunk exactly as the instrumented driver runs it: live
+    # ledger attached, the chunk call wrapped in the driver's span, the
+    # per-chunk counter/watermark flush issued after — all of which
+    # must stay HOST-side (same FFT/scatter ceilings as solo_chunk,
+    # host_transfers_in_scan == 0)
+    from ibamr_tpu import obs
+
+    integ, state = _shell()
+    drv = _driver(integ)
+    chunk = _unwrap(drv._chunk(4))
+    _attach_contract_ledger()
+
+    def run(st, dt):
+        with obs.span("driver/chunk", step=0, length=4):
+            out = chunk(st, dt)
+        obs.chunk_boundary(step=4)
+        return out
+
+    return run, (state, _DT), ()
+
+
+def _build_fleet_chunk_telemetry():
+    import jax.numpy as jnp
+
+    from ibamr_tpu import obs
+    from ibamr_tpu.utils import lanes as _lanes
+
+    integ, state = _shell()
+    drv = _driver(integ, lanes=2)
+    chunk = _unwrap(drv._chunk(2))
+    stacked = _lanes.stack_lanes([state, state])
+    dt_vec = jnp.full((2,), _DT, dtype=jnp.float32)
+    alive = jnp.ones((2,), dtype=bool)
+    _attach_contract_ledger()
+
+    def run(st, dt, al):
+        with obs.span("driver/chunk", step=0, length=2):
+            out = chunk(st, dt, al)
+        obs.chunk_boundary(step=2)
+        return out
+
+    return run, (stacked, dt_vec, alive), ()
+
+
 def _build_donated_step():
     # IBExplicitIntegrator.jitted_step(donate=True) unwrapped: verifies
     # the integrator-level donation request actually aliases buffers
@@ -243,6 +304,15 @@ ARTIFACTS: Dict[str, Artifact] = {
                        "verifies whole-chunk buffer donation"),
         Artifact("fleet_chunk", _build_fleet_chunk,
                  notes="2-lane vmapped chunk with lane-freeze select"),
+        Artifact("solo_chunk_telemetry", _build_solo_chunk_telemetry,
+                 notes="solo chunk lowered with a live run ledger, "
+                       "driver span and per-chunk flush attached; "
+                       "telemetry must stay host-side (same ceilings "
+                       "as solo_chunk, zero in-scan transfers)"),
+        Artifact("fleet_chunk_telemetry", _build_fleet_chunk_telemetry,
+                 notes="fleet chunk lowered telemetry-on; same "
+                       "ceilings as fleet_chunk, zero in-scan "
+                       "transfers"),
         Artifact("donated_step", _build_donated_step,
                  notes="integrator jitted_step(donate=True); verified "
                        "against the compiled alias table"),
@@ -267,10 +337,28 @@ def measure_artifact(name: str) -> dict:
     backend; the CI gate runs this in a ``JAX_PLATFORMS=cpu`` child."""
     from jax.experimental import disable_x64
 
+    from ibamr_tpu import obs
+
     art = ARTIFACTS[name]
-    with disable_x64():
-        fn, args, donate = art.build()
-        census = graph_census(fn, args, donate_argnums=donate)
+    prev = obs.current()
+    try:
+        with disable_x64():
+            fn, args, donate = art.build()
+            census = graph_census(fn, args, donate_argnums=donate)
+    finally:
+        # telemetry-on builders attach a contract ledger that must stay
+        # live through the census; restore whatever the CALLER had
+        # attached (in-process test measurement must not steal a real
+        # run's ledger)
+        led = obs.current()
+        if led is not prev:
+            obs.detach()
+            try:
+                led.close()
+            except Exception:
+                pass
+            if prev is not None:
+                obs.attach(prev)
     return budget_metrics(census)
 
 

@@ -249,6 +249,9 @@ def build_engine_with_fallback(name, grid: StaggeredGrid, vertices,
             return fast, eng_name
         except Exception as e:
             nxt = chain[i + 1]
+            from ibamr_tpu.ops.interaction_packed import \
+                record_engine_fallback
+            record_engine_fallback(eng_name, nxt)
             warnings.warn(
                 f"transfer engine {eng_name!r} failed to "
                 f"build/compile ({type(e).__name__}: {e}); degrading "

@@ -1,0 +1,51 @@
+"""One pane of glass: the process-wide telemetry bus (PR 9).
+
+Three primitives, one correlated stream per run:
+
+- **spans** — nested wall-clock phases (:func:`span`), async-dispatch
+  aware (``block_on=`` a pytree, the ``utils/timers.py`` discipline)
+  and mirrored into ``jax.named_scope`` so phase names land in on-chip
+  profiler traces;
+- **counters / gauges** — a labeled metric registry (:func:`counter`,
+  :func:`gauge`) every subsystem publishes into: spectral-plan cache
+  hits, engine fallbacks, checkpoint queue depth, supervisor retries,
+  lane triage, replay verdicts, device-memory watermarks;
+- **the run ledger** — a per-run append-only ``ledger.jsonl``
+  (:class:`RunLedger`): spans close into it, counters snapshot into it
+  at every chunk boundary, incidents and heartbeats cross-reference it
+  by ``seq``, and every record carries the flight-recorder run
+  fingerprint digest as ``run_id``.
+
+The non-negotiable constraint: telemetry adds ZERO host transfers
+inside the scanned chunk (pinned by the ``*_telemetry`` graph-contract
+artifacts) and <2% warm-chunk wall overhead (self-accounted in
+``RunLedger.overhead_s``, pinned like the flight recorder's). All
+host-side work rides the existing one-transfer-per-chunk sync points.
+
+See docs/OBSERVABILITY.md for the ledger schema and the CLI cookbook
+(``tools/obs.py summary | tail | compare``).
+"""
+
+from ibamr_tpu.obs.bus import (  # noqa: F401
+    LEDGER_SCHEMA,
+    RunLedger,
+    attach,
+    chunk_boundary,
+    counter,
+    current,
+    detach,
+    emit,
+    gauge,
+    last_seq,
+    ledger,
+    metrics_snapshot,
+    read_ledger,
+    reset_metrics,
+    run_id_from_fingerprint,
+    sample_memory_watermarks,
+    span,
+)
+from ibamr_tpu.obs.export import (  # noqa: F401
+    prometheus_text,
+    write_prometheus,
+)

@@ -1,0 +1,75 @@
+"""Prometheus text-exposition snapshot exporter.
+
+The future warm-pool server (ROADMAP item 1) needs a ``/metrics``
+endpoint; everything before it needs the same serialization for
+artifacts: :func:`prometheus_text` renders the live registry (or a
+``counters`` record lifted from a ledger) in the Prometheus text
+format — ``# TYPE`` headers, sanitized metric names, escaped label
+values — and :func:`write_prometheus` lands it atomically so a
+scraper never reads a torn file.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Optional
+
+from ibamr_tpu.obs.bus import iter_metrics
+
+
+def _base_name(key: str) -> str:
+    return key.split("{", 1)[0]
+
+
+def prometheus_text(counters: Optional[dict] = None,
+                    gauges: Optional[dict] = None) -> str:
+    """Render metrics in the Prometheus text exposition format.
+
+    With no arguments, serializes the LIVE registry. Passing
+    ``counters``/``gauges`` dicts (rendered-key -> value, exactly what
+    a ledger ``counters`` record holds) renders a historical snapshot
+    instead — ``tools/obs.py`` uses this to export from a ledger of a
+    finished run."""
+    samples = []            # (kind, base_name, key, value)
+    if counters is None and gauges is None:
+        for kind, _name, _labels, key, value in iter_metrics():
+            samples.append((kind, _base_name(key), key, value))
+    else:
+        for key, value in (counters or {}).items():
+            samples.append(("counter", _base_name(key), key, value))
+        for key, value in (gauges or {}).items():
+            samples.append(("gauge", _base_name(key), key, value))
+
+    lines = []
+    seen_type = set()
+    # group by (kind, base name); stable sort keeps families together
+    for kind, base, key, value in sorted(samples):
+        if (kind, base) not in seen_type:
+            seen_type.add((kind, base))
+            lines.append(f"# TYPE {base} {kind}")
+        v = float(value)
+        text = repr(int(v)) if v == int(v) else repr(v)
+        lines.append(f"{key} {text}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def write_prometheus(path: str, counters: Optional[dict] = None,
+                     gauges: Optional[dict] = None) -> str:
+    """Atomically write :func:`prometheus_text` to ``path`` (temp +
+    ``os.replace``, the repo-wide torn-read discipline)."""
+    text = prometheus_text(counters=counters, gauges=gauges)
+    d = os.path.dirname(path) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(prefix=".metrics-", suffix=".tmp", dir=d)
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(text)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path

@@ -396,3 +396,16 @@ def fallback_chain(name):
         cur = ENGINE_FALLBACKS[cur]
         chain.append(cur)
     return chain
+
+
+def record_engine_fallback(failed: str, to: str) -> None:
+    """Publish one ENGINE_FALLBACKS degradation onto the telemetry bus
+    (labeled by the failed engine and its replacement). Called by
+    ``models.shell3d.build_engine_with_fallback`` next to the warning
+    it already emits — the warning tells a human once, the counter
+    makes the degradation visible in every later ledger snapshot."""
+    from ibamr_tpu import obs
+
+    obs.counter("engine_fallbacks_total",
+                engine=normalize_engine_name(failed),
+                to=normalize_engine_name(to)).inc()

@@ -312,6 +312,15 @@ _cache: "OrderedDict[tuple, SpectralPlan]" = OrderedDict()
 _lock = threading.Lock()
 _stats = {"hits": 0, "misses": 0, "evictions": 0}
 
+# telemetry twins (PR 9): the same three events published onto the
+# process-wide bus, so a run ledger's per-chunk counter snapshots show
+# plan-cache behavior alongside every other subsystem
+from ibamr_tpu import obs as _obs  # noqa: E402
+
+_OBS_HITS = _obs.counter("spectral_plan_hits_total")
+_OBS_MISSES = _obs.counter("spectral_plan_misses_total")
+_OBS_EVICTIONS = _obs.counter("spectral_plan_evictions_total")
+
 
 def plan_key(shape: Sequence[int], dx: Sequence[float], dtype,
              bc: str = "periodic") -> tuple:
@@ -338,6 +347,7 @@ def get_plan(shape: Sequence[int], dx: Sequence[float], dtype,
         plan = _cache.get(key)
         if plan is not None:
             _stats["hits"] += 1
+            _OBS_HITS.inc()
             _cache.move_to_end(key)
             return plan
     # build outside the lock (table construction runs device code)
@@ -347,13 +357,16 @@ def get_plan(shape: Sequence[int], dx: Sequence[float], dtype,
         existing = _cache.get(key)
         if existing is not None:
             _stats["hits"] += 1
+            _OBS_HITS.inc()
             _cache.move_to_end(key)
             return existing
         _stats["misses"] += 1
+        _OBS_MISSES.inc()
         _cache[key] = plan
         while len(_cache) > _CACHE_MAXSIZE:
             _cache.popitem(last=False)
             _stats["evictions"] += 1
+            _OBS_EVICTIONS.inc()
     return plan
 
 

@@ -420,10 +420,13 @@ class AsyncCheckpointWriter:
         """Gather and enqueue one checkpoint write. Returns the write
         future, or ``None`` when the save was shed under
         ``overflow="drop"`` backlog."""
+        from ibamr_tpu import obs as _obs
         self._raise_finished()
         if self.queue_depth() >= self.max_pending:
             if self.overflow == "drop":
                 self.dropped_saves += 1
+                _obs.counter("ckpt_dropped_saves_total",
+                             writer="single").inc()
                 return None
             # backpressure: the oldest pending write must land before
             # this save may pin another host copy of the state; wait
@@ -441,6 +444,8 @@ class AsyncCheckpointWriter:
                                 arrays, schema, step, metadata,
                                 self.keep, self.lanes)
         self._pending.append(fut)
+        _obs.gauge("ckpt_queue_depth",
+                   writer="single").set(self.queue_depth())
         return fut
 
     def wait(self) -> None:

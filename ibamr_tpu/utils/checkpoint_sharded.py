@@ -559,10 +559,13 @@ class AsyncShardedWriter:
         """Snapshot per-shard buffers and enqueue the write. Returns
         the committer future, or ``None`` when the save was shed under
         ``overflow="drop"`` backlog."""
+        from ibamr_tpu import obs as _obs
         self._raise_finished()
         if self.queue_depth() >= self.max_pending:
             if self.overflow == "drop":
                 self.dropped_saves += 1
+                _obs.counter("ckpt_dropped_saves_total",
+                             writer="sharded").inc()
                 return None
             # backpressure: wait for the OLDEST pending write; wait
             # without .result() so _raise_finished surfaces a failure
@@ -582,6 +585,8 @@ class AsyncShardedWriter:
                                   metadata)
         with self._lock:
             self._pending.append(fut)
+        _obs.gauge("ckpt_queue_depth",
+                   writer="sharded").set(self.queue_depth())
         return fut
 
     def wait(self) -> None:

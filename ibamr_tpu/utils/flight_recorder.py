@@ -288,6 +288,14 @@ class FlightRecorder:
             fp.setdefault(k, _json_safe(v))
         return fp
 
+    def run_id(self, driver=None) -> str:
+        """The 16-hex run identity the observability ledger stamps on
+        every record — a digest of :meth:`fingerprint`, so the ledger,
+        the incident capsules and the replay verdicts of one run all
+        cross-reference by the same id."""
+        from ibamr_tpu.obs import run_id_from_fingerprint
+        return run_id_from_fingerprint(self.fingerprint(driver=driver))
+
     @staticmethod
     def _engine_info(integ, spec):
         """(engine label, fallback chain) actually in use, best-effort."""

@@ -29,6 +29,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ibamr_tpu import obs as _obs
+
+# module-cached handles: inc() on the instance is the lock-free path
+_CHUNKS_TOTAL = _obs.counter("driver_chunks_total")
+_STEPS_TOTAL = _obs.counter("driver_steps_total")
+
 
 class SimulationDiverged(RuntimeError):
     """Raised when the state stops being finite; carries diagnostics.
@@ -467,16 +473,31 @@ class HierarchyDriver:
                                        length=n, integ=self.integ,
                                        cfg=cfg, alive=snap_alive)
             t0 = time.perf_counter()
-            if self.timer is not None:
-                with self.timer.scope(self.timer_name):
+            # the chunk span brackets dispatch AND the one-per-chunk
+            # host sync below; with a run ledger attached it closes
+            # into the ledger (kind "span"), else it costs two clock
+            # reads. Telemetry never reaches inside the jitted chunk —
+            # the *_telemetry graph contracts pin zero in-scan host
+            # transfers with the bus armed.
+            with _obs.span("driver/chunk", step=step, length=n):
+                if self.timer is not None:
+                    with self.timer.scope(self.timer_name):
+                        state, health = self._chunk(n)(state,
+                                                       *chunk_args)
+                        # one device sync per chunk (inside the scope):
+                        # the finite bool or the fused vitals vector
+                        health = np.asarray(health)
+                else:
                     state, health = self._chunk(n)(state, *chunk_args)
-                    # one device sync per chunk (inside the scope):
-                    # either the finite bool or the fused vitals vector
                     health = np.asarray(health)
-            else:
-                state, health = self._chunk(n)(state, *chunk_args)
-                health = np.asarray(health)
             self.last_chunk_wall_s = time.perf_counter() - t0
+            _CHUNKS_TOTAL.inc()
+            _STEPS_TOTAL.inc(n)
+            # per-chunk counters snapshot + device-memory watermarks,
+            # riding the sync that just happened (no-op when no ledger
+            # is attached)
+            _obs.chunk_boundary(step=step + n,
+                                chunk_wall_s=self.last_chunk_wall_s)
             if fleet:
                 # per-lane triage; raises LaneFault (carrying the
                 # post-chunk state so healthy-lane progress survives)

@@ -8,6 +8,7 @@ one JSONL stream of per-step dicts, plus a human-readable console echo.
 from __future__ import annotations
 
 import json
+import math
 import os
 import sys
 from typing import Any, Dict, IO, Optional
@@ -39,8 +40,19 @@ class MetricsLogger:
             self._fh = open(path, "a")
 
     def log(self, record: Dict[str, Any]) -> None:
-        rec = {k: _jsonable(v) for k, v in record.items()}
-        line = json.dumps(rec)
+        rec = {}
+        for k, v in record.items():
+            v = _jsonable(v)
+            if isinstance(v, float) and not math.isfinite(v):
+                # strict-JSON stream: a NaN/Inf vital must neither
+                # break downstream json.loads (json.dumps would emit
+                # bare NaN) nor vanish silently — null the value and
+                # flag it, so the non-finite event stays queryable
+                rec[k] = None
+                rec[f"{k}_nonfinite"] = repr(v)
+            else:
+                rec[k] = v
+        line = json.dumps(rec, allow_nan=False)
         if self._fh is not None:
             self._fh.write(line + "\n")
             self._fh.flush()
@@ -52,6 +64,11 @@ class MetricsLogger:
 
     def close(self) -> None:
         if self._fh is not None:
+            try:
+                self._fh.flush()
+                os.fsync(self._fh.fileno())
+            except (OSError, ValueError):
+                pass            # closed/unsyncable stream: still close
             self._fh.close()
             self._fh = None
 

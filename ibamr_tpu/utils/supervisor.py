@@ -43,10 +43,17 @@ import signal
 import time
 from typing import Any, Callable, Optional
 
+from ibamr_tpu import obs as _obs
 from ibamr_tpu.utils.checkpoint import (AsyncCheckpointWriter,
                                         latest_step, restore_checkpoint,
                                         restore_lane, save_checkpoint)
 from ibamr_tpu.utils.hierarchy_driver import LaneFault, SimulationDiverged
+
+_RETRIES = _obs.counter("supervisor_retries_total")
+_ROLLBACKS = _obs.counter("supervisor_rollbacks_total")
+_ESCALATIONS = _obs.counter("supervisor_precision_escalations_total")
+_LANE_ROLLBACKS = _obs.counter("supervisor_lane_rollbacks_total")
+_LANE_QUARANTINES = _obs.counter("supervisor_lane_quarantines_total")
 
 
 class PreemptionSignal(BaseException):
@@ -181,6 +188,17 @@ class ResilientDriver:
         rec.setdefault("schema", 3)
         if "replay" not in rec:
             rec["replay"] = self._dump_replay(rec)
+        # cross-reference the run ledger (PR 9): the incident's slim
+        # twin lands there as kind "incident" and the JSONL record
+        # carries its ledger seq — one pointer from incidents.jsonl to
+        # the correlated span/counter stream and back
+        seq = _obs.emit(
+            "incident",
+            event=rec.get("event"), incident_kind=rec.get("kind"),
+            step=rec.get("step"), lane=rec.get("lane"),
+            retry=rec.get("retry"), replay=rec.get("replay"))
+        if seq is not None:
+            rec["ledger_seq"] = seq
         self.incidents.append(rec)
         os.makedirs(os.path.dirname(self.incident_log) or ".",
                     exist_ok=True)
@@ -273,9 +291,12 @@ class ResilientDriver:
         quarantined = int((~alive).sum())
         retrying = sum(1 for ln, r in self._lane_retries.items()
                        if r > 0 and alive[ln])
-        return {"lanes_ok": int(driver.lanes) - quarantined - retrying,
-                "lanes_quarantined": quarantined,
-                "lanes_retrying": retrying}
+        fields = {"lanes_ok": int(driver.lanes) - quarantined - retrying,
+                  "lanes_quarantined": quarantined,
+                  "lanes_retrying": retrying}
+        for k, v in fields.items():
+            _obs.gauge(k).set(v)
+        return fields
 
     def _recover_lanes(self, e: LaneFault, initial: tuple):
         """Per-lane rollback / quarantine for a :class:`LaneFault`.
@@ -328,6 +349,7 @@ class ResilientDriver:
                     "from_checkpoint": from_ck, "replay": replay}
             if retries < self.max_retries:
                 self._lane_retries[lane] = retries + 1
+                _LANE_ROLLBACKS.inc()
                 dt_before = float(driver.lane_dt[lane])
                 driver.lane_dt[lane] = dt_before * self.dt_backoff
                 if probe is not None:
@@ -340,6 +362,7 @@ class ResilientDriver:
                     "dt_after": float(driver.lane_dt[lane])}))
             else:
                 driver.lane_alive[lane] = False
+                _LANE_QUARANTINES.inc()
                 self._record(dict(base, **{
                     "event": "lane_quarantine",
                     "retries": retries,
@@ -393,11 +416,17 @@ class ResilientDriver:
             # driver raises on divergence before this runs
             self._last = (s, k)
             if self.watchdog is not None:
+                led = _obs.current()
                 self.watchdog.beat(
                     step=k,
                     last_chunk_wall_s=getattr(driver,
                                               "last_chunk_wall_s", None),
                     ckpt_queue_depth=writer.queue_depth(),
+                    # one pointer from a stalled run's heartbeat to its
+                    # ledger (and the exact record to start reading at)
+                    ledger_path=(led.path if led is not None else None),
+                    ledger_seq=(led.last_seq if led is not None
+                                else None),
                     **self._lane_beat_fields())
             return user_metrics(s, k) if user_metrics is not None else None
 
@@ -457,6 +486,7 @@ class ResilientDriver:
                             "dt": dt_before}))
                         raise
                     retries += 1
+                    _RETRIES.inc()
                     try:
                         writer.wait()  # pending intervals land first
                     except Exception:
@@ -465,10 +495,12 @@ class ResilientDriver:
                         if kind == "precision_drift" else None
                     cur_state, cur_step, ck = self._rollback(initial[0],
                                                              initial)
+                    _ROLLBACKS.inc()
                     if esc is not None:
                         # precision, not stability, is the problem: dt
                         # stays put; the retry reruns the rolled-back
                         # chunk at the escalated spectral_dtype
+                        _ESCALATIONS.inc()
                         self._record(dict(payload, **{
                             "event": "precision_escalation",
                             "kind": kind, "step": e.step,

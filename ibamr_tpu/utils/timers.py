@@ -68,13 +68,21 @@ class TimerManager:
 
     @contextmanager
     def scope(self, name: str, block_on=None):
+        # span emission (PR 9): the scope IS a telemetry span — one
+        # bookkeeping path, not two. The span enters jax.named_scope,
+        # blocks on `block_on` before its clock read, and closes into
+        # the attached run ledger; the Timer accumulates immediately
+        # after (same wall time to within microseconds), keeping the
+        # report() table alive for callers that never attach a ledger.
+        from ibamr_tpu.obs import span as _span
+
         t = self.get(name)
         t.start()
-        with jax.named_scope(name.split("::")[-1]):
-            try:
+        try:
+            with _span(name, block_on=block_on):
                 yield t
-            finally:
-                t.stop(block_on=block_on)
+        finally:
+            t.stop()
 
     def report(self) -> str:
         if not self.timers:

@@ -157,6 +157,10 @@ class RunWatchdog:
         self._lanes_ok: Optional[int] = None
         self._lanes_quarantined: Optional[int] = None
         self._lanes_retrying: Optional[int] = None
+        # run-ledger pointer (PR 9): a stalled run's correlated
+        # telemetry stream is one heartbeat read away
+        self._ledger_path: Optional[str] = None
+        self._ledger_seq: Optional[int] = None
         self._ema_chunk_s: Optional[float] = None
         self._armed = True
         self.stalls: list = []          # one record per detected stall
@@ -168,7 +172,9 @@ class RunWatchdog:
              ckpt_queue_depth: Optional[int] = None,
              lanes_ok: Optional[int] = None,
              lanes_quarantined: Optional[int] = None,
-             lanes_retrying: Optional[int] = None) -> None:
+             lanes_retrying: Optional[int] = None,
+             ledger_path: Optional[str] = None,
+             ledger_seq: Optional[int] = None) -> None:
         """Record liveness (call once per completed chunk). Also
         refreshes the heartbeat file immediately, so the file is never
         staler than the run's real progress; the daemon only keeps it
@@ -197,6 +203,10 @@ class RunWatchdog:
                 self._lanes_quarantined = int(lanes_quarantined)
             if lanes_retrying is not None:
                 self._lanes_retrying = int(lanes_retrying)
+            if ledger_path is not None:
+                self._ledger_path = str(ledger_path)
+            if ledger_seq is not None:
+                self._ledger_seq = int(ledger_seq)
             self._armed = True          # re-arm: the run moved again
             payload = self._payload_locked()
         if self.heartbeat_path is not None:
@@ -221,6 +231,11 @@ class RunWatchdog:
             payload["lanes_ok"] = self._lanes_ok
             payload["lanes_quarantined"] = self._lanes_quarantined
             payload["lanes_retrying"] = self._lanes_retrying
+        if self._ledger_path is not None:
+            # a stall incident is one pointer away from the correlated
+            # telemetry stream (and the seq to start reading at)
+            payload["ledger_path"] = self._ledger_path
+            payload["ledger_seq"] = self._ledger_seq
         return payload
 
     # -- detector -----------------------------------------------------------

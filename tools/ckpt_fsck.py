@@ -252,9 +252,27 @@ def audit(root: str) -> dict:
     dirs = [audit_dir(d) for d in _checkpoint_dirs(root)]
     total = _counts([r for d in dirs for r in d["steps"]])
     return {"root": os.path.abspath(root), "dirs": dirs,
+            "run_id": _ledger_run_id(root),
             "counts": total,
             "clean": (total["torn"] == 0 and total["corrupt"] == 0
                       and total["partial"] == 0)}
+
+
+def _ledger_run_id(root: str):
+    """The ``run_id`` of the run that wrote this tree, read from its
+    ``ledger.jsonl`` (PR 9) — so an fsck report, the ledger, and the
+    incident capsules of one run cross-reference by the same id.
+    ``None`` when the run predates the ledger."""
+    path = os.path.join(root, "ledger.jsonl")
+    try:
+        from ibamr_tpu.obs import read_ledger
+        for rec in read_ledger(path):
+            rid = rec.get("run_id")
+            if rid:
+                return rid
+    except Exception:
+        pass
+    return None
 
 
 # ---------------------------------------------------------------------------

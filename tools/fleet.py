@@ -70,6 +70,9 @@ def run_fleet(integ, stacked, cfg, lanes, directory=None,
               max_retries=2, dt_backoff=0.5, quarantine_threshold=0.5,
               heartbeat=None):
     """One supervised fleet run; returns (summary dict, final state)."""
+    import contextlib
+
+    from ibamr_tpu import obs
     from ibamr_tpu.utils.health import HealthProbe
     from ibamr_tpu.utils.hierarchy_driver import HierarchyDriver
     from ibamr_tpu.utils.supervisor import ResilientDriver
@@ -82,6 +85,20 @@ def run_fleet(integ, stacked, cfg, lanes, directory=None,
         wd = RunWatchdog(heartbeat_path=heartbeat, interval_s=5.0,
                          min_stall_s=300.0)
     t0 = time.perf_counter()
+    ledger_path = None
+    ledger_seq = None
+    if directory:
+        # run ledger: spans/counters/incidents of THIS run land in one
+        # seq-ordered stream, stamped with the flight-recorder run_id
+        from ibamr_tpu.utils.flight_recorder import FlightRecorder
+        try:
+            fp = FlightRecorder(capacity=1).fingerprint(driver=drv)
+        except Exception:
+            fp = None
+        ledger_path = os.path.join(directory, "ledger.jsonl")
+        ledger_cm = obs.ledger(ledger_path, fingerprint=fp)
+    else:
+        ledger_cm = contextlib.nullcontext()
     if directory:
         sup = ResilientDriver(drv, directory, max_retries=max_retries,
                               dt_backoff=dt_backoff,
@@ -89,7 +106,9 @@ def run_fleet(integ, stacked, cfg, lanes, directory=None,
                               handle_signals=False, watchdog=wd,
                               incident_log=os.path.join(
                                   directory, "incidents.jsonl"))
-        final = sup.run(stacked)
+        with ledger_cm as led:
+            final = sup.run(stacked)
+        ledger_seq = led.last_seq if led is not None else None
         incidents = list(sup.incidents)
     else:
         if wd is not None:
@@ -130,6 +149,10 @@ def run_fleet(integ, stacked, cfg, lanes, directory=None,
         "incidents": [r.get("event") for r in incidents],
         "per_lane": per_lane,
     }
+    if ledger_path is not None:
+        summary["ledger_path"] = ledger_path
+        summary["ledger_records"] = (ledger_seq + 1
+                                     if ledger_seq is not None else 0)
     return summary, final
 
 

@@ -1,0 +1,377 @@
+"""Run-ledger reader: summarize, follow, and compare telemetry (PR 9).
+
+The ledger (``ibamr_tpu.obs``) is an append-only ``ledger.jsonl`` —
+spans, per-chunk counter snapshots, incidents — every record stamped
+with the run fingerprint digest (``run_id``) and a monotonic ``seq``.
+This tool is the operator's side of that contract:
+
+- ``summary``: one screen per run — the span tree aggregated by path
+  with percent-of-parent, the counter/gauge table from the LAST
+  per-chunk snapshot (counters are cumulative, so the last snapshot IS
+  the run total — no summing, which is what makes supervised retries
+  double-count-proof), and the incident timeline cross-referenced by
+  seq.
+- ``tail``: live follow of a growing ledger alongside the watchdog
+  heartbeat (staleness age), for watching a run without attaching to
+  its process.
+- ``compare``: two ledgers -> per-phase wall deltas; two bench JSONs
+  (``BENCH_r*.json`` or raw ``bench.py`` output) -> per-stage and
+  per-phase deltas between revisions.
+
+Examples::
+
+    python tools/obs.py summary /tmp/fleet/ledger.jsonl
+    python tools/obs.py tail /tmp/fleet --max-seconds 30
+    python tools/obs.py compare /tmp/a/ledger.jsonl /tmp/b/ledger.jsonl
+    python tools/obs.py compare BENCH_r04.json BENCH_r05.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from ibamr_tpu.obs import read_ledger  # noqa: E402
+
+LEDGER_NAME = "ledger.jsonl"
+
+
+def resolve_ledger(path: str) -> str:
+    """A directory is accepted and means its ``ledger.jsonl``."""
+    if os.path.isdir(path):
+        return os.path.join(path, LEDGER_NAME)
+    return path
+
+
+def _fmt_s(v) -> str:
+    if v is None:
+        return "-"
+    v = float(v)
+    if v >= 100:
+        return f"{v:.1f}s"
+    if v >= 0.1:
+        return f"{v:.3f}s"
+    return f"{v * 1e3:.2f}ms"
+
+
+def _fmt_num(v) -> str:
+    if isinstance(v, float) and v == int(v):
+        v = int(v)
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+# ---------------------------------------------------------------------------
+# summary
+# ---------------------------------------------------------------------------
+
+def span_tree(records: list) -> dict:
+    """Aggregate span records by slash ``path``:
+    ``{path: {"count": n, "total_s": s, "errors": e, "depth": d}}``."""
+    tree: dict = {}
+    for rec in records:
+        if rec.get("kind") != "span":
+            continue
+        path = rec.get("path") or rec.get("name", "?")
+        node = tree.setdefault(path, {"count": 0, "total_s": 0.0,
+                                      "errors": 0,
+                                      "depth": path.count("/")})
+        node["count"] += 1
+        node["total_s"] += float(rec.get("dur_s") or 0.0)
+        if rec.get("error"):
+            node["errors"] += 1
+    return tree
+
+
+def percent_of_parent(tree: dict, path: str,
+                      wall_s=None) -> float | None:
+    """Share of the parent phase's wall time this phase accounts for.
+    Roots are charged against ``wall_s`` (the run's first->last record
+    span) when known, else against the sum of all root phases."""
+    total = tree[path]["total_s"]
+    denom = 0.0
+    p = path
+    while "/" in p:
+        # nearest ancestor that actually has spans (a slash inside a
+        # single span NAME does not invent a phantom parent)
+        p = p.rsplit("/", 1)[0]
+        denom = tree.get(p, {}).get("total_s") or 0.0
+        if denom:
+            break
+    if not denom:
+        roots = [q for q in tree
+                 if not any(q != r and q.startswith(r + "/")
+                            for r in tree)]
+        denom = wall_s if wall_s else sum(
+            tree[q]["total_s"] for q in roots)
+        if path not in roots and not wall_s:
+            return None
+    if not denom:
+        return None
+    return 100.0 * total / denom
+
+
+def render_span_tree(records: list, wall_s=None) -> list:
+    tree = span_tree(records)
+    lines = []
+    if not tree:
+        return ["  (no spans)"]
+
+    def eff_depth(path):
+        # indent by ancestors that actually exist as spans, so a slash
+        # inside one span NAME does not indent under a phantom parent
+        return sum(1 for r in tree
+                   if r != path and path.startswith(r + "/"))
+
+    width = max(len(p.split("/")[-1]) + 2 * eff_depth(p)
+                for p in tree) + 2
+    for path in sorted(tree):
+        node = tree[path]
+        pct = percent_of_parent(tree, path, wall_s)
+        label = "  " * eff_depth(path) + path.split("/")[-1]
+        err = f"  errors={node['errors']}" if node["errors"] else ""
+        lines.append(
+            f"  {label:<{width}} {_fmt_s(node['total_s']):>10}"
+            f"  x{node['count']:<5}"
+            f" {'' if pct is None else f'{pct:5.1f}%':>7}{err}")
+    return lines
+
+
+def last_counters(records: list):
+    """The newest ``counters`` record (cumulative => run totals)."""
+    snap = None
+    for rec in records:
+        if rec.get("kind") == "counters":
+            snap = rec
+    return snap
+
+
+def render_counters(snap) -> list:
+    if snap is None:
+        return ["  (no counter snapshots)"]
+    lines = []
+    for kind in ("counters", "gauges"):
+        table = snap.get(kind) or {}
+        for key in sorted(table):
+            lines.append(f"  {key:<58} {_fmt_num(table[key]):>14}")
+    return lines or ["  (empty snapshot)"]
+
+
+def render_incidents(records: list, t0=None) -> list:
+    lines = []
+    for rec in records:
+        if rec.get("kind") not in ("incident", "replay"):
+            continue
+        rel = ("     -" if t0 is None or rec.get("t") is None
+               else f"{rec['t'] - t0:+9.2f}s")
+        what = rec.get("event") or rec.get("incident_kind") \
+            or rec.get("verdict") or rec["kind"]
+        extra = " ".join(
+            f"{k}={rec[k]}" for k in ("incident_kind", "step", "lane",
+                                      "retry", "verdict")
+            if rec.get(k) is not None and rec.get(k) != what)
+        lines.append(f"  seq={rec['seq']:<6} {rel}  {what:<22} {extra}")
+    return lines or ["  (no incidents)"]
+
+
+def cmd_summary(args) -> int:
+    path = resolve_ledger(args.ledger)
+    records = read_ledger(path)
+    if not records:
+        print(f"[obs] no readable records in {path}", file=sys.stderr)
+        return 1
+    start = next((r for r in records if r.get("kind") == "run_start"),
+                 records[0])
+    end = next((r for r in records if r.get("kind") == "run_end"), None)
+    times = [r["t"] for r in records if isinstance(r.get("t"),
+                                                   (int, float))]
+    wall = (max(times) - min(times)) if len(times) > 1 else None
+    print(f"run_id: {start.get('run_id')}   records: {len(records)}"
+          f"   wall: {_fmt_s(wall)}"
+          + ("" if end is None else
+             f"   obs_overhead: {_fmt_s(end.get('overhead_s'))}"))
+    fp = start.get("fingerprint") or {}
+    if fp:
+        print(f"fingerprint: platform={fp.get('platform')}"
+              f" engine={fp.get('engine')}"
+              f" spectral_dtype={fp.get('spectral_dtype')}"
+              f" config_digest={str(fp.get('config_digest'))[:12]}")
+    print("\nphases (total, calls, % of parent):")
+    for ln in render_span_tree(records, wall):
+        print(ln)
+    print("\ncounters (last snapshot = run totals):")
+    for ln in render_counters(last_counters(records)):
+        print(ln)
+    print("\nincidents:")
+    t0 = min(times) if times else None
+    for ln in render_incidents(records, t0):
+        print(ln)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# tail
+# ---------------------------------------------------------------------------
+
+def _one_line(rec: dict) -> str:
+    kind = rec.get("kind")
+    if kind == "span":
+        return (f"seq={rec['seq']:<6} span      "
+                f"{rec.get('path')}  {_fmt_s(rec.get('dur_s'))}")
+    if kind == "counters":
+        n = len(rec.get("counters") or {}) + len(rec.get("gauges") or {})
+        return (f"seq={rec['seq']:<6} counters  step={rec.get('step')} "
+                f"chunk={_fmt_s(rec.get('chunk_wall_s'))} "
+                f"({n} metrics)")
+    body = {k: v for k, v in rec.items()
+            if k not in ("seq", "run_id", "t", "kind")}
+    return f"seq={rec['seq']:<6} {kind:<9} {json.dumps(body)[:140]}"
+
+
+def cmd_tail(args) -> int:
+    path = resolve_ledger(args.ledger)
+    hb_path = args.heartbeat or os.path.join(
+        os.path.dirname(path) or ".", "heartbeat.json")
+    from ibamr_tpu.utils.watchdog import heartbeat_age
+    seen = -1
+    deadline = (time.monotonic() + args.max_seconds
+                if args.max_seconds else None)
+    last_hb_print = 0.0
+    while True:
+        for rec in read_ledger(path):
+            if rec["seq"] > seen:
+                seen = rec["seq"]
+                print(_one_line(rec), flush=True)
+        now = time.monotonic()
+        if now - last_hb_print >= args.heartbeat_every:
+            last_hb_print = now
+            age = heartbeat_age(hb_path)
+            if age is not None:
+                print(f"[heartbeat] age={age:.1f}s ({hb_path})",
+                      file=sys.stderr, flush=True)
+        if deadline is not None and now >= deadline:
+            return 0
+        time.sleep(args.interval)
+
+
+# ---------------------------------------------------------------------------
+# compare
+# ---------------------------------------------------------------------------
+
+def _is_ledger(path: str) -> bool:
+    return os.path.isdir(path) or path.endswith(".jsonl")
+
+
+def _bench_payload(path: str) -> dict:
+    """Accept a raw ``bench.py`` JSON or a ``BENCH_r*.json`` wrapper
+    (the relay driver stores the parsed result under ``parsed``)."""
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, dict) and isinstance(data.get("parsed"), dict):
+        return data["parsed"]
+    return data
+
+
+def _delta_line(name: str, a, b) -> str:
+    if a in (None, 0) or b is None:
+        return f"  {name:<34} {_fmt_num(a):>12} -> {_fmt_num(b):>12}"
+    return (f"  {name:<34} {_fmt_num(a):>12} -> {_fmt_num(b):>12}"
+            f"   {100.0 * (float(b) - float(a)) / float(a):+7.1f}%")
+
+
+def compare_ledgers(path_a: str, path_b: str) -> list:
+    lines = []
+    ta = span_tree(read_ledger(resolve_ledger(path_a)))
+    tb = span_tree(read_ledger(resolve_ledger(path_b)))
+    lines.append("per-phase wall (A -> B):")
+    for path in sorted(set(ta) | set(tb)):
+        a = ta.get(path, {}).get("total_s")
+        b = tb.get(path, {}).get("total_s")
+        lines.append(_delta_line(path, a, b))
+    ca = last_counters(read_ledger(resolve_ledger(path_a)))
+    cb = last_counters(read_ledger(resolve_ledger(path_b)))
+    if ca or cb:
+        lines.append("counters (last snapshot, A -> B):")
+        ka = (ca or {}).get("counters") or {}
+        kb = (cb or {}).get("counters") or {}
+        for key in sorted(set(ka) | set(kb)):
+            lines.append(_delta_line(key, ka.get(key), kb.get(key)))
+    return lines
+
+
+def compare_bench(path_a: str, path_b: str) -> list:
+    a, b = _bench_payload(path_a), _bench_payload(path_b)
+    lines = []
+    sa = {s.get("n"): s for s in (a.get("stages") or [])}
+    sb = {s.get("n"): s for s in (b.get("stages") or [])}
+    lines.append("stages steps/s (A -> B):")
+    for n in sorted(set(sa) | set(sb), key=lambda x: (x is None, x)):
+        lines.append(_delta_line(
+            f"n={n}", sa.get(n, {}).get("steps_per_sec"),
+            sb.get(n, {}).get("steps_per_sec")))
+    pa, pb = a.get("phases") or {}, b.get("phases") or {}
+    keys = [k for k in sorted(set(pa) | set(pb))
+            if isinstance(pa.get(k), (int, float))
+            or isinstance(pb.get(k), (int, float))]
+    if keys:
+        lines.append("phases (A -> B):")
+        for k in keys:
+            lines.append(_delta_line(k, pa.get(k), pb.get(k)))
+    for key in ("value", "mxu_vs_scatter"):
+        if a.get(key) is not None or b.get(key) is not None:
+            lines.append(_delta_line(key, a.get(key), b.get(key)))
+    return lines
+
+
+def cmd_compare(args) -> int:
+    if _is_ledger(args.a) and _is_ledger(args.b):
+        lines = compare_ledgers(args.a, args.b)
+    else:
+        lines = compare_bench(args.a, args.b)
+    print(f"A: {args.a}\nB: {args.b}")
+    for ln in lines:
+        print(ln)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="run-ledger summary / tail / compare")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("summary", help="phase tree + counters + "
+                                       "incident timeline")
+    s.add_argument("ledger", help="ledger.jsonl or its directory")
+    s.set_defaults(fn=cmd_summary)
+
+    t = sub.add_parser("tail", help="follow a growing ledger (plus "
+                                    "heartbeat staleness)")
+    t.add_argument("ledger")
+    t.add_argument("--interval", type=float, default=1.0)
+    t.add_argument("--heartbeat", default="",
+                   help="heartbeat.json (default: next to the ledger)")
+    t.add_argument("--heartbeat-every", type=float, default=5.0)
+    t.add_argument("--max-seconds", type=float, default=0.0,
+                   help="exit after this long (0 = follow forever)")
+    t.set_defaults(fn=cmd_tail)
+
+    c = sub.add_parser("compare", help="two ledgers, or two bench "
+                                       "JSONs (BENCH_r*.json)")
+    c.add_argument("a")
+    c.add_argument("b")
+    c.set_defaults(fn=cmd_compare)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -151,6 +151,12 @@ def main() -> int:
     ap.add_argument("--stall-after", type=float, default=300.0,
                     help="bench heartbeat age (s) that counts as a "
                          "stall; 3x this kills the bench early")
+    ap.add_argument("--profile-stages", type=str,
+                    default="n256,packed*",
+                    help="stage globs the bench profiles on a healthy "
+                         "window (bench.py --profile-stages); captures "
+                         "land under <--out stem>_profile/ as "
+                         "<stage>_<gitrev>/ ('' disables)")
     args = ap.parse_args()
 
     from ibamr_tpu.utils.backend_guard import probe_accelerator
@@ -172,11 +178,19 @@ def main() -> int:
         env.pop("JAX_PLATFORMS", None)  # let the container default win
         hb_path = args.out.replace(".json", "_heartbeat.json")
         record_dir = args.out.replace(".json", "_record")
+        profile_dir = args.out.replace(".json", "_profile")
+        bench_cmd = [sys.executable, os.path.join(REPO, "bench.py"),
+                     "--stages", "64,128,256", "--heartbeat", hb_path,
+                     "--record", record_dir, "--fleet", "8"]
+        if args.profile_stages:
+            # device profiles of the named stages ride the same healthy
+            # window; they are the only trace-level artifact a dead
+            # relay cannot be asked for afterwards
+            bench_cmd += ["--profile", profile_dir,
+                          "--profile-stages", args.profile_stages]
         t0 = time.time()
         rc, out, err, stalled = run_bench_watched(
-            [sys.executable, os.path.join(REPO, "bench.py"),
-             "--stages", "64,128,256", "--heartbeat", hb_path,
-             "--record", record_dir, "--fleet", "8"],
+            bench_cmd,
             f, env, args.bench_timeout, hb_path, args.stall_after,
             record_dir=record_dir)
         if rc is None:
@@ -194,6 +208,13 @@ def main() -> int:
                 json.dump(result, g, indent=1)
             log(f, f"CAPTURED TPU bench -> {args.out}")
             captures += 1
+            profs = [d for d in (result.get("profiles") or [])
+                     if os.path.isdir(d)]
+            if profs:
+                log(f, "profile captures: " + ", ".join(profs))
+            elif args.profile_stages:
+                log(f, "no profile captures landed (stages skipped "
+                       "or profiler unavailable)")
             # follow with the per-engine microbench while the window is warm
             try:
                 r2 = subprocess.run(

@@ -499,6 +499,10 @@ def replay(capsule_dir: str, overrides: dict | None = None,
         verdict = "reproduced"
         result["dt_dependent"] = True
     result["verdict"] = verdict
+    from ibamr_tpu import obs as _obs
+    _obs.counter("replay_verdicts_total", verdict=verdict).inc()
+    _obs.emit("replay", verdict=verdict, step=result.get("step"),
+              override_failed=result.get("override_failed"))
     return result
 
 
