@@ -298,28 +298,45 @@ def git_short_rev() -> str:
         return "norev"
 
 
-def stage_profile_dir(args, label: str, rev: str) -> str:
+def stage_profile_dir(args, label: str, rev: str,
+                      used=None) -> str:
     """Capture dir for one stage under ``--profile-stages``, or ``""``
     (no capture). ``--profile-stages`` is a comma-separated list of
     fnmatch globs over stage labels — ramp stages are ``n<size>``
-    (``n256``), flagship legs their engine label (``packed*``)."""
+    (``n256``), flagship legs their engine label (``packed*``).
+
+    ``used`` (a per-run dict the caller owns) de-collides repeated
+    labels: two stages sharing a label under the same rev used to get
+    the SAME dir, interleaving their traces into one unusable capture
+    — now the repeat gets a ``_2``/``_3`` suffix and a warning."""
     import fnmatch
     if not args.profile or not args.profile_stages:
         return ""
     pats = [p.strip() for p in args.profile_stages.split(",")
             if p.strip()]
-    if any(fnmatch.fnmatch(label, p) for p in pats):
-        return os.path.join(args.profile, f"{label}_{rev}")
-    return ""
+    if not any(fnmatch.fnmatch(label, p) for p in pats):
+        return ""
+    d = os.path.join(args.profile, f"{label}_{rev}")
+    if used is not None:
+        n = used.get(d, 0) + 1
+        used[d] = n
+        if n > 1:
+            log(f"[bench] profile label {label!r} repeats under rev "
+                f"{rev}; capturing into {label}_{rev}_{n} instead")
+            d = f"{d}_{n}"
+    return d
 
 
 def run_engine_leg(jax, label, engine, n, n_lat, n_lon, args, t_start,
-                   platform):
+                   platform, profile_dir=None):
     """One transfer-engine leg at size ``n``: pallas engines run in a
     TERMINABLE child with a deadline-derived budget (remote-compile
     stall history) and must land on the parent's platform; the rest
     run in-process. Shared by the flagship shootout and the mid-size
-    compare so the guard policy cannot drift between them."""
+    compare so the guard policy cannot drift between them.
+    ``profile_dir`` arms the in-stage device capture (pallas/hybrid
+    children excepted: the profiler is per-process and the child owns
+    the step there)."""
     if label == "fluid_bf16":
         # mixed-precision FLUID leg: the best non-pallas transfer
         # engine (packed_bf16) plus bf16/split-real spectral
@@ -327,7 +344,8 @@ def run_engine_leg(jax, label, engine, n, n_lat, n_lon, args, t_start,
         # floor itself
         return run_stage(jax, n, n_lat, n_lon, args.steps, args.warmup,
                          args.dt, use_fast="packed_bf16",
-                         spectral_dtype="bf16")
+                         spectral_dtype="bf16", profile_dir=profile_dir,
+                         profile_stage=label)
     if label.startswith(("pallas", "hybrid")):
         # guarded child: these engines contain Pallas programs (the
         # relay's remote-compile service stalled on one in round 2)
@@ -346,7 +364,8 @@ def run_engine_leg(jax, label, engine, n, n_lat, n_lon, args, t_start,
                                f"{platform!r}")
         return st
     return run_stage(jax, n, n_lat, n_lon, args.steps, args.warmup,
-                     args.dt, use_fast=engine)
+                     args.dt, use_fast=engine, profile_dir=profile_dir,
+                     profile_stage=label)
 
 
 def phase_breakdown(jax, integ, state, dt: float, iters: int = 10) -> dict:
@@ -451,7 +470,8 @@ def phase_breakdown(jax, integ, state, dt: float, iters: int = 10) -> dict:
 def run_stage(jax, n: int, n_lat: int, n_lon: int, steps: int,
               warmup: int, dt: float, use_fast=None,
               fast_opts=None, spectral_dtype=None,
-              record_dir=None) -> dict:
+              record_dir=None, profile_dir=None,
+              profile_stage=None) -> dict:
     """Build the shell config at one grid size and time the jitted step.
     ``fast_opts=(tile, cap)`` overrides the MXU engine geometry (the
     cap/tile sweep); ``spectral_dtype="bf16"`` opts the fluid substep
@@ -460,7 +480,19 @@ def run_stage(jax, n: int, n_lat: int, n_lon: int, steps: int,
     (host-side, before donation can invalidate it) and a non-finite
     finish dumps a ``record_dir/incidents`` replay capsule carrying the
     exact factory spec — ``tools/replay.py`` rebuilds the stage from it
-    offline (docs/RESILIENCE.md)."""
+    offline (docs/RESILIENCE.md).
+
+    ``profile_dir`` captures a device profile of the MEASURED loop
+    only — the capture starts after compile+warmup, because the
+    trace-viewer JSON export caps at 1e6 events and a multi-second
+    XLA compile floods it with python-tracer events, truncating the
+    device-op events attribution needs (measured: an 8 s in-capture
+    compile left 25 op events of a 4-step run). The capture also gets
+    the ``census_counts.json`` roofline sidecar: the PR-8 byte/flop
+    census of one step jaxpr plus the exact number of step launches
+    captured, so ``tools/prof.py`` can turn attributed seconds into
+    achieved GB/s — traced while the step function is still in hand
+    (trace only, no extra compile)."""
     from ibamr_tpu.models.shell3d import build_shell_example
 
     integ, state = build_shell_example(
@@ -503,7 +535,9 @@ def run_stage(jax, n: int, n_lat: int, n_lon: int, steps: int,
         # true barrier.
         jax.device_get(s.X[0])
 
-    def timed_run():
+    from ibamr_tpu.utils.timers import profile_trace
+
+    def timed_run(capture_dir=""):
         nonlocal state
         t_c0 = time.perf_counter()
         for _ in range(max(warmup, 1)):
@@ -512,24 +546,31 @@ def run_stage(jax, n: int, n_lat: int, n_lon: int, steps: int,
         compile_s = time.perf_counter() - t_c0
 
         # accumulate refresh hits as a device scalar (no per-step sync;
-        # a host round-trip per step would poison the timing)
+        # a host round-trip per step would poison the timing); the
+        # profile capture brackets EXACTLY these `steps` launches (the
+        # census sidecar's executions count) — trace start/stop sit
+        # outside the timed window
         hit_acc = None
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            state, st_stats = step(state, dt)
-            rh = st_stats.get("refresh_hit")
-            if rh is not None:
-                rh = rh.astype(jax.numpy.int32)
-                hit_acc = rh if hit_acc is None else hit_acc + rh
-        hard_sync(state)
-        elapsed = time.perf_counter() - t0
+        elapsed = 0.0
+        with profile_trace(capture_dir, stage=profile_stage):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                state, st_stats = step(state, dt)
+                rh = st_stats.get("refresh_hit")
+                if rh is not None:
+                    rh = rh.astype(jax.numpy.int32)
+                    hit_acc = rh if hit_acc is None else hit_acc + rh
+            hard_sync(state)
+            elapsed = time.perf_counter() - t0
         if hit_acc is not None:
             hit_acc = int(jax.device_get(hit_acc))
         return compile_s, elapsed, hit_acc
 
-    compile_s, elapsed, refresh_hits = timed_run()
+    compile_s, elapsed, refresh_hits = timed_run(
+        capture_dir=profile_dir or "")
     # plausibility floor: one 256^3 step streams >1 GB of HBM; anything
     # under 1 ms/step at n>=128 is a relay timing artifact -> remeasure
+    # (without re-capturing: the profiler session already closed)
     if n >= 128 and (elapsed / steps) * 1e3 < 1.0:
         log(f"[bench] n={n}: implausible {elapsed / steps * 1e3:.3f} "
             "ms/step; remeasuring once")
@@ -563,6 +604,24 @@ def run_stage(jax, n: int, n_lat: int, n_lon: int, steps: int,
         # cheap re-gather, falls paid a full re-pack (drift bound blown)
         out["refresh_hits"] = refresh_hits
         out["repack_falls"] = steps - refresh_hits
+    if profile_dir:
+        # roofline sidecar beside the capture; never let a census
+        # hiccup (an exotic engine's trace failing) cost the stage
+        try:
+            from ibamr_tpu.obs import deviceprof
+            from ibamr_tpu.obs.roofline import census_sidecar
+
+            census = census_sidecar(
+                lambda s: step(s, dt)[0], (state,),
+                label=profile_stage or f"n{n}",
+                executions=steps, n=n, markers=n_markers)
+            os.makedirs(profile_dir, exist_ok=True)
+            with open(os.path.join(profile_dir,
+                                   deviceprof.CENSUS_NAME), "w") as f:
+                json.dump(census, f, indent=1, sort_keys=True)
+        except Exception as e:  # noqa: BLE001
+            log(f"[bench] census sidecar failed for n={n}: "
+                f"{type(e).__name__}: {e}")
     return out
 
 
@@ -639,12 +698,52 @@ def main():
     }
     orig_steps, orig_deadline = args.steps, args.deadline
     profile_rev = git_short_rev() if args.profile_stages else "norev"
+    profile_dirs_used = {}
 
     def profile_dir_for(label: str) -> str:
-        d = stage_profile_dir(args, label, profile_rev)
+        d = stage_profile_dir(args, label, profile_rev,
+                              used=profile_dirs_used)
         if d:
-            result["profiles"].append(d)
+            # manifest entries are dicts since PR 10 (was: bare path
+            # strings — tools/obs.py compare still reads those from
+            # old bench JSONs); attribute_profile fills bytes/summary
+            # once the capture closes
+            result["profiles"].append(
+                {"dir": d, "stage": label, "rev": profile_rev,
+                 "bytes": None, "attributed": False})
         return d
+
+    def attribute_profile(d: str) -> None:
+        """Post-capture: record the capture's on-disk weight and
+        attribute it in-process (offline parsing — a failure costs the
+        summary, never the bench)."""
+        if not d:
+            return
+        entry = next((e for e in result["profiles"]
+                      if isinstance(e, dict) and e.get("dir") == d),
+                     None)
+        if entry is None:
+            return
+        try:
+            from ibamr_tpu.obs import deviceprof
+
+            entry["bytes"] = deviceprof.capture_bytes(d)
+            if not deviceprof.find_trace_files(d):
+                # a guarded-child leg (pallas) or failed stage leaves
+                # the dir empty: say so instead of writing a vacuous
+                # all-zero summary
+                raise FileNotFoundError("no trace files captured")
+            summary = deviceprof.attribute_capture(d)
+            probs = deviceprof.validate_summary(summary)
+            if probs:
+                raise ValueError("; ".join(probs))
+            deviceprof.write_summary(d, summary)
+            entry["summary"] = deviceprof.compact_summary(summary)
+            entry["attributed"] = True
+        except Exception as e:  # noqa: BLE001
+            entry["error"] = f"{type(e).__name__}: {e}"
+            log(f"[bench] profile attribution failed for {d}: "
+                f"{entry['error']}")
 
     try:
         from ibamr_tpu.utils.backend_guard import init_backend_with_retry
@@ -713,25 +812,25 @@ def main():
             n_lon = max(16, int(round(args.n_lon * frac)))
             try:
                 log(f"[bench] stage n={n} markers~{n_lat * n_lon} ...")
-                from ibamr_tpu.utils.timers import profile_trace
-
                 t_stage = time.perf_counter()
-                with profile_trace(
-                        profile_dir_for(f"n{n}")
-                        if args.profile_stages
-                        else (args.profile if n == args.n else "")):
-                    # the ramp pins the BUCKETED-MXU engine: it has been
-                    # the staged baseline since round 1, and keeping it
-                    # preserves the longitudinal r1/r3/r5 comparison now
-                    # that the model's auto default is the (faster)
-                    # packed engine; the shootout below times the fast
-                    # engines at the target size
-                    stage = run_stage(jax, n, n_lat, n_lon, args.steps,
-                                      args.warmup, args.dt,
-                                      use_fast=True,
-                                      record_dir=(os.path.join(
-                                          args.record, f"n{n}")
-                                          if args.record else None))
+                pd = (profile_dir_for(f"n{n}") if args.profile_stages
+                      else (args.profile if n == args.n else ""))
+                # the ramp pins the BUCKETED-MXU engine: it has been
+                # the staged baseline since round 1, and keeping it
+                # preserves the longitudinal r1/r3/r5 comparison now
+                # that the model's auto default is the (faster)
+                # packed engine; the shootout below times the fast
+                # engines at the target size. run_stage owns the
+                # profile capture (measured loop only — see its doc).
+                stage = run_stage(jax, n, n_lat, n_lon, args.steps,
+                                  args.warmup, args.dt,
+                                  use_fast=True,
+                                  record_dir=(os.path.join(
+                                      args.record, f"n{n}")
+                                      if args.record else None),
+                                  profile_dir=(pd or None),
+                                  profile_stage=f"n{n}")
+                attribute_profile(pd)
                 log(f"[bench] stage n={n}: {stage['steps_per_sec']} "
                     "steps/s")
                 if wd is not None:
@@ -768,11 +867,12 @@ def main():
                     continue
                 try:
                     t_leg = time.perf_counter()
-                    from ibamr_tpu.utils.timers import profile_trace
-                    with profile_trace(profile_dir_for(label)):
-                        st = run_engine_leg(jax, label, label, args.n,
-                                            args.n_lat, args.n_lon,
-                                            args, t_start, platform)
+                    pd = profile_dir_for(label)
+                    st = run_engine_leg(jax, label, label, args.n,
+                                        args.n_lat, args.n_lon,
+                                        args, t_start, platform,
+                                        profile_dir=(pd or None))
+                    attribute_profile(pd)
                     st["platform"] = platform
                     log(f"[bench] flagship {label}: "
                         f"{st['steps_per_sec']} steps/s")

@@ -22,8 +22,17 @@ artifacts) and <2% warm-chunk wall overhead (self-accounted in
 ``RunLedger.overhead_s``, pinned like the flight recorder's). All
 host-side work rides the existing one-transfer-per-chunk sync points.
 
+PR 10 adds the read-back half: :mod:`ibamr_tpu.obs.deviceprof` parses
+``jax.profiler`` captures and attributes device-lane op time back to
+span paths (the ledger's ``device_time`` record / ``prof_summary.json``
+artifact), and :mod:`ibamr_tpu.obs.roofline` joins that time with the
+PR-8 graph-census byte/flop counts into achieved-bandwidth numbers.
+Both are offline, stdlib-only, and imported lazily here — attaching a
+ledger to a run never pays for the trace parser.
+
 See docs/OBSERVABILITY.md for the ledger schema and the CLI cookbook
-(``tools/obs.py summary | tail | compare``).
+(``tools/obs.py summary | tail | compare``,
+``tools/prof.py attribute | diff | archive``).
 """
 
 from ibamr_tpu.obs.bus import (  # noqa: F401

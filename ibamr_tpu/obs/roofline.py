@@ -1,0 +1,142 @@
+"""Roofline join: attributed device time x graph-census counts (PR 10).
+
+PR 8's ``graph_census`` counts what a step graph *moves and computes*
+(``fft_bytes``, ``dot_flops`` — static, from the jaxpr); PR 10's
+``deviceprof`` measures where device time *went* (dynamic, from the
+profiler trace). Neither alone answers the question ROADMAP item 3
+keeps open — "are the hot loops near the machine's roof, or is there
+headroom?" — because bytes without seconds give no bandwidth and
+seconds without bytes give no efficiency. This module is the join:
+
+    achieved FFT GB/s   = fft_bytes_per_step / fft_seconds_per_step
+    achieved dot GFLOP/s = dot_flops_per_step / dot_seconds_per_step
+    fraction_of_step_accounted = (fft_s + dot_s) / total_device_s
+
+The census side arrives as the ``census_counts.json`` sidecar
+``bench.py`` writes into each ``--profile-stages`` capture dir at
+capture time (when the jaxpr is still in hand); the time side is the
+``op_classes`` table :func:`deviceprof.attribute_events` tallies from
+the trace. ``executions`` (how many step/chunk launches ran under the
+capture) normalizes both to per-execution numbers.
+
+Like ``deviceprof``, this is offline and host-side: stdlib only, pure
+functions over two dicts. No peak-bandwidth table is hardcoded — the
+CPU backend this repo tests on has no meaningful roof, and the TPU
+roof belongs in the reader's head (or a future budgets file), not
+baked into the artifact. The artifact reports *achieved* rates;
+"fraction of roof" is a presentation-layer division.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+__all__ = ["roofline_join", "census_sidecar", "render_roofline"]
+
+
+def _get(d: dict, key: str, default=0):
+    v = d.get(key, default)
+    return v if isinstance(v, (int, float)) else default
+
+
+def roofline_join(summary: dict, census: dict) -> Optional[dict]:
+    """Join one attribution summary with its census sidecar.
+
+    ``summary`` needs ``op_classes`` (``fft_s``/``dot_s``) and
+    ``total_device_s``; ``census`` needs the ``fft_census``/
+    ``dot_census`` byte/flop counts plus ``executions``. Returns the
+    roofline block for ``prof_summary.json``, or None when the join is
+    impossible (no executions recorded, or no device time)."""
+    execs = _get(census, "executions")
+    op_classes = summary.get("op_classes") or {}
+    total = _get(summary, "total_device_s")
+    if execs <= 0 or total <= 0:
+        return None
+    fft_s = _get(op_classes, "fft_s")
+    dot_s = _get(op_classes, "dot_s")
+    fft_bytes = _get(census, "fft_bytes")
+    dot_bytes = (_get(census, "dot_lhs_bytes")
+                 + _get(census, "dot_rhs_bytes")
+                 + _get(census, "dot_out_bytes"))
+    dot_flops = _get(census, "dot_flops")
+    out = {
+        "executions": int(execs),
+        "device_s_per_execution": round(total / execs, 9),
+        "fft": None,
+        "dot": None,
+        # how much of the measured device time the two censused op
+        # classes explain — low values mean the step is dominated by
+        # ops the census does not model (elementwise fusions, copies)
+        "fraction_of_step_accounted": round((fft_s + dot_s) / total, 6),
+    }
+    if fft_bytes > 0 and fft_s > 0:
+        per_exec_s = fft_s / execs
+        out["fft"] = {
+            "bytes_per_execution": int(fft_bytes),
+            "device_s_per_execution": round(per_exec_s, 9),
+            "achieved_gb_per_s": round(fft_bytes / per_exec_s / 1e9, 3),
+            "fft_ops": int(_get(census, "fft_ops")),
+        }
+    if dot_flops > 0 and dot_s > 0:
+        per_exec_s = dot_s / execs
+        out["dot"] = {
+            "flops_per_execution": int(dot_flops),
+            "bytes_per_execution": int(dot_bytes),
+            "device_s_per_execution": round(per_exec_s, 9),
+            "achieved_gflop_per_s": round(
+                dot_flops / per_exec_s / 1e9, 3),
+            "achieved_gb_per_s": round(dot_bytes / per_exec_s / 1e9, 3)
+            if dot_bytes > 0 else None,
+            "dot_count": int(_get(census, "dot_count")),
+        }
+    return out
+
+
+def census_sidecar(fn, args, label: str = "",
+                   executions: int = 0, **extra) -> dict:
+    """Build the ``census_counts.json`` document for one captured
+    stage: trace ``fn(*args)`` (trace only — no compile) and run the
+    PR-8 byte/flop censuses over the jaxpr. Called by ``bench.py`` at
+    capture time, when the step function and its arguments are still
+    in hand; everything downstream is offline."""
+    import jax
+
+    from ibamr_tpu.analysis.graph_census import dot_census, fft_census
+
+    jaxpr = jax.make_jaxpr(fn)(*args).jaxpr
+    out = {"schema": 1, "label": label, "executions": int(executions)}
+    out.update(fft_census(jaxpr))
+    out.pop("fft_transforms", None)       # shapes, not needed downstream
+    out.update(dot_census(jaxpr))
+    out.update(extra)
+    return out
+
+
+def render_roofline(roofline: Optional[dict]) -> List[str]:
+    """Human lines for ``tools/prof.py show``."""
+    if not roofline:
+        return ["  (no roofline: census sidecar or executions missing)"]
+    lines = [
+        f"  executions: {roofline.get('executions')}   "
+        f"device {roofline.get('device_s_per_execution', 0) * 1e3:.3f} "
+        f"ms/execution   "
+        f"accounted by fft+dot: "
+        f"{100.0 * (roofline.get('fraction_of_step_accounted') or 0):.1f}%"
+    ]
+    fft = roofline.get("fft")
+    if fft:
+        lines.append(
+            f"  fft: {fft['bytes_per_execution'] / 1e6:.2f} MB/exec in "
+            f"{fft['device_s_per_execution'] * 1e3:.3f} ms -> "
+            f"{fft['achieved_gb_per_s']:.2f} GB/s achieved "
+            f"({fft['fft_ops']} transforms)")
+    dot = roofline.get("dot")
+    if dot:
+        gb = (f", {dot['achieved_gb_per_s']:.2f} GB/s"
+              if dot.get("achieved_gb_per_s") else "")
+        lines.append(
+            f"  dot: {dot['flops_per_execution'] / 1e6:.2f} MFLOP/exec "
+            f"in {dot['device_s_per_execution'] * 1e3:.3f} ms -> "
+            f"{dot['achieved_gflop_per_s']:.2f} GFLOP/s achieved{gb} "
+            f"({dot['dot_count']} contractions)")
+    return lines

@@ -107,20 +107,32 @@ def timer(name: str, block_on=None):
 
 
 @contextmanager
-def profile_trace(log_dir: Optional[str]):
+def profile_trace(log_dir: Optional[str], stage: Optional[str] = None):
     """Capture a jax/XLA device profile for the enclosed region
     (SURVEY.md §5.1 — the deep-dive layer under TimerManager's wall
     timers, viewable in TensorBoard / Perfetto). No-op when ``log_dir``
     is falsy, so call sites can thread a ``--profile DIR`` flag through
     unconditionally. The ``named_scope`` annotations that TimerManager
-    already emits show up as trace regions."""
+    already emits show up as trace regions.
+
+    PR 10 rides the bus: the capture runs inside an
+    ``obs.span("profile_trace", capture_dir=..., stage=...)``, and a
+    ``profile`` ledger record lands when the trace closes — so
+    ``tools/obs.py tail`` shows a profile landing live, and the ledger
+    names the capture dir ``tools/prof.py attribute`` should be
+    pointed at. Telemetry-off runs pay only the span's no-op path."""
     if not log_dir:
         yield
         return
     import jax.profiler as _prof
 
-    _prof.start_trace(log_dir)
-    try:
-        yield
-    finally:
-        _prof.stop_trace()
+    from ibamr_tpu import obs
+
+    with obs.span("profile_trace", capture_dir=str(log_dir),
+                  stage=stage):
+        _prof.start_trace(log_dir)
+        try:
+            yield
+        finally:
+            _prof.stop_trace()
+            obs.emit("profile", capture_dir=str(log_dir), stage=stage)

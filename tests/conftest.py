@@ -123,6 +123,9 @@ SLOW_TESTS = {
     "test_intrinsic_curvature_equilibrium",
     "test_vortex_matches_uniform_fine",
     "test_profile_trace_writes_trace",
+    # PR 10: real jax.profiler capture + attribute round trip (~30 s:
+    # one jit compile, a 40-step captured run, and trace parsing)
+    "test_real_capture_attributes_driver_chunk",
     "test_gib_twisted_rod_relaxes",
     "test_project_vc_divergence_free",
     "test_pallas_total_force_conserved",

@@ -182,6 +182,63 @@ def render_incidents(records: list, t0=None) -> list:
     return lines or ["  (no incidents)"]
 
 
+# ---------------------------------------------------------------------------
+# the device column (PR 10): host spans x attributed device time
+# ---------------------------------------------------------------------------
+
+def device_spans(records: list, summary_path: str = ""):
+    """Per-span device seconds + total, from an explicit
+    ``prof_summary.json`` or from the ledger's LAST ``device_time``
+    record (``tools/prof.py attribute --ledger`` appends one).
+    Returns ``(spans, total_device_s)`` or ``None``."""
+    if summary_path:
+        from ibamr_tpu.obs.deviceprof import read_summary
+
+        s = read_summary(summary_path)
+        spans = {k: (v.get("device_s") if isinstance(v, dict) else v)
+                 for k, v in (s.get("spans") or {}).items()}
+        return spans, s.get("total_device_s")
+    recs = [r for r in records if r.get("kind") == "device_time"]
+    if not recs:
+        return None
+    last = recs[-1]
+    return (last.get("spans") or {}), last.get("total_device_s")
+
+
+def render_device_table(records: list, dev) -> list:
+    """host vs attributed device time per phase: host share of the
+    run, device share of the capture, and the host/device gap — the
+    dispatch/python overhead the device never saw (a host phase much
+    wider than its device time is overhead; the reverse is a span that
+    closed before its async work drained)."""
+    spans, dev_total = dev
+    tree = span_tree(records)
+    host_total = sum(n["total_s"] for p, n in tree.items()
+                     if not any(p != r and p.startswith(r + "/")
+                                for r in tree)) or None
+    paths = sorted(set(tree) | set(spans))
+    if not paths:
+        return ["  (no spans on either side)"]
+    width = max(len(p) for p in paths) + 2
+    lines = [f"  {'phase':<{width}} {'host':>10} {'host%':>7}"
+             f" {'device':>10} {'dev%':>7} {'gap':>10}"]
+    for p in paths:
+        h = tree.get(p, {}).get("total_s")
+        d = spans.get(p)
+        hp = (f"{100.0 * h / host_total:6.1f}%"
+              if h is not None and host_total else "      -")
+        dp = (f"{100.0 * d / dev_total:6.1f}%"
+              if d is not None and dev_total else "      -")
+        gap = (_fmt_s(h - d) if h is not None and d is not None
+               else "-")
+        lines.append(f"  {p:<{width}} {_fmt_s(h):>10} {hp:>7}"
+                     f" {_fmt_s(d):>10} {dp:>7} {gap:>10}")
+    if dev_total is not None:
+        lines.append(f"  {'(device total)':<{width}} {'':>10} {'':>7}"
+                     f" {_fmt_s(dev_total):>10}")
+    return lines
+
+
 def cmd_summary(args) -> int:
     path = resolve_ledger(args.ledger)
     records = read_ledger(path)
@@ -207,6 +264,17 @@ def cmd_summary(args) -> int:
     print("\nphases (total, calls, % of parent):")
     for ln in render_span_tree(records, wall):
         print(ln)
+    if getattr(args, "device", None) is not None:
+        dev = device_spans(records, "" if args.device is True
+                           else args.device)
+        print("\ndevice time (host vs attributed device, per phase):")
+        if dev is None:
+            print("  (no device_time record in the ledger — run "
+                  "`tools/prof.py attribute <capture> --ledger ...`, "
+                  "or pass --device <prof_summary.json>)")
+        else:
+            for ln in render_device_table(records, dev):
+                print(ln)
     print("\ncounters (last snapshot = run totals):")
     for ln in render_counters(last_counters(records)):
         print(ln)
@@ -231,6 +299,14 @@ def _one_line(rec: dict) -> str:
         return (f"seq={rec['seq']:<6} counters  step={rec.get('step')} "
                 f"chunk={_fmt_s(rec.get('chunk_wall_s'))} "
                 f"({n} metrics)")
+    if kind == "profile":
+        return (f"seq={rec['seq']:<6} profile   "
+                f"stage={rec.get('stage')} -> {rec.get('capture_dir')}")
+    if kind == "device_time":
+        return (f"seq={rec['seq']:<6} device    "
+                f"{_fmt_s(rec.get('total_device_s'))} device, "
+                f"{100.0 * (rec.get('fraction_attributed') or 0):.1f}% "
+                f"attributed ({rec.get('capture_dir')})")
     body = {k: v for k, v in rec.items()
             if k not in ("seq", "run_id", "t", "kind")}
     return f"seq={rec['seq']:<6} {kind:<9} {json.dumps(body)[:140]}"
@@ -307,6 +383,23 @@ def compare_ledgers(path_a: str, path_b: str) -> list:
     return lines
 
 
+def _profile_entries(payload: dict) -> dict:
+    """{stage label: entry dict} from a bench JSON's ``profiles``
+    manifest — dict entries (PR 10: ``{dir, stage, rev, bytes,
+    attributed, summary?}``) or the bare path strings older bench
+    JSONs recorded (``<label>_<rev>`` dirs -> label)."""
+    out = {}
+    for e in payload.get("profiles") or []:
+        if isinstance(e, dict):
+            out[e.get("stage") or e.get("dir", "?")] = e
+        elif isinstance(e, str):
+            label = os.path.basename(os.path.normpath(e))
+            label = label.rsplit("_", 1)[0] if "_" in label else label
+            out[label] = {"dir": e, "stage": label, "bytes": None,
+                          "attributed": False}
+    return out
+
+
 def compare_bench(path_a: str, path_b: str) -> list:
     a, b = _bench_payload(path_a), _bench_payload(path_b)
     lines = []
@@ -328,6 +421,17 @@ def compare_bench(path_a: str, path_b: str) -> list:
     for key in ("value", "mxu_vs_scatter"):
         if a.get(key) is not None or b.get(key) is not None:
             lines.append(_delta_line(key, a.get(key), b.get(key)))
+    fa, fb = _profile_entries(a), _profile_entries(b)
+    if fa or fb:
+        lines.append("profiles (attributed device s/capture, A -> B;"
+                     " gate drift with tools/prof.py diff):")
+        for label in sorted(set(fa) | set(fb)):
+            lines.append(_delta_line(
+                f"device[{label}]",
+                ((fa.get(label) or {}).get("summary")
+                 or {}).get("total_device_s"),
+                ((fb.get(label) or {}).get("summary")
+                 or {}).get("total_device_s")))
     return lines
 
 
@@ -350,6 +454,12 @@ def main(argv=None) -> int:
     s = sub.add_parser("summary", help="phase tree + counters + "
                                        "incident timeline")
     s.add_argument("ledger", help="ledger.jsonl or its directory")
+    s.add_argument("--device", nargs="?", const=True, default=None,
+                   metavar="PROF_SUMMARY",
+                   help="add the host-vs-device table per phase, from "
+                        "the ledger's device_time record (bare flag) "
+                        "or an explicit prof_summary.json / capture "
+                        "dir")
     s.set_defaults(fn=cmd_summary)
 
     t = sub.add_parser("tail", help="follow a growing ledger (plus "

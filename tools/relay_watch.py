@@ -208,8 +208,13 @@ def main() -> int:
                 json.dump(result, g, indent=1)
             log(f, f"CAPTURED TPU bench -> {args.out}")
             captures += 1
-            profs = [d for d in (result.get("profiles") or [])
-                     if os.path.isdir(d)]
+            # manifest entries are dicts since PR 10 ({dir, stage,
+            # rev, bytes, attributed}); older bench revs emitted bare
+            # path strings — accept both
+            profs = [d for d in
+                     ((e.get("dir") if isinstance(e, dict) else e)
+                      for e in (result.get("profiles") or []))
+                     if d and os.path.isdir(d)]
             if profs:
                 log(f, "profile captures: " + ", ".join(profs))
             elif args.profile_stages:
@@ -274,6 +279,32 @@ def main() -> int:
                     g.write(r4.stdout or "")
             except subprocess.TimeoutExpired:
                 log(f, "graph_audit timed out")
+            # fifth step (PR 10): archive each profile capture — the
+            # attribution summary is the regression-comparable
+            # artifact; the raw multi-MB traces are pruned ONLY after
+            # `prof.py archive` schema-validated the summary (a
+            # malformed summary exits 2 and the raw trace survives for
+            # a human to parse)
+            for d in profs:
+                try:
+                    r5 = subprocess.run(
+                        [sys.executable,
+                         os.path.join(REPO, "tools", "prof.py"),
+                         "archive", d],
+                        capture_output=True, text=True, cwd=REPO,
+                        env=env, timeout=600)
+                    log(f, f"prof archive {d} rc={r5.returncode}\n"
+                           + "\n".join((r5.stdout or ""
+                                        ).strip().splitlines()[-3:])
+                           + ("\n" + "\n".join(
+                               (r5.stderr or ""
+                                ).strip().splitlines()[-5:])
+                              if r5.returncode else ""))
+                    if r5.returncode:
+                        log(f, f"prof archive FAILED for {d}; raw "
+                               "trace kept for manual attribution")
+                except subprocess.TimeoutExpired:
+                    log(f, f"prof archive timed out for {d}")
         else:
             log(f, "bench ran but did not produce a TPU JSON line; re-arming")
             time.sleep(args.interval)
