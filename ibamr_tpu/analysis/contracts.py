@@ -251,6 +251,21 @@ def _build_open_channel_step():
     return (lambda s: io.step(s)), (state,), ()
 
 
+def _build_served_chunk():
+    # the warm-pool router's first-step ack: a 1-step 2-lane fleet
+    # chunk with ONE live lane and one dead-on-arrival padding lane
+    # (pad_lanes). The serving path must lower the same in-scan
+    # structure as the batch fleet chunk — a padded request bucket
+    # cannot buy extra host transfers or scatters
+    from ibamr_tpu.serve.aot_cache import ExecutableCache
+    from ibamr_tpu.serve.router import BucketSpec, WarmPool
+
+    pool = WarmPool(BucketSpec(n_cells=_N, n_lat=_N_LAT, n_lon=_N_LON,
+                               lanes=2, engine="packed"),
+                    ExecutableCache())
+    return pool.contract_args(length=1, live=1)
+
+
 def _build_solo_step_256():
     from ibamr_tpu.models.shell3d import build_shell_example
 
@@ -319,6 +334,10 @@ ARTIFACTS: Dict[str, Artifact] = {
         Artifact("lane_fetch", _build_lane_fetch,
                  notes="per-lane capsule fetch (lane_slice) — zero "
                        "scatter/fft/host budget"),
+        Artifact("served_chunk", _build_served_chunk,
+                 notes="warm-pool 1-step ack chunk, 1 live + 1 padded "
+                       "lane; the serving path pins the same in-scan "
+                       "ceilings as the batch fleet chunk"),
         Artifact("open_channel_step", _build_open_channel_step,
                  notes="open-boundary stabilized-PPM step (saddle "
                        "Stokes); dtype-clean pin after the f64 "

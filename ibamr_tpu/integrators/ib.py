@@ -85,6 +85,10 @@ class IBMethod:
         # optional FastInteraction engine (ops.interaction_fast): the
         # bucketed-MXU formulation of spread/interp; None = scatter path
         self.fast = fast
+        # RESOLVED engine name (set by factory builders after auto
+        # resolution / fallback) — fingerprint and cache-key material;
+        # None = derive a label from the engine object's type
+        self.engine_name = None
 
     def compute_force(self, X: jnp.ndarray, U: jnp.ndarray,
                       t) -> jnp.ndarray:

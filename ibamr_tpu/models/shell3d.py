@@ -289,11 +289,13 @@ def build_shell_example(
     intermediates); ``"mxu_bf16"`` / ``"packed_bf16"`` = the MXU /
     packed engines with bf16-compressed contraction operands (halves
     the dominant HBM traffic; ~3 decimal digits of delta-weight
-    precision); False = XLA scatter/gather. None = auto: the
-    occupancy-packed engine when the grid is tile-divisible and the
-    marker count is large enough to matter (promoted from bucketed-MXU
-    after the round-5 on-chip shootout: packed measured 2.6x mxu at
-    256^3, roundoff-exact), scatter otherwise.
+    precision); False = XLA scatter/gather. None = auto, resolved by
+    :mod:`ibamr_tpu.models.engine_resolver` (``IBAMR_TRANSFER_ENGINE``
+    env override, ``IBAMR_TUNING_DB`` tuning file, else the built-in
+    promotion: the occupancy-packed engine when the grid is
+    tile-divisible and the marker count is large enough to matter,
+    scatter otherwise). The resolved name lands on ``ib.engine_name``
+    for fingerprinting/cache keying.
 
     ``engine_fallback`` (default True; knob ``IBMethod {
     engine_fallback = FALSE }``): when the chosen engine fails to
@@ -371,19 +373,15 @@ def build_shell_example(
     from ibamr_tpu.ops.delta import get_kernel
     support, _ = get_kernel(kernel)
     if use_fast_interaction is None:
-        # auto requires tile divisibility AND the make_geometry minimum
-        # extent (tile + support + 1) so small grids fall back to the
-        # scatter path instead of raising (ADVICE round 1). Round 5:
-        # auto picks the occupancy-PACKED engine — the on-chip shootout
-        # measured it 2.6x the bucketed-MXU engine at 256^3 (9.19 vs
-        # 3.53 steps/s) and 4.2x at 128^3, roundoff-exact vs the
-        # scatter oracle (bf16 compression stays opt-in: exactness is
-        # the default contract).
-        eligible = (
-            n_markers >= 4096
-            and all(v % 8 == 0 for v in n[:-1])
-            and all(v >= 8 + support + 1 for v in n[:-1]))
-        use_fast_interaction = "packed" if eligible else False
+        # auto resolves through the pluggable resolver (env override,
+        # tuning-DB file, else the built-in round-5 packed promotion)
+        # so the flight-recorder fingerprint and the serving cache key
+        # carry the RESOLVED engine, never the "auto" alias, and the
+        # ROADMAP autotuner has a seam to publish winners into
+        from ibamr_tpu.models.engine_resolver import resolve_engine
+        resolved = resolve_engine(n, n_markers, support)
+        use_fast_interaction = {
+            "scatter": False, "mxu": True}.get(resolved, resolved)
     _ENGINES = (True, False, None, "pallas", "packed", "pallas_packed",
                 "mxu_bf16", "packed_bf16", "packed3", "packed3_bf16",
                 "hybrid_packed", "hybrid_packed_bf16", "hybrid_bf16")
@@ -392,13 +390,18 @@ def build_shell_example(
             f"unknown use_fast_interaction {use_fast_interaction!r}; "
             f"one of {_ENGINES}")
     if engine_fallback:
-        fast, _eng = build_engine_with_fallback(
+        fast, eng_name = build_engine_with_fallback(
             use_fast_interaction, grid, structure.vertices, kernel)
     else:
+        from ibamr_tpu.ops.interaction_packed import normalize_engine_name
         fast = construct_transfer_engine(
             use_fast_interaction, grid, structure.vertices, kernel)
+        eng_name = normalize_engine_name(use_fast_interaction)
     ib = IBMethod(structure.force_specs(dtype=dtype), kernel=kernel,
                   fast=fast)
+    # the RESOLVED engine (post-auto-resolution, post-fallback): what
+    # the flight-recorder fingerprint and the serving cache key carry
+    ib.engine_name = eng_name
     integ = IBExplicitIntegrator(ins, ib, scheme="midpoint")
     state = integ.initialize(structure.vertices)
     return integ, state

@@ -1,0 +1,7 @@
+"""Serving layer: AOT executable cache + warm-pool scenario router.
+
+``aot_cache`` generalizes the SpectralPlan hash-cons
+(solvers/spectral_plan.py:get_plan) from FFT symbol tables to whole
+compiled executables; ``router`` packs scenario requests into
+pre-compiled fleet-lane buckets on top of it. See docs/SERVING.md.
+"""

@@ -1,0 +1,448 @@
+"""Warm-pool scenario router: scenario requests onto pre-compiled
+fleet-lane buckets.
+
+Request lifecycle (docs/SERVING.md):
+
+1. **submit** — requests are grouped by scenario family (shape,
+   engine, spectral dtype, physics constants baked into the closure).
+2. **bucket** — each group is packed into the nearest declared
+   ``(family, B)`` bucket: smallest ``B >= group size``; oversize
+   groups split across batches; short groups are PADDED to ``B`` with
+   copies of their last lane marked not-alive
+   (:func:`ibamr_tpu.utils.lanes.pad_lanes`) — the fleet chunk's alive
+   mask freezes padding in-graph.
+3. **warm / miss** — a warm bucket serves immediately from its
+   AOT-compiled lane chunks; a miss compiles ASYNCHRONOUSLY (one
+   background build per bucket, published to the shared
+   :class:`~ibamr_tpu.serve.aot_cache.ExecutableCache`) while the
+   requests wait — the compile lands in the cold requests'
+   request-to-first-step latency and nowhere else.
+4. **run** — the pre-compiled chunk advances all lanes; per-lane
+   finite health quarantines a bad tenant's lane (PR-7 ``jnp.where``
+   freeze) without perturbing neighbours. Per-lane dt and the alive
+   mask are TRACED arguments: heterogeneous requests never retrace.
+5. **account** — every request emits a ``request`` ledger record
+   (tenant, family key, bucket, lane, cold/warm, first-step and total
+   latency, steps, verdict) plus ``serve_*_total`` counters.
+
+The router runs only chunk lengths it pre-compiled (1 for the
+first-step ack, ``chunk_steps`` for cruise), so a warm second request
+of the same family performs ZERO compiles — pinned structurally by
+``tools/serve.py check`` against SERVE_CONTRACT.json.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ibamr_tpu import obs as _obs
+from ibamr_tpu.serve import aot_cache
+from ibamr_tpu.utils import lanes as _lanes
+
+_REQS = _obs.counter("serve_requests_total")
+_COLD = _obs.counter("serve_cold_requests_total")
+_QUAR = _obs.counter("serve_quarantined_total")
+_PADS = _obs.counter("serve_padded_lanes_total")
+
+
+@dataclass(frozen=True)
+class BucketSpec:
+    """One warm-pool bucket: a pre-compiled (shape, engine, dtype, B)
+    fleet-lane executable family. Family fields select the compiled
+    graph; ``lanes`` is the batch capacity; ``chunk_steps`` the cruise
+    chunk length (also the quarantine-triage cadence)."""
+    n_cells: int
+    n_lat: int
+    n_lon: int
+    lanes: int
+    engine: Optional[str] = None            # None = auto -> resolver
+    spectral_dtype: Optional[str] = None
+    mu: float = 0.05
+    dt: float = 5e-5                        # template dt (dt is traced)
+    chunk_steps: int = 2
+
+    def family(self):
+        return (self.n_cells, self.n_lat, self.n_lon, self.engine,
+                self.spectral_dtype, self.mu)
+
+
+@dataclass
+class ScenarioRequest:
+    """One tenant's scenario. Family fields select the bucket; value
+    fields (``dt``, ``steps``, ``perturb``) are traced arguments or
+    host-side loop bounds and never retrace."""
+    tenant: str
+    n_cells: int
+    n_lat: int = 8
+    n_lon: int = 16
+    steps: int = 3
+    dt: float = 5e-5
+    engine: Optional[str] = None
+    spectral_dtype: Optional[str] = None
+    mu: float = 0.05
+    # per-lane initial velocity offset amplitude; a non-finite value
+    # poisons the lane's state (the quarantine drill in tests)
+    perturb: float = 0.0
+
+    def family(self):
+        return (self.n_cells, self.n_lat, self.n_lon, self.engine,
+                self.spectral_dtype, self.mu)
+
+
+@dataclass
+class RequestResult:
+    """Per-request accounting (mirrors the ``request`` ledger record)."""
+    tenant: str
+    ok: bool
+    quarantined: bool
+    cold: bool
+    bucket_lanes: int
+    lane: int
+    steps_done: int
+    first_step_s: float
+    total_s: float
+    family_key: str
+    error: Optional[str] = None
+
+
+class WarmPool:
+    """One warm bucket: integrator + template state + the AOT-compiled
+    lane chunks (length 1 for the first-step ack, ``chunk_steps`` for
+    cruise), all published through the shared executable cache."""
+
+    def __init__(self, spec: BucketSpec, cache):
+        import jax.numpy as jnp
+
+        from ibamr_tpu.models.shell3d import build_shell_example
+        from ibamr_tpu.utils.hierarchy_driver import (HierarchyDriver,
+                                                      RunConfig)
+
+        self.spec = spec
+        self.cache = cache
+        engine_arg = (None if spec.engine in (None, "auto")
+                      else {"scatter": False,
+                            "mxu": True}.get(spec.engine, spec.engine))
+        self.integ, self.template = build_shell_example(
+            n_cells=spec.n_cells, n_lat=spec.n_lat, n_lon=spec.n_lon,
+            radius=0.25, aspect=1.2, stiffness=1.0,
+            rest_length_factor=0.75, mu=spec.mu,
+            use_fast_interaction=engine_arg,
+            spectral_dtype=spec.spectral_dtype)
+        self.engine = self.integ.ib.engine_name
+        cfg = RunConfig(dt=spec.dt, num_steps=spec.chunk_steps,
+                        health_interval=spec.chunk_steps)
+        self.driver = HierarchyDriver(self.integ, cfg, lanes=spec.lanes)
+        self.fingerprint = aot_cache.step_fingerprint(self.integ)
+        self.key = aot_cache.cache_key(
+            self.fingerprint,
+            extra={"kind": "fleet_chunk", "lanes": spec.lanes})
+        self._dt_vec = jnp.full((spec.lanes,), spec.dt,
+                                dtype=jnp.float32)
+
+    def _template_args(self, live: int = 1):
+        stacked, alive = _lanes.pad_lanes([self.template] * live,
+                                          self.spec.lanes)
+        return stacked, self._dt_vec, alive
+
+    def contract_args(self, length: int = 1, live: int = 1):
+        """(fn, args, donate_argnums) of this pool's chunk for the
+        graph-contract census (``served_chunk`` in
+        analysis/contracts.py) — the serving ack path must lower the
+        same in-scan structure as the batch fleet chunk."""
+        jitted = self.driver._chunk(length)
+        fn = getattr(jitted, "__wrapped__", jitted)
+        return fn, self._template_args(live=live), ()
+
+    def ensure_compiled(self) -> None:
+        """AOT-compile the ack (length 1) and cruise chunks through
+        the cache. Idempotent; this is the whole cost of a bucket
+        miss."""
+        for length in sorted({1, self.spec.chunk_steps}):
+            self.chunk(length)
+
+    def chunk(self, length: int):
+        """The compiled fleet chunk of ``length`` steps. EVERY call
+        goes through the hash-cons — a warm pool reads as cache hits
+        (the ``warm_hits`` contract observable), a cold one as exactly
+        one miss per (family, lanes, length)."""
+        args = self._template_args(live=self.spec.lanes)
+        entry = self.cache.get_or_compile(
+            self.fingerprint,
+            lambda: self.driver._chunk(length).lower(*args).compile(),
+            extra={"kind": "fleet_chunk", "lanes": self.spec.lanes,
+                   "length": length,
+                   "args": aot_cache.arg_signature(args)},
+            label=(f"pool:{self.spec.n_cells}^3"
+                   f"x{self.spec.lanes}:len{length}"))
+        return entry.executable
+
+    def request_state(self, req: ScenarioRequest):
+        """Template state with the request's perturbation applied: a
+        per-component constant velocity offset (divergence-free) —
+        values only, never shapes/dtypes (the family contract)."""
+        import jax.numpy as jnp
+
+        if req.perturb == 0.0:
+            return self.template
+        st = self.template
+        u = tuple(c + jnp.asarray(req.perturb * 1e-3 * (d + 1),
+                                  dtype=c.dtype)
+                  for d, c in enumerate(st.ins.u))
+        return st._replace(ins=st.ins._replace(u=u))
+
+
+class _PoolBuild:
+    __slots__ = ("event", "pool", "error", "thread")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.pool = None
+        self.error = None
+        self.thread = None
+
+
+class WarmPoolRouter:
+    """Packs scenario requests into warm-pool buckets (module
+    docstring has the request lifecycle)."""
+
+    def __init__(self, buckets: Sequence[BucketSpec] = (), cache=None,
+                 allow_dynamic: bool = True, default_lanes: int = 2):
+        self.cache = cache if cache is not None else aot_cache.get_cache()
+        self._specs = list(buckets)
+        self._pools: dict = {}
+        self._inflight: dict = {}
+        self._lock = threading.Lock()
+        self.allow_dynamic = allow_dynamic
+        self.default_lanes = int(default_lanes)
+
+    # -- pool lifecycle -----------------------------------------------------
+
+    def is_warm(self, spec: BucketSpec) -> bool:
+        with self._lock:
+            return spec in self._pools
+
+    def warm(self, spec: Optional[BucketSpec] = None,
+             block: bool = True):
+        """Pre-compile bucket(s) (``spec=None`` warms every declared
+        bucket). ``block=False`` returns immediately with the builds
+        running in the background."""
+        specs = [spec] if spec is not None else list(self._specs)
+        waits = [self._ensure_pool(s) for s in specs]
+        if block:
+            return [w() for w in waits]
+        return waits
+
+    def _ensure_pool(self, spec: BucketSpec):
+        """Warm pool for ``spec``, compiled asynchronously on a miss
+        (one background build per bucket, published to the shared
+        executable cache). Returns a ``wait()`` callable producing the
+        pool — a cold request's latency includes this wait; every
+        other family keeps serving meanwhile."""
+        with self._lock:
+            pool = self._pools.get(spec)
+            if pool is not None:
+                return lambda: pool
+            flight = self._inflight.get(spec)
+            if flight is None:
+                flight = _PoolBuild()
+                self._inflight[spec] = flight
+                t = threading.Thread(target=self._build_pool,
+                                     args=(spec, flight), daemon=True)
+                flight.thread = t
+                t.start()
+
+        def wait():
+            flight.event.wait()
+            if flight.error is not None:
+                raise flight.error
+            return flight.pool
+
+        return wait
+
+    def _build_pool(self, spec: BucketSpec, flight: _PoolBuild) -> None:
+        try:
+            pool = WarmPool(spec, self.cache)
+            pool.ensure_compiled()
+            with self._lock:
+                self._pools[spec] = pool
+                self._inflight.pop(spec, None)
+            flight.pool = pool
+        except Exception as e:  # noqa: BLE001 - delivered to waiters
+            with self._lock:
+                self._inflight.pop(spec, None)
+            flight.error = e
+        finally:
+            flight.event.set()
+
+    # -- bucketing ----------------------------------------------------------
+
+    def _bucket_for(self, family, count: int) -> BucketSpec:
+        """Nearest bucket: same family, smallest ``lanes >= count``;
+        else the largest same-family bucket (the group splits); else a
+        dynamic bucket when allowed."""
+        with self._lock:
+            cands = [s for s in self._specs if s.family() == family]
+        if not cands:
+            if not self.allow_dynamic:
+                raise KeyError(
+                    f"no declared bucket for scenario family {family} "
+                    f"(allow_dynamic=False)")
+            lanes = max(self.default_lanes, count)
+            spec = BucketSpec(n_cells=family[0], n_lat=family[1],
+                              n_lon=family[2], lanes=lanes,
+                              engine=family[3], spectral_dtype=family[4],
+                              mu=family[5])
+            with self._lock:
+                self._specs.append(spec)
+            cands = [spec]
+        fits = sorted((s for s in cands if s.lanes >= count),
+                      key=lambda s: s.lanes)
+        return fits[0] if fits else max(cands, key=lambda s: s.lanes)
+
+    # -- serving ------------------------------------------------------------
+
+    def serve(self, requests: Sequence[ScenarioRequest]):
+        """Serve a batch of scenario requests; returns one
+        :class:`RequestResult` per request, input order preserved."""
+        results: list = [None] * len(requests)
+        groups: dict = {}
+        for i, r in enumerate(requests):
+            groups.setdefault(r.family(), []).append((i, r))
+        for family, members in groups.items():
+            pos = 0
+            while pos < len(members):
+                spec = self._bucket_for(family, len(members) - pos)
+                batch = members[pos:pos + spec.lanes]
+                pos += len(batch)
+                out = self._serve_batch(spec, [r for _, r in batch])
+                for (i, _), res in zip(batch, out):
+                    results[i] = res
+        return results
+
+    def _serve_batch(self, spec: BucketSpec,
+                     reqs: Sequence[ScenarioRequest]):
+        import jax.numpy as jnp
+
+        t_submit = time.perf_counter()
+        cold = not self.is_warm(spec)
+        pool = self._ensure_pool(spec)()   # cold: compile lands here
+        B = spec.lanes
+        pads = B - len(reqs)
+        if pads:
+            _PADS.inc(pads)
+        stacked, _ = _lanes.pad_lanes(
+            [pool.request_state(r) for r in reqs], B)
+        dt_vec = jnp.asarray(
+            [r.dt for r in reqs] + [reqs[-1].dt] * pads,
+            dtype=pool._dt_vec.dtype)
+
+        steps_done = np.zeros(B, dtype=int)
+        target = np.array([r.steps for r in reqs] + [0] * pads)
+        quarantined = np.zeros(B, dtype=bool)
+        alive_host = np.arange(B) < len(reqs)
+        first_step_s = None
+        state = stacked
+        while True:
+            remaining = target - steps_done
+            live = alive_host & (remaining > 0)
+            if not live.any():
+                break
+            # only pre-compiled lengths run (1 and chunk_steps): the
+            # warm path performs ZERO compiles by construction
+            length = (spec.chunk_steps
+                      if first_step_s is not None
+                      and int(remaining[live].max()) >= spec.chunk_steps
+                      else 1)
+            run_mask = live & (remaining >= length)
+            state, health = pool.chunk(length)(
+                state, dt_vec, jnp.asarray(run_mask))
+            h = np.asarray(health)       # one host transfer per chunk
+            if first_step_s is None:
+                first_step_s = time.perf_counter() - t_submit
+            steps_done[run_mask] += length
+            newly_bad = run_mask & (h < 0.5)
+            quarantined |= newly_bad
+            alive_host &= ~newly_bad
+
+        total_s = time.perf_counter() - t_submit
+        if first_step_s is None:          # zero-step requests
+            first_step_s = total_s
+        results = []
+        for lane, r in enumerate(reqs):
+            q = bool(quarantined[lane])
+            ok = bool(steps_done[lane] >= r.steps) and not q
+            _REQS.inc()
+            if cold:
+                _COLD.inc()
+            if q:
+                _QUAR.inc()
+            results.append(RequestResult(
+                tenant=r.tenant, ok=ok, quarantined=q, cold=cold,
+                bucket_lanes=B, lane=lane,
+                steps_done=int(steps_done[lane]),
+                first_step_s=first_step_s, total_s=total_s,
+                family_key=pool.key,
+                error=("lane quarantined (non-finite state)" if q
+                       else None)))
+            _obs.emit("request", tenant=r.tenant, family=pool.key,
+                      engine=pool.engine, bucket_lanes=B, lane=lane,
+                      cold=cold, ok=ok, quarantined=q,
+                      steps=int(steps_done[lane]),
+                      first_step_s=round(first_step_s, 4),
+                      total_s=round(total_s, 4))
+        return results
+
+
+def cold_warm_drill(n_cells: int = 16, n_lat: int = 8, n_lon: int = 16,
+                    lanes: int = 2, steps: int = 3, dt: float = 5e-5,
+                    engine: Optional[str] = None,
+                    spectral_dtype: Optional[str] = None,
+                    cache_dir: Optional[str] = None) -> dict:
+    """The serving benchmark: one scenario family served twice through
+    a FRESH router + FRESH executable cache — request 1 pays the cold
+    path (bucket compile on miss), request 2 rides warm. Returns
+    request-to-first-step latencies plus compile counts; the serve
+    contract (``tools/serve.py check`` vs SERVE_CONTRACT.json) pins
+    ``warm_compiles == 0`` and ``warm_new_trace_signatures == 0``
+    structurally."""
+    cache = aot_cache.ExecutableCache(directory=cache_dir)
+    spec = BucketSpec(n_cells=n_cells, n_lat=n_lat, n_lon=n_lon,
+                      lanes=lanes, engine=engine,
+                      spectral_dtype=spectral_dtype, dt=dt,
+                      chunk_steps=max(1, min(2, steps)))
+    router = WarmPoolRouter([spec], cache=cache, allow_dynamic=False)
+
+    def one(tag):
+        before = cache.stats()
+        res = router.serve([ScenarioRequest(
+            tenant=tag, n_cells=n_cells, n_lat=n_lat, n_lon=n_lon,
+            steps=steps, dt=dt, engine=engine,
+            spectral_dtype=spectral_dtype)])[0]
+        after = cache.stats()
+        return res, {"compiles": after["misses"] - before["misses"],
+                     "hits": after["hits"] - before["hits"]}
+
+    cold_res, cold_stats = one("drill-cold")
+    pool = router._pools[spec]
+    sigs_cold = sum(pool.driver.trace_counts.values())
+    warm_res, warm_stats = one("drill-warm")
+    sigs_warm = sum(pool.driver.trace_counts.values())
+    return {
+        "n": n_cells, "lanes": lanes, "steps": steps,
+        "engine": pool.engine,
+        "family_key": cold_res.family_key,
+        "cold_first_step_s": round(cold_res.first_step_s, 4),
+        "warm_first_step_s": round(warm_res.first_step_s, 4),
+        "warm_over_cold": round(
+            warm_res.first_step_s / max(cold_res.first_step_s, 1e-9), 6),
+        "cold_compiles": cold_stats["compiles"],
+        "warm_compiles": warm_stats["compiles"],
+        "warm_hits": warm_stats["hits"],
+        "warm_new_trace_signatures": sigs_warm - sigs_cold,
+        "cold_ok": bool(cold_res.ok), "warm_ok": bool(warm_res.ok),
+    }

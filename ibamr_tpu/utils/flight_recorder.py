@@ -76,6 +76,24 @@ def _json_safe(obj):
     return repr(obj)
 
 
+def canonicalize(obj):
+    """Deterministic JSON-safe form of fingerprint/cache-key material:
+    values through :func:`_json_safe` (tuples -> lists, numpy scalars ->
+    python), dict keys RECURSIVELY sorted. Two semantically identical
+    configs that differ only in dict insertion order canonicalize (and
+    therefore digest) identically — the serving cache
+    (ibamr_tpu/serve/aot_cache.py) keys whole compiled executables on
+    these digests, so key stability is a correctness property, not a
+    nicety."""
+    def _sort(v):
+        if isinstance(v, dict):
+            return {k: _sort(v[k]) for k in sorted(v)}
+        if isinstance(v, list):
+            return [_sort(x) for x in v]
+        return v
+    return _sort(_json_safe(obj))
+
+
 def _engine_label(val) -> Optional[str]:
     """Normalize an engine selection value (the ``use_fast_interaction``
     vocabulary) to a stable string label."""
@@ -286,7 +304,9 @@ class FlightRecorder:
         }
         for k, v in self.extra.items():
             fp.setdefault(k, _json_safe(v))
-        return fp
+        # canonical form: dict insertion order must never leak into
+        # run_id / serving-cache digests
+        return canonicalize(fp)
 
     def run_id(self, driver=None) -> str:
         """The 16-hex run identity the observability ledger stamps on
@@ -296,11 +316,28 @@ class FlightRecorder:
         from ibamr_tpu.obs import run_id_from_fingerprint
         return run_id_from_fingerprint(self.fingerprint(driver=driver))
 
+    def observe(self, integ=None, cfg=None) -> None:
+        """Bind integrator/config context for fingerprinting WITHOUT
+        taking a ring snapshot — the serving cache keys entries on the
+        fingerprint of an integrator it never runs through a driver."""
+        if integ is not None:
+            self._integ = integ
+        if cfg is not None:
+            self._cfg = cfg
+
     @staticmethod
     def _engine_info(integ, spec):
-        """(engine label, fallback chain) actually in use, best-effort."""
+        """(engine label, fallback chain) actually in use, best-effort.
+        The RESOLVED name stamped by the factory (``ib.engine_name``,
+        post-auto-resolution and post-fallback) wins over the factory
+        spec's alias — the fingerprint must describe what runs, not
+        what was asked for."""
         label = None
-        if spec.get("kind") == "factory":
+        ib_resolved = getattr(getattr(integ, "ib", None),
+                              "engine_name", None)
+        if ib_resolved is not None:
+            label = str(ib_resolved)
+        if label is None and spec.get("kind") == "factory":
             kwargs = spec.get("kwargs", {})
             if "use_fast_interaction" in kwargs:
                 label = _engine_label(kwargs["use_fast_interaction"])

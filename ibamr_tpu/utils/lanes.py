@@ -69,6 +69,24 @@ def broadcast_lane(lane_state, n: int):
         lane_state)
 
 
+def pad_lanes(states, n: int):
+    """Lane-stack ``states`` padded to ``n`` lanes with copies of the
+    LAST state; returns ``(stacked, alive)`` where ``alive`` is the
+    (n,) bool mask marking the real lanes. Padding lanes are
+    dead-on-arrival: the fleet chunk's alive mask freezes them
+    in-graph, so a short request group rides a bigger warm-pool bucket
+    (ibamr_tpu/serve/router.py) at zero semantic cost — the padded
+    rows never influence, and are never reported as, results."""
+    if not states:
+        raise ValueError("pad_lanes needs at least one lane state")
+    if len(states) > n:
+        raise ValueError(
+            f"pad_lanes: {len(states)} states exceed the {n}-lane bucket")
+    stacked = stack_lanes(list(states) + [states[-1]] * (n - len(states)))
+    alive = jnp.arange(n) < len(states)
+    return stacked, alive
+
+
 def lane_mask_shape(mask: jnp.ndarray, leaf: jnp.ndarray) -> jnp.ndarray:
     """Reshape a (B,) lane mask for broadcasting against a lane-stacked
     leaf: (B, 1, ..., 1) with the leaf's rank."""

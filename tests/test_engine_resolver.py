@@ -1,0 +1,110 @@
+"""Pluggable auto-engine resolution (PR 12 satellite).
+
+``use_fast_interaction=None`` ("auto") no longer hard-codes the packed
+promotion inline: resolution routes through
+``ibamr_tpu/models/engine_resolver.py`` (env override -> tuning DB ->
+built-in heuristic), and the RESOLVED name — never "auto" — is stamped
+on the integrator and lands in the flight-recorder fingerprint, so the
+serving cache key always reflects what actually runs.
+"""
+
+import json
+
+import pytest
+
+from ibamr_tpu.models.engine_resolver import (ENV_ENGINE, ENV_TUNING_DB,
+                                              RESOLVED_ENGINES,
+                                              default_rule,
+                                              load_tuning_db,
+                                              resolve_engine)
+
+_SUPPORT = 2                          # ib4 half-width
+
+
+def test_default_rule_promotion_band():
+    # large tile-divisible grid with enough markers -> packed
+    assert default_rule((128, 128, 128), 100_000, _SUPPORT) == "packed"
+    # too few markers -> scatter
+    assert default_rule((128, 128, 128), 100, _SUPPORT) == "scatter"
+    # not tile-divisible -> scatter
+    assert default_rule((12, 12, 12), 100_000, _SUPPORT) == "scatter"
+    # tile-divisible but below the make_geometry minimum extent
+    assert default_rule((8, 8, 8), 100_000, _SUPPORT) == "scatter"
+
+
+def test_env_override_wins_and_validates():
+    env = {ENV_ENGINE: "packed3"}
+    assert resolve_engine((8, 8, 8), 10, _SUPPORT, env=env) == "packed3"
+    # "auto"/empty defer to the rest of the chain
+    assert resolve_engine((8, 8, 8), 10, _SUPPORT,
+                          env={ENV_ENGINE: "auto"}) == "scatter"
+    assert resolve_engine((8, 8, 8), 10, _SUPPORT,
+                          env={ENV_ENGINE: ""}) == "scatter"
+    # a typo'd engine dies at build time, never poisons a cache key
+    with pytest.raises(ValueError, match="unknown transfer engine"):
+        resolve_engine((8, 8, 8), 10, _SUPPORT,
+                       env={ENV_ENGINE: "packedd"})
+    assert "auto" not in RESOLVED_ENGINES
+
+
+def test_tuning_db_first_match_wins(tmp_path):
+    db = tmp_path / "tuning.json"
+    db.write_text(json.dumps({"entries": [
+        {"engine": "packed3", "n_cells": 256},
+        {"engine": "mxu", "markers_min": 50, "markers_max": 500},
+    ]}))
+    env = {ENV_TUNING_DB: str(db)}
+    assert resolve_engine((256, 256, 256), 10_000, _SUPPORT,
+                          env=env) == "packed3"
+    assert resolve_engine((64, 64, 64), 100, _SUPPORT, env=env) == "mxu"
+    # no entry matches -> heuristic
+    assert resolve_engine((64, 64, 64), 10, _SUPPORT,
+                          env=env) == "scatter"
+    # env override outranks the DB
+    assert resolve_engine((256, 256, 256), 10_000, _SUPPORT,
+                          env={ENV_TUNING_DB: str(db),
+                               ENV_ENGINE: "pallas"}) == "pallas"
+
+
+def test_malformed_tuning_db_raises(tmp_path):
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"winners": []}))
+    with pytest.raises(ValueError, match="entries"):
+        load_tuning_db(str(bad))
+    # a configured-but-broken DB is an error, not a silent fallback
+    with pytest.raises(ValueError):
+        resolve_engine((64, 64, 64), 10, _SUPPORT,
+                       env={ENV_TUNING_DB: str(bad)})
+    with pytest.raises(ValueError, match="unknown transfer engine"):
+        ok_shape = tmp_path / "typo.json"
+        ok_shape.write_text(json.dumps(
+            {"entries": [{"engine": "warp9"}]}))
+        resolve_engine((64, 64, 64), 10, _SUPPORT,
+                       env={ENV_TUNING_DB: str(ok_shape)})
+
+
+def test_resolved_engine_stamped_on_integrator_and_fingerprint():
+    from ibamr_tpu.models.shell3d import build_shell_example
+    from ibamr_tpu.serve.aot_cache import step_fingerprint
+
+    integ, _ = build_shell_example(n_cells=8, n_lat=6, n_lon=8,
+                                   radius=0.25, aspect=1.2,
+                                   stiffness=1.0,
+                                   rest_length_factor=0.75, mu=0.05,
+                                   use_fast_interaction=None)
+    # tiny grid: the heuristic resolves auto -> scatter, and the
+    # RESOLVED name (not "auto") is what the fingerprint carries
+    assert integ.ib.engine_name == "scatter"
+    fp = step_fingerprint(integ)
+    assert fp["engine"] == "scatter"
+
+
+def test_explicit_engine_stamped_too():
+    from ibamr_tpu.models.shell3d import build_shell_example
+
+    integ, _ = build_shell_example(n_cells=8, n_lat=6, n_lon=8,
+                                   radius=0.25, aspect=1.2,
+                                   stiffness=1.0,
+                                   rest_length_factor=0.75, mu=0.05,
+                                   use_fast_interaction=False)
+    assert integ.ib.engine_name == "scatter"

@@ -1,0 +1,66 @@
+"""Canonical flight-recorder fingerprints (PR 12 satellite).
+
+``run_id`` is the serving cache's key source, so it must be a pure
+function of fingerprint CONTENT: dict insertion order cannot move it,
+and the compile-identity fields (engine, spectral dtype, mesh, x64)
+must each move it.
+"""
+
+import jax
+
+from ibamr_tpu.obs import run_id_from_fingerprint
+from ibamr_tpu.utils.flight_recorder import FlightRecorder, canonicalize
+
+
+def _small_integ():
+    from ibamr_tpu.models.shell3d import build_shell_example
+
+    integ, _ = build_shell_example(n_cells=8, n_lat=6, n_lon=8,
+                                   radius=0.25, aspect=1.2,
+                                   stiffness=1.0,
+                                   rest_length_factor=0.75, mu=0.05)
+    return integ
+
+
+def test_canonicalize_sorts_keys_recursively():
+    a = {"b": 2, "a": {"y": [1, {"q": 0, "p": 1}], "x": 0}}
+    b = {"a": {"x": 0, "y": [1, {"p": 1, "q": 0}]}, "b": 2}
+    import json
+    assert json.dumps(canonicalize(a)) == json.dumps(canonicalize(b))
+    # lists keep their order — only mapping keys are canonical
+    assert canonicalize({"k": [2, 1]})["k"] == [2, 1]
+
+
+def test_run_id_insertion_order_invariant():
+    integ = _small_integ()
+    rec_ab = FlightRecorder(capacity=1,
+                            extra_fingerprint={"alpha": 1, "beta": 2})
+    rec_ba = FlightRecorder(capacity=1,
+                            extra_fingerprint={"beta": 2, "alpha": 1})
+    rec_ab.observe(integ=integ)
+    rec_ba.observe(integ=integ)
+    assert rec_ab.run_id() == rec_ba.run_id()
+    # different CONTENT still separates
+    rec_c = FlightRecorder(capacity=1,
+                           extra_fingerprint={"alpha": 1, "beta": 3})
+    rec_c.observe(integ=integ)
+    assert rec_c.run_id() != rec_ab.run_id()
+
+
+def test_run_id_sensitive_to_compile_identity_fields():
+    rec = FlightRecorder(capacity=1)
+    rec.observe(integ=_small_integ())
+    fp = rec.fingerprint()
+    base = run_id_from_fingerprint(fp)
+    for mutation in ({"engine": "mutated"},
+                     {"spectral_dtype": "bf16-mutated"},
+                     {"mesh_shape": [4, 2]},
+                     {"x64": not fp.get("x64")}):
+        assert run_id_from_fingerprint(dict(fp, **mutation)) != base, \
+            f"run_id ignored {list(mutation)[0]}"
+
+
+def test_fingerprint_reports_x64_mode():
+    rec = FlightRecorder(capacity=1)
+    rec.observe(integ=_small_integ())
+    assert rec.fingerprint()["x64"] == jax.config.jax_enable_x64

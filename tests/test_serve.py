@@ -1,0 +1,325 @@
+"""Serving layer: AOT executable cache + warm-pool router (PR 12).
+
+Cache-mechanics tests use fake build functions (no compiles, pure
+hash-cons semantics). The heavier router tests share one module-scoped
+warm pool at the tiny shell shape so the fast tier pays the bucket
+compile once; the cold-vs-warm smoke is the SAME drill
+``tools/serve.py check`` pins against SERVE_CONTRACT.json, so the
+zero-recompile warm-path guarantee gates tier-1 directly.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from ibamr_tpu import obs
+from ibamr_tpu.serve import aot_cache
+from ibamr_tpu.serve.aot_cache import ExecutableCache
+from ibamr_tpu.serve.router import (BucketSpec, ScenarioRequest,
+                                    WarmPoolRouter, cold_warm_drill)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# tiny shell family shared by every heavy test in this module
+_N, _N_LAT, _N_LON = 8, 6, 8
+
+
+# ---------------------------------------------------------------------------
+# cache mechanics (fake builds — no jax compiles)
+# ---------------------------------------------------------------------------
+
+def _fp(tag):
+    """A minimal fingerprint-shaped dict distinct per tag."""
+    return {"config_digest": f"cfg-{tag}", "engine": "scatter",
+            "spectral_dtype": None, "x64": True, "platform": "cpu"}
+
+
+def test_cache_hit_miss_and_lru_eviction():
+    cache = ExecutableCache(capacity=2)
+    builds = []
+
+    def build(tag):
+        def _b():
+            builds.append(tag)
+            return ("exe", tag)
+        return _b
+
+    e1 = cache.get_or_compile(_fp("a"), build("a"))
+    assert cache.get_or_compile(_fp("a"), build("a")).executable \
+        == e1.executable
+    assert builds == ["a"]                       # second call was a hit
+    cache.get_or_compile(_fp("b"), build("b"))
+    # touch "a" so "b" is the LRU victim when "c" lands
+    cache.get_or_compile(_fp("a"), build("a"))
+    cache.get_or_compile(_fp("c"), build("c"))
+    assert len(cache) == 2
+    assert builds == ["a", "b", "c"]
+    st = cache.stats()
+    assert (st["hits"], st["misses"], st["evictions"]) == (2, 3, 1)
+    # the evicted family recompiles (displacing LRU "a"); the freshly
+    # retained one still hits
+    cache.get_or_compile(_fp("b"), build("b"))
+    assert builds == ["a", "b", "c", "b"]
+    cache.get_or_compile(_fp("c"), build("c"))
+    assert builds == ["a", "b", "c", "b"]
+
+
+def test_cache_key_separates_extra_material():
+    fp = _fp("x")
+    k1 = aot_cache.cache_key(fp, extra={"lanes": 2, "length": 1})
+    k2 = aot_cache.cache_key(fp, extra={"lanes": 2, "length": 2})
+    k3 = aot_cache.cache_key(fp, extra={"length": 1, "lanes": 2})
+    assert k1 != k2                  # chunk length is compile identity
+    assert k1 == k3                  # dict order is not
+
+
+def test_concurrent_get_or_compile_builds_once():
+    cache = ExecutableCache(capacity=4)
+    n_builds = [0]
+    release = threading.Event()
+
+    def slow_build():
+        n_builds[0] += 1
+        release.wait(5.0)
+        return object()
+
+    got, errs = [], []
+
+    def worker():
+        try:
+            got.append(cache.get_or_compile(_fp("k"), slow_build))
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.2)                  # let every waiter reach the latch
+    release.set()
+    for t in threads:
+        t.join(10.0)
+    assert not errs
+    assert n_builds[0] == 1          # exactly one build for the key
+    assert len({id(e.executable) for e in got}) == 1
+    st = cache.stats()
+    assert st["misses"] == 1
+    # each waiter re-enters after the latch and reads the published
+    # entry as a hit (so it also counts one inflight wait)
+    assert st["hits"] == 3
+    assert 0 <= st["inflight_waits"] <= 3
+
+
+def test_failed_build_propagates_and_does_not_poison():
+    cache = ExecutableCache(capacity=4)
+    with pytest.raises(RuntimeError, match="boom"):
+        cache.get_or_compile(_fp("bad"), lambda: (_ for _ in ()).throw(
+            RuntimeError("boom")))
+    # the key is not latched dead: a later build succeeds
+    ent = cache.get_or_compile(_fp("bad"), lambda: "ok")
+    assert ent.executable == "ok"
+
+
+def test_corrupt_manifest_refused_and_reaped(tmp_path):
+    d = str(tmp_path / "aot")
+    cache = ExecutableCache(capacity=4, directory=d)
+    ent = cache.get_or_compile(_fp("m"), lambda: "exe")
+    path = cache.manifest_path(ent.key)
+    assert cache.published_keys() == [ent.key]
+
+    # flip a byte inside the signed body -> digest mismatch
+    doc = json.load(open(path))
+    doc["body"]["label"] = "tampered"
+    json.dump(doc, open(path, "w"))
+    fresh = ExecutableCache(capacity=4, directory=d)
+    assert fresh._read_manifest(ent.key) is None   # corruption never loads
+    assert not os.path.exists(path)                # reaped
+    assert fresh.stats()["corrupt"] == 1
+    # the recompile is accounted a true cold build, not a cached load
+    rebuilt = fresh.get_or_compile(_fp("m"), lambda: "exe2")
+    assert rebuilt.cold_source == "compile"
+
+    # unreadable JSON is refused the same way
+    ent2 = cache.get_or_compile(_fp("m2"), lambda: "exe")
+    with open(cache.manifest_path(ent2.key), "w") as f:
+        f.write("{not json")
+    assert fresh._read_manifest(ent2.key) is None
+    # a valid manifest marks the rebuild as persistent-cache-served
+    ent3 = cache.get_or_compile(_fp("m3"), lambda: "exe")
+    fresh2 = ExecutableCache(capacity=4, directory=d)
+    warm = fresh2.get_or_compile(_fp("m3"), lambda: "exe")
+    assert warm.key == ent3.key
+    assert warm.cold_source == "persistent"
+
+
+# ---------------------------------------------------------------------------
+# the cold-vs-warm drill (module-scoped: ONE bucket compile for the file)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def drill():
+    return cold_warm_drill(n_cells=_N, n_lat=_N_LAT, n_lon=_N_LON,
+                           lanes=2, steps=3)
+
+
+def test_cold_warm_smoke_zero_recompiles(drill):
+    assert drill["cold_ok"] and drill["warm_ok"]
+    assert drill["cold_compiles"] >= 1      # ack + cruise chunks
+    assert drill["warm_compiles"] == 0      # the tentpole guarantee
+    assert drill["warm_hits"] >= 1
+    assert drill["warm_new_trace_signatures"] == 0
+    # acceptance: warm request-to-first-step <= 5% of cold
+    assert drill["warm_over_cold"] <= 0.05
+    assert drill["engine"] != "auto"        # resolver output, resolved
+
+
+def test_drill_meets_serve_contract(drill):
+    """The repo's pinned SERVE_CONTRACT.json gates tier-1 through the
+    same diff the ``tools/serve.py check`` CLI applies."""
+    from tools.serve import diff_contract, load_contract
+
+    regressions, _ = diff_contract(drill, load_contract())
+    assert regressions == []
+
+
+def test_serve_check_exit_codes(tmp_path, monkeypatch):
+    """check exits 0/1/2 exactly like graph_audit (clean / improved-or-
+    unbudgeted / regressed), without re-running the drill."""
+    import tools.serve as serve_cli
+
+    measured = {"n": _N, "lanes": 2, "steps": 3, "engine": "scatter",
+                "cold_first_step_s": 5.0, "warm_first_step_s": 0.01,
+                "warm_over_cold": 0.002, "cold_compiles": 2,
+                "warm_compiles": 0, "warm_hits": 2,
+                "warm_new_trace_signatures": 0,
+                "cold_ok": True, "warm_ok": True}
+    monkeypatch.setattr(serve_cli, "run_drill",
+                        lambda args, force_cpu_backend: dict(measured))
+    contract = str(tmp_path / "contract.json")
+
+    assert serve_cli.main(["check", "--tighten",
+                           "--contract", contract]) == 0
+    assert serve_cli.main(["check", "--contract", contract]) == 0
+
+    improved = dict(measured, cold_compiles=1)
+    monkeypatch.setattr(serve_cli, "run_drill",
+                        lambda args, force_cpu_backend: improved)
+    assert serve_cli.main(["check", "--contract", contract]) == 1
+
+    regressed = dict(measured, warm_compiles=1)
+    monkeypatch.setattr(serve_cli, "run_drill",
+                        lambda args, force_cpu_backend: regressed)
+    assert serve_cli.main(["check", "--json",
+                           "--contract", contract]) == 2
+
+    broken = dict(measured, warm_ok=False)
+    monkeypatch.setattr(serve_cli, "run_drill",
+                        lambda args, force_cpu_backend: broken)
+    assert serve_cli.main(["check", "--contract", contract]) == 2
+
+
+# ---------------------------------------------------------------------------
+# router: bucketing, padding, quarantine, per-request accounting
+# (one module-scoped 4-lane warm pool shared by every test below)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def warm_router():
+    spec = BucketSpec(n_cells=_N, n_lat=_N_LAT, n_lon=_N_LON, lanes=4)
+    router = WarmPoolRouter([spec], cache=ExecutableCache(),
+                            allow_dynamic=False)
+    router.warm(spec)
+    return router, spec
+
+
+def _req(tag, **kw):
+    kw.setdefault("steps", 2)
+    return ScenarioRequest(tenant=tag, n_cells=_N, n_lat=_N_LAT,
+                           n_lon=_N_LON, **kw)
+
+
+def test_short_group_padded_into_bucket(warm_router):
+    router, spec = warm_router
+    before = router.cache.stats()
+    results = router.serve([_req("t0"), _req("t1"), _req("t2")])
+    after = router.cache.stats()
+    assert [r.tenant for r in results] == ["t0", "t1", "t2"]
+    assert all(r.ok and not r.quarantined for r in results)
+    assert all(r.bucket_lanes == 4 for r in results)   # padded to B=4
+    assert [r.lane for r in results] == [0, 1, 2]
+    assert all(not r.cold for r in results)            # pool was warm
+    assert after["misses"] == before["misses"]         # zero compiles
+    assert after["hits"] > before["hits"]
+
+
+def test_oversize_group_splits_across_batches(warm_router):
+    router, _ = warm_router
+    results = router.serve([_req(f"t{i}", steps=1) for i in range(6)])
+    assert all(r.ok for r in results)
+    # 6 requests through a 4-lane bucket: lanes wrap across 2 batches
+    assert [r.lane for r in results] == [0, 1, 2, 3, 0, 1]
+
+
+def test_unknown_family_without_dynamic_raises(warm_router):
+    router, _ = warm_router
+    with pytest.raises(KeyError, match="no declared bucket"):
+        router.serve([ScenarioRequest(tenant="alien", n_cells=_N,
+                                      n_lat=4, n_lon=4)])
+
+
+def test_quarantine_isolates_poisoned_lane(warm_router):
+    router, _ = warm_router
+    results = router.serve([_req("good"),
+                            _req("bad", perturb=float("nan")),
+                            _req("also-good")])
+    by = {r.tenant: r for r in results}
+    assert by["bad"].quarantined and not by["bad"].ok
+    assert "quarantined" in by["bad"].error
+    assert by["good"].ok and not by["good"].quarantined
+    assert by["also-good"].ok
+
+
+def test_request_ledger_accounting(warm_router, tmp_path):
+    router, _ = warm_router
+    path = str(tmp_path / "ledger.jsonl")
+    with obs.ledger(path):
+        router.serve([_req("tenant-a"), _req("tenant-b")])
+    recs = [r for r in obs.read_ledger(path)
+            if r.get("kind") == "request"]
+    assert [r["tenant"] for r in recs] == ["tenant-a", "tenant-b"]
+    for r in recs:
+        assert r["ok"] and not r["quarantined"] and not r["cold"]
+        assert r["bucket_lanes"] == 4
+        assert r["steps"] == 2
+        assert r["first_step_s"] <= r["total_s"]
+        assert r["engine"] and r["engine"] != "auto"
+
+
+def test_obs_summary_renders_serving_block(warm_router, tmp_path,
+                                           capsys):
+    from tools.obs import cmd_summary
+
+    router, _ = warm_router
+    path = str(tmp_path / "ledger.jsonl")
+    with obs.ledger(path):
+        router.serve([_req("render-me")])
+
+    class _Args:
+        ledger = path
+        device = None
+
+    assert cmd_summary(_Args()) == 0
+    out = capsys.readouterr().out
+    assert "serving (warm-pool efficacy)" in out
+    assert "warm first-step" in out
+
+
+def test_served_chunk_contract_artifact_registered():
+    from ibamr_tpu.analysis.contracts import ARTIFACTS
+
+    assert "served_chunk" in ARTIFACTS
+    budgets = json.load(open(os.path.join(REPO, "GRAPH_BUDGETS.json")))
+    pinned = budgets["artifacts"]["served_chunk"]
+    assert pinned["host_transfers_in_scan"] == 0

@@ -165,6 +165,56 @@ def render_counters(snap) -> list:
     return lines or ["  (empty snapshot)"]
 
 
+def render_serving(snap, records: list) -> list:
+    """Warm-pool efficacy block (PR 12): the AOT executable cache's
+    hit ratio plus the router's request/quarantine/padding totals,
+    from the counter snapshot; per-request ``request`` records add the
+    cold-vs-warm first-step latency split. Empty when the run never
+    touched the serving layer."""
+    table = (snap or {}).get("counters") or {}
+    hits = table.get("aot_cache_hits_total", 0)
+    misses = table.get("aot_cache_misses_total", 0)
+    if not (hits or misses):
+        # router-only runs never emit a counters snapshot (no driver
+        # chunk accounting) — fall back to the per-event records
+        events = [r.get("event") for r in records
+                  if r.get("kind") == "aot_cache"]
+        hits = events.count("hit")
+        misses = events.count("miss")
+    reqs = [r for r in records if r.get("kind") == "request"]
+    if not (hits or misses or reqs):
+        return []
+    lines = []
+    total = hits + misses
+    ratio = f" ({100.0 * hits / total:.1f}% warm)" if total else ""
+    lines.append(f"  executables: {hits} hit(s) / {misses} miss(es)"
+                 f"{ratio}")
+    for key, label in (("aot_cache_evictions_total", "evictions"),
+                       ("aot_cache_corrupt_total",
+                        "corrupt entries refused"),
+                       ("aot_cache_inflight_waits_total",
+                        "in-flight compile waits"),
+                       ("serve_requests_total", "requests served"),
+                       ("serve_cold_requests_total", "cold requests"),
+                       ("serve_quarantined_total", "lanes quarantined"),
+                       ("serve_padded_lanes_total", "padded lanes")):
+        if table.get(key):
+            lines.append(f"  {label}: {_fmt_num(table[key])}")
+    if reqs:
+        cold = [r["first_step_s"] for r in reqs
+                if r.get("cold") and r.get("first_step_s") is not None]
+        warm = [r["first_step_s"] for r in reqs
+                if not r.get("cold")
+                and r.get("first_step_s") is not None]
+        if cold:
+            lines.append(f"  cold first-step: "
+                         f"{_fmt_s(max(cold))} worst of {len(cold)}")
+        if warm:
+            lines.append(f"  warm first-step: "
+                         f"{_fmt_s(max(warm))} worst of {len(warm)}")
+    return lines
+
+
 def render_incidents(records: list, t0=None) -> list:
     lines = []
     for rec in records:
@@ -278,6 +328,11 @@ def cmd_summary(args) -> int:
     print("\ncounters (last snapshot = run totals):")
     for ln in render_counters(last_counters(records)):
         print(ln)
+    serving = render_serving(last_counters(records), records)
+    if serving:
+        print("\nserving (warm-pool efficacy):")
+        for ln in serving:
+            print(ln)
     print("\nincidents:")
     t0 = min(times) if times else None
     for ln in render_incidents(records, t0):
@@ -302,6 +357,17 @@ def _one_line(rec: dict) -> str:
     if kind == "profile":
         return (f"seq={rec['seq']:<6} profile   "
                 f"stage={rec.get('stage')} -> {rec.get('capture_dir')}")
+    if kind == "request":
+        return (f"seq={rec['seq']:<6} request   "
+                f"tenant={rec.get('tenant')} "
+                f"{'cold' if rec.get('cold') else 'warm'} "
+                f"lane={rec.get('lane')} "
+                f"first_step={_fmt_s(rec.get('first_step_s'))} "
+                f"ok={rec.get('ok')}")
+    if kind == "aot_cache":
+        return (f"seq={rec['seq']:<6} aot_cache "
+                f"{rec.get('event')} key={rec.get('key')} "
+                f"label={rec.get('label')}")
     if kind == "device_time":
         return (f"seq={rec['seq']:<6} device    "
                 f"{_fmt_s(rec.get('total_device_s'))} device, "

@@ -279,6 +279,26 @@ def main() -> int:
                     g.write(r4.stdout or "")
             except subprocess.TimeoutExpired:
                 log(f, "graph_audit timed out")
+            # serving-latency capture (PR 12): cold-vs-warm
+            # request-to-first-step through the warm-pool router on
+            # the still-healthy accelerator — the only place the
+            # REAL-device cold-start cost (and the warm pool's
+            # amortization of it) is ever measured; CI's serve check
+            # pins the same drill on CPU
+            try:
+                r6 = subprocess.run(
+                    [sys.executable,
+                     os.path.join(REPO, "tools", "serve.py"),
+                     "bench"],
+                    capture_output=True, text=True, cwd=REPO, env=env,
+                    timeout=args.bench_timeout)
+                log(f, f"serve bench rc={r6.returncode}\n"
+                       + "\n".join((r6.stdout or "").strip().splitlines()[-3:]))
+                with open(args.out.replace(".json", "_serve.json"),
+                          "w") as g:
+                    g.write(r6.stdout or "")
+            except subprocess.TimeoutExpired:
+                log(f, "serve bench timed out")
             # fifth step (PR 10): archive each profile capture — the
             # attribution summary is the regression-comparable
             # artifact; the raw multi-MB traces are pruned ONLY after
