@@ -280,6 +280,39 @@ def serve_reference(timeout_s: float = 300.0, n: int = 16,
         f"serve leg hung > {timeout_s:.0f}s", "serve")
 
 
+def _tune_child(q, n, n_lat, n_lon, reps):
+    """Child body: a small measured autotuner grid (ibamr_tpu/tune/)
+    on a single virtual CPU device — scatter vs packed across both
+    spectral dtypes, trials compiled through the AOT cache."""
+    try:
+        import sys as _sys
+        _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from ibamr_tpu.utils.backend_guard import force_cpu
+
+        jax = force_cpu(1)
+        enable_compile_cache(jax)
+        from ibamr_tpu.tune.runner import search
+
+        res = search(n_cells=n, n_lat=n_lat, n_lon=n_lon,
+                     engines=("scatter", "packed"),
+                     spectral_dtypes=("f32", "bf16"),
+                     chunk_lengths=(1,), reps=reps, probe=False)
+        q.put(res.to_dict())
+    except Exception as e:  # noqa: BLE001 - report, parent decides
+        q.put({"error": f"{type(e).__name__}: {e}"})
+
+
+def tune_reference(timeout_s: float = 300.0, n: int = 16,
+                   n_lat: int = 8, n_lon: int = 16, reps: int = 2):
+    """Measured engine-search signal (PR 13): the autotuner's small
+    CPU grid in a TERMINABLE child. Trends the measured ranking and
+    margins across rounds next to the serve leg; the full on-chip
+    search + DB publication rides tools/relay_watch.py instead."""
+    return _run_guarded_child(
+        _tune_child, (n, n_lat, n_lon, reps), timeout_s,
+        f"tune leg hung > {timeout_s:.0f}s", "tune")
+
+
 def cpu_sharded_reference_with_trend(n_devices: int = 8):
     """The n=32 smoke leg PLUS a larger n=48 leg, with the
     speedup-vs-size trend (round 5, VERDICT round 4 weak #3: the
@@ -707,6 +740,10 @@ def main():
                     help="also time a B-lane vmapped ensemble of the "
                          "small shell vs the same lanes sequentially "
                          "(0 disables)")
+    ap.add_argument("--tune-grid", action="store_true",
+                    help="also run the autotuner's small measured "
+                         "engine grid (scatter vs packed x f32/bf16) "
+                         "in a CPU child and trend the ranking")
     ap.add_argument("--record", type=str, default="",
                     help="arm a flight recorder on every ramp stage; a "
                          "diverged stage dumps a replay capsule under "
@@ -740,6 +777,7 @@ def main():
         "cpu_sharded_ref": None,
         "fleet": None,
         "serve": None,
+        "tune": None,
         "profiles": [],
         "error": None,
     }
@@ -1095,6 +1133,22 @@ def main():
             log(f"[bench] serve: {result['serve']}")
         except Exception as e:
             result["serve"] = {"error": f"{type(e).__name__}: {e}"}
+
+        # autotuner leg (PR 13): the measured scatter-vs-packed grid
+        # in a CPU child, trending ranking + margin per round
+        if args.tune_grid:
+            try:
+                remaining = (args.deadline
+                             - (time.perf_counter() - t_start))
+                if remaining < 30.0:
+                    result["tune"] = {
+                        "error": "skipped (deadline exhausted)"}
+                else:
+                    result["tune"] = tune_reference(
+                        timeout_s=min(300.0, remaining))
+                log(f"[bench] tune: {result['tune']}")
+            except Exception as e:
+                result["tune"] = {"error": f"{type(e).__name__}: {e}"}
 
         if errors:
             msg = "; ".join(errors)

@@ -1,26 +1,40 @@
-"""Pluggable transfer-engine resolver — the autotuner seam.
+"""Pluggable transfer-engine resolver — the autotuner's consumption seam.
 
 ``build_shell_example(use_fast_interaction=None)`` ("auto") used to
 hard-code the round-5 packed promotion inline. The serving cache
 (ibamr_tpu/serve/aot_cache.py) keys executables on the RESOLVED engine,
-and the ROADMAP on-device autotuner needs a place to publish measured
-winners — so auto resolution now routes through this module:
+and the measured-search autotuner (ibamr_tpu/tune/, docs/TUNING.md)
+publishes winners here — so auto resolution routes through this module:
 
 1. ``IBAMR_TRANSFER_ENGINE`` env var: an explicit operator override
    (validated against the engine vocabulary; ``"auto"``/empty defers).
-2. ``IBAMR_TUNING_DB`` env var: path to a JSON tuning database — the
-   autotuner's publication format. Entries match on grid shape and
-   marker count; the first match wins::
+2. A JSON tuning database: ``IBAMR_TUNING_DB`` env var when set (the
+   values ``none``/``off``/``0`` disable DB lookup entirely), else the
+   committed ``TUNING_DB.json`` at the repo root when it exists.
+   Schema v1 (``{"schema": 1, "entries": [...]}``; the legacy
+   schema-less ``{"entries": [...]}`` form is still read). Entries
+   match on grid shape, marker count, spectral dtype, platform and
+   chunk length; the MOST SPECIFIC match wins, with file order as the
+   deterministic tiebreak (earlier wins at equal specificity)::
 
-       {"entries": [
-         {"engine": "packed3", "n_cells": 256},
+       {"schema": 1, "entries": [
+         {"engine": "packed_bf16", "n": [256, 256, 256],
+          "platform": "tpu", "spectral_dtype": "f32",
+          "provenance": {"platform": "tpu"}},
          {"engine": "packed", "markers_min": 4096}
        ]}
 
    Recognized match fields (all optional; an entry with none matches
    everything): ``n_cells`` (exact cubic extent), ``n`` (exact grid
    list), ``markers_min`` / ``markers_max`` (inclusive marker-count
-   band).
+   band), ``spectral_dtype`` (the fluid transform precision knob),
+   ``platform`` (jax backend name), ``chunk_length`` (scan chunk
+   length — only matched when the caller resolves for a specific
+   length; a pinned field the query does not supply does NOT match).
+   An entry whose ``provenance.platform`` differs from the current
+   backend is SKIPPED silently — a CPU-measured winner can never steer
+   a TPU run, and the committed TPU-measured defaults fall through to
+   the heuristic on the CPU test backend.
 3. The built-in heuristic: the round-5 promotion (occupancy-packed
    when the grid is tile-divisible and the marker count is large
    enough to matter; scatter otherwise).
@@ -29,7 +43,9 @@ The resolver returns a RESOLVED engine name — never ``"auto"`` — so the
 flight-recorder fingerprint and the serving cache key always reflect
 what actually runs. A bad override or a corrupt tuning DB raises at
 build time (fail-fast: a typo'd engine name must die here, not silently
-fall back and poison a cache key).
+fall back and poison a cache key). DB consultations are counted on the
+telemetry bus (``tuning_db_{hits,fallbacks,provenance_skips}_total``)
+so `tools/obs.py summary` can report hit/fallback efficacy per run.
 """
 
 from __future__ import annotations
@@ -38,8 +54,20 @@ import json
 import os
 from typing import Optional, Sequence
 
+from ibamr_tpu import obs as _obs
+
 ENV_ENGINE = "IBAMR_TRANSFER_ENGINE"
 ENV_TUNING_DB = "IBAMR_TUNING_DB"
+
+# IBAMR_TUNING_DB sentinel values that disable DB lookup (including
+# the committed default DB)
+DB_DISABLE_VALUES = ("none", "off", "0")
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+DEFAULT_DB_PATH = os.path.join(REPO_ROOT, "TUNING_DB.json")
+
+DB_SCHEMA = 1
 
 # the resolved-name vocabulary (normalize_engine_name output space);
 # "auto" is deliberately absent — resolution must terminate here
@@ -47,6 +75,19 @@ RESOLVED_ENGINES = (
     "scatter", "mxu", "packed", "pallas", "pallas_packed", "mxu_bf16",
     "packed_bf16", "packed3", "packed3_bf16", "hybrid_packed",
     "hybrid_packed_bf16", "hybrid_bf16")
+
+# match-field specificity weights: an exact grid list outranks a cubic
+# extent; every other pinned field counts 1. The sum is the entry's
+# specificity score; most-specific-match-wins with file order breaking
+# ties (earlier wins) — deterministic, never first-match-in-file-order
+# (overlapping entries used to silently shadow each other).
+MATCH_FIELDS = ("n_cells", "n", "markers_min", "markers_max",
+                "spectral_dtype", "platform", "chunk_length")
+_FIELD_WEIGHT = {"n": 2}
+
+_HITS = _obs.counter("tuning_db_hits_total")
+_FALLBACKS = _obs.counter("tuning_db_fallbacks_total")
+_PROV_SKIPS = _obs.counter("tuning_db_provenance_skips_total")
 
 
 def default_rule(n: Sequence[int], n_markers: int, support: int) -> str:
@@ -73,47 +114,169 @@ def _validate(name: str, source: str) -> str:
     return name
 
 
+def normalize_spectral_dtype(value) -> str:
+    """Canonical spectral-dtype token for matching: ``None`` means the
+    full-precision default ("f32")."""
+    return str(value).strip().lower() if value else "f32"
+
+
+# parsed-DB cache keyed on (path, mtime) — resolve_engine runs once per
+# build, but the serving router builds many pools per process
+_db_cache: dict = {}
+
+
 def load_tuning_db(path: str) -> list:
     """Entries of a tuning-DB file; raises on unreadable/malformed input
-    (a configured-but-broken DB is an error, not a silent fallback)."""
+    (a configured-but-broken DB is an error, not a silent fallback).
+    Accepts schema v1 (``{"schema": 1, "entries": [...]}``) and the
+    legacy schema-less form."""
+    try:
+        mtime = os.path.getmtime(path)
+        cached = _db_cache.get(path)
+        if cached is not None and cached[0] == mtime:
+            return cached[1]
+    except OSError:
+        mtime = None
     with open(path) as f:
         doc = json.load(f)
+    schema = doc.get("schema")
+    if schema is not None and schema != DB_SCHEMA:
+        raise ValueError(
+            f"tuning DB {path}: unknown schema {schema!r} "
+            f"(this build reads schema {DB_SCHEMA})")
     entries = doc.get("entries")
     if not isinstance(entries, list):
         raise ValueError(
             f"tuning DB {path}: expected a top-level 'entries' list")
+    if mtime is not None:
+        _db_cache[path] = (mtime, entries)
     return entries
 
 
-def _entry_matches(entry: dict, n: Sequence[int], n_markers: int) -> bool:
-    if "n_cells" in entry:
+def entry_specificity(entry: dict) -> int:
+    """Specificity score: the weighted count of pinned match fields
+    (``n`` counts double — an exact grid list is more specific than a
+    cubic extent). Ties resolve to file order (earlier wins)."""
+    return sum(_FIELD_WEIGHT.get(f, 1) for f in MATCH_FIELDS
+               if entry.get(f) is not None)
+
+
+def entry_matches(entry: dict, n: Sequence[int], n_markers: int,
+                  spectral_dtype: Optional[str] = None,
+                  platform: Optional[str] = None,
+                  chunk_length: Optional[int] = None) -> bool:
+    """Does ``entry`` match the query configuration? A pinned field the
+    query does not supply (platform unknown, no chunk length) does NOT
+    match — steering on unknown context would be a guess, and the
+    heuristic is a better guess."""
+    if entry.get("n_cells") is not None:
         if not all(int(v) == int(entry["n_cells"]) for v in n):
             return False
-    if "n" in entry:
+    if entry.get("n") is not None:
         if [int(v) for v in entry["n"]] != [int(v) for v in n]:
             return False
-    if "markers_min" in entry and n_markers < int(entry["markers_min"]):
+    if entry.get("markers_min") is not None \
+            and n_markers < int(entry["markers_min"]):
         return False
-    if "markers_max" in entry and n_markers > int(entry["markers_max"]):
+    if entry.get("markers_max") is not None \
+            and n_markers > int(entry["markers_max"]):
         return False
+    if entry.get("spectral_dtype") is not None:
+        if (normalize_spectral_dtype(entry["spectral_dtype"])
+                != normalize_spectral_dtype(spectral_dtype)):
+            return False
+    if entry.get("platform") is not None:
+        if platform is None \
+                or str(entry["platform"]).lower() != str(platform).lower():
+            return False
+    if entry.get("chunk_length") is not None:
+        if chunk_length is None \
+                or int(entry["chunk_length"]) != int(chunk_length):
+            return False
     return True
 
 
+def provenance_compatible(entry: dict,
+                          platform: Optional[str]) -> bool:
+    """A ``provenance.platform`` pin restricts an entry to the backend
+    it was measured on — a CPU-measured winner must never steer a TPU
+    run (and vice versa). Unknown current platform fails closed."""
+    prov = entry.get("provenance") or {}
+    pinned = prov.get("platform")
+    if pinned is None:
+        return True
+    return (platform is not None
+            and str(pinned).lower() == str(platform).lower())
+
+
+def current_platform() -> Optional[str]:
+    """The active jax backend name, or None when jax is unavailable
+    (entries pinning a platform then never match — fail closed)."""
+    try:
+        import jax
+        return jax.default_backend()
+    except Exception:
+        return None
+
+
+def lookup_tuning_db(entries: list, n: Sequence[int], n_markers: int,
+                     spectral_dtype: Optional[str] = None,
+                     platform: Optional[str] = None,
+                     chunk_length: Optional[int] = None
+                     ) -> Optional[dict]:
+    """The winning DB entry for a query, or None. Most-specific-match
+    wins; equal specificity resolves to file order (earlier wins).
+    Provenance-incompatible entries are skipped (counted) before
+    matching."""
+    best, best_score = None, -1
+    for entry in entries:
+        if not isinstance(entry, dict):
+            raise ValueError(f"tuning DB entry is not an object: "
+                             f"{entry!r}")
+        if not provenance_compatible(entry, platform):
+            _PROV_SKIPS.inc()
+            continue
+        if not entry_matches(entry, n, n_markers,
+                             spectral_dtype=spectral_dtype,
+                             platform=platform,
+                             chunk_length=chunk_length):
+            continue
+        score = entry_specificity(entry)
+        if score > best_score:      # ties keep the EARLIER entry
+            best, best_score = entry, score
+    return best
+
+
 def resolve_engine(n: Sequence[int], n_markers: int, support: int,
-                   env: Optional[dict] = None) -> str:
+                   env: Optional[dict] = None, *,
+                   spectral_dtype: Optional[str] = None,
+                   platform: Optional[str] = None,
+                   chunk_length: Optional[int] = None) -> str:
     """Resolve the ``auto`` engine alias to a concrete engine name for a
     grid of extents ``n`` carrying ``n_markers`` markers under a delta
     kernel of half-width ``support``. Resolution order: env override,
-    tuning DB, built-in heuristic. ``env`` substitutes for
-    ``os.environ`` in tests."""
+    tuning DB (most-specific match; see module docstring), built-in
+    heuristic. ``env`` substitutes for ``os.environ`` in tests;
+    ``platform`` defaults to the active jax backend."""
     env = os.environ if env is None else env
     override = str(env.get(ENV_ENGINE, "") or "").strip().lower()
     if override and override != "auto":
         return _validate(override, f"${ENV_ENGINE}")
     db_path = str(env.get(ENV_TUNING_DB, "") or "").strip()
+    if db_path.lower() in DB_DISABLE_VALUES:
+        return default_rule(n, n_markers, support)
+    if not db_path and os.path.exists(DEFAULT_DB_PATH):
+        db_path = DEFAULT_DB_PATH
     if db_path:
-        for entry in load_tuning_db(db_path):
-            if _entry_matches(entry, n, n_markers):
-                return _validate(str(entry.get("engine", "")).lower(),
-                                 f"tuning DB {db_path}")
+        entries = load_tuning_db(db_path)
+        if platform is None:
+            platform = current_platform()
+        hit = lookup_tuning_db(
+            entries, n, n_markers, spectral_dtype=spectral_dtype,
+            platform=platform, chunk_length=chunk_length)
+        if hit is not None:
+            _HITS.inc()
+            return _validate(str(hit.get("engine", "")).lower(),
+                             f"tuning DB {db_path}")
+        _FALLBACKS.inc()
     return default_rule(n, n_markers, support)

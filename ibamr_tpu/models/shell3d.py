@@ -377,9 +377,12 @@ def build_shell_example(
         # tuning-DB file, else the built-in round-5 packed promotion)
         # so the flight-recorder fingerprint and the serving cache key
         # carry the RESOLVED engine, never the "auto" alias, and the
-        # ROADMAP autotuner has a seam to publish winners into
+        # tune/ autotuner has a seam to publish winners into. The
+        # spectral dtype is part of the query: the measured ranking can
+        # differ between f32 and bf16 transform configurations.
         from ibamr_tpu.models.engine_resolver import resolve_engine
-        resolved = resolve_engine(n, n_markers, support)
+        resolved = resolve_engine(n, n_markers, support,
+                                  spectral_dtype=spectral_dtype)
         use_fast_interaction = {
             "scatter": False, "mxu": True}.get(resolved, resolved)
     _ENGINES = (True, False, None, "pallas", "packed", "pallas_packed",

@@ -47,16 +47,28 @@ def test_env_override_wins_and_validates():
     assert "auto" not in RESOLVED_ENGINES
 
 
-def test_tuning_db_first_match_wins(tmp_path):
+def test_tuning_db_most_specific_wins(tmp_path):
     db = tmp_path / "tuning.json"
-    db.write_text(json.dumps({"entries": [
+    db.write_text(json.dumps({"schema": 1, "entries": [
         {"engine": "packed3", "n_cells": 256},
+        # generic marker-band entry FIRST...
         {"engine": "mxu", "markers_min": 50, "markers_max": 500},
+        # ...but the later, MORE SPECIFIC entry wins the overlap:
+        # file order is not load-bearing for differently-specific
+        # entries (the PR-12 first-match order-dependence is gone)
+        {"engine": "packed3_bf16", "n_cells": 64,
+         "markers_min": 50, "markers_max": 500},
     ]}))
     env = {ENV_TUNING_DB: str(db)}
     assert resolve_engine((256, 256, 256), 10_000, _SUPPORT,
                           env=env) == "packed3"
-    assert resolve_engine((64, 64, 64), 100, _SUPPORT, env=env) == "mxu"
+    # overlap: both the mxu band and the n_cells=64 entry match;
+    # higher specificity (n_cells + band > band alone) wins
+    assert resolve_engine((64, 64, 64), 100, _SUPPORT,
+                          env=env) == "packed3_bf16"
+    # off the pinned n_cells, the generic band entry still serves
+    assert resolve_engine((32, 32, 32), 100, _SUPPORT,
+                          env=env) == "mxu"
     # no entry matches -> heuristic
     assert resolve_engine((64, 64, 64), 10, _SUPPORT,
                           env=env) == "scatter"
@@ -64,6 +76,68 @@ def test_tuning_db_first_match_wins(tmp_path):
     assert resolve_engine((256, 256, 256), 10_000, _SUPPORT,
                           env={ENV_TUNING_DB: str(db),
                                ENV_ENGINE: "pallas"}) == "pallas"
+
+
+def test_tuning_db_equal_specificity_keeps_file_order(tmp_path):
+    db = tmp_path / "tuning.json"
+    db.write_text(json.dumps({"schema": 1, "entries": [
+        {"engine": "mxu", "markers_min": 50, "markers_max": 500},
+        {"engine": "packed3", "markers_min": 40, "markers_max": 600},
+    ]}))
+    # both match at score 2 -> the deterministic tiebreak is file
+    # order (earlier wins), never dict-iteration accident
+    assert resolve_engine((64, 64, 64), 100, _SUPPORT,
+                          env={ENV_TUNING_DB: str(db)}) == "mxu"
+
+
+def test_tuning_db_platform_and_provenance_gates(tmp_path):
+    db = tmp_path / "tuning.json"
+    db.write_text(json.dumps({"schema": 1, "entries": [
+        # platform match-field pin: only serves tpu queries
+        {"engine": "packed3", "platform": "tpu"},
+        # provenance pin: measured on tpu, must not steer cpu runs
+        {"engine": "mxu", "markers_min": 50, "markers_max": 500,
+         "provenance": {"platform": "tpu", "timestamp": "2026-08-06"}},
+    ]}))
+    env = {ENV_TUNING_DB: str(db)}
+    # under the forced-cpu test backend both entries are skipped
+    assert resolve_engine((64, 64, 64), 100, _SUPPORT,
+                          env=env) == "scatter"
+    # an explicit tpu query reaches them (10 markers: outside the mxu
+    # band, so the platform-pinned entry serves)
+    assert resolve_engine((64, 64, 64), 10, _SUPPORT, env=env,
+                          platform="tpu") == "packed3"
+    # cpu provenance serves cpu queries
+    db.write_text(json.dumps({"schema": 1, "entries": [
+        {"engine": "mxu", "markers_min": 50, "markers_max": 500,
+         "provenance": {"platform": "cpu",
+                        "timestamp": "2026-08-06"}}]}))
+    assert resolve_engine((64, 64, 64), 100, _SUPPORT,
+                          env=env) == "mxu"
+
+
+def test_tuning_db_disable_and_spectral_dtype_match(tmp_path):
+    db = tmp_path / "tuning.json"
+    db.write_text(json.dumps({"schema": 1, "entries": [
+        {"engine": "mxu", "markers_min": 50, "markers_max": 500,
+         "spectral_dtype": "bf16"}]}))
+    env = {ENV_TUNING_DB: str(db)}
+    # a bf16-pinned entry does not serve the default-f32 query...
+    assert resolve_engine((64, 64, 64), 100, _SUPPORT,
+                          env=env) == "scatter"
+    # ...but serves the bf16 one
+    assert resolve_engine((64, 64, 64), 100, _SUPPORT, env=env,
+                          spectral_dtype="bf16") == "mxu"
+    # IBAMR_TUNING_DB=none opts out of the committed default DB
+    assert resolve_engine((64, 64, 64), 100, _SUPPORT,
+                          env={ENV_TUNING_DB: "none"}) == "scatter"
+
+
+def test_tuning_db_unknown_schema_rejected(tmp_path):
+    db = tmp_path / "tuning.json"
+    db.write_text(json.dumps({"schema": 99, "entries": []}))
+    with pytest.raises(ValueError, match="schema"):
+        load_tuning_db(str(db))
 
 
 def test_malformed_tuning_db_raises(tmp_path):

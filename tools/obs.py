@@ -215,6 +215,52 @@ def render_serving(snap, records: list) -> list:
     return lines
 
 
+def render_tuning(snap, records: list) -> list:
+    """Autotuner block (PR 13): the resolver's DB hit/fallback/skip
+    totals plus the measured winner per searched configuration key,
+    from ``tune_trial`` ledger records. Empty when the run never
+    touched the tuner or the tuning DB."""
+    table = (snap or {}).get("counters") or {}
+    trials = [r for r in records if r.get("kind") == "tune_trial"]
+    counter_keys = ("tuning_db_hits_total", "tuning_db_fallbacks_total",
+                    "tuning_db_provenance_skips_total",
+                    "tune_trials_total", "tune_pruned_total",
+                    "tune_errors_total")
+    if not trials and not any(table.get(k) for k in counter_keys):
+        return []
+    lines = []
+    for key, label in ((counter_keys[0], "DB hits"),
+                       (counter_keys[1], "DB fallbacks (heuristic)"),
+                       (counter_keys[2], "DB provenance skips"),
+                       (counter_keys[3], "trials measured"),
+                       (counter_keys[4], "candidates pruned"),
+                       (counter_keys[5], "trial errors")):
+        if table.get(key):
+            lines.append(f"  {label}: {_fmt_num(table[key])}")
+    # winner per configuration key (n, markers), with its margin over
+    # the best OTHER engine — the same ranking tune.py publishes
+    by_key = {}
+    for r in trials:
+        if r.get("error") or not r.get("steps_per_s"):
+            continue
+        by_key.setdefault((r.get("n"), r.get("markers")), []).append(r)
+    for (n, markers), rows in sorted(by_key.items(),
+                                     key=lambda kv: kv[0]):
+        rows.sort(key=lambda r: r["steps_per_s"], reverse=True)
+        w = rows[0]
+        ru = next((r for r in rows[1:]
+                   if r.get("engine") != w.get("engine")), None)
+        margin = (f", {w['steps_per_s'] / ru['steps_per_s']:.2f}x over "
+                  f"{ru['engine']}" if ru and ru.get("steps_per_s")
+                  else "")
+        lines.append(
+            f"  n={n} markers={markers}: {w.get('engine')}"
+            f"/{w.get('spectral_dtype')}/L{w.get('chunk_length')} "
+            f"{w['steps_per_s']:.2f} steps/s ({len(rows)} trials"
+            f"{margin})")
+    return lines
+
+
 def render_incidents(records: list, t0=None) -> list:
     lines = []
     for rec in records:
@@ -333,6 +379,11 @@ def cmd_summary(args) -> int:
         print("\nserving (warm-pool efficacy):")
         for ln in serving:
             print(ln)
+    tuning = render_tuning(last_counters(records), records)
+    if tuning:
+        print("\ntuning (autotuner + resolver DB):")
+        for ln in tuning:
+            print(ln)
     print("\nincidents:")
     t0 = min(times) if times else None
     for ln in render_incidents(records, t0):
@@ -364,6 +415,14 @@ def _one_line(rec: dict) -> str:
                 f"lane={rec.get('lane')} "
                 f"first_step={_fmt_s(rec.get('first_step_s'))} "
                 f"ok={rec.get('ok')}")
+    if kind == "tune_trial":
+        return (f"seq={rec['seq']:<6} tune      "
+                f"{rec.get('engine')}/{rec.get('spectral_dtype')}"
+                f"/L{rec.get('chunk_length')} n={rec.get('n')} "
+                f"{rec.get('steps_per_s')} steps/s "
+                f"{'HIT' if rec.get('cache_hit') else 'compile'}"
+                + (f" ERROR={rec.get('error')}" if rec.get("error")
+                   else ""))
     if kind == "aot_cache":
         return (f"seq={rec['seq']:<6} aot_cache "
                 f"{rec.get('event')} key={rec.get('key')} "

@@ -181,7 +181,8 @@ def main() -> int:
         profile_dir = args.out.replace(".json", "_profile")
         bench_cmd = [sys.executable, os.path.join(REPO, "bench.py"),
                      "--stages", "64,128,256", "--heartbeat", hb_path,
-                     "--record", record_dir, "--fleet", "8"]
+                     "--record", record_dir, "--fleet", "8",
+                     "--tune-grid"]
         if args.profile_stages:
             # device profiles of the named stages ride the same healthy
             # window; they are the only trace-level artifact a dead
@@ -299,6 +300,31 @@ def main() -> int:
                     g.write(r6.stdout or "")
             except subprocess.TimeoutExpired:
                 log(f, "serve bench timed out")
+            # seventh step (PR 13): measured engine search on the real
+            # accelerator — the ONLY place the tuning DB's numbers can
+            # come from. Publishes winners to a per-capture DB next to
+            # the artifact (never straight onto the committed
+            # TUNING_DB.json — a human promotes it after `tune.py
+            # check` holds); flagship-matched marker lattices per size
+            try:
+                tune_db = args.out.replace(".json", "_tuning_db.json")
+                r7 = subprocess.run(
+                    [sys.executable,
+                     os.path.join(REPO, "tools", "tune.py"),
+                     "search", "--n", "128,256",
+                     "--engines",
+                     "packed,packed_bf16,pallas_packed,packed3_bf16,mxu",
+                     "--dtypes", "f32", "--chunk-lengths", "1,4",
+                     "--reps", "5", "--publish", "--db", tune_db],
+                    capture_output=True, text=True, cwd=REPO, env=env,
+                    timeout=args.bench_timeout)
+                log(f, f"tune search rc={r7.returncode}\n"
+                       + "\n".join((r7.stderr or "").strip().splitlines()[-5:]))
+                with open(args.out.replace(".json", "_tune.json"),
+                          "w") as g:
+                    g.write(r7.stdout or "")
+            except subprocess.TimeoutExpired:
+                log(f, "tune search timed out")
             # fifth step (PR 10): archive each profile capture — the
             # attribution summary is the regression-comparable
             # artifact; the raw multi-MB traces are pruned ONLY after
