@@ -246,7 +246,7 @@ def fleet_reference(B: int = 8, timeout_s: float = 600.0, n: int = 32,
         f"fleet leg hung > {timeout_s:.0f}s", "fleet")
 
 
-def _serve_child(q, n, n_lat, n_lon, lanes, steps, dt):
+def _serve_child(q, n, n_lat, n_lon, lanes, steps, dt, warm_requests):
     """Child body: the request-to-first-step latency drill — one
     scenario family served cold then warm through a fresh warm-pool
     router (ibamr_tpu/serve/router.py), on a single virtual CPU device
@@ -261,22 +261,28 @@ def _serve_child(q, n, n_lat, n_lon, lanes, steps, dt):
         from ibamr_tpu.serve.router import cold_warm_drill
 
         q.put(cold_warm_drill(n_cells=n, n_lat=n_lat, n_lon=n_lon,
-                              lanes=lanes, steps=steps, dt=dt))
+                              lanes=lanes, steps=steps, dt=dt,
+                              warm_requests=warm_requests))
     except Exception as e:  # noqa: BLE001 - report, parent decides
         q.put({"error": f"{type(e).__name__}: {e}"})
 
 
 def serve_reference(timeout_s: float = 300.0, n: int = 16,
                     n_lat: int = 8, n_lon: int = 16, lanes: int = 2,
-                    steps: int = 3, dt: float = 5e-5):
+                    steps: int = 3, dt: float = 5e-5,
+                    warm_requests: int = 8):
     """Cold-vs-warm serving latency signal (PR 12): request-to-first-
     step latency of the warm-pool router, cold (bucket compiles on
     miss) vs warm (AOT cache hit), in a TERMINABLE child. The same
     drill that SERVE_CONTRACT.json pins structurally
     (``tools/serve.py check``); here it rides the bench artifact so the
-    cold/warm ratio is trended across rounds."""
+    cold/warm ratio is trended across rounds. ``warm_requests`` extra
+    warm serves (PR 14) give the drill's ``warm_p50_s``/``warm_p99_s``
+    histogram percentiles a real sample, and the per-key histogram
+    snapshot rides the artifact for ``tools/obs.py compare``."""
     return _run_guarded_child(
-        _serve_child, (n, n_lat, n_lon, lanes, steps, dt), timeout_s,
+        _serve_child, (n, n_lat, n_lon, lanes, steps, dt,
+                       warm_requests), timeout_s,
         f"serve leg hung > {timeout_s:.0f}s", "serve")
 
 
@@ -1130,7 +1136,9 @@ def main():
             else:
                 result["serve"] = serve_reference(
                     timeout_s=min(300.0, remaining))
-            log(f"[bench] serve: {result['serve']}")
+            log("[bench] serve: " + str({
+                k: v for k, v in (result["serve"] or {}).items()
+                if k != "histograms"}))
         except Exception as e:
             result["serve"] = {"error": f"{type(e).__name__}: {e}"}
 

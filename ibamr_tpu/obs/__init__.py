@@ -36,23 +36,34 @@ See docs/OBSERVABILITY.md for the ledger schema and the CLI cookbook
 """
 
 from ibamr_tpu.obs.bus import (  # noqa: F401
+    HISTOGRAM_BOUNDS,
+    Histogram,
     LEDGER_SCHEMA,
     RunLedger,
     attach,
     chunk_boundary,
     counter,
     current,
+    current_trace,
+    describe,
     detach,
     emit,
     gauge,
+    help_for,
+    histogram,
     last_seq,
     ledger,
     metrics_snapshot,
+    new_trace_id,
+    peek_gauge,
+    quantiles_from_counts,
     read_ledger,
+    record_trace_ids,
     reset_metrics,
     run_id_from_fingerprint,
     sample_memory_watermarks,
     span,
+    trace_scope,
 )
 from ibamr_tpu.obs.export import (  # noqa: F401
     prometheus_text,

@@ -27,6 +27,7 @@ import os
 import re
 import threading
 import time
+from bisect import bisect_right
 from contextlib import contextmanager
 from typing import Any, Dict, Optional, Tuple
 
@@ -94,9 +95,99 @@ class Gauge:
         self.value = float(v)
 
 
+# Fixed log-spaced histogram bounds shared by every histogram: six
+# buckets per decade over 1e-6 .. 1e3 (sub-microsecond observes through
+# ~17-minute walls; anything above lands in the +Inf bucket). One
+# process-wide lattice keeps snapshots mergeable and the percentile
+# estimator's worst-case error a single bucket ratio (10^(1/6) ~ 1.47x).
+HISTOGRAM_BOUNDS: Tuple[float, ...] = tuple(
+    10.0 ** (-6.0 + k / 6.0) for k in range(55))
+
+
+class Histogram:
+    """Fixed-bucket latency/size distribution.
+
+    ``observe`` is the hot path and follows the counter contract:
+    the bucket index is computed first (the only function call), then
+    the bucket count and running sum update as straight-line attribute
+    arithmetic — GIL-atomic, no lock, no ledger write. Bucket counts
+    are NON-cumulative in memory; the exporter cumulates them into
+    Prometheus ``le`` series and :func:`quantiles_from_counts`
+    estimates percentiles by interpolating within the target bucket.
+    """
+
+    __slots__ = ("name", "labels", "key", "counts", "sum")
+
+    bounds = HISTOGRAM_BOUNDS
+
+    def __init__(self, name: str, labels: Tuple[Tuple[str, str], ...]):
+        self.name = name
+        self.labels = labels
+        self.key = _render_key(name, labels)
+        self.counts = [0] * (len(HISTOGRAM_BOUNDS) + 1)
+        self.sum = 0.0
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        i = bisect_right(HISTOGRAM_BOUNDS, v)
+        self.counts[i] += 1
+        self.sum += v
+
+    @property
+    def count(self) -> int:
+        return sum(self.counts)
+
+    def snapshot(self) -> dict:
+        """``{"sum": s, "count": n, "counts": [...]}`` — the per-chunk
+        ledger form (raw per-bucket counts, shared bounds implied)."""
+        counts = list(self.counts)
+        return {"sum": self.sum, "count": sum(counts), "counts": counts}
+
+    def quantile(self, q: float) -> Optional[float]:
+        return quantiles_from_counts(self.counts, [q])[0]
+
+
+def quantiles_from_counts(counts, qs, bounds=HISTOGRAM_BOUNDS):
+    """Percentile estimates from per-bucket (non-cumulative) counts.
+
+    For each quantile ``q`` in ``qs``: find the bucket holding the
+    ``q``-th ranked observation and interpolate linearly between its
+    bounds (the first bucket's lower bound is 0; the +Inf bucket
+    reports the last finite bound — the estimator cannot see past it).
+    Returns one value per ``q``, ``None`` where the histogram is empty.
+    """
+    total = sum(counts)
+    out = []
+    for q in qs:
+        if total == 0:
+            out.append(None)
+            continue
+        q = min(max(float(q), 0.0), 1.0)
+        rank = q * total
+        cum = 0.0
+        idx = len(counts) - 1
+        for i, c in enumerate(counts):
+            cum += c
+            if cum >= rank and c:
+                idx = i
+                break
+        if idx >= len(bounds):                 # +Inf bucket
+            out.append(float(bounds[-1]))
+            continue
+        lo = 0.0 if idx == 0 else float(bounds[idx - 1])
+        hi = float(bounds[idx])
+        below = cum - counts[idx]
+        frac = (rank - below) / counts[idx] if counts[idx] else 0.0
+        out.append(lo + (hi - lo) * min(max(frac, 0.0), 1.0))
+    return out
+
+
 _REG_LOCK = threading.Lock()
 _COUNTERS: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], Counter] = {}
 _GAUGES: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], Gauge] = {}
+_HISTOGRAMS: Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
+                  Histogram] = {}
+_HELP: Dict[str, str] = {}
 
 
 def counter(name: str, **labels) -> Counter:
@@ -122,14 +213,57 @@ def gauge(name: str, **labels) -> Gauge:
     return g
 
 
+def histogram(name: str, **labels) -> Histogram:
+    """The process-wide histogram for ``(name, labels)`` — registry
+    semantics identical to :func:`counter` (created on first use, lock
+    only on creation and snapshot, ``reset_metrics`` zeroes values in
+    place so module-cached handles stay live). Cache the returned
+    instance on hot paths; ``observe`` is the lock-free part."""
+    key = (name, tuple(sorted((str(k), str(v))
+                              for k, v in labels.items())))
+    h = _HISTOGRAMS.get(key)
+    if h is None:
+        with _REG_LOCK:
+            h = _HISTOGRAMS.setdefault(key, Histogram(name, key[1]))
+    return h
+
+
+def peek_gauge(name: str, **labels) -> Optional[float]:
+    """The gauge's value WITHOUT creating it — ``None`` when no
+    subsystem ever touched that metric. Lets an observer (the watchdog
+    heartbeat) report serving fields only on runs that actually serve,
+    keeping the solo heartbeat schema untouched."""
+    key = (name, tuple(sorted((str(k), str(v))
+                              for k, v in labels.items())))
+    g = _GAUGES.get(key)
+    return None if g is None else g.value
+
+
+def describe(name: str, text: str) -> None:
+    """Register the ``# HELP`` line for a metric family (by bare
+    name). Subsystems call this next to the ``counter()``/
+    ``histogram()`` creation; the exporter falls back to a generic
+    line for families nobody described."""
+    with _REG_LOCK:
+        _HELP[_sanitize_name(name)] = str(text)
+
+
+def help_for(name: str) -> Optional[str]:
+    with _REG_LOCK:
+        return _HELP.get(_sanitize_name(name))
+
+
 def metrics_snapshot() -> dict:
-    """``{"counters": {key: value}, "gauges": {key: value}}`` with
+    """``{"counters": {key: value}, "gauges": {key: value},
+    "histograms": {key: {sum, count, counts}}}`` with
     Prometheus-rendered keys. The instant snapshot written into the
     ledger at every chunk boundary and serialized by the exporter."""
     with _REG_LOCK:
         return {
             "counters": {c.key: c.value for c in _COUNTERS.values()},
             "gauges": {g.key: g.value for g in _GAUGES.values()},
+            "histograms": {h.key: h.snapshot()
+                           for h in _HISTOGRAMS.values()},
         }
 
 
@@ -143,15 +277,22 @@ def reset_metrics() -> None:
             c.value = 0
         for g in _GAUGES.values():
             g.value = 0.0
+        for h in _HISTOGRAMS.values():
+            for i in range(len(h.counts)):
+                h.counts[i] = 0
+            h.sum = 0.0
 
 
 def iter_metrics():
-    """Yield ``(kind, name, labels, key, value)`` for the exporter."""
+    """Yield ``(kind, name, labels, key, value)`` for the exporter.
+    Histogram values are their :meth:`Histogram.snapshot` dicts."""
     with _REG_LOCK:
-        items = ([("counter", c) for c in _COUNTERS.values()]
-                 + [("gauge", g) for g in _GAUGES.values()])
-    for kind, m in items:
-        yield kind, m.name, m.labels, m.key, m.value
+        items = ([("counter", c, c.value) for c in _COUNTERS.values()]
+                 + [("gauge", g, g.value) for g in _GAUGES.values()]
+                 + [("histogram", h, h.snapshot())
+                    for h in _HISTOGRAMS.values()])
+    for kind, m, value in items:
+        yield kind, m.name, m.labels, m.key, value
 
 
 # ---------------------------------------------------------------------------
@@ -323,9 +464,14 @@ def last_seq() -> Optional[int]:
 
 def emit(kind: str, **payload) -> Optional[int]:
     """Append to the current ledger; ``None`` when none is attached
-    (telemetry-off runs pay nothing)."""
+    (telemetry-off runs pay nothing). Records emitted inside a
+    :func:`trace_scope` are stamped with the active trace identity
+    unless the payload already carries one."""
     led = _CURRENT
-    return led.append(kind, payload) if led is not None else None
+    if led is None:
+        return None
+    _stamp_trace(payload)
+    return led.append(kind, payload)
 
 
 @contextmanager
@@ -346,10 +492,83 @@ def ledger(path: str, fingerprint: Optional[dict] = None,
 
 
 # ---------------------------------------------------------------------------
-# spans
+# trace identity: request-scoped correlation across ledger records
 # ---------------------------------------------------------------------------
 
 _TLS = threading.local()
+
+
+def new_trace_id() -> str:
+    """Mint a request-scoped trace identity (16 hex). Unlike
+    ``run_id`` — a digest of the run fingerprint, the root of the
+    trace tree — a trace_id names ONE request's path through the
+    process: admission, bucket wait, any compile it paid for, ack,
+    cruise chunks, completion or quarantine."""
+    return hashlib.sha256(os.urandom(16)).hexdigest()[:16]
+
+
+def _trace_stack() -> list:
+    st = getattr(_TLS, "trace", None)
+    if st is None:
+        st = _TLS.trace = []
+    return st
+
+
+def current_trace() -> Tuple[str, ...]:
+    """The innermost active trace identity — ``()`` outside any
+    :func:`trace_scope`. Thread-local: a worker thread doing traced
+    work on a request's behalf must enter its own scope (the router
+    hands the waiting requests' ids to the background pool build)."""
+    st = getattr(_TLS, "trace", None)
+    return st[-1] if st else ()
+
+
+@contextmanager
+def trace_scope(*trace_ids):
+    """Attribute everything emitted in this block — ledger records via
+    :func:`emit`, closing spans, capsule manifests — to the given
+    trace id(s). A batch serving several requests carries all their
+    ids; ``None`` entries are dropped so callers can pass optional
+    ids straight through."""
+    ids = tuple(str(t) for t in trace_ids if t)
+    st = _trace_stack()
+    st.append(ids)
+    try:
+        yield ids
+    finally:
+        st.pop()
+
+
+def _stamp_trace(payload: dict) -> None:
+    """Stamp the active trace identity into a ledger payload (single
+    id as ``trace_id``, several as ``trace_ids``) unless the caller
+    already set one explicitly — explicit beats ambient, so a
+    per-lane record can name ITS request inside a batch scope."""
+    if "trace_id" in payload or "trace_ids" in payload:
+        return
+    ids = current_trace()
+    if not ids:
+        return
+    if len(ids) == 1:
+        payload["trace_id"] = ids[0]
+    else:
+        payload["trace_ids"] = list(ids)
+
+
+def record_trace_ids(rec: dict) -> Tuple[str, ...]:
+    """Every trace id a ledger record names (reader-side helper:
+    ``tools/obs.py trace`` matches on this)."""
+    ids = []
+    if rec.get("trace_id"):
+        ids.append(str(rec["trace_id"]))
+    for t in rec.get("trace_ids") or ():
+        ids.append(str(t))
+    return tuple(ids)
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
 
 
 def _stack() -> list:
@@ -401,6 +620,7 @@ def span(name: str, block_on=None, **attrs):
                 payload["attrs"] = attrs
             if err is not None:
                 payload["error"] = err
+            _stamp_trace(payload)
             led.append("span", payload)
 
 
@@ -452,9 +672,12 @@ def chunk_boundary(step: Optional[int] = None,
     snap = metrics_snapshot()
     extra = time.perf_counter() - t0   # append() accounts for itself
     led.overhead_s += extra
-    return led.append("counters", {
+    rec = {
         "step": step,
         "chunk_wall_s": chunk_wall_s,
         "counters": snap["counters"],
         "gauges": snap["gauges"],
-        "obs_overhead_s": round(led.overhead_s, 6)})
+        "obs_overhead_s": round(led.overhead_s, 6)}
+    if snap["histograms"]:
+        rec["histograms"] = snap["histograms"]
+    return led.append("counters", rec)

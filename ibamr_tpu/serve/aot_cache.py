@@ -56,6 +56,18 @@ _MISSES = _obs.counter("aot_cache_misses_total")
 _EVICTS = _obs.counter("aot_cache_evictions_total")
 _CORRUPT = _obs.counter("aot_cache_corrupt_total")
 _WAITS = _obs.counter("aot_cache_inflight_waits_total")
+# build-time distribution, split by where the backend compile came
+# from: source="compile" (true cold build) vs "persistent" (XLA's disk
+# cache served it — the load-time tail the persistent layer exists for)
+_H_BUILD = {s: _obs.histogram("aot_cache_build_seconds", source=s)
+            for s in ("compile", "persistent")}
+_obs.describe("aot_cache_hits_total",
+              "In-process executable-cache hits.")
+_obs.describe("aot_cache_misses_total",
+              "Executable-cache misses (one AOT build each).")
+_obs.describe("aot_cache_build_seconds",
+              "Executable build wall time on a miss, by "
+              "source=compile|persistent.")
 
 # fingerprint fields that determine the compiled executable — the
 # "scenario family". Everything else in the fingerprint (rng keys,
@@ -269,6 +281,7 @@ class ExecutableCache:
             flight.entry = entry
             self._inflight.pop(key, None)
         _MISSES.inc()
+        _H_BUILD[entry.cold_source].observe(compile_s)
         _obs.emit("aot_cache", event="miss", key=key, label=label,
                   compile_s=round(compile_s, 3),
                   cold_source=entry.cold_source)

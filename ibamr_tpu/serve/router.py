@@ -49,6 +49,35 @@ _COLD = _obs.counter("serve_cold_requests_total")
 _QUAR = _obs.counter("serve_quarantined_total")
 _PADS = _obs.counter("serve_padded_lanes_total")
 
+# Request-latency distributions (PR 14). Handles are module-cached —
+# ``reset_metrics`` zeroes values in place, so these stay live.
+_H_REQ = {p: _obs.histogram("serve_request_seconds", path=p)
+          for p in ("cold", "warm")}
+_H_FIRST = {p: _obs.histogram("serve_first_step_seconds", path=p)
+            for p in ("cold", "warm")}
+_H_WAIT = _obs.histogram("serve_bucket_wait_seconds")
+_H_PADFRAC = _obs.histogram("serve_padding_fraction")
+_obs.describe("serve_requests_total", "Requests completed by the router.")
+_obs.describe("serve_cold_requests_total",
+              "Requests that paid a bucket compile (cold path).")
+_obs.describe("serve_quarantined_total",
+              "Requests whose lane was quarantined mid-flight.")
+_obs.describe("serve_padded_lanes_total",
+              "Dead padding lanes stepped alongside live requests.")
+_obs.describe("serve_request_seconds",
+              "End-to-end request latency (submit to completion), "
+              "by path=cold|warm.")
+_obs.describe("serve_first_step_seconds",
+              "Request-to-first-step ack latency, by path=cold|warm.")
+_obs.describe("serve_bucket_wait_seconds",
+              "Wait for the bucket's warm pool (compile time on a miss).")
+_obs.describe("serve_padding_fraction",
+              "Per-batch fraction of bucket lanes that were padding.")
+_obs.describe("serve_requests_inflight",
+              "Requests admitted and not yet completed.")
+_obs.describe("serve_requests_completed",
+              "Requests completed since process start.")
+
 
 @dataclass(frozen=True)
 class BucketSpec:
@@ -108,6 +137,7 @@ class RequestResult:
     total_s: float
     family_key: str
     error: Optional[str] = None
+    trace_id: Optional[str] = None
 
 
 class WarmPool:
@@ -197,13 +227,16 @@ class WarmPool:
 
 
 class _PoolBuild:
-    __slots__ = ("event", "pool", "error", "thread")
+    __slots__ = ("event", "pool", "error", "thread", "trace_ids")
 
-    def __init__(self):
+    def __init__(self, trace_ids=()):
         self.event = threading.Event()
         self.pool = None
         self.error = None
         self.thread = None
+        # the cold requests waiting on this build: the background
+        # compile's spans and aot_cache records bill to THEIR traces
+        self.trace_ids = tuple(t for t in trace_ids if t)
 
 
 class WarmPoolRouter:
@@ -237,19 +270,22 @@ class WarmPoolRouter:
             return [w() for w in waits]
         return waits
 
-    def _ensure_pool(self, spec: BucketSpec):
+    def _ensure_pool(self, spec: BucketSpec, trace_ids=()):
         """Warm pool for ``spec``, compiled asynchronously on a miss
         (one background build per bucket, published to the shared
         executable cache). Returns a ``wait()`` callable producing the
         pool — a cold request's latency includes this wait; every
-        other family keeps serving meanwhile."""
+        other family keeps serving meanwhile. ``trace_ids`` names the
+        requests whose cold path this build is (thread-locals do not
+        cross threads, so the identity is handed over explicitly); a
+        build already in flight keeps its original attribution."""
         with self._lock:
             pool = self._pools.get(spec)
             if pool is not None:
                 return lambda: pool
             flight = self._inflight.get(spec)
             if flight is None:
-                flight = _PoolBuild()
+                flight = _PoolBuild(trace_ids=trace_ids)
                 self._inflight[spec] = flight
                 t = threading.Thread(target=self._build_pool,
                                      args=(spec, flight), daemon=True)
@@ -266,8 +302,11 @@ class WarmPoolRouter:
 
     def _build_pool(self, spec: BucketSpec, flight: _PoolBuild) -> None:
         try:
-            pool = WarmPool(spec, self.cache)
-            pool.ensure_compiled()
+            with _obs.trace_scope(*flight.trace_ids), \
+                    _obs.span("serve/pool_build",
+                              lanes=spec.lanes, n=spec.n_cells):
+                pool = WarmPool(spec, self.cache)
+                pool.ensure_compiled()
             with self._lock:
                 self._pools[spec] = pool
                 self._inflight.pop(spec, None)
@@ -308,108 +347,179 @@ class WarmPoolRouter:
 
     def serve(self, requests: Sequence[ScenarioRequest]):
         """Serve a batch of scenario requests; returns one
-        :class:`RequestResult` per request, input order preserved."""
-        results: list = [None] * len(requests)
-        groups: dict = {}
-        for i, r in enumerate(requests):
-            groups.setdefault(r.family(), []).append((i, r))
-        for family, members in groups.items():
-            pos = 0
-            while pos < len(members):
-                spec = self._bucket_for(family, len(members) - pos)
-                batch = members[pos:pos + spec.lanes]
-                pos += len(batch)
-                out = self._serve_batch(spec, [r for _, r in batch])
-                for (i, _), res in zip(batch, out):
-                    results[i] = res
+        :class:`RequestResult` per request, input order preserved.
+
+        Admission mints each request a ``trace_id`` and emits a
+        ``request_admit`` ledger record; every record and span the
+        request touches downstream carries the id, so
+        ``tools/obs.py trace <id>`` rebuilds the full
+        admission→completion timeline from the ledger alone."""
+        g_in = _obs.gauge("serve_requests_inflight")
+        g_done = _obs.gauge("serve_requests_completed")
+        tids = [_obs.new_trace_id() for _ in requests]
+        g_in.set(g_in.value + len(requests))
+        for r, tid in zip(requests, tids):
+            _obs.emit("request_admit", trace_id=tid, tenant=r.tenant,
+                      family=str(r.family()), steps=int(r.steps))
+        try:
+            results: list = [None] * len(requests)
+            groups: dict = {}
+            for i, r in enumerate(requests):
+                groups.setdefault(r.family(), []).append((i, r))
+            for family, members in groups.items():
+                pos = 0
+                while pos < len(members):
+                    spec = self._bucket_for(family, len(members) - pos)
+                    batch = members[pos:pos + spec.lanes]
+                    pos += len(batch)
+                    out = self._serve_batch(spec, [r for _, r in batch],
+                                            [tids[i] for i, _ in batch])
+                    for (i, _), res in zip(batch, out):
+                        results[i] = res
+        finally:
+            g_in.set(max(g_in.value - len(requests), 0))
+        g_done.set(g_done.value + len(requests))
         return results
 
     def _serve_batch(self, spec: BucketSpec,
-                     reqs: Sequence[ScenarioRequest]):
+                     reqs: Sequence[ScenarioRequest],
+                     tids: Sequence[Optional[str]] = ()):
         import jax.numpy as jnp
 
+        tids = list(tids) or [None] * len(reqs)
         t_submit = time.perf_counter()
-        cold = not self.is_warm(spec)
-        pool = self._ensure_pool(spec)()   # cold: compile lands here
-        B = spec.lanes
-        pads = B - len(reqs)
-        if pads:
-            _PADS.inc(pads)
-        stacked, _ = _lanes.pad_lanes(
-            [pool.request_state(r) for r in reqs], B)
-        dt_vec = jnp.asarray(
-            [r.dt for r in reqs] + [reqs[-1].dt] * pads,
-            dtype=pool._dt_vec.dtype)
+        with _obs.trace_scope(*tids), \
+                _obs.span("serve/request", lanes=spec.lanes,
+                          requests=len(reqs)):
+            cold = not self.is_warm(spec)
+            wait = self._ensure_pool(spec, trace_ids=tids)
+            with _obs.span("bucket_wait", cold=cold):
+                t_wait = time.perf_counter()
+                pool = wait()              # cold: compile lands here
+                _H_WAIT.observe(time.perf_counter() - t_wait)
+            B = spec.lanes
+            pads = B - len(reqs)
+            if pads:
+                _PADS.inc(pads)
+            _H_PADFRAC.observe(pads / B)
+            stacked, _ = _lanes.pad_lanes(
+                [pool.request_state(r) for r in reqs], B)
+            dt_vec = jnp.asarray(
+                [r.dt for r in reqs] + [reqs[-1].dt] * pads,
+                dtype=pool._dt_vec.dtype)
 
-        steps_done = np.zeros(B, dtype=int)
-        target = np.array([r.steps for r in reqs] + [0] * pads)
-        quarantined = np.zeros(B, dtype=bool)
-        alive_host = np.arange(B) < len(reqs)
-        first_step_s = None
-        state = stacked
-        while True:
-            remaining = target - steps_done
-            live = alive_host & (remaining > 0)
-            if not live.any():
-                break
-            # only pre-compiled lengths run (1 and chunk_steps): the
-            # warm path performs ZERO compiles by construction
-            length = (spec.chunk_steps
-                      if first_step_s is not None
-                      and int(remaining[live].max()) >= spec.chunk_steps
-                      else 1)
-            run_mask = live & (remaining >= length)
-            state, health = pool.chunk(length)(
-                state, dt_vec, jnp.asarray(run_mask))
-            h = np.asarray(health)       # one host transfer per chunk
-            if first_step_s is None:
-                first_step_s = time.perf_counter() - t_submit
-            steps_done[run_mask] += length
-            newly_bad = run_mask & (h < 0.5)
-            quarantined |= newly_bad
-            alive_host &= ~newly_bad
+            steps_done = np.zeros(B, dtype=int)
+            target = np.array([r.steps for r in reqs] + [0] * pads)
+            quarantined = np.zeros(B, dtype=bool)
+            alive_host = np.arange(B) < len(reqs)
+            first_step_s = None
+            state = stacked
+            while True:
+                remaining = target - steps_done
+                live = alive_host & (remaining > 0)
+                if not live.any():
+                    break
+                # only pre-compiled lengths run (1 and chunk_steps):
+                # the warm path performs ZERO compiles by construction
+                length = (spec.chunk_steps
+                          if first_step_s is not None
+                          and int(remaining[live].max())
+                          >= spec.chunk_steps
+                          else 1)
+                run_mask = live & (remaining >= length)
+                with _obs.span("ack" if first_step_s is None
+                               else "cruise", steps=length):
+                    state, health = pool.chunk(length)(
+                        state, dt_vec, jnp.asarray(run_mask))
+                    h = np.asarray(health)   # one transfer per chunk
+                if first_step_s is None:
+                    first_step_s = time.perf_counter() - t_submit
+                steps_done[run_mask] += length
+                newly_bad = run_mask & (h < 0.5)
+                for lane in np.nonzero(newly_bad)[0]:
+                    if lane >= len(reqs):
+                        continue
+                    _obs.emit("lane_quarantine",
+                              trace_id=tids[lane] or None,
+                              tenant=reqs[lane].tenant, family=pool.key,
+                              lane=int(lane),
+                              step=int(steps_done[lane]))
+                quarantined |= newly_bad
+                alive_host &= ~newly_bad
 
-        total_s = time.perf_counter() - t_submit
-        if first_step_s is None:          # zero-step requests
-            first_step_s = total_s
-        results = []
-        for lane, r in enumerate(reqs):
-            q = bool(quarantined[lane])
-            ok = bool(steps_done[lane] >= r.steps) and not q
-            _REQS.inc()
-            if cold:
-                _COLD.inc()
-            if q:
-                _QUAR.inc()
-            results.append(RequestResult(
-                tenant=r.tenant, ok=ok, quarantined=q, cold=cold,
-                bucket_lanes=B, lane=lane,
-                steps_done=int(steps_done[lane]),
-                first_step_s=first_step_s, total_s=total_s,
-                family_key=pool.key,
-                error=("lane quarantined (non-finite state)" if q
-                       else None)))
-            _obs.emit("request", tenant=r.tenant, family=pool.key,
-                      engine=pool.engine, bucket_lanes=B, lane=lane,
-                      cold=cold, ok=ok, quarantined=q,
-                      steps=int(steps_done[lane]),
-                      first_step_s=round(first_step_s, 4),
-                      total_s=round(total_s, 4))
+            total_s = time.perf_counter() - t_submit
+            if first_step_s is None:          # zero-step requests
+                first_step_s = total_s
+            path = "cold" if cold else "warm"
+            results = []
+            for lane, r in enumerate(reqs):
+                q = bool(quarantined[lane])
+                ok = bool(steps_done[lane] >= r.steps) and not q
+                _REQS.inc()
+                if cold:
+                    _COLD.inc()
+                if q:
+                    _QUAR.inc()
+                _H_REQ[path].observe(total_s)
+                _H_FIRST[path].observe(first_step_s)
+                results.append(RequestResult(
+                    tenant=r.tenant, ok=ok, quarantined=q, cold=cold,
+                    bucket_lanes=B, lane=lane,
+                    steps_done=int(steps_done[lane]),
+                    first_step_s=first_step_s, total_s=total_s,
+                    family_key=pool.key, trace_id=tids[lane],
+                    error=("lane quarantined (non-finite state)" if q
+                           else None)))
+                _obs.emit("request", trace_id=tids[lane] or None,
+                          tenant=r.tenant, family=pool.key,
+                          engine=pool.engine, bucket_lanes=B, lane=lane,
+                          cold=cold, ok=ok, quarantined=q,
+                          steps=int(steps_done[lane]),
+                          first_step_s=round(first_step_s, 4),
+                          total_s=round(total_s, 4))
         return results
+
+
+def _histogram_delta(before: dict, after: dict) -> dict:
+    """Per-key difference of two ``metrics_snapshot()["histograms"]``
+    dicts, keeping only keys that saw observes in between — the drill
+    reports ITS distribution even when the process served before."""
+    out = {}
+    for key, snap in after.items():
+        b = before.get(key)
+        if b is None:
+            counts = list(snap["counts"])
+            s = float(snap["sum"])
+        else:
+            counts = [int(a) - int(x)
+                      for a, x in zip(snap["counts"], b["counts"])]
+            s = float(snap["sum"]) - float(b["sum"])
+        n = sum(counts)
+        if n > 0:
+            out[key] = {"sum": s, "count": n, "counts": counts}
+    return out
 
 
 def cold_warm_drill(n_cells: int = 16, n_lat: int = 8, n_lon: int = 16,
                     lanes: int = 2, steps: int = 3, dt: float = 5e-5,
                     engine: Optional[str] = None,
                     spectral_dtype: Optional[str] = None,
-                    cache_dir: Optional[str] = None) -> dict:
+                    cache_dir: Optional[str] = None,
+                    warm_requests: int = 1) -> dict:
     """The serving benchmark: one scenario family served twice through
     a FRESH router + FRESH executable cache — request 1 pays the cold
     path (bucket compile on miss), request 2 rides warm. Returns
     request-to-first-step latencies plus compile counts; the serve
     contract (``tools/serve.py check`` vs SERVE_CONTRACT.json) pins
     ``warm_compiles == 0`` and ``warm_new_trace_signatures == 0``
-    structurally."""
+    structurally.
+
+    ``warm_requests > 1`` serves extra warm requests AFTER the
+    contract-measured one (its compile/hit accounting is untouched) so
+    the warm-path percentiles (``warm_p50_s``/``warm_p99_s``, from the
+    ``serve_first_step_seconds{path="warm"}`` histogram delta) rest on
+    a real sample; the full per-key histogram delta rides along under
+    ``"histograms"`` for ``tools/obs.py compare`` and the SLO gate."""
     cache = aot_cache.ExecutableCache(directory=cache_dir)
     spec = BucketSpec(n_cells=n_cells, n_lat=n_lat, n_lon=n_lon,
                       lanes=lanes, engine=engine,
@@ -427,11 +537,20 @@ def cold_warm_drill(n_cells: int = 16, n_lat: int = 8, n_lon: int = 16,
         return res, {"compiles": after["misses"] - before["misses"],
                      "hits": after["hits"] - before["hits"]}
 
+    hist_before = _obs.metrics_snapshot()["histograms"]
     cold_res, cold_stats = one("drill-cold")
     pool = router._pools[spec]
     sigs_cold = sum(pool.driver.trace_counts.values())
     warm_res, warm_stats = one("drill-warm")
     sigs_warm = sum(pool.driver.trace_counts.values())
+    for k in range(max(0, int(warm_requests) - 1)):
+        one(f"drill-warm-{k + 2}")
+    hist = _histogram_delta(hist_before,
+                            _obs.metrics_snapshot()["histograms"])
+    warm_first = hist.get('serve_first_step_seconds{path="warm"}')
+    warm_p50, warm_p99 = (
+        _obs.quantiles_from_counts(warm_first["counts"], [0.5, 0.99])
+        if warm_first else (None, None))
     return {
         "n": n_cells, "lanes": lanes, "steps": steps,
         "engine": pool.engine,
@@ -445,4 +564,10 @@ def cold_warm_drill(n_cells: int = 16, n_lat: int = 8, n_lon: int = 16,
         "warm_hits": warm_stats["hits"],
         "warm_new_trace_signatures": sigs_warm - sigs_cold,
         "cold_ok": bool(cold_res.ok), "warm_ok": bool(warm_res.ok),
+        "warm_requests": max(1, int(warm_requests)),
+        "warm_p50_s": (None if warm_p50 is None
+                       else round(warm_p50, 6)),
+        "warm_p99_s": (None if warm_p99 is None
+                       else round(warm_p99, 6)),
+        "histograms": hist,
     }

@@ -408,12 +408,21 @@ class AsyncCheckpointWriter:
         # `_write_arrays` is looked up per call so fault injection
         # (tools.fault_injection.failing_checkpoint_writes) sees both
         # attempts.
+        import time as _time
+
+        from ibamr_tpu import obs as _obs
+        t0 = _time.perf_counter()
         try:
-            return _write_arrays(directory, arrays, schema, step,
-                                 metadata, keep, lanes=lanes)
-        except Exception:
-            return _write_arrays(directory, arrays, schema, step,
-                                 metadata, keep, lanes=lanes)
+            try:
+                return _write_arrays(directory, arrays, schema, step,
+                                     metadata, keep, lanes=lanes)
+            except Exception:
+                return _write_arrays(directory, arrays, schema, step,
+                                     metadata, keep, lanes=lanes)
+        finally:
+            _obs.histogram("ckpt_commit_seconds",
+                           writer="single").observe(
+                _time.perf_counter() - t0)
 
     def save(self, state: Any, step: int,
              metadata: Optional[Dict[str, Any]] = None):

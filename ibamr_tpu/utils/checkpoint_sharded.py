@@ -517,16 +517,27 @@ class AsyncShardedWriter:
                 fname, lambda f: np.savez(f, **arrays))
             return _shard_name(i), {"crc32": crc, "size": size}
 
+        import time as _time
+
+        from ibamr_tpu import obs as _obs
+        t0 = _time.perf_counter()
         try:
-            return self._write_step_once(step, n_shards, leaves_meta,
-                                         per_shard, schema, metadata,
-                                         write_one)
-        except Exception:
-            # one retry: the atomic-replace protocol makes it
-            # idempotent (same contract as the single-host writer)
-            return self._write_step_once(step, n_shards, leaves_meta,
-                                         per_shard, schema, metadata,
-                                         write_one)
+            try:
+                return self._write_step_once(step, n_shards,
+                                             leaves_meta, per_shard,
+                                             schema, metadata,
+                                             write_one)
+            except Exception:
+                # one retry: the atomic-replace protocol makes it
+                # idempotent (same contract as the single-host writer)
+                return self._write_step_once(step, n_shards,
+                                             leaves_meta, per_shard,
+                                             schema, metadata,
+                                             write_one)
+        finally:
+            _obs.histogram("ckpt_commit_seconds",
+                           writer="sharded").observe(
+                _time.perf_counter() - t0)
 
     def _write_step_once(self, step, n_shards, leaves_meta, per_shard,
                          schema, metadata, write_one):

@@ -414,6 +414,19 @@ class FlightRecorder:
             "fingerprint": self.fingerprint(driver),
             "time": time.time(),
         }
+        try:
+            # a capsule dumped while serving names the request(s) whose
+            # trace crosses it — the ledger timeline and the capsule
+            # then cross-reference by trace_id, not just run_id
+            from ibamr_tpu.obs import bus as _bus
+            tids = _bus.current_trace()
+            if tids:
+                if len(tids) == 1:
+                    manifest["trace_id"] = tids[0]
+                else:
+                    manifest["trace_ids"] = list(tids)
+        except Exception:
+            pass
         if lane is not None:
             fleet_size = (len(entry.dt) if fleet else
                           getattr(driver, "lanes", None))

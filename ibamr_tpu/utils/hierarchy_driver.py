@@ -31,9 +31,13 @@ import numpy as np
 
 from ibamr_tpu import obs as _obs
 
-# module-cached handles: inc() on the instance is the lock-free path
+# module-cached handles: inc()/observe() on the instance is the
+# lock-free path
 _CHUNKS_TOTAL = _obs.counter("driver_chunks_total")
 _STEPS_TOTAL = _obs.counter("driver_steps_total")
+_CHUNK_WALL = _obs.histogram("driver_chunk_wall_seconds")
+_obs.describe("driver_chunk_wall_seconds",
+              "Per-chunk wall time including the post-chunk sync.")
 
 
 class SimulationDiverged(RuntimeError):
@@ -493,6 +497,7 @@ class HierarchyDriver:
             self.last_chunk_wall_s = time.perf_counter() - t0
             _CHUNKS_TOTAL.inc()
             _STEPS_TOTAL.inc(n)
+            _CHUNK_WALL.observe(self.last_chunk_wall_s)
             # per-chunk counters snapshot + device-memory watermarks,
             # riding the sync that just happened (no-op when no ledger
             # is attached)

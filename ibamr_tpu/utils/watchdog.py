@@ -236,6 +236,20 @@ class RunWatchdog:
             # telemetry stream (and the seq to start reading at)
             payload["ledger_path"] = self._ledger_path
             payload["ledger_seq"] = self._ledger_seq
+        # serving runs (PR 14): the router's request gauges, present
+        # only when the process actually served — peeked, not created,
+        # so the solo heartbeat schema is untouched (PR-7 precedent)
+        try:
+            from ibamr_tpu.obs import bus as _bus
+            inflight = _bus.peek_gauge("serve_requests_inflight")
+            completed = _bus.peek_gauge("serve_requests_completed")
+        except Exception:
+            inflight = completed = None
+        if inflight is not None or completed is not None:
+            payload["requests_inflight"] = (
+                None if inflight is None else int(inflight))
+            payload["requests_completed"] = (
+                None if completed is None else int(completed))
         return payload
 
     # -- detector -----------------------------------------------------------

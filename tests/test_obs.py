@@ -265,28 +265,59 @@ def test_prometheus_export_lints(tmp_path):
     obs.reset_metrics()
     obs.counter("lint_events_total", kind='we"ird', k2="b").inc(7)
     obs.gauge("lint_depth").set(2.5)
+    obs.describe("lint_events_total", "Lint fixture counter.")
+    h = obs.histogram("lint_latency_seconds", stage="a")
+    for v in (0.001, 0.02, 3.0):
+        h.observe(v)
     text = obs.prometheus_text()
     name = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
     label = r'[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
     sample = re.compile(
         rf"^{name}(?:\{{{label}(?:,{label})*\}})? -?[0-9.e+-]+$")
-    type_line = re.compile(rf"^# TYPE {name} (counter|gauge)$")
-    seen_types = set()
+    type_line = re.compile(
+        rf"^# TYPE {name} (counter|gauge|histogram)$")
+    help_line = re.compile(rf"^# HELP {name} \S.*$")
+    seen_types, seen_helps = set(), set()
     for line in text.strip().splitlines():
-        if line.startswith("#"):
+        if line.startswith("# TYPE"):
             m = type_line.match(line)
             assert m, f"bad TYPE line: {line!r}"
             seen_types.add(line.split()[2])
             continue
+        if line.startswith("#"):
+            m = help_line.match(line)
+            assert m, f"bad HELP line: {line!r}"
+            seen_helps.add(line.split()[2])
+            continue
         assert sample.match(line), f"bad sample line: {line!r}"
     assert "lint_events_total" in seen_types
     assert "lint_depth" in seen_types
-    # ledger-snapshot rendering takes the same path
+    assert "lint_latency_seconds" in seen_types
+    assert "lint_events_total" in seen_helps
+    # histogram family: full cumulative series with le labels
+    buckets = [ln for ln in text.splitlines()
+               if ln.startswith("lint_latency_seconds_bucket{")]
+    assert buckets and 'le="+Inf"' in buckets[-1]
+    assert all('stage="a"' in ln for ln in buckets)
+    cums = [int(ln.rsplit(" ", 1)[1]) for ln in buckets]
+    assert cums == sorted(cums) and cums[-1] == 3   # cumulative
+    assert any(ln.startswith("lint_latency_seconds_sum{")
+               for ln in text.splitlines())
+    assert any(ln.startswith("lint_latency_seconds_count{")
+               and ln.rstrip().endswith(" 3")
+               for ln in text.splitlines())
+    # ledger-snapshot rendering takes the same path (and an
+    # undescribed family gets no HELP line — exposition unchanged)
     out = tmp_path / "metrics.prom"
     obs.write_prometheus(str(out),
                          counters={"from_ledger_total": 3}, gauges={})
     assert out.read_text() == "# TYPE from_ledger_total counter\n" \
                               "from_ledger_total 3\n"
+    # a ledger histogram snapshot rendered standalone
+    snap = obs.metrics_snapshot()["histograms"]
+    text2 = obs.prometheus_text(histograms=snap)
+    assert "# TYPE lint_latency_seconds histogram" in text2
+    assert 'le="+Inf",stage="a"} 3' in text2
 
 
 def test_memory_watermarks_cpu_noop(monkeypatch):
@@ -481,3 +512,152 @@ def test_fleet_run_ledger_renders_summary(tmp_path, capsys):
     text = obs.prometheus_text(counters=snap["counters"],
                                gauges=snap["gauges"])
     assert "# TYPE driver_steps_total counter" in text
+
+
+# ---------------------------------------------------------------------------
+# latency histograms
+# ---------------------------------------------------------------------------
+
+def test_histogram_identity_and_reset_liveness():
+    obs.reset_metrics()
+    h1 = obs.histogram("hist_demo_seconds", path="warm")
+    h2 = obs.histogram("hist_demo_seconds", path="warm")
+    assert h1 is h2                       # registry identity, like counters
+    h3 = obs.histogram("hist_demo_seconds", path="cold")
+    assert h3 is not h1
+    h1.observe(0.5)
+    h1.observe(2.0)
+    assert h1.count == 2 and h1.sum == 2.5
+    snap = obs.metrics_snapshot()["histograms"]
+    assert snap['hist_demo_seconds{path="warm"}']["count"] == 2
+    # reset zeroes in place: the held handle stays live
+    obs.reset_metrics()
+    assert h1.count == 0 and h1.sum == 0.0
+    h1.observe(1.0)
+    assert obs.metrics_snapshot()["histograms"][
+        'hist_demo_seconds{path="warm"}']["count"] == 1
+
+
+def test_histogram_concurrent_observes_lose_no_counts():
+    """observe() must be GIL-atomic: threaded observers may not lose
+    increments (the same pin counters carry)."""
+    import threading
+    obs.reset_metrics()
+    h = obs.histogram("hist_race_seconds")
+    n_threads, n_obs = 4, 20_000
+    vals = [1e-5, 1e-3, 0.1, 10.0]
+
+    def worker(v):
+        for _ in range(n_obs):
+            h.observe(v)
+
+    ts = [threading.Thread(target=worker, args=(vals[i],))
+          for i in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert h.count == n_threads * n_obs
+    assert math.isclose(h.sum, n_obs * sum(vals), rel_tol=1e-9)
+
+
+def test_histogram_percentiles_vs_numpy_oracle():
+    """Bucketed estimates must land within one bucket ratio
+    (10**(1/6) per decade-sixth bounds) of the exact percentile."""
+    rng = np.random.default_rng(7)
+    samples = rng.lognormal(mean=-3.0, sigma=1.2, size=5000)
+    h = obs.Histogram("oracle_seconds", ())
+    for v in samples:
+        h.observe(float(v))
+    ratio = 10.0 ** (1.0 / 6.0)
+    for q in (0.5, 0.95, 0.99):
+        est = h.quantile(q)
+        exact = float(np.percentile(samples, 100.0 * q))
+        assert exact / ratio <= est <= exact * ratio, \
+            f"q={q}: est {est:.6g} vs exact {exact:.6g}"
+    # degenerate cases
+    empty = obs.Histogram("empty_seconds", ())
+    assert empty.quantile(0.5) is None
+    over = obs.Histogram("over_seconds", ())
+    over.observe(1e9)                     # lands in the +Inf bucket
+    assert over.quantile(0.99) == obs.HISTOGRAM_BOUNDS[-1]
+
+
+def test_quantiles_from_counts_matches_handle():
+    h = obs.Histogram("qfc_seconds", ())
+    for v in (0.001, 0.002, 0.02, 0.5, 0.5, 3.0):
+        h.observe(v)
+    counts = h.snapshot()["counts"]
+    qs = obs.quantiles_from_counts(counts, [0.5, 0.95, 0.99])
+    assert qs == [h.quantile(0.5), h.quantile(0.95), h.quantile(0.99)]
+
+
+def test_chunk_boundary_snapshots_histograms(tmp_path):
+    obs.reset_metrics()
+    path = str(tmp_path / "ledger.jsonl")
+    with obs.ledger(path):
+        obs.histogram("hist_led_seconds").observe(0.25)
+        obs.chunk_boundary()
+    recs = [r for r in obs.read_ledger(path) if r["kind"] == "counters"]
+    assert recs and recs[-1]["histograms"][
+        "hist_led_seconds"]["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# request-scoped trace identity
+# ---------------------------------------------------------------------------
+
+def test_trace_scope_stamps_emit_and_span(tmp_path):
+    obs.reset_metrics()
+    path = str(tmp_path / "ledger.jsonl")
+    tid = obs.new_trace_id()
+    assert re.fullmatch(r"[0-9a-f]{16}", tid)
+    with obs.ledger(path):
+        with obs.trace_scope(tid):
+            assert obs.current_trace() == (tid,)
+            obs.emit("demo_event", detail=1)
+            with obs.span("demo/phase"):
+                pass
+        obs.emit("outside_event")         # after scope: unstamped
+    recs = obs.read_ledger(path)
+    by_kind = {}
+    for r in recs:
+        by_kind.setdefault(r["kind"], []).append(r)
+    assert by_kind["demo_event"][0]["trace_id"] == tid
+    assert by_kind["span"][0]["trace_id"] == tid
+    assert "trace_id" not in by_kind["outside_event"][0]
+    assert obs.record_trace_ids(by_kind["demo_event"][0]) == (tid,)
+    assert obs.record_trace_ids(by_kind["outside_event"][0]) == ()
+
+
+def test_trace_scope_batch_stamps_id_list(tmp_path):
+    obs.reset_metrics()
+    path = str(tmp_path / "ledger.jsonl")
+    t1, t2 = obs.new_trace_id(), obs.new_trace_id()
+    with obs.ledger(path):
+        with obs.trace_scope(t1, t2):     # a batch serving two requests
+            obs.emit("batch_event")
+        with obs.trace_scope(t1, None):   # Nones are dropped
+            obs.emit("solo_event")
+    recs = {r["kind"]: r for r in obs.read_ledger(path)}
+    assert recs["batch_event"]["trace_ids"] == [t1, t2]
+    assert "trace_id" not in recs["batch_event"]
+    assert recs["solo_event"]["trace_id"] == t1
+    assert obs.record_trace_ids(recs["batch_event"]) == (t1, t2)
+
+
+def test_heartbeat_serving_fields(tmp_path):
+    from ibamr_tpu.utils.watchdog import RunWatchdog, read_heartbeat
+    hb = str(tmp_path / "heartbeat.json")
+    wd = RunWatchdog(heartbeat_path=hb)
+    if obs.peek_gauge("serve_requests_inflight") is None:
+        wd.beat(step=1)                   # solo schema: fields absent
+        assert "requests_inflight" not in read_heartbeat(hb)
+    # once the router's gauges exist the beat carries them
+    obs.gauge("serve_requests_inflight").set(2)
+    obs.gauge("serve_requests_completed").set(5)
+    wd.beat(step=2)
+    payload = read_heartbeat(hb)
+    assert payload["requests_inflight"] == 2
+    assert payload["requests_completed"] == 5
+    assert isinstance(payload["requests_completed"], int)

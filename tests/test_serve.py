@@ -285,9 +285,9 @@ def test_request_ledger_accounting(warm_router, tmp_path):
     router, _ = warm_router
     path = str(tmp_path / "ledger.jsonl")
     with obs.ledger(path):
-        router.serve([_req("tenant-a"), _req("tenant-b")])
-    recs = [r for r in obs.read_ledger(path)
-            if r.get("kind") == "request"]
+        results = router.serve([_req("tenant-a"), _req("tenant-b")])
+    all_recs = obs.read_ledger(path)
+    recs = [r for r in all_recs if r.get("kind") == "request"]
     assert [r["tenant"] for r in recs] == ["tenant-a", "tenant-b"]
     for r in recs:
         assert r["ok"] and not r["quarantined"] and not r["cold"]
@@ -295,6 +295,70 @@ def test_request_ledger_accounting(warm_router, tmp_path):
         assert r["steps"] == 2
         assert r["first_step_s"] <= r["total_s"]
         assert r["engine"] and r["engine"] != "auto"
+    # trace identity: every request minted a distinct id at admission,
+    # and the completion record carries the same id as the result
+    admits = [r for r in all_recs if r.get("kind") == "request_admit"]
+    assert [a["tenant"] for a in admits] == ["tenant-a", "tenant-b"]
+    tids = [a["trace_id"] for a in admits]
+    assert len(set(tids)) == 2
+    assert [r.trace_id for r in results] == tids
+    assert [r["trace_id"] for r in recs] == tids
+    # batch spans are stamped with BOTH ids (one batch, two requests)
+    spans = [r for r in all_recs if r.get("kind") == "span"
+             and r.get("path", "").startswith("serve/request")]
+    assert spans and sorted(obs.record_trace_ids(spans[0])) \
+        == sorted(tids)
+
+
+def test_trace_timeline_reconstructs_request(warm_router, tmp_path,
+                                             capsys):
+    """Acceptance: ``tools/obs.py trace <id>`` rebuilds one request's
+    admission -> execution -> completion timeline from the ledger
+    alone, resolving unique id prefixes."""
+    from tools.obs import main as obs_main
+
+    router, _ = warm_router
+    path = str(tmp_path / "ledger.jsonl")
+    with obs.ledger(path):
+        res = router.serve([_req("traced", steps=2)])[0]
+    assert res.ok and res.trace_id
+
+    rc = obs_main(["trace", path, res.trace_id[:6]])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert f"trace {res.trace_id}" in out
+    assert "admitted" in out and "tenant=traced" in out
+    assert "completed" in out and "warm" in out
+    assert "verdict: ok" in out
+    lines = out.strip().splitlines()
+    assert "admitted" in lines[1]        # admission leads the timeline
+    assert any("serve/request" in ln for ln in lines)
+
+    # unknown prefix: no timeline, rc 1
+    assert obs_main(["trace", path, "ffffffff"]) == 1
+    capsys.readouterr()
+
+
+def test_tail_filters_by_trace_and_grep(warm_router, tmp_path,
+                                        capsys):
+    from tools.obs import main as obs_main
+
+    router, _ = warm_router
+    path = str(tmp_path / "ledger.jsonl")
+    with obs.ledger(path):
+        r0, r1 = router.serve([_req("tail-a"), _req("tail-b")])
+
+    fast = ["--max-seconds", "0.01", "--interval", "0.01"]
+    assert obs_main(["tail", path, "--trace", r0.trace_id[:8]]
+                    + fast) == 0
+    out = capsys.readouterr().out
+    assert "tenant=tail-a" in out
+    # per-request records of the OTHER request are filtered out
+    assert "tenant=tail-b" not in out
+
+    assert obs_main(["tail", path, "--grep", "tail-b"] + fast) == 0
+    out = capsys.readouterr().out
+    assert "tenant=tail-b" in out and "tenant=tail-a" not in out
 
 
 def test_obs_summary_renders_serving_block(warm_router, tmp_path,

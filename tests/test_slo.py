@@ -1,0 +1,222 @@
+"""Serving-path SLO gate (tools/slo.py, SLO.json).
+
+One module-scoped drill ledger feeds every CLI test — the acceptance
+matrix (0 clean / 1 unevaluable / 2 violated) re-evaluates the same
+measurement against different contracts instead of re-compiling a
+bucket per case. Pure-function tests (evaluate, slis_from_*,
+tighten_contract) run on synthetic inputs.
+"""
+
+import json
+import os
+import sys
+import types
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from ibamr_tpu import obs                              # noqa: E402
+import tools.slo as slo                                # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# one drill, one ledger (module-scoped: a single bucket compile)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def drill_ledger(tmp_path_factory):
+    td = tmp_path_factory.mktemp("slo")
+    path = str(td / "ledger.jsonl")
+    obs.reset_metrics()                 # hermetic SLIs for this ledger
+    args = types.SimpleNamespace(
+        backend="cpu", n=8, n_lat=6, n_lon=8, lanes=2, steps=3,
+        dt=5e-5, engine="", warm_requests=8)
+    out = slo.run_drill_ledger(args, path)
+    return path, out
+
+
+# ---------------------------------------------------------------------------
+# acceptance: committed contract vs a fresh drill ledger
+# ---------------------------------------------------------------------------
+
+def test_committed_contract_attained(drill_ledger, capsys):
+    """The repo's pinned SLO.json exits 0 against a fresh drill."""
+    path, _ = drill_ledger
+    rc = slo.main(["check", "--ledger", path])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "clean" in out
+    assert "VIOLATED" not in out
+
+
+def test_injected_violation_exits_2(drill_ledger, tmp_path, capsys):
+    path, _ = drill_ledger
+    bad = {"slo_schema": 1, "drill": {},
+           "slos": {"warm_path_compiles": {"ceiling": -1},
+                    "warm_first_step_p99_s": {"ceiling": 1e-9}}}
+    cpath = str(tmp_path / "bad_slo.json")
+    json.dump(bad, open(cpath, "w"))
+    rc = slo.main(["check", "--ledger", path, "--contract", cpath,
+                   "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 2
+    assert doc["exit"] == 2
+    assert len(doc["violated"]) == 2
+    assert any("warm_path_compiles" in v for v in doc["violated"])
+
+
+def test_missing_contract_and_unmeasurable_exit_1(drill_ledger,
+                                                  tmp_path, capsys):
+    path, _ = drill_ledger
+    # no contract file at all -> unevaluable
+    rc = slo.main(["check", "--ledger", path, "--contract",
+                   str(tmp_path / "absent.json")])
+    out = capsys.readouterr().out
+    assert rc == 1 and "no contract" in out
+    # a budgeted SLI the ledger cannot produce -> unevaluable
+    weird = {"slo_schema": 1, "drill": {},
+             "slos": {"p999_of_nothing_s": {"ceiling": 1.0}}}
+    cpath = str(tmp_path / "weird.json")
+    json.dump(weird, open(cpath, "w"))
+    rc = slo.main(["check", "--ledger", path, "--contract", cpath])
+    out = capsys.readouterr().out
+    assert rc == 1 and "not measurable" in out
+
+
+def test_tighten_then_check_round_trips(drill_ledger, tmp_path,
+                                        capsys):
+    path, _ = drill_ledger
+    cpath = str(tmp_path / "tight.json")
+    assert slo.main(["check", "--ledger", path, "--tighten",
+                     "--contract", cpath]) == 0
+    capsys.readouterr()
+    doc = json.load(open(cpath))
+    assert doc["slo_schema"] == slo.SLO_SCHEMA
+    assert "warm_first_step_p99_s" in doc["slos"]
+    assert doc["slos"]["warm_path_compiles"] == {"ceiling": 0}
+    # the tightened contract is attained by the measurement it came from
+    assert slo.main(["check", "--ledger", path,
+                     "--contract", cpath]) == 0
+    capsys.readouterr()
+
+
+def test_drill_json_path_evaluates_saved_artifact(drill_ledger,
+                                                  tmp_path, capsys):
+    _, drill = drill_ledger
+    # as a bench artifact ({"serve": {...}}) — the compare shape
+    jpath = str(tmp_path / "bench.json")
+    json.dump({"serve": drill}, open(jpath, "w"))
+    rc = slo.main(["check", "--drill-json", jpath])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+
+
+# ---------------------------------------------------------------------------
+# unit: SLI computation and the evaluate matrix
+# ---------------------------------------------------------------------------
+
+def test_slis_from_ledger_on_drill(drill_ledger):
+    path, drill = drill_ledger
+    slis = slo.slis_from_ledger(obs.read_ledger(path))
+    assert slis["warm_path_compiles"] == 0          # PR-11 guarantee
+    assert slis["quarantine_rate"] == 0.0
+    assert 0.0 < slis["cache_hit_ratio"] < 1.0      # cold misses exist
+    assert slis["warm_first_step_p99_s"] is not None
+    assert slis["warm_first_step_p99_s"] < 2.0
+    # the histogram estimate brackets the drill's own percentile
+    assert slis["padding_fraction"] is not None
+    assert 0.0 <= slis["padding_fraction"] <= 1.0
+
+
+def test_slis_from_ledger_synthetic_fallback():
+    """No histogram snapshot: warm p99 falls back to the empirical
+    quantile of request records."""
+    recs = [
+        {"kind": "request_admit", "seq": 1, "trace_id": "a" * 16},
+        {"kind": "aot_cache", "seq": 2, "event": "miss"},
+        {"kind": "request", "seq": 3, "trace_id": "a" * 16,
+         "cold": True, "quarantined": False, "first_step_s": 5.0},
+        {"kind": "request_admit", "seq": 4, "trace_id": "b" * 16},
+        {"kind": "aot_cache", "seq": 5, "event": "hit"},
+        {"kind": "request", "seq": 6, "trace_id": "b" * 16,
+         "cold": False, "quarantined": False, "first_step_s": 0.01},
+    ]
+    slis = slo.slis_from_ledger(recs)
+    assert slis["warm_first_step_p99_s"] == 0.01
+    assert slis["warm_path_compiles"] == 0    # the miss predates warm
+    assert slis["quarantine_rate"] == 0.0
+    assert slis["cache_hit_ratio"] == 0.5
+    assert slis["padding_fraction"] is None   # no histogram anywhere
+    # a miss AFTER the warm admission counts against the warm path
+    recs.append({"kind": "aot_cache", "seq": 7, "event": "miss"})
+    assert slo.slis_from_ledger(recs)["warm_path_compiles"] == 1
+
+
+def test_evaluate_matrix():
+    contract = {"slos": {
+        "warm_first_step_p99_s": {"ceiling": 1.0},
+        "cache_hit_ratio": {"floor": 0.5},
+        "quarantine_rate": {"ceiling": 0.0},
+    }}
+    ok = {"warm_first_step_p99_s": 0.01, "cache_hit_ratio": 0.9,
+          "quarantine_rate": 0.0}
+    v, u, m = slo.evaluate(ok, contract)
+    assert (v, u) == ([], []) and len(m) == 3
+    # headroom is attainment, never drift
+    assert any("within ceiling" in s for s in m)
+    bad = dict(ok, cache_hit_ratio=0.1, quarantine_rate=0.5)
+    v, u, m = slo.evaluate(bad, contract)
+    assert len(v) == 2 and not u
+    assert any("floor" in s for s in v)
+    part = dict(ok, cache_hit_ratio=None)
+    v, u, m = slo.evaluate(part, contract)
+    assert not v and len(u) == 1 and len(m) == 2
+    # a malformed budget (no ceiling/floor) is unmeasurable, not fatal
+    v, u, m = slo.evaluate(ok, {"slos": {"x": {}}})
+    assert not v and len(u) == 1
+
+
+def test_load_contract_rejects_wrong_schema(tmp_path):
+    p = str(tmp_path / "future.json")
+    json.dump({"slo_schema": 99, "slos": {}}, open(p, "w"))
+    with pytest.raises(ValueError, match="slo_schema"):
+        slo.load_contract(p)
+
+
+def test_tighten_contract_slack_rules():
+    slis = {"warm_first_step_p99_s": 0.01, "warm_path_compiles": 0,
+            "padding_fraction": 0.95, "quarantine_rate": 0.0,
+            "cache_hit_ratio": 0.1}
+    doc = slo.tighten_contract(slis, {"n": 8})
+    s = doc["slos"]
+    assert s["warm_first_step_p99_s"]["ceiling"] == 0.5   # floored
+    assert s["warm_path_compiles"]["ceiling"] == 0        # exact pin
+    assert s["padding_fraction"]["ceiling"] == 1.0        # clamped
+    assert s["cache_hit_ratio"]["floor"] == 0.0           # clamped
+    big = slo.tighten_contract(
+        dict(slis, warm_first_step_p99_s=3.0), {})
+    assert big["slos"]["warm_first_step_p99_s"]["ceiling"] == 6.0
+    # absent SLIs produce no budget at all
+    sparse = slo.tighten_contract({"quarantine_rate": 0.0}, {})
+    assert set(sparse["slos"]) == {"quarantine_rate"}
+
+
+def test_empirical_quantile_edges():
+    assert slo._empirical_quantile([], 0.99) is None
+    assert slo._empirical_quantile([7.0], 0.5) == 7.0
+    vals = [float(i) for i in range(1, 101)]
+    assert slo._empirical_quantile(vals, 0.99) == 99.0
+    assert slo._empirical_quantile(vals, 0.5) == 50.0
+
+
+def test_committed_contract_matches_schema():
+    """The contract in the repo root is loadable and budgets only
+    known SLIs in known directions."""
+    doc = slo.load_contract()
+    assert doc["slo_schema"] == slo.SLO_SCHEMA
+    for name, budget in doc["slos"].items():
+        assert name in slo.SLI_NAMES, name
+        key = "floor" if name in slo.FLOORS else "ceiling"
+        assert set(budget) == {key}, (name, budget)

@@ -13,15 +13,22 @@ This tool is the operator's side of that contract:
   seq.
 - ``tail``: live follow of a growing ledger alongside the watchdog
   heartbeat (staleness age), for watching a run without attaching to
-  its process.
+  its process; ``--grep``/``--trace`` narrow the stream to one
+  substring or one request's trace id.
+- ``trace``: one served request's full admission→completion timeline
+  (admission record, spans with parentage, cache events, quarantine,
+  completion verdict) reconstructed from the ledger alone by its
+  ``trace_id`` (PR 14 — unique prefixes accepted).
 - ``compare``: two ledgers -> per-phase wall deltas; two bench JSONs
-  (``BENCH_r*.json`` or raw ``bench.py`` output) -> per-stage and
-  per-phase deltas between revisions.
+  (``BENCH_r*.json`` or raw ``bench.py`` output) -> per-stage,
+  per-phase, and serve-leg latency-percentile deltas between
+  revisions.
 
 Examples::
 
     python tools/obs.py summary /tmp/fleet/ledger.jsonl
-    python tools/obs.py tail /tmp/fleet --max-seconds 30
+    python tools/obs.py tail /tmp/fleet --max-seconds 30 --trace 3fa2
+    python tools/obs.py trace /tmp/serve/ledger.jsonl 3fa2
     python tools/obs.py compare /tmp/a/ledger.jsonl /tmp/b/ledger.jsonl
     python tools/obs.py compare BENCH_r04.json BENCH_r05.json
 """
@@ -38,7 +45,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
-from ibamr_tpu.obs import read_ledger  # noqa: E402
+from ibamr_tpu.obs import (  # noqa: E402
+    quantiles_from_counts,
+    read_ledger,
+    record_trace_ids,
+)
 
 LEDGER_NAME = "ledger.jsonl"
 
@@ -163,6 +174,37 @@ def render_counters(snap) -> list:
         for key in sorted(table):
             lines.append(f"  {key:<58} {_fmt_num(table[key]):>14}")
     return lines or ["  (empty snapshot)"]
+
+
+def render_latency(snap) -> list:
+    """Latency-percentile table from the histogram snapshots of the
+    last ``counters`` record (cumulative => run distribution). Empty
+    when the run recorded no histograms."""
+    hists = (snap or {}).get("histograms") or {}
+    rows = []
+    for key in sorted(hists):
+        s = hists[key]
+        n = s.get("count") or 0
+        if not n:
+            continue
+        p50, p95, p99 = quantiles_from_counts(s["counts"],
+                                              [0.5, 0.95, 0.99])
+        rows.append((key, n, float(s.get("sum") or 0.0) / n,
+                     p50, p95, p99))
+    if not rows:
+        return []
+    width = max(len(k) for k, *_ in rows) + 2
+    lines = [f"  {'histogram':<{width}} {'count':>7} {'mean':>10}"
+             f" {'p50':>10} {'p95':>10} {'p99':>10}"]
+    for key, n, mean, p50, p95, p99 in rows:
+        # *_seconds families render as durations; dimensionless
+        # histograms (padding fraction) as plain numbers
+        fmt = (_fmt_s if key.split("{", 1)[0].endswith("_seconds")
+               else lambda v: _fmt_num(round(float(v), 6)))
+        lines.append(f"  {key:<{width}} {n:>7} {fmt(mean):>10}"
+                     f" {fmt(p50):>10} {fmt(p95):>10}"
+                     f" {fmt(p99):>10}")
+    return lines
 
 
 def render_serving(snap, records: list) -> list:
@@ -374,6 +416,11 @@ def cmd_summary(args) -> int:
     print("\ncounters (last snapshot = run totals):")
     for ln in render_counters(last_counters(records)):
         print(ln)
+    latency = render_latency(last_counters(records))
+    if latency:
+        print("\nlatency (histogram percentiles, last snapshot):")
+        for ln in latency:
+            print(ln)
     serving = render_serving(last_counters(records), records)
     if serving:
         print("\nserving (warm-pool efficacy):")
@@ -437,6 +484,18 @@ def _one_line(rec: dict) -> str:
     return f"seq={rec['seq']:<6} {kind:<9} {json.dumps(body)[:140]}"
 
 
+def _tail_match(rec: dict, grep: str, trace: str) -> bool:
+    """Both filters must pass: ``grep`` is a substring match against
+    the raw record JSON, ``trace`` a (prefix-tolerant) trace-id match —
+    together they let one request be followed live."""
+    if trace and not any(t == trace or t.startswith(trace)
+                         for t in record_trace_ids(rec)):
+        return False
+    if grep and grep not in json.dumps(rec):
+        return False
+    return True
+
+
 def cmd_tail(args) -> int:
     path = resolve_ledger(args.ledger)
     hb_path = args.heartbeat or os.path.join(
@@ -450,7 +509,8 @@ def cmd_tail(args) -> int:
         for rec in read_ledger(path):
             if rec["seq"] > seen:
                 seen = rec["seq"]
-                print(_one_line(rec), flush=True)
+                if _tail_match(rec, args.grep, args.trace):
+                    print(_one_line(rec), flush=True)
         now = time.monotonic()
         if now - last_hb_print >= args.heartbeat_every:
             last_hb_print = now
@@ -461,6 +521,91 @@ def cmd_tail(args) -> int:
         if deadline is not None and now >= deadline:
             return 0
         time.sleep(args.interval)
+
+
+# ---------------------------------------------------------------------------
+# trace: one request's timeline, from the ledger alone
+# ---------------------------------------------------------------------------
+
+def render_trace(records: list, tid: str) -> list:
+    """One request's full admission→completion timeline: every record
+    carrying ``tid``, chronological, spans indented by their recorded
+    depth (parentage), times relative to the first record (admission).
+    Empty when nothing carries the id."""
+    matched = [r for r in records if tid in record_trace_ids(r)]
+    if not matched:
+        return []
+    t0 = next((r["t"] for r in matched
+               if isinstance(r.get("t"), (int, float))), None)
+    run_id = matched[0].get("run_id")
+    admit = next((r for r in matched
+                  if r.get("kind") == "request_admit"), None)
+    done = next((r for r in matched if r.get("kind") == "request"),
+                None)
+    tenant = admit.get("tenant") if admit else None
+    lines = [f"trace {tid}  (run {run_id}"
+             + (f", tenant {tenant}" if tenant else "")
+             + f")  {len(matched)} record(s)"]
+    for rec in matched:
+        rel = ("        -" if t0 is None
+               or not isinstance(rec.get("t"), (int, float))
+               else f"{rec['t'] - t0:+9.3f}s")
+        kind = rec.get("kind")
+        if kind == "span":
+            indent = "  " * int(rec.get("depth") or 0)
+            desc = (f"{indent}span {rec.get('path')}  "
+                    f"{_fmt_s(rec.get('dur_s'))}")
+        elif kind == "request_admit":
+            desc = (f"admitted         tenant={rec.get('tenant')} "
+                    f"steps={rec.get('steps')}")
+        elif kind == "request":
+            desc = (f"completed        "
+                    f"{'cold' if rec.get('cold') else 'warm'} "
+                    f"ok={rec.get('ok')} lane={rec.get('lane')} "
+                    f"first_step={_fmt_s(rec.get('first_step_s'))} "
+                    f"total={_fmt_s(rec.get('total_s'))}"
+                    + (" QUARANTINED" if rec.get("quarantined")
+                       else ""))
+        elif kind == "aot_cache":
+            desc = (f"aot_cache {rec.get('event'):<7}"
+                    f"label={rec.get('label')}"
+                    + (f" compile={_fmt_s(rec.get('compile_s'))}"
+                       if rec.get("compile_s") is not None else ""))
+        elif kind == "lane_quarantine":
+            desc = (f"lane_quarantine  lane={rec.get('lane')} "
+                    f"step={rec.get('step')}")
+        else:
+            body = {k: v for k, v in rec.items()
+                    if k not in ("seq", "run_id", "t", "kind",
+                                 "trace_id", "trace_ids")}
+            desc = f"{kind:<16} {json.dumps(body)[:120]}"
+        lines.append(f"  seq={rec['seq']:<6} {rel}  {desc}")
+    if done is not None:
+        verdict = ("ok" if done.get("ok")
+                   else "quarantined" if done.get("quarantined")
+                   else "failed")
+        lines.append(f"  verdict: {verdict}")
+    return lines
+
+
+def cmd_trace(args) -> int:
+    path = resolve_ledger(args.ledger)
+    records = read_ledger(path)
+    wanted = args.trace_id
+    full = sorted({t for r in records for t in record_trace_ids(r)
+                   if t == wanted or t.startswith(wanted)})
+    if not full:
+        print(f"[obs] no records carry trace id {wanted!r} in {path}",
+              file=sys.stderr)
+        return 1
+    if len(full) > 1 and wanted not in full:
+        print(f"[obs] ambiguous trace-id prefix {wanted!r}: "
+              f"{', '.join(full)}", file=sys.stderr)
+        return 1
+    tid = wanted if wanted in full else full[0]
+    for ln in render_trace(records, tid):
+        print(ln)
+    return 0
 
 
 # ---------------------------------------------------------------------------
@@ -546,6 +691,28 @@ def compare_bench(path_a: str, path_b: str) -> list:
     for key in ("value", "mxu_vs_scatter"):
         if a.get(key) is not None or b.get(key) is not None:
             lines.append(_delta_line(key, a.get(key), b.get(key)))
+    va, vb = a.get("serve") or {}, b.get("serve") or {}
+    serve_keys = [k for k in ("cold_first_step_s", "warm_first_step_s",
+                              "warm_p50_s", "warm_p99_s",
+                              "warm_over_cold")
+                  if va.get(k) is not None or vb.get(k) is not None]
+    if serve_keys:
+        lines.append("serve (cold/warm drill, A -> B):")
+        for k in serve_keys:
+            lines.append(_delta_line(k, va.get(k), vb.get(k)))
+        ha = (va.get("histograms") or {})
+        hb = (vb.get("histograms") or {})
+        for key in sorted(set(ha) | set(hb)):
+            sa_, sb_ = ha.get(key), hb.get(key)
+            pa_ = (quantiles_from_counts(sa_["counts"], [0.99])[0]
+                   if sa_ and sa_.get("count") else None)
+            pb_ = (quantiles_from_counts(sb_["counts"], [0.99])[0]
+                   if sb_ and sb_.get("count") else None)
+            if pa_ is not None or pb_ is not None:
+                lines.append(_delta_line(
+                    f"p99[{key}]",
+                    None if pa_ is None else round(pa_, 6),
+                    None if pb_ is None else round(pb_, 6)))
     fa, fb = _profile_entries(a), _profile_entries(b)
     if fa or fb:
         lines.append("profiles (attributed device s/capture, A -> B;"
@@ -596,7 +763,20 @@ def main(argv=None) -> int:
     t.add_argument("--heartbeat-every", type=float, default=5.0)
     t.add_argument("--max-seconds", type=float, default=0.0,
                    help="exit after this long (0 = follow forever)")
+    t.add_argument("--grep", default="",
+                   help="only records whose JSON contains this "
+                        "substring")
+    t.add_argument("--trace", default="",
+                   help="only records carrying this trace id (prefix "
+                        "ok) — follow one request live")
     t.set_defaults(fn=cmd_tail)
+
+    tr = sub.add_parser("trace", help="one request's full "
+                                      "admission->completion timeline "
+                                      "from the ledger")
+    tr.add_argument("ledger", help="ledger.jsonl or its directory")
+    tr.add_argument("trace_id", help="trace id (unique prefix ok)")
+    tr.set_defaults(fn=cmd_trace)
 
     c = sub.add_parser("compare", help="two ledgers, or two bench "
                                        "JSONs (BENCH_r*.json)")

@@ -325,6 +325,26 @@ def main() -> int:
                     g.write(r7.stdout or "")
             except subprocess.TimeoutExpired:
                 log(f, "tune search timed out")
+            # eighth step (PR 14): measured SLO attainment on the real
+            # device — the CPU drill in CI proves the mechanism, but
+            # only a healthy window can stamp what the latency SLOs
+            # look like where traffic actually runs. Advisory here
+            # (the exit code is logged, not enforced): the committed
+            # SLO.json ceilings are CPU-calibrated.
+            try:
+                r8 = subprocess.run(
+                    [sys.executable,
+                     os.path.join(REPO, "tools", "slo.py"),
+                     "check", "--backend", "device", "--json"],
+                    capture_output=True, text=True, cwd=REPO, env=env,
+                    timeout=args.bench_timeout)
+                log(f, f"slo check rc={r8.returncode}\n"
+                       + "\n".join((r8.stdout or "").strip().splitlines()[-3:]))
+                with open(args.out.replace(".json", "_slo.json"),
+                          "w") as g:
+                    g.write(r8.stdout or "")
+            except subprocess.TimeoutExpired:
+                log(f, "slo check timed out")
             # fifth step (PR 10): archive each profile capture — the
             # attribution summary is the regression-comparable
             # artifact; the raw multi-MB traces are pruned ONLY after

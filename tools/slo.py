@@ -1,0 +1,368 @@
+"""Serving-path SLO gate (docs/SERVING.md).
+
+``slo.py check`` evaluates measured SLIs against the versioned
+``SLO.json`` contract — the latency/health counterpart of the
+``tools/serve.py check`` compile-count contract. SLIs come from a run
+ledger: by default the command runs a fresh ``cold_warm_drill`` with a
+ledger attached (forced host-CPU backend unless ``--backend device``),
+flushes the metric registry into it, and reads the SLIs back from the
+ledger alone — the same computation works on any production ledger via
+``--ledger``, and on a saved drill/bench JSON via ``--drill-json``.
+
+SLIs (:func:`slis_from_ledger`):
+
+- ``warm_first_step_p99_s`` — p99 request-to-first-step latency on the
+  warm path, estimated from the
+  ``serve_first_step_seconds{path="warm"}`` histogram snapshot in the
+  last ``counters`` record (empirical fallback from ``request``
+  records when no snapshot landed);
+- ``warm_path_compiles`` — ``aot_cache`` miss records at or after the
+  first warm request's admission (the PR-11 "warm path is free" claim
+  restated as an SLO);
+- ``padding_fraction`` — mean of the ``serve_padding_fraction``
+  histogram (dead lanes stepped per batch);
+- ``quarantine_rate`` — quarantined / completed requests;
+- ``cache_hit_ratio`` — executable-cache hits / (hits + misses).
+
+Exit convention (the ``graph_audit`` family, with one deliberate
+difference): **headroom under a ceiling is attainment, not drift** —
+a warm p99 far below budget is the system working, so it exits 0, not
+1. Exit 1 means the check could not be evaluated (no contract, or a
+budgeted SLI the measurement cannot produce); exit 2 means an SLO is
+violated. ``--tighten`` rewrites the contract from the measurement
+with slack on the latency/ratio budgets.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+CONTRACT_PATH = os.path.join(REPO, "SLO.json")
+SLO_SCHEMA = 1
+
+# SLI names and their direction; a contract may budget any subset
+CEILINGS = ("warm_first_step_p99_s", "warm_path_compiles",
+            "padding_fraction", "quarantine_rate")
+FLOORS = ("cache_hit_ratio",)
+SLI_NAMES = CEILINGS + FLOORS
+
+_WARM_FIRST_KEY = 'serve_first_step_seconds{path="warm"}'
+_PADFRAC_KEY = "serve_padding_fraction"
+
+
+def _last_histograms(records) -> dict:
+    """The histogram snapshot of the LAST ``counters`` record carrying
+    one (cumulative, so the last wins)."""
+    out = {}
+    for rec in records:
+        if rec.get("kind") == "counters" and rec.get("histograms"):
+            out = rec["histograms"]
+    return out
+
+
+def _empirical_quantile(values, q):
+    if not values:
+        return None
+    vs = sorted(values)
+    return vs[min(len(vs) - 1, max(0, math.ceil(q * len(vs)) - 1))]
+
+
+def slis_from_ledger(records) -> dict:
+    """Compute every SLI the ledger can support; absent ones are
+    ``None`` (a budgeted-but-``None`` SLI makes the check exit 1)."""
+    from ibamr_tpu.obs.bus import quantiles_from_counts
+
+    requests = [r for r in records if r.get("kind") == "request"]
+    admits = [r for r in records if r.get("kind") == "request_admit"]
+    cache_ev = [r for r in records if r.get("kind") == "aot_cache"]
+    warm = [r for r in requests if not r.get("cold")]
+
+    slis: dict = {name: None for name in SLI_NAMES}
+    hists = _last_histograms(records)
+
+    # warm first-step p99: histogram estimate, else empirical
+    snap = hists.get(_WARM_FIRST_KEY)
+    if snap and snap.get("count"):
+        slis["warm_first_step_p99_s"] = quantiles_from_counts(
+            snap["counts"], [0.99])[0]
+    elif warm:
+        slis["warm_first_step_p99_s"] = _empirical_quantile(
+            [r["first_step_s"] for r in warm
+             if r.get("first_step_s") is not None], 0.99)
+
+    # compiles on the warm path: aot_cache misses at/after the first
+    # warm request's admission (trace ids join the two record kinds)
+    if warm:
+        warm_tids = {r["trace_id"] for r in warm if r.get("trace_id")}
+        warm_admits = [a["seq"] for a in admits
+                       if a.get("trace_id") in warm_tids]
+        if warm_admits:
+            first_warm_seq = min(warm_admits)
+            slis["warm_path_compiles"] = sum(
+                1 for e in cache_ev
+                if e.get("event") == "miss"
+                and e.get("seq", -1) >= first_warm_seq)
+
+    snap = hists.get(_PADFRAC_KEY)
+    if snap and snap.get("count"):
+        slis["padding_fraction"] = (float(snap["sum"])
+                                    / float(snap["count"]))
+
+    if requests:
+        slis["quarantine_rate"] = (
+            sum(1 for r in requests if r.get("quarantined"))
+            / len(requests))
+
+    hits = sum(1 for e in cache_ev if e.get("event") == "hit")
+    misses = sum(1 for e in cache_ev if e.get("event") == "miss")
+    if hits + misses:
+        slis["cache_hit_ratio"] = hits / (hits + misses)
+    return slis
+
+
+def slis_from_drill(drill: dict) -> dict:
+    """SLIs from a saved ``cold_warm_drill`` / serve-bench JSON (the
+    ``--drill-json`` path — no ledger needed)."""
+    from ibamr_tpu.obs.bus import quantiles_from_counts
+
+    slis: dict = {name: None for name in SLI_NAMES}
+    hists = drill.get("histograms") or {}
+    snap = hists.get(_WARM_FIRST_KEY)
+    if snap and snap.get("count"):
+        slis["warm_first_step_p99_s"] = quantiles_from_counts(
+            snap["counts"], [0.99])[0]
+    elif drill.get("warm_p99_s") is not None:
+        slis["warm_first_step_p99_s"] = drill["warm_p99_s"]
+    elif drill.get("warm_first_step_s") is not None:
+        slis["warm_first_step_p99_s"] = drill["warm_first_step_s"]
+    if drill.get("warm_compiles") is not None:
+        slis["warm_path_compiles"] = drill["warm_compiles"]
+    snap = hists.get(_PADFRAC_KEY)
+    if snap and snap.get("count"):
+        slis["padding_fraction"] = (float(snap["sum"])
+                                    / float(snap["count"]))
+    oks = [drill.get("cold_ok"), drill.get("warm_ok")]
+    if all(o is not None for o in oks):
+        slis["quarantine_rate"] = sum(0 if o else 1 for o in oks) / 2
+    hits = drill.get("warm_hits")
+    if hits is not None:
+        misses = (drill.get("warm_compiles") or 0)
+        if hits + misses:
+            slis["cache_hit_ratio"] = hits / (hits + misses)
+    return slis
+
+
+def load_contract(path: str = CONTRACT_PATH) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("slo_schema") != SLO_SCHEMA:
+        raise ValueError(f"unsupported slo_schema "
+                         f"{doc.get('slo_schema')!r} in {path}")
+    return doc
+
+
+def evaluate(slis: dict, contract: dict):
+    """(violations, unmeasurable, met) — human-readable lines for each
+    budgeted SLO. Attainment headroom is 'met', never drift."""
+    violations, unmeasurable, met = [], [], []
+    for name, budget in sorted((contract.get("slos") or {}).items()):
+        got = slis.get(name)
+        if "ceiling" in budget:
+            want, floor = float(budget["ceiling"]), False
+        elif "floor" in budget:
+            want, floor = float(budget["floor"]), True
+        else:
+            unmeasurable.append(f"{name}: budget has neither ceiling "
+                                f"nor floor")
+            continue
+        if got is None:
+            unmeasurable.append(f"{name}: not measurable from this "
+                                f"ledger")
+            continue
+        got = float(got)
+        bad = got < want if floor else got > want
+        word = "floor" if floor else "ceiling"
+        if bad:
+            violations.append(f"{name}: measured {got:.6g} vs {word} "
+                              f"{want:.6g} (VIOLATED)")
+        else:
+            met.append(f"{name}: measured {got:.6g} within {word} "
+                       f"{want:.6g}")
+    return violations, unmeasurable, met
+
+
+def tighten_contract(slis: dict, drill_cfg: dict) -> dict:
+    """A fresh contract from measured SLIs, with slack where variance
+    lives: latency ceilings at 2x measured (floored at 0.5 s), ratio
+    ceilings +0.2, the hit-ratio floor −0.2; count SLOs pin exactly."""
+    slos = {}
+    if slis.get("warm_first_step_p99_s") is not None:
+        slos["warm_first_step_p99_s"] = {"ceiling": round(
+            max(2.0 * slis["warm_first_step_p99_s"], 0.5), 4)}
+    if slis.get("warm_path_compiles") is not None:
+        slos["warm_path_compiles"] = {
+            "ceiling": int(slis["warm_path_compiles"])}
+    if slis.get("padding_fraction") is not None:
+        slos["padding_fraction"] = {"ceiling": round(
+            min(slis["padding_fraction"] + 0.2, 1.0), 4)}
+    if slis.get("quarantine_rate") is not None:
+        slos["quarantine_rate"] = {
+            "ceiling": round(slis["quarantine_rate"], 4)}
+    if slis.get("cache_hit_ratio") is not None:
+        slos["cache_hit_ratio"] = {"floor": round(
+            max(slis["cache_hit_ratio"] - 0.2, 0.0), 4)}
+    return {
+        "_doc": ("Serving-path SLO contract (tools/slo.py check; see "
+                 "docs/SERVING.md). Ceilings violate UP, floors "
+                 "violate DOWN; headroom is attainment, not drift. "
+                 "Written by --tighten."),
+        "slo_schema": SLO_SCHEMA,
+        "drill": drill_cfg,
+        "slos": slos,
+    }
+
+
+def run_drill_ledger(args, ledger_path: str) -> dict:
+    """Run ``cold_warm_drill`` with a fresh attached ledger and flush
+    the metric registry into it; returns the drill output."""
+    if args.backend == "device":
+        from ibamr_tpu.utils.backend_guard import init_backend_with_retry
+        _jax, _platform, err = init_backend_with_retry(retries=1,
+                                                       delay=2.0)
+        if err:
+            print(f"[slo] backend init degraded: {err}",
+                  file=sys.stderr)
+    else:
+        from ibamr_tpu.utils.backend_guard import force_cpu
+        force_cpu()
+    from ibamr_tpu import obs as _obs
+    from ibamr_tpu.serve.router import cold_warm_drill
+
+    with _obs.ledger(ledger_path):
+        out = cold_warm_drill(
+            n_cells=args.n, n_lat=args.n_lat, n_lon=args.n_lon,
+            lanes=args.lanes, steps=args.steps, dt=args.dt,
+            engine=args.engine or None,
+            warm_requests=args.warm_requests)
+        # land the histogram snapshots in the ledger: the SLI
+        # computation must work from the ledger ALONE
+        _obs.chunk_boundary()
+    return out
+
+
+def cmd_check(args) -> int:
+    if args.ledger:
+        from ibamr_tpu.obs.bus import read_ledger
+        slis = slis_from_ledger(read_ledger(args.ledger))
+        drill_cfg = {"source": args.ledger}
+    elif args.drill_json:
+        with open(args.drill_json) as f:
+            doc = json.load(f)
+        drill = doc.get("serve", doc)   # bench artifact or raw drill
+        slis = slis_from_drill(drill)
+        drill_cfg = {"source": args.drill_json}
+    else:
+        from ibamr_tpu.obs.bus import read_ledger
+        with tempfile.TemporaryDirectory(prefix="slo-") as td:
+            lp = os.path.join(td, "ledger.jsonl")
+            run_drill_ledger(args, lp)
+            records = read_ledger(lp)
+        slis = slis_from_ledger(records)
+        drill_cfg = {"n": args.n, "n_lat": args.n_lat,
+                     "n_lon": args.n_lon, "lanes": args.lanes,
+                     "steps": args.steps,
+                     "warm_requests": args.warm_requests}
+
+    if args.tighten:
+        doc = tighten_contract(slis, drill_cfg)
+        with open(args.contract, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"[slo] wrote {args.contract}")
+        return 0
+
+    try:
+        contract = load_contract(args.contract)
+    except FileNotFoundError:
+        contract = None
+    if contract is None:
+        violations, unmeasurable, met = [], [], []
+    else:
+        violations, unmeasurable, met = evaluate(slis, contract)
+    rc = (2 if violations
+          else 1 if unmeasurable or contract is None
+          else 0)
+    if args.as_json:
+        print(json.dumps({
+            "exit": rc, "slis": slis,
+            "violated": violations, "unmeasurable": unmeasurable,
+            "met": met, "unbudgeted": contract is None},
+            indent=1, sort_keys=True))
+        return rc
+    for line in violations:
+        print(f"[slo] {line}")
+    for line in unmeasurable:
+        print(f"[slo] {line}")
+    for line in met:
+        print(f"[slo] {line}")
+    if contract is None:
+        print(f"[slo] no contract at {args.contract} — run --tighten "
+              f"to pin")
+    verdict = {0: "clean — every SLO attained",
+               1: "unevaluable — missing contract or SLI "
+                  "(run --tighten to pin)",
+               2: "VIOLATED — the serving path is out of SLO"}[rc]
+    print(f"[slo] {verdict}")
+    return rc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="serving-path SLO gate: evaluate a ledger (or a "
+                    "fresh cold_warm_drill) against SLO.json")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    c = sub.add_parser("check", help="evaluate SLIs vs the contract "
+                                     "(exit 0 clean / 1 unevaluable / "
+                                     "2 violated)")
+    c.add_argument("--contract", type=str, default=CONTRACT_PATH)
+    c.add_argument("--ledger", type=str, default="",
+                   help="evaluate an existing ledger.jsonl instead of "
+                        "running a drill")
+    c.add_argument("--drill-json", type=str, default="",
+                   help="evaluate a saved drill/bench JSON instead of "
+                        "running a drill")
+    c.add_argument("--backend", choices=("cpu", "device"),
+                   default="cpu",
+                   help="drill backend: forced host CPU (hermetic CI "
+                        "default) or the real device (relay captures)")
+    c.add_argument("--n", type=int, default=8)
+    c.add_argument("--n-lat", type=int, default=6)
+    c.add_argument("--n-lon", type=int, default=8)
+    c.add_argument("--lanes", type=int, default=2)
+    c.add_argument("--steps", type=int, default=3)
+    c.add_argument("--dt", type=float, default=5e-5)
+    c.add_argument("--engine", type=str, default="",
+                   help="engine name ('' = auto via the resolver)")
+    c.add_argument("--warm-requests", type=int, default=8)
+    c.add_argument("--tighten", action="store_true",
+                   help="rewrite the contract from the measured SLIs "
+                        "(with slack on latency/ratio budgets)")
+    c.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable report on stdout")
+    c.set_defaults(fn=cmd_check)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
