@@ -266,6 +266,82 @@ def _build_served_chunk():
     return pool.contract_args(length=1, live=1)
 
 
+def _require_devices(jax, n=8):
+    if len(jax.devices()) < n:
+        raise RuntimeError(
+            f"sharded artifact needs {n} devices (virtual CPU devices "
+            f"count) — got {len(jax.devices())}; the audit child forces "
+            f"force_cpu({n}) and the test conftest sets "
+            f"--xla_force_host_platform_device_count=8")
+
+
+def _build_sharded_chunk():
+    # the pod driver's unit of work: the dispatched sharded coupled IB
+    # step (pencil-FFT solves + S2 co-partitioned transfers) scanned
+    # over a 2-step chunk on the 8-device mesh. The collective/overlap
+    # metrics pinned here are the comm-layer contract of ROADMAP item 2
+    # (sharded_speedup diagnosis): a refactor that adds a transpose,
+    # doubles a halo, or un-hides an async pair regresses the budget.
+    import jax
+
+    from ibamr_tpu.parallel import make_mesh
+    from ibamr_tpu.parallel.mesh import make_sharded_step, place_state
+
+    _require_devices(jax)
+    integ, state0 = _shell()
+    mesh = make_mesh(8)
+    step = _unwrap(make_sharded_step(integ, mesh))
+    state = place_state(state0, integ.ins.grid, mesh)
+
+    def chunk(st, dt):
+        def body(s, _):
+            return step(s, dt), ()
+        out, _ = jax.lax.scan(body, st, None, length=2)
+        return out
+
+    return chunk, (state, _DT), ()
+
+
+def _build_fftpar_transpose():
+    # the pencil-FFT Helmholtz solve in isolation: on the (4, 2) mesh
+    # over the 16^3 grid this is exactly 4 all_to_all transposes in,
+    # 4 back out — the framework's true long-range communication
+    import jax
+
+    from ibamr_tpu.parallel import make_mesh
+    from ibamr_tpu.parallel.fftpar import PencilFFT
+
+    _require_devices(jax)
+    integ, state = _shell()
+    mesh = make_mesh(8)
+    pencil = PencilFFT(integ.ins.grid, mesh)
+    rhs = state.ins.u[0]
+    return (lambda r: pencil.helmholtz(r, 200.0, -0.025)), (rhs,), ()
+
+
+def _build_lagrangian_exchange():
+    # the S2 co-partition exchange in isolation: owner bucketing +
+    # local spread + ppermute halo accumulate (parallel/lagrangian);
+    # ppermute count/bytes per sharded axis are the budget
+    import jax
+    import jax.numpy as jnp
+
+    from ibamr_tpu.parallel import ShardedInteraction, make_mesh
+
+    _require_devices(jax)
+    integ, state = _shell()
+    mesh = make_mesh(8)
+    si = ShardedInteraction(integ.ins.grid, mesh,
+                            n_markers=state.X.shape[0])
+    F = jnp.zeros_like(state.X)
+
+    def exchange(Fa, Xa, m):
+        b = si.buckets(Xa, m)
+        return si.spread_vel(Fa, Xa, weights=m, b=b)
+
+    return exchange, (F, state.X, state.mask), ()
+
+
 def _build_solo_step_256():
     from ibamr_tpu.models.shell3d import build_shell_example
 
@@ -345,6 +421,16 @@ ARTIFACTS: Dict[str, Artifact] = {
         Artifact("solo_step_256", _build_solo_step_256, heavy=True,
                  notes="flagship 256^3 coupled step (slow tier; "
                        "graph_audit --heavy)"),
+        Artifact("sharded_chunk", _build_sharded_chunk,
+                 notes="8-device sharded coupled IB chunk (pencil FFT "
+                       "+ S2 transfers); the collective/overlap census "
+                       "is the pod comm-layer pin"),
+        Artifact("fftpar_transpose", _build_fftpar_transpose,
+                 notes="pencil-FFT Helmholtz on the (4,2) mesh; "
+                       "all_to_all transpose count/bytes budgeted"),
+        Artifact("lagrangian_exchange", _build_lagrangian_exchange,
+                 notes="S2 owner-bucketed spread with ppermute halo "
+                       "accumulate; ppermute count/bytes budgeted"),
     )
 }
 

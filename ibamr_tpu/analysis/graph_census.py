@@ -37,7 +37,8 @@ from typing import Iterator, Optional, Tuple
 
 __all__ = [
     "iter_eqns", "fft_census", "dot_census", "convert_census",
-    "host_transfer_census", "hlo_op_counts", "op_class_counts",
+    "host_transfer_census", "collective_census", "overlap_census",
+    "hlo_op_counts", "op_class_counts",
     "donation_census", "graph_census", "budget_metrics",
 ]
 
@@ -241,6 +242,50 @@ def host_transfer_census(jaxpr) -> dict:
     return out
 
 
+# the explicit cross-device primitives jax traces into a jaxpr.
+# psum appears only where the program ASKS for it (shard_map bodies,
+# pmapped code); the psums GSPMD inserts to implement a sharded jnp
+# reduction materialize at partitioning time and are visible only in
+# HLO (the collective_ops op-class and :func:`overlap_census`).
+_COLLECTIVE_PRIMS = ("ppermute", "psum", "all_gather", "all_to_all",
+                     "pbroadcast")
+
+
+def collective_census(jaxpr) -> dict:
+    """Per-primitive count + bytes census of the explicit collectives.
+
+    Primitive-level on purpose (the :func:`scatter_gather_census`
+    argument): backend partitioners rewrite, fuse, and batch
+    collectives before optimized HLO — CPU lowers them synchronously,
+    TPU splits them into start/done pairs — while the jaxpr primitive
+    count is exactly the number of cross-device exchanges the program
+    *asked* for, identical on every backend.
+
+    Bytes are the sum of each collective's OUTPUT aval sizes — the
+    per-shard payload a device materializes from its peers per
+    execution (for ``psum``/``ppermute``/``pbroadcast`` this equals
+    the input payload; for ``all_gather`` it is the gathered result,
+    ``axis_size`` times the input). Inside a ``shard_map`` body avals
+    are per-shard, so the numbers read as per-device traffic — the
+    operand the roofline join divides by ``comm_s``.
+    """
+    out = {"collective_prims": 0, "collective_bytes": 0}
+    for p in _COLLECTIVE_PRIMS:
+        out[f"{p}_prims"] = 0
+        out[f"{p}_bytes"] = 0
+    for eqn, _ in iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name not in _COLLECTIVE_PRIMS:
+            continue
+        nbytes = sum(v.aval.size * v.aval.dtype.itemsize
+                     for v in eqn.outvars)
+        out[f"{name}_prims"] += 1
+        out[f"{name}_bytes"] += nbytes
+        out["collective_prims"] += 1
+        out["collective_bytes"] += nbytes
+    return out
+
+
 # ---------------------------------------------------------------------------
 # HLO-text censuses
 # ---------------------------------------------------------------------------
@@ -301,6 +346,94 @@ def op_class_counts(ops) -> dict:
     return out
 
 
+# async collective machinery in optimized HLO: `<op>-start` issues the
+# transfer, the matching `<op>-done` blocks on it; XLA also wraps some
+# collectives in generic `async-start`/`async-done` pairs.
+_ASYNC_START_RE = re.compile(
+    r"^(all-gather|all-reduce|all-to-all|collective-permute|"
+    r"reduce-scatter|collective-broadcast|copy|send|recv|async)-start$")
+_SYNC_COLLECTIVE_RE = re.compile(
+    r"^(all-gather|all-reduce|all-to-all|collective-permute|"
+    r"reduce-scatter|collective-broadcast)(\.|$)")
+# opcodes that are bookkeeping, not schedulable compute: having only
+# these between a start and its done hides nothing
+_STRUCTURAL_OPS = {"parameter", "constant", "tuple", "get-tuple-element",
+                   "bitcast", "bitcast-convert", "after-all", "domain"}
+
+_DEF_RE = re.compile(r"^\s*(?:ROOT\s+)?%([\w.-]+)\s*=")
+
+
+def overlap_census(hlo_text: str) -> dict:
+    """Census of collective/compute overlap in optimized HLO.
+
+    Pairs every ``<collective>-start`` with the ``*-done`` that
+    consumes it and counts the schedulable compute ops the scheduler
+    placed BETWEEN them — the structural observable for async halo
+    exchange: a pair with zero compute in the window (``unhidden``)
+    pays its full link latency on the critical path. Synchronous
+    collective ops (how the CPU backend — and an unscheduled TPU
+    module — emit them) can never overlap and are counted separately
+    as ``collective_sync_ops``.
+
+    Returns::
+
+        {"overlap_pairs": start/done pairs found,
+         "overlap_hidden": pairs with >=1 compute op in the window,
+         "overlap_unhidden": pairs with an empty window,
+         "collective_sync_ops": synchronous collective ops,
+         "overlap_sites": [up to 16 {op, compute_between}]}
+    """
+    # (line_idx, def_name, opcode) for every op-defining line, in
+    # program order (HLO text lists each computation's ops in order)
+    defs = []
+    for idx, line in enumerate(hlo_text.splitlines()):
+        if "=" not in line:
+            continue
+        dm = _DEF_RE.match(line)
+        rhs = re.sub(r'"[^"]*"', '""', line.split("=", 1)[1])
+        om = re.search(r"\b([a-z][a-z0-9_.-]*)\s*\(", rhs)
+        if not (dm and om):
+            continue
+        defs.append((idx, dm.group(1), om.group(1), rhs))
+
+    out = {"overlap_pairs": 0, "overlap_hidden": 0,
+           "overlap_unhidden": 0, "collective_sync_ops": 0,
+           "overlap_sites": []}
+    # strip the .N instance suffix HLO appends to repeated opcodes
+    base = lambda op: re.sub(r"\.\d+$", "", op)  # noqa: E731
+    starts = {}          # def name -> (position in defs, opcode)
+    for pos, (idx, name, op, rhs) in enumerate(defs):
+        b = base(op)
+        if _ASYNC_START_RE.match(b):
+            starts[name] = (pos, op)
+        elif b.endswith("-done"):
+            # which start does this done consume?
+            used = [s for s in starts
+                    if re.search(r"%" + re.escape(s) + r"\b", rhs)]
+            if not used:
+                continue
+            sname = used[0]
+            spos, sop = starts.pop(sname)
+            compute = 0
+            for _, _, iop, _ in defs[spos + 1:pos]:
+                ib = base(iop)
+                if (ib in _STRUCTURAL_OPS or ib.endswith("-start")
+                        or ib.endswith("-done")):
+                    continue
+                compute += 1
+            out["overlap_pairs"] += 1
+            if compute:
+                out["overlap_hidden"] += 1
+            else:
+                out["overlap_unhidden"] += 1
+            if len(out["overlap_sites"]) < 16:
+                out["overlap_sites"].append(
+                    {"op": sop, "compute_between": compute})
+        elif _SYNC_COLLECTIVE_RE.match(b):
+            out["collective_sync_ops"] += 1
+    return out
+
+
 _ALIAS_RE = re.compile(r"input_output_alias=\{((?:[^{}]|\{[^{}]*\})*)\}")
 _ALIAS_ENTRY_RE = re.compile(
     r"\{[^{}]*\}\s*:\s*\(\s*(\d+)\s*,\s*\{[^{}]*\}\s*,\s*"
@@ -349,6 +482,8 @@ def graph_census(fn, args, donate_argnums=()) -> dict:
     out.update(dot_census(jaxpr.jaxpr))
     out.update(convert_census(jaxpr.jaxpr))
     out.update(host_transfer_census(jaxpr.jaxpr))
+    out.update(collective_census(jaxpr.jaxpr))
+    out.update(overlap_census(text))
     out.update(donation_census(text))
     out["hlo_ops_total"] = sum(ops.values())
     return out
@@ -362,6 +497,16 @@ BUDGET_MAX_METRICS = (
     "host_transfers_in_scan", "host_transfers", "f64_widenings",
     "weak_widenings", "roundtrip_chains", "convert_ops", "gather_ops",
     "custom_calls", "collective_ops", "dot_count",
+    # PR 15: the comm layer. Per-primitive collective counts + bytes
+    # (jaxpr level, backend-independent) and the HLO overlap census —
+    # `overlap_unhidden` is the structural pin for async halo
+    # exchange: an unhidden start/done pair pays full link latency.
+    "collective_prims", "collective_bytes",
+    "ppermute_prims", "ppermute_bytes", "psum_prims", "psum_bytes",
+    "all_gather_prims", "all_gather_bytes",
+    "all_to_all_prims", "all_to_all_bytes",
+    "pbroadcast_prims", "pbroadcast_bytes",
+    "overlap_pairs", "overlap_unhidden", "collective_sync_ops",
 )
 BUDGET_MIN_METRICS = ("donated_args",)
 

@@ -30,6 +30,13 @@ PR-8 graph-census byte/flop counts into achieved-bandwidth numbers.
 Both are offline, stdlib-only, and imported lazily here — attaching a
 ledger to a run never pays for the trace parser.
 
+PR 15 adds pod scope: ``RunLedger(..., proc=...)`` routes each process
+of a multi-host run to its own ``ledger-<proc>.jsonl`` shard (same
+``run_id`` everywhere), :mod:`ibamr_tpu.obs.merge` interleaves the
+shards deterministically (``(seq, proc)`` order, torn-tail tolerant,
+per-proc counter namespacing), and the device-time attribution grows a
+``comm_s`` op-class so collective time is a first-class rollup.
+
 See docs/OBSERVABILITY.md for the ledger schema and the CLI cookbook
 (``tools/obs.py summary | tail | compare``,
 ``tools/prof.py attribute | diff | archive``).
@@ -62,10 +69,17 @@ from ibamr_tpu.obs.bus import (  # noqa: F401
     reset_metrics,
     run_id_from_fingerprint,
     sample_memory_watermarks,
+    shard_path,
     span,
     trace_scope,
 )
 from ibamr_tpu.obs.export import (  # noqa: F401
     prometheus_text,
     write_prometheus,
+)
+from ibamr_tpu.obs.merge import (  # noqa: F401
+    find_shards,
+    fleet_counters,
+    fleet_prometheus_text,
+    merge_ledgers,
 )

@@ -339,6 +339,23 @@ def run_id_from_fingerprint(fingerprint: Optional[dict]) -> str:
     return hashlib.sha256(blob.encode()).hexdigest()[:16]
 
 
+_PROC_OK = re.compile(r"[^A-Za-z0-9_.-]")
+
+
+def shard_path(path: str, proc) -> str:
+    """The ledger-shard filename for one process of a multi-process
+    run: a directory (or a ``.../ledger.jsonl`` path) becomes
+    ``.../ledger-<proc>.jsonl``. Every host of a pod run passes the
+    SAME ``path`` and its own ``proc`` (``jax.process_index()``), so
+    the shards land side by side for :mod:`ibamr_tpu.obs.merge`."""
+    p = _PROC_OK.sub("_", str(proc)) or "0"
+    if os.path.isdir(path) or path.endswith(os.sep):
+        return os.path.join(path, f"ledger-{p}.jsonl")
+    d, base = os.path.split(path)
+    root, ext = os.path.splitext(base or "ledger.jsonl")
+    return os.path.join(d, f"{root}-{p}{ext or '.jsonl'}")
+
+
 class RunLedger:
     """Per-run append-only ``ledger.jsonl``.
 
@@ -351,11 +368,22 @@ class RunLedger:
     :func:`read_ledger` tolerates (skips) a torn final line from a
     kill mid-write. ``overhead_s`` accumulates the wall cost of every
     append — the observability bill, kept in-band so the <2% budget is
-    enforced, not promised."""
+    enforced, not promised.
+
+    ``proc`` (PR 15) is the process identity of a multi-host run:
+    ``None`` (the default) keeps single-process behavior bit-for-bit;
+    a process index reroutes the file to :func:`shard_path`'s
+    ``ledger-<proc>.jsonl`` and stamps ``proc`` on every record, while
+    ``run_id`` — a fingerprint digest, identical on every host of the
+    same run — stays the cross-shard join key."""
 
     def __init__(self, path: str,
                  fingerprint: Optional[dict] = None,
-                 run_id: Optional[str] = None):
+                 run_id: Optional[str] = None,
+                 proc: Optional[object] = None):
+        self.proc = None if proc is None else str(proc)
+        if self.proc is not None:
+            path = shard_path(path, self.proc)
         self.path = path
         self.run_id = run_id or run_id_from_fingerprint(fingerprint)
         self.overhead_s = 0.0
@@ -384,6 +412,8 @@ class RunLedger:
         """Append one record; returns its ``seq``."""
         t0 = time.perf_counter()
         rec = dict(_jsonable(payload or {}))
+        if self.proc is not None and "proc" not in rec:
+            rec["proc"] = self.proc
         with self._lock:
             self._seq += 1
             rec.update(seq=self._seq, run_id=self.run_id,
@@ -476,9 +506,11 @@ def emit(kind: str, **payload) -> Optional[int]:
 
 @contextmanager
 def ledger(path: str, fingerprint: Optional[dict] = None,
-           run_id: Optional[str] = None):
+           run_id: Optional[str] = None,
+           proc: Optional[object] = None):
     """Open, attach, and on exit detach + fsync-close a run ledger."""
-    led = RunLedger(path, fingerprint=fingerprint, run_id=run_id)
+    led = RunLedger(path, fingerprint=fingerprint, run_id=run_id,
+                    proc=proc)
     prev = attach(led)
     try:
         yield led

@@ -60,10 +60,21 @@ _DEVICE_PROC_RE = re.compile(r"/device:|^TPU|^GPU", re.IGNORECASE)
 _OP_LANE_RE = re.compile(r"XLA Ops|TensorFlow Ops", re.IGNORECASE)
 # args keys that can carry a slash-separated framework scope path
 _SCOPE_ARG_KEYS = ("tf_op", "op_name", "long_name", "name", "scope")
-# op-class buckets for the roofline join: FFT ops and contractions
+# op-class buckets for the roofline join: FFT ops, contractions, and
+# (PR 15) collectives.  A device op is comm when its HLO opcode is a
+# collective (sync or async -start/-done halves) OR its framework scope
+# path passes through a ``comm`` component — the named scope the
+# parallel layer (fftpar/lagrangian/mesh/norms/krylov) wraps every
+# cross-device exchange in — so partitioner-materialized resharding
+# that keeps a fused non-collective opcode still lands in ``comm_s``.
 _FFT_OP_RE = re.compile(r"(^|[./])i?r?fft", re.IGNORECASE)
 _DOT_OP_RE = re.compile(r"(^|[./])(dot|convolution|gemm|matmul)",
                         re.IGNORECASE)
+_COMM_OP_RE = re.compile(
+    r"(^|[./])(all-reduce|all-gather|all-to-all|collective-permute|"
+    r"reduce-scatter|collective-broadcast)(-start|-done)?(\.|$)",
+    re.IGNORECASE)
+_COMM_SCOPE = "comm"
 
 
 # ---------------------------------------------------------------------------
@@ -249,20 +260,29 @@ def attribute_events(events: List[dict],
     Returns the core of a :data:`SUMMARY_NAME` document; every second
     of device-lane time lands either in ``spans`` (attributed — via
     scope prefix, module match, or module identity) or in the explicit
-    ``unattributed`` breakdown. ``op_classes`` tallies FFT/contraction
-    op time for the roofline join."""
+    ``unattributed`` breakdown. ``op_classes`` tallies
+    FFT/contraction/collective op time for the roofline join; the
+    classes partition ``total_device_s`` exactly (``other_s`` is the
+    remainder), independent of the span accounting identity."""
     leaf_map = span_leaf_map(span_paths)
     module_map = dict(module_map or {})
     spans: Dict[str, dict] = {}
     unattributed: Dict[str, float] = {}
     total = attributed = 0.0
-    fft_s = dot_s = 0.0
+    fft_s = dot_s = comm_s = 0.0
     for e in events:
         dur = float(e.get("dur") or 0.0) / 1e6
         total += dur
         opname = str((e.get("args") or {}).get("hlo_op")
                      or e.get("name") or "?")
-        if _FFT_OP_RE.search(opname):
+        # comm wins over fft/dot: a collective (or an op inside the
+        # parallel layer's ``comm`` named scope) is wire time even when
+        # its fused opcode also mentions a compute class
+        if _COMM_OP_RE.search(opname) or any(
+                _norm_component(c) == _COMM_SCOPE
+                for c in _scope_components(e)):
+            comm_s += dur
+        elif _FFT_OP_RE.search(opname):
             fft_s += dur
         elif _DOT_OP_RE.search(opname):
             dot_s += dur
@@ -294,7 +314,9 @@ def attribute_events(events: List[dict],
                                key=lambda kv: -kv[1])[:max_ops]},
         "op_classes": {"fft_s": round(fft_s, 9),
                        "dot_s": round(dot_s, 9),
-                       "other_s": round(total - fft_s - dot_s, 9)},
+                       "comm_s": round(comm_s, 9),
+                       "other_s": round(total - fft_s - dot_s
+                                        - comm_s, 9)},
     }
 
 

@@ -10,7 +10,14 @@ seconds without bytes give no efficiency. This module is the join:
 
     achieved FFT GB/s   = fft_bytes_per_step / fft_seconds_per_step
     achieved dot GFLOP/s = dot_flops_per_step / dot_seconds_per_step
-    fraction_of_step_accounted = (fft_s + dot_s) / total_device_s
+    achieved comm GB/s  = wire_bytes_per_step / comm_seconds_per_step
+    fraction_of_step_accounted = (fft_s + dot_s + comm_s) / total_device_s
+
+    The comm wire-bytes proxy is ``collective_bytes -
+    pbroadcast_bytes`` from the PR-15 ``collective_census``:
+    ``pbroadcast`` prims are shard_map's replication-tracking
+    bookkeeping and lower to no-ops, so counting their avals would
+    flatter the interconnect rate.
 
 The census side arrives as the ``census_counts.json`` sidecar
 ``bench.py`` writes into each ``--profile-stages`` capture dir at
@@ -54,20 +61,27 @@ def roofline_join(summary: dict, census: dict) -> Optional[dict]:
         return None
     fft_s = _get(op_classes, "fft_s")
     dot_s = _get(op_classes, "dot_s")
+    comm_s = _get(op_classes, "comm_s")
     fft_bytes = _get(census, "fft_bytes")
     dot_bytes = (_get(census, "dot_lhs_bytes")
                  + _get(census, "dot_rhs_bytes")
                  + _get(census, "dot_out_bytes"))
     dot_flops = _get(census, "dot_flops")
+    # wire-bytes proxy: pbroadcast is replication bookkeeping that
+    # lowers to no-ops — subtract it so achieved GB/s is honest
+    comm_bytes = max(0, _get(census, "collective_bytes")
+                     - _get(census, "pbroadcast_bytes"))
     out = {
         "executions": int(execs),
         "device_s_per_execution": round(total / execs, 9),
         "fft": None,
         "dot": None,
-        # how much of the measured device time the two censused op
-        # classes explain — low values mean the step is dominated by
-        # ops the census does not model (elementwise fusions, copies)
-        "fraction_of_step_accounted": round((fft_s + dot_s) / total, 6),
+        "comm": None,
+        # how much of the measured device time the censused op classes
+        # explain — low values mean the step is dominated by ops the
+        # census does not model (elementwise fusions, copies)
+        "fraction_of_step_accounted": round(
+            (fft_s + dot_s + comm_s) / total, 6),
     }
     if fft_bytes > 0 and fft_s > 0:
         per_exec_s = fft_s / execs
@@ -89,6 +103,16 @@ def roofline_join(summary: dict, census: dict) -> Optional[dict]:
             if dot_bytes > 0 else None,
             "dot_count": int(_get(census, "dot_count")),
         }
+    if comm_bytes > 0 and comm_s > 0:
+        per_exec_s = comm_s / execs
+        out["comm"] = {
+            # per-device wire traffic (shard_map avals are per-shard)
+            "bytes_per_execution": int(comm_bytes),
+            "device_s_per_execution": round(per_exec_s, 9),
+            "achieved_gb_per_s": round(
+                comm_bytes / per_exec_s / 1e9, 3),
+            "collective_prims": int(_get(census, "collective_prims")),
+        }
     return out
 
 
@@ -101,13 +125,15 @@ def census_sidecar(fn, args, label: str = "",
     in hand; everything downstream is offline."""
     import jax
 
-    from ibamr_tpu.analysis.graph_census import dot_census, fft_census
+    from ibamr_tpu.analysis.graph_census import (collective_census,
+                                                 dot_census, fft_census)
 
     jaxpr = jax.make_jaxpr(fn)(*args).jaxpr
     out = {"schema": 1, "label": label, "executions": int(executions)}
     out.update(fft_census(jaxpr))
     out.pop("fft_transforms", None)       # shapes, not needed downstream
     out.update(dot_census(jaxpr))
+    out.update(collective_census(jaxpr))
     out.update(extra)
     return out
 
@@ -120,7 +146,7 @@ def render_roofline(roofline: Optional[dict]) -> List[str]:
         f"  executions: {roofline.get('executions')}   "
         f"device {roofline.get('device_s_per_execution', 0) * 1e3:.3f} "
         f"ms/execution   "
-        f"accounted by fft+dot: "
+        f"accounted by fft+dot+comm: "
         f"{100.0 * (roofline.get('fraction_of_step_accounted') or 0):.1f}%"
     ]
     fft = roofline.get("fft")
@@ -139,4 +165,12 @@ def render_roofline(roofline: Optional[dict]) -> List[str]:
             f"in {dot['device_s_per_execution'] * 1e3:.3f} ms -> "
             f"{dot['achieved_gflop_per_s']:.2f} GFLOP/s achieved{gb} "
             f"({dot['dot_count']} contractions)")
+    comm = roofline.get("comm")
+    if comm:
+        lines.append(
+            f"  comm: {comm['bytes_per_execution'] / 1e6:.2f} MB/exec "
+            f"(per device, wire) in "
+            f"{comm['device_s_per_execution'] * 1e3:.3f} ms -> "
+            f"{comm['achieved_gb_per_s']:.2f} GB/s achieved "
+            f"({comm['collective_prims']} collectives)")
     return lines

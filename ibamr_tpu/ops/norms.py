@@ -4,7 +4,9 @@ Reference parity: IBTK ``NormOps`` / SAMRAIVectorReal norms (T17).
 Volume-weighted L1/L2/max norms and inner products. These are the global
 reductions of the framework (the analog of the reference's MPI-reduced
 PETSc VecNorm/VecDot, SURVEY.md §2.4); under sharding XLA lowers them to
-``psum`` collectives.
+``psum`` collectives — every reduction here runs under the ``comm``
+named scope so that cross-device time attributes to the comm op-class
+(obs/deviceprof ``comm_s``) instead of landing in ``unattributed``.
 """
 
 from __future__ import annotations
@@ -15,11 +17,20 @@ import jax
 import jax.numpy as jnp
 
 
+def _reduce(fn, *args):
+    """One global reduction under the ``comm`` named scope. The scope
+    costs nothing single-device; under sharding it labels the psum the
+    partitioner inserts for the cross-shard combine."""
+    with jax.named_scope("comm"):
+        return fn(*args)
+
+
 def tree_dot(a: Any, b: Any) -> jnp.ndarray:
     """Unweighted inner product over any matching pytrees (the primitive
     under every norm and Krylov residual in the framework). Mismatched
     structures raise (via tree_map); empty trees give 0.0."""
-    sums = jax.tree_util.tree_map(lambda x, y: jnp.sum(x * y), a, b)
+    sums = jax.tree_util.tree_map(
+        lambda x, y: _reduce(jnp.sum, x * y), a, b)
     leaves = jax.tree_util.tree_leaves(sums)
     if not leaves:
         return jnp.asarray(0.0)
@@ -30,28 +41,28 @@ def tree_dot(a: Any, b: Any) -> jnp.ndarray:
 
 
 def l1_norm(f: jnp.ndarray, cell_volume: float = 1.0) -> jnp.ndarray:
-    return jnp.sum(jnp.abs(f)) * cell_volume
+    return _reduce(jnp.sum, jnp.abs(f)) * cell_volume
 
 
 def l2_norm(f: jnp.ndarray, cell_volume: float = 1.0) -> jnp.ndarray:
-    return jnp.sqrt(jnp.sum(jnp.square(f)) * cell_volume)
+    return jnp.sqrt(_reduce(jnp.sum, jnp.square(f)) * cell_volume)
 
 
 def max_norm(f: jnp.ndarray) -> jnp.ndarray:
-    return jnp.max(jnp.abs(f))
+    return _reduce(jnp.max, jnp.abs(f))
 
 
 def vel_l2_norm(u: Sequence[jnp.ndarray], cell_volume: float = 1.0) -> jnp.ndarray:
-    s = jnp.sum(jnp.square(u[0]))
+    s = _reduce(jnp.sum, jnp.square(u[0]))
     for c in u[1:]:
-        s = s + jnp.sum(jnp.square(c))
+        s = s + _reduce(jnp.sum, jnp.square(c))
     return jnp.sqrt(s * cell_volume)
 
 
 def vel_max_norm(u: Sequence[jnp.ndarray]) -> jnp.ndarray:
-    m = jnp.max(jnp.abs(u[0]))
+    m = _reduce(jnp.max, jnp.abs(u[0]))
     for c in u[1:]:
-        m = jnp.maximum(m, jnp.max(jnp.abs(c)))
+        m = jnp.maximum(m, _reduce(jnp.max, jnp.abs(c)))
     return m
 
 

@@ -52,6 +52,17 @@ def _slice_for_shard(l: jnp.ndarray, idx, count: int) -> jnp.ndarray:
     return lax.dynamic_slice(l, (idx * size,), (size,))
 
 
+def _transpose(c: jnp.ndarray, axis_name, split_axis: int,
+               concat_axis: int) -> jnp.ndarray:
+    """One pencil transpose (tiled all_to_all) under the ``comm`` named
+    scope, so device profiles attribute the exchange to the comm
+    op-class (obs/deviceprof ``comm_s``) instead of anonymous lowered
+    ops — the dynamic twin of the static ``collective_census`` pin."""
+    with jax.named_scope("comm"):
+        return lax.all_to_all(c, axis_name, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=True)
+
+
 class PencilFFT:
     """Distributed spectral solver bound to one (grid, mesh) pair.
 
@@ -105,8 +116,7 @@ class PencilFFT:
                 c = r.astype(cdt)
                 for d in range(1, dim):
                     c = jnp.fft.fft(c, axis=d)
-                c = lax.all_to_all(c, ax, split_axis=1, concat_axis=0,
-                                   tiled=True)
+                c = _transpose(c, ax, 1, 0)
                 c = jnp.fft.fft(c, axis=0)
                 i = lax.axis_index(ax)
                 parts = [lam[0].reshape((-1,) + (1,) * (dim - 1)),
@@ -117,8 +127,7 @@ class PencilFFT:
                         (1,) * d + (-1,) + (1,) * (dim - 1 - d)))
                 c = op(sum(parts), c, *scalars)
                 c = jnp.fft.ifft(c, axis=0)
-                c = lax.all_to_all(c, ax, split_axis=0, concat_axis=1,
-                                   tiled=True)
+                c = _transpose(c, ax, 0, 1)
                 for d in range(dim - 1, 0, -1):
                     c = jnp.fft.ifft(c, axis=d)
                 return jnp.real(c).astype(rdt)
@@ -129,11 +138,9 @@ class PencilFFT:
             def kernel(r, *scalars):
                 c = r.astype(cdt)
                 c = jnp.fft.fft(c, axis=2)
-                c = lax.all_to_all(c, ay, split_axis=2, concat_axis=1,
-                                   tiled=True)
+                c = _transpose(c, ay, 2, 1)
                 c = jnp.fft.fft(c, axis=1)
-                c = lax.all_to_all(c, ax, split_axis=1, concat_axis=0,
-                                   tiled=True)
+                c = _transpose(c, ax, 1, 0)
                 c = jnp.fft.fft(c, axis=0)
                 ix, iy = lax.axis_index(ax), lax.axis_index(ay)
                 sym = (lam[0][:, None, None]
@@ -141,11 +148,9 @@ class PencilFFT:
                        + _slice_for_shard(lam[2], iy, sizes[1])[None, None, :])
                 c = op(sym, c, *scalars)
                 c = jnp.fft.ifft(c, axis=0)
-                c = lax.all_to_all(c, ax, split_axis=0, concat_axis=1,
-                                   tiled=True)
+                c = _transpose(c, ax, 0, 1)
                 c = jnp.fft.ifft(c, axis=1)
-                c = lax.all_to_all(c, ay, split_axis=1, concat_axis=2,
-                                   tiled=True)
+                c = _transpose(c, ay, 1, 2)
                 c = jnp.fft.ifft(c, axis=2)
                 return jnp.real(c).astype(rdt)
 
@@ -156,22 +161,18 @@ class PencilFFT:
             def kernel(r, *scalars):
                 c = r.astype(cdt)
                 # unshard axis 1 by splitting axis 0 further over ay
-                c = lax.all_to_all(c, ay, split_axis=0, concat_axis=1,
-                                   tiled=True)
+                c = _transpose(c, ay, 0, 1)
                 c = jnp.fft.fft(c, axis=1)
-                c = lax.all_to_all(c, (ax, ay), split_axis=1, concat_axis=0,
-                                   tiled=True)
+                c = _transpose(c, (ax, ay), 1, 0)
                 c = jnp.fft.fft(c, axis=0)
                 i = lax.axis_index((ax, ay))
                 sym = (lam[0][:, None]
                        + _slice_for_shard(lam[1], i, ptot)[None, :])
                 c = op(sym, c, *scalars)
                 c = jnp.fft.ifft(c, axis=0)
-                c = lax.all_to_all(c, (ax, ay), split_axis=0, concat_axis=1,
-                                   tiled=True)
+                c = _transpose(c, (ax, ay), 0, 1)
                 c = jnp.fft.ifft(c, axis=1)
-                c = lax.all_to_all(c, ay, split_axis=1, concat_axis=0,
-                                   tiled=True)
+                c = _transpose(c, ay, 1, 0)
                 return jnp.real(c).astype(rdt)
 
         return kernel

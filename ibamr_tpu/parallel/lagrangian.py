@@ -248,8 +248,11 @@ class ShardedInteraction:
         # block's lo slab into our interior tail (and mirrored for hi)
         fwd = [(i, (i - 1) % Pd) for i in range(Pd)]
         bwd = [(i, (i + 1) % Pd) for i in range(Pd)]
-        from_next = lax.ppermute(lo_slab, ax, perm=fwd)
-        from_prev = lax.ppermute(hi_slab, ax, perm=bwd)
+        # `comm` scope: device profiles classify the halo pushes into
+        # the comm_s op-class (obs/deviceprof) instead of anonymous ops
+        with jax.named_scope("comm"):
+            from_next = lax.ppermute(lo_slab, ax, perm=fwd)
+            from_prev = lax.ppermute(hi_slab, ax, perm=bwd)
         interior = self._take(buf, d, w, w + nl)
         idx_hi = [slice(None)] * buf.ndim
         idx_hi[d] = slice(nl - w, nl)
@@ -267,8 +270,11 @@ class ShardedInteraction:
         w, nl = self.w, self.nloc[d]
         fwd = [(i, (i + 1) % Pd) for i in range(Pd)]
         bwd = [(i, (i - 1) % Pd) for i in range(Pd)]
-        lo_ghost = lax.ppermute(self._take(f, d, nl - w, nl), ax, perm=fwd)
-        hi_ghost = lax.ppermute(self._take(f, d, 0, w), ax, perm=bwd)
+        with jax.named_scope("comm"):
+            lo_ghost = lax.ppermute(self._take(f, d, nl - w, nl), ax,
+                                    perm=fwd)
+            hi_ghost = lax.ppermute(self._take(f, d, 0, w), ax,
+                                    perm=bwd)
         return jnp.concatenate([lo_ghost, f, hi_ghost], axis=d)
 
     # -- public ops ----------------------------------------------------------

@@ -25,6 +25,15 @@ Pytree = Any
 Operator = Callable[[Pytree], Pytree]
 
 
+def _gnorm(v) -> jnp.ndarray:
+    """Global l2 norm under the ``comm`` named scope (the norms.py
+    ``_reduce`` discipline): under sharding the reduction lowers to a
+    psum, and the scope is what attributes that collective to the comm
+    op-class in device profiles instead of ``unattributed``."""
+    with jax.named_scope("comm"):
+        return jnp.linalg.norm(v)
+
+
 def tree_axpy(alpha, x: Pytree, y: Pytree) -> Pytree:
     """alpha * x + y"""
     return jax.tree_util.tree_map(lambda xi, yi: alpha * xi + yi, x, y)
@@ -199,13 +208,13 @@ def _fgmres_flat(Aop, b, x0, Mop, m, tol, atol, restarts):
     """
     n = b.shape[0]
     dtype = b.dtype
-    bnorm = jnp.linalg.norm(b)
+    bnorm = _gnorm(b)
     stop = jnp.maximum(tol * bnorm, atol)
 
     def restart_body(carry):
         x, _, it = carry
         r = b - Aop(x)
-        beta = jnp.linalg.norm(r)
+        beta = _gnorm(r)
         beta_safe = jnp.where(beta == 0, 1.0, beta)
         V0 = jnp.zeros((m + 1, n), dtype=dtype).at[0].set(r / beta_safe)
         Z0 = jnp.zeros((m, n), dtype=dtype)
@@ -225,7 +234,7 @@ def _fgmres_flat(Aop, b, x0, Mop, m, tol, atol, restarts):
             w = w - V.T @ dots
             dots2 = (V @ w) * mask
             w = w - V.T @ dots2
-            wnorm = jnp.linalg.norm(w)
+            wnorm = _gnorm(w)
             H = H.at[:, j].set(dots + dots2).at[j + 1, j].set(wnorm)
             V = V.at[j + 1].set(w / jnp.where(wnorm == 0, 1.0, wnorm))
             Z = Z.at[j].set(z)
@@ -248,7 +257,7 @@ def _fgmres_flat(Aop, b, x0, Mop, m, tol, atol, restarts):
         # (keeping x unchanged along that direction is exact).
         y = jnp.where(jnp.isfinite(y), y, jnp.zeros_like(y))
         x = x + Z.T @ y
-        rn = jnp.linalg.norm(b - Aop(x))
+        rn = _gnorm(b - Aop(x))
         return x, rn, it + 1
 
     def cond(carry):
@@ -286,7 +295,7 @@ def fgmres(A: Operator, b: Pytree, x0: Optional[Pytree] = None,
 
     x, rn, it = _fgmres_flat(Aop, bflat, x0flat, Mop, m, tol, atol,
                              restarts)
-    bnorm = jnp.linalg.norm(bflat)
+    bnorm = _gnorm(bflat)
     stop = jnp.maximum(tol * bnorm, atol)
     return SolveResult(x=unravel(x), iters=it, resnorm=rn,
                        converged=rn <= stop)
@@ -315,7 +324,7 @@ def newton_krylov(F: Operator, x0: Pytree, tol: float = 1e-8,
         return out
 
     f0 = Fflat(x0flat)
-    fnorm0 = jnp.linalg.norm(f0)
+    fnorm0 = _gnorm(f0)
     stop = jnp.maximum(tol * jnp.maximum(fnorm0, 1e-30), atol)
 
     def cond(carry):
@@ -348,13 +357,13 @@ def newton_krylov(F: Operator, x0: Pytree, tol: float = 1e-8,
         def ls_body(c):
             s, _, bs, bfn, tries = c
             s = s * 0.5
-            fn = jnp.linalg.norm(Fflat(x + s * dx))
+            fn = _gnorm(Fflat(x + s * dx))
             better = fn < bfn                      # False for NaN fn
             bs = jnp.where(better, s, bs)
             bfn = jnp.where(better, fn, bfn)
             return s, fn, bs, bfn, tries + 1
 
-        fn_full = jnp.linalg.norm(Fflat(x + dx))
+        fn_full = _gnorm(Fflat(x + dx))
         one = jnp.asarray(1.0, dtype=x.dtype)
         full_ok = jnp.isfinite(fn_full)
         bs0 = jnp.where(full_ok, one, one / 64.0)  # NaN full step: tiny

@@ -39,7 +39,8 @@ BUDGETS = load_budgets()
 # artifacts stay fully gated by `tools/graph_audit.py` (CI) and the
 # full-suite run.
 _SLOW_LIGHT = {"solo_step", "solo_step_bf16", "solo_chunk",
-               "donated_chunk", "fleet_chunk", "open_channel_step"}
+               "donated_chunk", "fleet_chunk", "open_channel_step",
+               "sharded_chunk"}
 
 _PARAMS = [
     pytest.param(name, marks=pytest.mark.slow)
@@ -82,6 +83,17 @@ def test_headline_invariants_are_budgeted():
     assert BUDGETS["donated_chunk"]["donated_args"] >= 1
     for name, b in BUDGETS.items():
         assert b["host_transfers_in_scan"] == 0, name
+    # PR 15: the pod comm-layer pins are in the committed file — the
+    # three sharded artifacts budget their collective census, the
+    # pencil transpose is exactly 4 all_to_all on the (4,2) mesh, and
+    # the S2 exchange's halo pushes are ppermutes
+    for name in ("sharded_chunk", "fftpar_transpose",
+                 "lagrangian_exchange"):
+        assert BUDGETS[name]["collective_prims"] > 0, name
+    assert BUDGETS["fftpar_transpose"]["all_to_all_prims"] == 4
+    assert BUDGETS["lagrangian_exchange"]["ppermute_prims"] > 0
+    assert BUDGETS["sharded_chunk"]["ppermute_prims"] > 0
+    assert BUDGETS["sharded_chunk"]["all_to_all_prims"] > 0
 
 
 def test_jit_lint_clean_over_package():

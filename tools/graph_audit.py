@@ -46,7 +46,10 @@ def _measure_child(q, name):
     try:
         from ibamr_tpu.utils.backend_guard import force_cpu
 
-        force_cpu()
+        # 8 virtual devices so the sharded artifacts (sharded_chunk,
+        # fftpar_transpose, lagrangian_exchange) see a real (4,2) mesh;
+        # the single-device artifacts are unaffected by the count.
+        force_cpu(8)
         from ibamr_tpu.analysis.contracts import measure_artifact
 
         t0 = time.perf_counter()

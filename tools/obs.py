@@ -22,11 +22,19 @@ This tool is the operator's side of that contract:
 - ``compare``: two ledgers -> per-phase wall deltas; two bench JSONs
   (``BENCH_r*.json`` or raw ``bench.py`` output) -> per-stage,
   per-phase, and serve-leg latency-percentile deltas between
-  revisions.
+  revisions; two fleet directories (auto-detected by their
+  ``ledger-<proc>.jsonl`` shards) -> per-proc deltas.
+- ``summary --fleet``: one pod run's merged rollup (PR 15) — the
+  directory's per-process ledger shards interleaved in ``(seq, proc)``
+  order: per-proc span trees, each proc's comm fraction from its
+  newest ``device_time`` attribution, per-host last-record staleness,
+  and the proc-labeled counter registry (cumulative per process,
+  never summed across procs).
 
 Examples::
 
     python tools/obs.py summary /tmp/fleet/ledger.jsonl
+    python tools/obs.py summary /tmp/pod --fleet
     python tools/obs.py tail /tmp/fleet --max-seconds 30 --trace 3fa2
     python tools/obs.py trace /tmp/serve/ledger.jsonl 3fa2
     python tools/obs.py compare /tmp/a/ledger.jsonl /tmp/b/ledger.jsonl
@@ -377,7 +385,85 @@ def render_device_table(records: list, dev) -> list:
     return lines
 
 
+# ---------------------------------------------------------------------------
+# fleet (PR 15): merged multi-process rollup
+# ---------------------------------------------------------------------------
+
+def _proc_records(merged: dict, proc: str) -> list:
+    return [r for r in merged["records"]
+            if str(r.get("proc", "")) == proc]
+
+
+def _comm_line(records: list):
+    """The comm rollup of one proc's NEWEST ``device_time`` record
+    (``tools/prof.py attribute --ledger`` appends one per capture) —
+    comm seconds, device total, and the comm fraction — or ``None``
+    when no attribution with op classes has run on that shard."""
+    for rec in reversed(records):
+        if rec.get("kind") != "device_time":
+            continue
+        oc = rec.get("op_classes") or {}
+        total = rec.get("total_device_s")
+        if "comm_s" not in oc or not total:
+            continue
+        comm = float(oc["comm_s"] or 0.0)
+        return (f"  comm: {_fmt_s(comm)} of {_fmt_s(total)} device "
+                f"({100.0 * comm / float(total):.1f}% of capture)")
+    return None
+
+
+def cmd_fleet_summary(args) -> int:
+    from ibamr_tpu.obs.merge import fleet_counters, merge_ledgers
+
+    try:
+        merged = merge_ledgers(args.ledger)
+    except ValueError as e:
+        print(f"[obs] {e}", file=sys.stderr)
+        return 1
+    if not merged["records"]:
+        print(f"[obs] no ledger shards under {args.ledger} "
+              f"(expected ledger-<proc>.jsonl)", file=sys.stderr)
+        return 1
+    now = time.time()
+    print(f"run_id: {merged['run_id']}   procs: "
+          f"{len(merged['procs'])}   records: "
+          f"{len(merged['records'])}")
+    for proc in merged["procs"]:
+        recs = _proc_records(merged, proc)
+        info = merged["per_proc"][proc]
+        times = [r["t"] for r in recs
+                 if isinstance(r.get("t"), (int, float))]
+        wall = (max(times) - min(times)) if len(times) > 1 else None
+        stale = (f"{now - info['last_t']:.1f}s ago"
+                 if info.get("last_t") else "-")
+        ended = any(r.get("kind") == "run_end" for r in recs)
+        print(f"\nproc {proc}: {info['records']} records   wall "
+              f"{_fmt_s(wall)}   last record {stale}"
+              + ("" if ended else "   (no run_end — alive or killed)"))
+        for ln in render_span_tree(recs, wall):
+            print(ln)
+        comm = _comm_line(recs)
+        if comm:
+            print(comm)
+    snap = fleet_counters(merged)
+    if snap["counters"] or snap["gauges"]:
+        print("\nfleet counters (last snapshot per proc, "
+              "proc-labeled — cumulative per process, never summed):")
+        for kind in ("counters", "gauges"):
+            for key in sorted(snap[kind]):
+                print(f"  {key:<58} {_fmt_num(snap[kind][key]):>14}")
+    print("\nincidents (all procs, merged order):")
+    times = [r["t"] for r in merged["records"]
+             if isinstance(r.get("t"), (int, float))]
+    t0 = min(times) if times else None
+    for ln in render_incidents(merged["records"], t0):
+        print(ln)
+    return 0
+
+
 def cmd_summary(args) -> int:
+    if getattr(args, "fleet", False):
+        return cmd_fleet_summary(args)
     path = resolve_ledger(args.ledger)
     records = read_ledger(path)
     if not records:
@@ -727,8 +813,52 @@ def compare_bench(path_a: str, path_b: str) -> list:
     return lines
 
 
+def _is_fleet(path: str) -> bool:
+    """A directory holding >= 2 ledger shards, or a shard file —
+    compare then goes per-proc."""
+    from ibamr_tpu.obs.merge import find_shards
+
+    if os.path.isfile(path):
+        return os.path.basename(path).startswith("ledger-")
+    return os.path.isdir(path) and len(find_shards(path)) > 1
+
+
+def compare_fleet(path_a: str, path_b: str) -> list:
+    """Per-proc deltas between two merged fleet ledgers: each proc's
+    span tree compared proc-to-proc (proc ids name the same rank of
+    the pod on both sides), then the proc-labeled counter registry."""
+    from ibamr_tpu.obs.merge import fleet_counters, merge_ledgers
+
+    ma, mb = merge_ledgers(path_a), merge_ledgers(path_b)
+    lines = [f"fleet: A procs={ma['procs']} run={ma['run_id']}   "
+             f"B procs={mb['procs']} run={mb['run_id']}"]
+    for proc in sorted(set(ma["procs"]) | set(mb["procs"])):
+        ta = span_tree(_proc_records(ma, proc))
+        tb = span_tree(_proc_records(mb, proc))
+        if not (ta or tb):
+            continue
+        lines.append(f"proc {proc} per-phase wall (A -> B):")
+        for path in sorted(set(ta) | set(tb)):
+            lines.append(_delta_line(path,
+                                     ta.get(path, {}).get("total_s"),
+                                     tb.get(path, {}).get("total_s")))
+    ka = fleet_counters(ma)["counters"]
+    kb = fleet_counters(mb)["counters"]
+    if ka or kb:
+        lines.append("fleet counters (last snapshot per proc, A -> B):")
+        for key in sorted(set(ka) | set(kb)):
+            lines.append(_delta_line(key, ka.get(key), kb.get(key)))
+    return lines
+
+
 def cmd_compare(args) -> int:
-    if _is_ledger(args.a) and _is_ledger(args.b):
+    if _is_fleet(args.a) and _is_fleet(args.b):
+        try:
+            lines = compare_fleet(args.a, args.b)
+        except ValueError as e:
+            print(f"[obs] {e}", file=sys.stderr)
+            return 1
+    elif _is_ledger(args.a) and _is_ledger(args.b):
         lines = compare_ledgers(args.a, args.b)
     else:
         lines = compare_bench(args.a, args.b)
@@ -746,6 +876,11 @@ def main(argv=None) -> int:
     s = sub.add_parser("summary", help="phase tree + counters + "
                                        "incident timeline")
     s.add_argument("ledger", help="ledger.jsonl or its directory")
+    s.add_argument("--fleet", action="store_true",
+                   help="merge the directory's ledger-<proc>.jsonl "
+                        "shards (one pod run) into per-proc span "
+                        "trees, comm fractions, staleness, and a "
+                        "proc-labeled counter rollup")
     s.add_argument("--device", nargs="?", const=True, default=None,
                    metavar="PROF_SUMMARY",
                    help="add the host-vs-device table per phase, from "

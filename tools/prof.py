@@ -163,6 +163,7 @@ def _ledger_device_record(ledger: str, summary: dict) -> None:
         "fraction_attributed": summary.get("fraction_attributed"),
         "spans": {k: (v.get("device_s") if isinstance(v, dict) else v)
                   for k, v in (summary.get("spans") or {}).items()},
+        "op_classes": summary.get("op_classes"),
     }
     fd = os.open(ledger, os.O_APPEND | os.O_CREAT | os.O_WRONLY, 0o644)
     try:
@@ -278,6 +279,16 @@ def diff_summaries(sa: dict, sb: dict, tol_pct: float,
     judge("unattributed",
           _per_exec(sa, sa.get("unattributed_s") or 0),
           _per_exec(sb, sb.get("unattributed_s") or 0))
+    # op-class drift (PR 15): comm_s is the pod health line — a halo
+    # that stopped overlapping or a new resharding shows up here even
+    # when the owning span's total stays inside the band. other_s is a
+    # remainder (total minus the named classes) so judging it would
+    # double-report every named-class move.
+    oca = sa.get("op_classes") or {}
+    ocb = sb.get("op_classes") or {}
+    for cls in sorted((set(oca) | set(ocb)) - {"other_s"}):
+        judge(f"op_class/{cls}", _per_exec(sa, oca.get(cls) or 0.0),
+              _per_exec(sb, ocb.get(cls) or 0.0))
     return lines, verdict
 
 

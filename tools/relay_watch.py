@@ -23,6 +23,7 @@ reconstruct exactly when the relay was up.
 from __future__ import annotations
 
 import argparse
+import glob
 import json
 import os
 import subprocess
@@ -345,6 +346,49 @@ def main() -> int:
                     g.write(r8.stdout or "")
             except subprocess.TimeoutExpired:
                 log(f, "slo check timed out")
+            # ninth step (PR 15): comm attribution + merged-ledger
+            # capture. Attribute each profile capture NOW so the
+            # comm_s op-class rollup (and the roofline's achieved
+            # interconnect GB/s) lands in prof_summary.json before
+            # the archive step prunes the raw trace; then, when the
+            # record dir holds per-process ledger shards (a pod run),
+            # land the merged fleet rollup next to the bench artifact
+            for d in profs:
+                try:
+                    r9 = subprocess.run(
+                        [sys.executable,
+                         os.path.join(REPO, "tools", "prof.py"),
+                         "attribute", d, "--json"],
+                        capture_output=True, text=True, cwd=REPO,
+                        env=env, timeout=600)
+                    tail = ""
+                    try:
+                        oc = (json.loads(r9.stdout or "{}")
+                              .get("op_classes") or {})
+                        tail = f"  comm_s={oc.get('comm_s')}"
+                    except ValueError:
+                        pass
+                    log(f, f"comm attribution {d} "
+                           f"rc={r9.returncode}{tail}")
+                except subprocess.TimeoutExpired:
+                    log(f, f"comm attribution timed out for {d}")
+            shard_dir = os.path.dirname(os.path.abspath(args.out))
+            if glob.glob(os.path.join(shard_dir, "ledger-*.jsonl")):
+                try:
+                    r9b = subprocess.run(
+                        [sys.executable,
+                         os.path.join(REPO, "tools", "obs.py"),
+                         "summary", shard_dir, "--fleet"],
+                        capture_output=True, text=True, cwd=REPO,
+                        env=env, timeout=600)
+                    log(f, f"fleet rollup rc={r9b.returncode}\n"
+                           + "\n".join((r9b.stdout or ""
+                                        ).strip().splitlines()[:4]))
+                    with open(args.out.replace(".json", "_fleet.txt"),
+                              "w") as g:
+                        g.write(r9b.stdout or "")
+                except subprocess.TimeoutExpired:
+                    log(f, "fleet rollup timed out")
             # fifth step (PR 10): archive each profile capture — the
             # attribution summary is the regression-comparable
             # artifact; the raw multi-MB traces are pruned ONLY after
