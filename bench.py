@@ -246,6 +246,60 @@ def fleet_reference(B: int = 8, timeout_s: float = 600.0, n: int = 32,
         f"fleet leg hung > {timeout_s:.0f}s", "fleet")
 
 
+def _fleet_mesh_child(q, Bs, n, n_lat, n_lon, steps, dt, n_devices):
+    """Child body: the B×D pod-fleet leg (PR 16) — the lane axis of a
+    B-lane fleet sharded over ``n_devices`` virtual CPU devices
+    (``parallel.mesh.make_lane_mesh``), aggregate lane-steps/s per B.
+    Relay-independent like the sharded reference; on a real pod the
+    same call times ICI-resident lanes."""
+    try:
+        import sys as _sys
+        _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from ibamr_tpu.utils.backend_guard import force_cpu
+
+        jax = force_cpu(n_devices)
+        enable_compile_cache(jax)
+        from ibamr_tpu.parallel.mesh import make_lane_mesh
+        from ibamr_tpu.utils.hierarchy_driver import RunConfig
+        from tools.fleet import build_fleet, run_fleet
+
+        mesh = make_lane_mesh(n_devices)
+        cfg = RunConfig(dt=dt, num_steps=steps, health_interval=4)
+        legs = []
+        for B in Bs:
+            integ, _, stacked = build_fleet(
+                n, n_lat, n_lon, 0.05, B, 0.01, None)
+            summary, _ = run_fleet(integ, stacked, cfg, B,
+                                   lane_mesh=mesh)
+            legs.append({
+                "lanes": B,
+                "lanes_per_device": B // n_devices,
+                "aggregate_steps_per_s":
+                    summary["aggregate_steps_per_s"],
+                "lanes_quarantined": summary["lanes_quarantined"],
+                "wall_s": summary["wall_s"]})
+        q.put({"n": n, "markers": n_lat * n_lon, "steps": steps,
+               "mesh_devices": n_devices, "legs": legs})
+    except Exception as e:  # noqa: BLE001 - report, parent decides
+        q.put({"error": f"{type(e).__name__}: {e}"})
+
+
+def fleet_mesh_reference(Bs=(8, 64, 256), timeout_s: float = 900.0,
+                         n: int = 16, n_lat: int = 8, n_lon: int = 16,
+                         steps: int = 4, dt: float = 1e-3,
+                         n_devices: int = 8):
+    """Pod-fleet throughput signal (PR 16): aggregate lane-steps/s of
+    B∈{8,64,256} lanes sharded over the 8-device lane mesh, in a
+    TERMINABLE child. Small fixed shape — a bounded smoke-timing on
+    CPU whose per-B trend (and 0-quarantine invariant) is what
+    relay_watch trends across rounds; the next healthy TPU window
+    times the same leg on real ICI."""
+    return _run_guarded_child(
+        _fleet_mesh_child,
+        (tuple(Bs), n, n_lat, n_lon, steps, dt, n_devices), timeout_s,
+        f"fleet-mesh leg hung > {timeout_s:.0f}s", "fleet-mesh")
+
+
 def _serve_child(q, n, n_lat, n_lon, lanes, steps, dt, warm_requests):
     """Child body: the request-to-first-step latency drill — one
     scenario family served cold then warm through a fresh warm-pool
@@ -746,6 +800,11 @@ def main():
                     help="also time a B-lane vmapped ensemble of the "
                          "small shell vs the same lanes sequentially "
                          "(0 disables)")
+    ap.add_argument("--fleet-mesh", action="store_true",
+                    help="also time the B x D pod fleet (PR 16): "
+                         "B in {8,64,256} lanes sharded over an "
+                         "8-device lane mesh, aggregate lane-steps/s "
+                         "per B")
     ap.add_argument("--tune-grid", action="store_true",
                     help="also run the autotuner's small measured "
                          "engine grid (scatter vs packed x f32/bf16) "
@@ -782,6 +841,7 @@ def main():
         "phases": None,
         "cpu_sharded_ref": None,
         "fleet": None,
+        "fleet_mesh": None,
         "serve": None,
         "tune": None,
         "profiles": [],
@@ -1123,6 +1183,26 @@ def main():
                 log(f"[bench] fleet: {result['fleet']}")
             except Exception as e:
                 result["fleet"] = {"error": f"{type(e).__name__}: {e}"}
+
+        if args.fleet_mesh:
+            # pod-fleet leg (PR 16): the lane axis sharded over the
+            # 8-device virtual lane mesh — B in {8,64,256} so the
+            # aggregate lane-steps/s scaling curve (and the
+            # zero-quarantine invariant) trends across rounds even
+            # with the relay down
+            try:
+                remaining = args.deadline - (time.perf_counter()
+                                             - t_start)
+                if remaining < 30.0:
+                    result["fleet_mesh"] = {
+                        "error": "skipped (deadline exhausted)"}
+                else:
+                    result["fleet_mesh"] = fleet_mesh_reference(
+                        timeout_s=min(900.0, remaining))
+                log(f"[bench] fleet_mesh: {result['fleet_mesh']}")
+            except Exception as e:
+                result["fleet_mesh"] = {
+                    "error": f"{type(e).__name__}: {e}"}
 
         # serving-latency leg: cold vs warm request-to-first-step
         # through the warm-pool router (PR 12). Like the sharded ref

@@ -111,13 +111,13 @@ def _build_transfer(engine, piece):
     return interp, (u, X, mask), ()
 
 
-def _driver(integ, lanes=None, donate=False):
+def _driver(integ, lanes=None, donate=False, lane_mesh=None):
     from ibamr_tpu.utils.health import HealthProbe
     from ibamr_tpu.utils.hierarchy_driver import HierarchyDriver, RunConfig
 
     cfg = RunConfig(dt=_DT, num_steps=4, health_interval=2,
                     donate=donate)
-    return HierarchyDriver(integ, cfg, lanes=lanes,
+    return HierarchyDriver(integ, cfg, lanes=lanes, lane_mesh=lane_mesh,
                            health_probe=HealthProbe.for_integrator(integ))
 
 
@@ -342,6 +342,60 @@ def _build_lagrangian_exchange():
     return exchange, (F, state.X, state.mask), ()
 
 
+def _build_fleet_mesh_chunk():
+    # the pod fleet's unit of work (PR 16): the 8-lane fleet chunk with
+    # its lane axis sharded over the 8-device lane mesh (B×D — each
+    # device owns whole lanes). Lanes are independent, so the ONLY
+    # collectives the partitioner may insert are boundary reshard pins;
+    # the budget holds this at zero-traffic and keeps the per-lane
+    # freeze/dt structure identical to fleet_chunk.
+    import jax
+    import jax.numpy as jnp
+
+    from ibamr_tpu.parallel.mesh import make_lane_mesh, place_lanes
+    from ibamr_tpu.utils import lanes as _lanes
+
+    _require_devices(jax)
+    integ, state = _shell()
+    mesh = make_lane_mesh(8)
+    drv = _driver(integ, lanes=8, lane_mesh=mesh)
+    chunk = _unwrap(drv._chunk(2))
+    stacked = place_lanes(_lanes.stack_lanes([state] * 8), mesh)
+    dt_vec = jnp.full((8,), _DT, dtype=jnp.float32)
+    alive = jnp.ones((8,), dtype=bool)
+    return chunk, (stacked, dt_vec, alive), ()
+
+
+def _build_krylov_reduce():
+    # the Krylov layer's per-iteration global reductions under GSPMD:
+    # a sharded CG on the (shifted) periodic Poisson operator. On the
+    # CPU mesh every global dot lowers to a synchronous all-reduce, so
+    # ``collective_sync_ops`` counts the syncs per compiled module —
+    # PR 16's fused ``tree_dots`` turns the two scalar (r,z)/(r,r)
+    # reductions per iteration into ONE (2,)-vector reduction and the
+    # budget pins the lower count.
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ibamr_tpu.parallel import make_mesh
+    from ibamr_tpu.solvers.krylov import cg
+
+    _require_devices(jax)
+    mesh = make_mesh(8)
+    sh = NamedSharding(mesh, PartitionSpec(*mesh.axis_names))
+
+    def A(x):
+        x = jax.lax.with_sharding_constraint(x, sh)
+        return (7.0 * x
+                - jnp.roll(x, 1, 0) - jnp.roll(x, -1, 0)
+                - jnp.roll(x, 1, 1) - jnp.roll(x, -1, 1)
+                - jnp.roll(x, 1, 2) - jnp.roll(x, -1, 2))
+
+    b = jax.device_put(jnp.ones((_N, _N, _N), jnp.float32), sh)
+    return (lambda r: cg(A, r, maxiter=8).x), (b,), ()
+
+
 def _build_solo_step_256():
     from ibamr_tpu.models.shell3d import build_shell_example
 
@@ -431,6 +485,13 @@ ARTIFACTS: Dict[str, Artifact] = {
         Artifact("lagrangian_exchange", _build_lagrangian_exchange,
                  notes="S2 owner-bucketed spread with ppermute halo "
                        "accumulate; ppermute count/bytes budgeted"),
+        Artifact("fleet_mesh_chunk", _build_fleet_mesh_chunk,
+                 notes="8-lane fleet chunk sharded over the 8-device "
+                       "lane mesh (B x D pod fleet); lanes are "
+                       "independent so collective traffic stays zero"),
+        Artifact("krylov_reduce", _build_krylov_reduce,
+                 notes="sharded CG global reductions; fused tree_dots "
+                       "pins one all-reduce sync per iteration pair"),
     )
 }
 

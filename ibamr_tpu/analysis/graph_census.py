@@ -38,6 +38,7 @@ from typing import Iterator, Optional, Tuple
 __all__ = [
     "iter_eqns", "fft_census", "dot_census", "convert_census",
     "host_transfer_census", "collective_census", "overlap_census",
+    "structural_overlap_census",
     "hlo_op_counts", "op_class_counts",
     "donation_census", "graph_census", "budget_metrics",
 ]
@@ -286,6 +287,87 @@ def collective_census(jaxpr) -> dict:
     return out
 
 
+# the data-MOVING collectives for the structural pipeline census.
+# pbroadcast is excluded on purpose: it is shard_map's replication
+# annotation, lowered to nothing on device — its hundreds of sites
+# would swamp the fraction the pipelined exchanges actually move.
+_MOVING_COLLECTIVE_PRIMS = ("ppermute", "psum", "all_gather",
+                            "all_to_all")
+
+# primitives that are pure layout/bookkeeping, not schedulable
+# compute: a window containing only these hides no link latency
+_LAYOUT_PRIMS = {"reshape", "broadcast_in_dim", "squeeze", "transpose",
+                 "convert_element_type", "copy", "slice",
+                 "sharding_constraint", "pbroadcast"}
+
+
+def structural_overlap_census(jaxpr, max_sites: int = 16) -> dict:
+    """Structural hidden/unhidden census at the jaxpr level.
+
+    The HLO :func:`overlap_census` sees only what one backend's
+    scheduler DID (the CPU backend lowers every collective
+    synchronously, so it reports zero pairs on the CI mesh); this
+    census measures what the traced program makes POSSIBLE, identically
+    on every backend: a data-moving collective (`ppermute`/`psum`/
+    `all_gather`/`all_to_all` — NOT `pbroadcast`, a no-traffic
+    replication annotation) counts as **hidden** when at least one
+    independent schedulable compute equation sits between its issue
+    site and its first consumer in trace order. Such a window is
+    exactly what lets a latency-hiding scheduler keep the transfer in
+    flight behind real work; an empty (or layout/collective-only)
+    window pins the exchange to the critical path on every backend.
+
+    Windows are computed per jaxpr body (trace order within a body is
+    the schedulable order; a collective whose result is a body OUTPUT
+    gets the remainder of the body as its window). Returns::
+
+        {"structural_collectives": data-moving collectives seen,
+         "hidden_collectives": with >=1 compute eqn in the window,
+         "unhidden_collectives": with an empty/bookkeeping-only window,
+         "hidden_fraction": int percent (100 when no collectives),
+         "unhidden_sites": [up to max_sites {prim, window_eqns}]}
+    """
+    out = {"structural_collectives": 0, "hidden_collectives": 0,
+           "unhidden_collectives": 0, "unhidden_sites": []}
+
+    def walk(jx):
+        eqns = list(jx.eqns)
+        for i, eqn in enumerate(eqns):
+            for _, sub in _sub_jaxprs(eqn.params):
+                walk(sub)
+            name = eqn.primitive.name
+            if name not in _MOVING_COLLECTIVE_PRIMS:
+                continue
+            produced = {id(v) for v in eqn.outvars}
+            first_use = len(eqns)
+            for j in range(i + 1, len(eqns)):
+                if any(id(v) in produced for v in eqns[j].invars):
+                    first_use = j
+                    break
+            compute = 0
+            for k in range(i + 1, first_use):
+                kn = eqns[k].primitive.name
+                if (kn in _LAYOUT_PRIMS
+                        or kn in _MOVING_COLLECTIVE_PRIMS):
+                    continue
+                compute += 1
+            out["structural_collectives"] += 1
+            if compute:
+                out["hidden_collectives"] += 1
+            else:
+                out["unhidden_collectives"] += 1
+                if len(out["unhidden_sites"]) < max_sites:
+                    out["unhidden_sites"].append(
+                        {"prim": name,
+                         "window_eqns": first_use - i - 1})
+
+    walk(jaxpr)
+    tot = out["structural_collectives"]
+    out["hidden_fraction"] = (
+        100 * out["hidden_collectives"] // tot if tot else 100)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # HLO-text censuses
 # ---------------------------------------------------------------------------
@@ -483,6 +565,7 @@ def graph_census(fn, args, donate_argnums=()) -> dict:
     out.update(convert_census(jaxpr.jaxpr))
     out.update(host_transfer_census(jaxpr.jaxpr))
     out.update(collective_census(jaxpr.jaxpr))
+    out.update(structural_overlap_census(jaxpr.jaxpr))
     out.update(overlap_census(text))
     out.update(donation_census(text))
     out["hlo_ops_total"] = sum(ops.values())
@@ -507,8 +590,16 @@ BUDGET_MAX_METRICS = (
     "all_to_all_prims", "all_to_all_bytes",
     "pbroadcast_prims", "pbroadcast_bytes",
     "overlap_pairs", "overlap_unhidden", "collective_sync_ops",
+    # PR 16: the structural pipeline census — an unhidden data-moving
+    # collective (empty issue->first-consumer window) serializes on
+    # every backend, so its count is a ceiling.
+    "unhidden_collectives",
 )
-BUDGET_MIN_METRICS = ("donated_args",)
+# "min" metrics regress DOWN: donation silently dropped by a refactor,
+# or a double-buffered pipeline collapsing back to a sync chain
+# (hidden_fraction is the int percent of data-moving collectives with
+# compute in their issue window — see structural_overlap_census).
+BUDGET_MIN_METRICS = ("donated_args", "hidden_fraction")
 
 
 def budget_metrics(census: dict) -> dict:

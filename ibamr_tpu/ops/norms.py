@@ -40,6 +40,35 @@ def tree_dot(a: Any, b: Any) -> jnp.ndarray:
     return s
 
 
+def tree_dots(pairs: Sequence[Any]) -> jnp.ndarray:
+    """K inner products as ONE fused reduction -> a (K,) vector.
+
+    ``pairs`` is a sequence of (a, b) pytree pairs with identical
+    structure. Per leaf the K elementwise products are stacked and
+    reduced over the trailing axes in a single ``jnp.sum`` — under
+    sharding the partitioner then inserts ONE psum of a length-K
+    vector where K scalar ``tree_dot`` calls would each sync the mesh
+    (the per-iteration Krylov reductions in solvers/krylov.py are the
+    consumers). Each row reduces over the same elements in the same
+    order as its scalar ``tree_dot``, so values are unchanged —
+    tests/test_norms_fused.py pins exact equality in f64."""
+    pairs = list(pairs)
+    if not pairs:
+        return jnp.zeros((0,))
+    per_leaf = jax.tree_util.tree_map(
+        lambda *xs: _reduce(
+            lambda s: jnp.sum(s, axis=tuple(range(1, s.ndim))),
+            jnp.stack(xs)),
+        *[jax.tree_util.tree_map(jnp.multiply, a, b) for a, b in pairs])
+    leaves = jax.tree_util.tree_leaves(per_leaf)
+    if not leaves:
+        return jnp.zeros((len(pairs),))
+    s = leaves[0]
+    for x in leaves[1:]:
+        s = s + x
+    return s
+
+
 def l1_norm(f: jnp.ndarray, cell_volume: float = 1.0) -> jnp.ndarray:
     return _reduce(jnp.sum, jnp.abs(f)) * cell_volume
 

@@ -17,6 +17,19 @@ FFT thunk that breaks the naive GSPMD lowering of `rfftn` on a 2D-sharded
 Supported decompositions (grid axes are sharded left-to-right by mesh
 axes): 2D or 3D grid x 1D mesh; 3D grid x 2D mesh (true pencils); 2D
 grid x 2D mesh (both mesh axes flattened into one transpose group).
+
+Double-buffered transposes (PR 16): with ``tiles > 1`` the 3-D kernels
+split each pencil stage along a BYSTANDER axis (one the stage's
+transpose and FFT never touch) and software-pipeline the tiles — tile
+``t+1``'s ``all_to_all`` is issued before tile ``t``'s local FFT /
+diagonal solve consumes its own, so every transpose but the pipeline
+boundary has independent compute inside its issue window
+(``analysis.graph_census.structural_overlap_census``) and a
+latency-hiding scheduler can keep it in flight behind the k-space
+algebra. Bitwise contract: tiling only ever slices a *batch* axis of a
+batched 1-D FFT and the pointwise symbol, so every transform and every
+symbol element sees exactly the arithmetic of the ``tiles=1`` chain —
+pinned in f64 by tests/test_fftpar.py.
 """
 
 from __future__ import annotations
@@ -71,9 +84,12 @@ class PencilFFT:
     shard_map as replicated operands so they may be traced values.
     """
 
-    def __init__(self, grid: StaggeredGrid, mesh: Mesh):
+    def __init__(self, grid: StaggeredGrid, mesh: Mesh, tiles: int = 2):
         self.grid = grid
         self.mesh = mesh
+        if tiles < 1:
+            raise ValueError(f"tiles must be >= 1, got {tiles}")
+        self.tiles = tiles
         dim = grid.dim
         axes = tuple(mesh.axis_names)
         sizes = tuple(mesh.shape[a] for a in axes)
@@ -109,7 +125,39 @@ class PencilFFT:
         cdt = jnp.complex128 if rdt == jnp.float64 else jnp.complex64
         lam = [_axis_symbol(n[d], dx[d], rdt) for d in range(dim)]
 
-        if len(axes) == 1:
+        if len(axes) == 1 and dim == 3:
+            ax = axes[0]
+            # bystander axis 2 is never touched by the ax transpose or
+            # the axis-0/1 FFTs, so the whole solve pipelines along it
+            tn = math.gcd(self.tiles, n[2])
+
+            def kernel(r, *scalars):
+                c = jnp.fft.fft(r.astype(cdt), axis=2)
+                parts = (jnp.split(c, tn, axis=2) if tn > 1 else [c])
+                pre = [jnp.fft.fft(parts[0], axis=1)]
+                inb = [_transpose(pre[0], ax, 1, 0)]
+                i = lax.axis_index(ax)
+                # symbol built AFTER the first inbound issue: its adds
+                # are the compute that hides tile 0's transpose
+                sym = (lam[0][:, None, None]
+                       + _slice_for_shard(lam[1], i, sizes[0])[None, :, None]
+                       + lam[2][None, None, :])
+                w = n[2] // tn
+                outb = []
+                for t in range(tn):
+                    if t + 1 < tn:
+                        pre.append(jnp.fft.fft(parts[t + 1], axis=1))
+                        inb.append(_transpose(pre[t + 1], ax, 1, 0))
+                    y = jnp.fft.fft(inb[t], axis=0)
+                    y = op(sym[:, :, t * w:(t + 1) * w], y, *scalars)
+                    y = jnp.fft.ifft(y, axis=0)
+                    outb.append(_transpose(y, ax, 0, 1))
+                res = [jnp.fft.ifft(o, axis=1) for o in outb]
+                c = (jnp.concatenate(res, axis=2) if tn > 1 else res[0])
+                c = jnp.fft.ifft(c, axis=2)
+                return jnp.real(c).astype(rdt)
+
+        elif len(axes) == 1:
             ax = axes[0]
 
             def kernel(r, *scalars):
@@ -134,24 +182,56 @@ class PencilFFT:
 
         elif dim == 3:
             ax, ay = axes
+            # stage A/C (ay transposes) pipeline along bystander axis 0
+            # (local extent n0/Px); stage B (ax transposes + diagonal
+            # solve) along bystander axis 2 (local extent n2/Py)
+            ta = math.gcd(self.tiles, n[0] // sizes[0])
+            tb = math.gcd(self.tiles, n[2] // sizes[1])
 
             def kernel(r, *scalars):
                 c = r.astype(cdt)
-                c = jnp.fft.fft(c, axis=2)
-                c = _transpose(c, ay, 2, 1)
-                c = jnp.fft.fft(c, axis=1)
-                c = _transpose(c, ax, 1, 0)
-                c = jnp.fft.fft(c, axis=0)
+                # stage A: axis-2 FFT per tile, ay transpose prefetched
+                # one tile ahead of the axis-1 FFT that consumes it
+                parts = (jnp.split(c, ta, axis=0) if ta > 1 else [c])
+                pre = [jnp.fft.fft(parts[0], axis=2)]
+                moved = [_transpose(pre[0], ay, 2, 1)]
+                outs = []
+                for t in range(ta):
+                    if t + 1 < ta:
+                        pre.append(jnp.fft.fft(parts[t + 1], axis=2))
+                        moved.append(_transpose(pre[t + 1], ay, 2, 1))
+                    outs.append(jnp.fft.fft(moved[t], axis=1))
+                c = (jnp.concatenate(outs, axis=0) if ta > 1 else outs[0])
+                # stage B: inbound ax transpose for tile t+1 in flight
+                # while tile t's axis-0 FFT + diagonal solve runs
+                parts = (jnp.split(c, tb, axis=2) if tb > 1 else [c])
+                inb = [_transpose(parts[0], ax, 1, 0)]
                 ix, iy = lax.axis_index(ax), lax.axis_index(ay)
+                # symbol built AFTER the first inbound issue: its adds
+                # are the compute that hides tile 0's transpose
                 sym = (lam[0][:, None, None]
                        + _slice_for_shard(lam[1], ix, sizes[0])[None, :, None]
                        + _slice_for_shard(lam[2], iy, sizes[1])[None, None, :])
-                c = op(sym, c, *scalars)
-                c = jnp.fft.ifft(c, axis=0)
-                c = _transpose(c, ax, 0, 1)
-                c = jnp.fft.ifft(c, axis=1)
-                c = _transpose(c, ay, 1, 2)
-                c = jnp.fft.ifft(c, axis=2)
+                w = n[2] // sizes[1] // tb
+                outb = []
+                for t in range(tb):
+                    if t + 1 < tb:
+                        inb.append(_transpose(parts[t + 1], ax, 1, 0))
+                    y = jnp.fft.fft(inb[t], axis=0)
+                    y = op(sym[:, :, t * w:(t + 1) * w], y, *scalars)
+                    y = jnp.fft.ifft(y, axis=0)
+                    outb.append(_transpose(y, ax, 0, 1))
+                res = [jnp.fft.ifft(o, axis=1) for o in outb]
+                c = (jnp.concatenate(res, axis=2) if tb > 1 else res[0])
+                # stage C: ay transpose back, axis-2 IFFT interleaved
+                parts = (jnp.split(c, ta, axis=0) if ta > 1 else [c])
+                back = [_transpose(parts[0], ay, 1, 2)]
+                res2 = []
+                for t in range(ta):
+                    if t + 1 < ta:
+                        back.append(_transpose(parts[t + 1], ay, 1, 2))
+                    res2.append(jnp.fft.ifft(back[t], axis=2))
+                c = (jnp.concatenate(res2, axis=0) if ta > 1 else res2[0])
                 return jnp.real(c).astype(rdt)
 
         else:  # dim == 2, 2D mesh: flatten both mesh axes into one group

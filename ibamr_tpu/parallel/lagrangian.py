@@ -236,9 +236,12 @@ class ShardedInteraction:
         idx[d] = slice(lo, hi)
         return a[tuple(idx)]
 
-    def _halo_add(self, buf, d):
-        """Push this device's halo slabs along local axis d to the ring
-        neighbors and accumulate; returns the axis-d interior."""
+    def _halo_issue(self, buf, d):
+        """Issue the two halo-accumulate ppermutes along local axis d;
+        returns the in-flight slabs for :meth:`_halo_retire`. Split
+        from the retire half so the fused multi-component kernels can
+        interleave another component's purely-local scatter between
+        issue and consumption (structural overlap, PR 16)."""
         ax = self.axes[d]
         Pd = self.sizes[d]
         w, nl = self.w, self.nloc[d]
@@ -253,6 +256,13 @@ class ShardedInteraction:
         with jax.named_scope("comm"):
             from_next = lax.ppermute(lo_slab, ax, perm=fwd)
             from_prev = lax.ppermute(hi_slab, ax, perm=bwd)
+        return from_next, from_prev
+
+    def _halo_retire(self, buf, d, slabs):
+        """Accumulate the in-flight axis-d slabs; returns the axis-d
+        interior."""
+        from_next, from_prev = slabs
+        w, nl = self.w, self.nloc[d]
         interior = self._take(buf, d, w, w + nl)
         idx_hi = [slice(None)] * buf.ndim
         idx_hi[d] = slice(nl - w, nl)
@@ -262,9 +272,14 @@ class ShardedInteraction:
         interior = interior.at[tuple(idx_lo)].add(from_prev)
         return interior
 
-    def _ghost_fill(self, f, d):
-        """Extend local field f with w ghost layers along axis d from
-        the ring neighbors."""
+    def _halo_add(self, buf, d):
+        """Push this device's halo slabs along local axis d to the ring
+        neighbors and accumulate; returns the axis-d interior."""
+        return self._halo_retire(buf, d, self._halo_issue(buf, d))
+
+    def _ghost_issue(self, f, d):
+        """Issue the two ghost-fill ppermutes along local axis d;
+        returns the in-flight ghost slabs for :meth:`_ghost_retire`."""
         ax = self.axes[d]
         Pd = self.sizes[d]
         w, nl = self.w, self.nloc[d]
@@ -275,7 +290,16 @@ class ShardedInteraction:
                                     perm=fwd)
             hi_ghost = lax.ppermute(self._take(f, d, 0, w), ax,
                                     perm=bwd)
-        return jnp.concatenate([lo_ghost, f, hi_ghost], axis=d)
+        return lo_ghost, hi_ghost
+
+    def _ghost_retire(self, f, d, slabs):
+        """Concatenate the in-flight ghost slabs onto local field f."""
+        return jnp.concatenate([slabs[0], f, slabs[1]], axis=d)
+
+    def _ghost_fill(self, f, d):
+        """Extend local field f with w ghost layers along axis d from
+        the ring neighbors."""
+        return self._ghost_retire(f, d, self._ghost_issue(f, d))
 
     # -- public ops ----------------------------------------------------------
     def spread(self, F: jnp.ndarray, X: jnp.ndarray, centering,
@@ -304,6 +328,12 @@ class ShardedInteraction:
             kernel, mesh=self.mesh,
             in_specs=(self.row_spec2, self.row_spec, self.row_spec),
             out_specs=self.grid_spec)(b.Xb, Fb, b.wb)
+        return self._spread_overflow(out, F, X, centering, b)
+
+    def _spread_overflow(self, out, F, X, centering, b: ShardBuckets):
+        """Gated overflow fallbacks on one spread component (shared by
+        the per-component and fused paths — identical graphs)."""
+        grid = self.grid
 
         def compact(o):
             return interaction.spread(F[b.o_idx], grid, X[b.o_idx],
@@ -329,7 +359,6 @@ class ShardedInteraction:
     def interpolate(self, f: jnp.ndarray, X: jnp.ndarray, centering,
                     b: ShardBuckets) -> jnp.ndarray:
         """Interpolate a sharded grid field at the markers -> (N,)."""
-        grid = self.grid
 
         def kernel(fl, Xl, wl):
             for d in range(self.n_sharded):
@@ -343,7 +372,13 @@ class ShardedInteraction:
             kernel, mesh=self.mesh,
             in_specs=(self.grid_spec, self.row_spec2, self.row_spec),
             out_specs=self.row_spec)(f, b.Xb, b.wb)
+        return self._interp_unbucket(Ub, f, X, centering, b)
 
+    def _interp_unbucket(self, Ub, f, X, centering, b: ShardBuckets):
+        """Slot gather back to global marker order + gated overflow
+        fallbacks on one interpolated component (shared by the
+        per-component and fused paths — identical graphs)."""
+        grid = self.grid
         # map back to global marker order (slot gather; the sentinel
         # slot P*cap maps overflowed markers to 0)
         U = jnp.take(Ub, jnp.minimum(b.slot_of_marker, Ub.shape[0] - 1),
@@ -368,14 +403,70 @@ class ShardedInteraction:
             lambda u: lax.cond(b.any_overflow, compact,
                                lambda uu: uu, u), U)
 
-    # drop-in FastInteraction-shaped surface (IBMethod engine seam)
+    # drop-in FastInteraction-shaped surface (IBMethod engine seam).
+    # The vector paths run ONE fused shard_map over all dim components
+    # and software-pipeline the halo exchange ACROSS components: while
+    # component c's ghost slabs ride the ring, component c+1's purely
+    # local scatter/stencil/gather executes — every component's own
+    # expression tree is untouched (axis order, accumulate order), so
+    # the fused result is bitwise identical to the per-component loop
+    # (pinned by tests/test_lagrangian_sharded.py).
     def interpolate_vel(self, u: Vel, X: jnp.ndarray,
                         weights: Optional[jnp.ndarray] = None,
                         b: Optional[ShardBuckets] = None) -> jnp.ndarray:
         if b is None:
             b = self.buckets(X, weights)
-        cols = [self.interpolate(u[d], X, d, b)
-                for d in range(self.grid.dim)]
+        C = self.grid.dim
+        S = self.n_sharded
+
+        def kernel(Xl, wl, *fls):
+            starts = self._starts()
+            exts = [None] * C
+            stencils = [None] * C
+            ready = []
+            inflight = []            # [component, axis, field, slabs]
+
+            def advance():
+                nxt = []
+                for c, d, f, slabs in inflight:
+                    fe = self._ghost_retire(f, d, slabs)
+                    if d + 1 < S:
+                        nxt.append([c, d + 1, fe,
+                                    self._ghost_issue(fe, d + 1)])
+                    else:
+                        exts[c] = fe
+                        ready.append(c)
+                inflight[:] = nxt
+
+            def gather(c):
+                lin, wgt = stencils[c]
+                vals = jnp.take(exts[c].reshape(-1), lin, axis=0)
+                return jnp.sum(vals * wgt, axis=-1) * wl
+
+            Us = [None] * C
+            for c in range(C):
+                inflight.append([c, 0, fls[c],
+                                 self._ghost_issue(fls[c], 0)])
+                # the stencil build is pure marker arithmetic — the
+                # compute that hides the ghost slabs just issued
+                stencils[c] = self._local_stencil(Xl, starts, c)[:2]
+                advance()
+            while inflight:
+                if ready:            # a gather hides the drain retires
+                    c = ready.pop(0)
+                    Us[c] = gather(c)
+                advance()
+            for c in ready:
+                Us[c] = gather(c)
+            return tuple(Us)
+
+        Ubs = shard_map(
+            kernel, mesh=self.mesh,
+            in_specs=(self.row_spec2, self.row_spec)
+            + (self.grid_spec,) * C,
+            out_specs=(self.row_spec,) * C)(b.Xb, b.wb, *u)
+        cols = [self._interp_unbucket(Ubs[c], u[c], X, c, b)
+                for c in range(C)]
         return jnp.stack(cols, axis=-1)
 
     def spread_vel(self, F: jnp.ndarray, X: jnp.ndarray,
@@ -383,5 +474,51 @@ class ShardedInteraction:
                    b: Optional[ShardBuckets] = None) -> Vel:
         if b is None:
             b = self.buckets(X, weights)
-        return tuple(self.spread(F[:, d], X, d, b)
-                     for d in range(self.grid.dim))
+        grid = self.grid
+        C = grid.dim
+        S = self.n_sharded
+        inv_vol = 1.0 / math.prod(grid.dx)
+        Fbs = []
+        for c in range(C):
+            Fb = jnp.zeros((self.P * self.cap + 1,), dtype=F.dtype)
+            Fb = Fb.at[b.slot_of_marker].add(F[:, c])[:-1]
+            Fbs.append(lax.with_sharding_constraint(
+                Fb, NamedSharding(self.mesh, self.row_spec)))
+
+        def kernel(Xl, wl, *Fls):
+            starts = self._starts()
+            outs = [None] * C
+            inflight = []            # [component, axis, buffer, slabs]
+
+            def advance():
+                nxt = []
+                for c, d, buf, slabs in inflight:
+                    interior = self._halo_retire(buf, d, slabs)
+                    if d + 1 < S:
+                        nxt.append([c, d + 1, interior,
+                                    self._halo_issue(interior, d + 1)])
+                    else:
+                        outs[c] = interior
+                inflight[:] = nxt
+
+            for c in range(C):
+                # the local scatter is the compute that hides the halo
+                # slabs issued for the previous component(s)
+                lin, wgt, ext_shape = self._local_stencil(Xl, starts, c)
+                vals = (Fls[c] * wl * inv_vol)[:, None] * wgt
+                buf = jnp.zeros(ext_shape, dtype=vals.dtype)
+                buf = buf.reshape(-1).at[lin.reshape(-1)].add(
+                    vals.reshape(-1)).reshape(ext_shape)
+                advance()
+                inflight.append([c, 0, buf, self._halo_issue(buf, 0)])
+            while inflight:
+                advance()
+            return tuple(outs)
+
+        outs = shard_map(
+            kernel, mesh=self.mesh,
+            in_specs=(self.row_spec2, self.row_spec)
+            + (self.row_spec,) * C,
+            out_specs=(self.grid_spec,) * C)(b.Xb, b.wb, *Fbs)
+        return tuple(self._spread_overflow(outs[c], F[:, c], X, c, b)
+                     for c in range(C))

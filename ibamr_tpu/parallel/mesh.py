@@ -595,6 +595,80 @@ def place_state(state, grid: StaggeredGrid, mesh: Mesh):
     return jax.tree_util.tree_map(put, state)
 
 
+# ---- fleet lane sharding (PR 16) ------------------------------------
+# The SECOND scaling axis: where the spatial meshes above split ONE
+# simulation's grid over D devices, a lane mesh splits a B-lane fleet
+# (utils.lanes stacked state, lane axis ALWAYS axis 0) across devices —
+# B/D whole lanes per device, zero cross-device traffic inside a step
+# (lanes are independent), so a pod runs B×D-lane ensembles with the
+# per-lane quarantine/dt machinery of HierarchyDriver untouched. The
+# bitwise contract (sharded fleet == replicated fleet, f64) is pinned
+# by tests/test_fleet_mesh.py.
+
+LANE_AXIS = "lanes"
+
+
+def make_lane_mesh(n_devices: Optional[int] = None,
+                   devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh over the lane (batch) axis of a stacked fleet state."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    import numpy as np
+    return Mesh(np.array(devices), (LANE_AXIS,))
+
+
+def lane_pspec(mesh: Mesh) -> P:
+    """PartitionSpec sharding axis 0 (the lane axis) over the lane mesh."""
+    return P(mesh.axis_names[0])
+
+
+def _check_lane_divisible(lanes: int, mesh: Mesh) -> None:
+    d = int(mesh.devices.size)
+    if lanes % d != 0:
+        raise ValueError(
+            f"fleet of {lanes} lanes does not divide the {d}-device "
+            f"lane mesh evenly (lanes % devices must be 0 so every "
+            f"device owns whole lanes)")
+
+
+def shard_lanes(state, mesh: Mesh):
+    """Constraint-pin every leaf's lane axis (axis 0) to the lane mesh.
+
+    ``utils.lanes.stack_lanes`` gives EVERY leaf — scalars included — a
+    leading (B,) lane axis, so the pin is unconditional; trailing axes
+    stay unsharded (each device owns whole lanes)."""
+    sharding = NamedSharding(mesh, lane_pspec(mesh))
+
+    def constrain(a):
+        if hasattr(a, "ndim") and a.ndim >= 1:
+            return _pin(a, sharding)
+        return a
+
+    return jax.tree_util.tree_map(constrain, state)
+
+
+def place_lanes(state, mesh: Mesh):
+    """Device-put a lane-stacked fleet state under the lane sharding
+    (so the first chunk doesn't start from a single-device layout, and
+    so sharded checkpoints record the lane-sharded layout)."""
+    sharding = NamedSharding(mesh, lane_pspec(mesh))
+    replicated = NamedSharding(mesh, P())
+    leaves = [l for l in jax.tree_util.tree_leaves(state)
+              if hasattr(l, "shape") and getattr(l, "ndim", 0) >= 1]
+    if leaves:
+        _check_lane_divisible(int(leaves[0].shape[0]), mesh)
+
+    def put(a):
+        a = jnp.asarray(a)
+        if a.ndim >= 1:
+            return jax.device_put(a, sharding)
+        return jax.device_put(a, replicated)
+
+    return jax.tree_util.tree_map(put, state)
+
+
 def make_sharded_vc_step(integ, mesh: Mesh):
     """Jitted variable-coefficient (multiphase) INS step with every
     grid field sharded over ``mesh`` — S1 for the P22 multiphase

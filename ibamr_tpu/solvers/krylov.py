@@ -19,7 +19,7 @@ from typing import Any, Callable, NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
-from ibamr_tpu.ops.norms import tree_dot  # noqa: E402  (shared primitive)
+from ibamr_tpu.ops.norms import tree_dot, tree_dots  # noqa: E402  (shared)
 
 Pytree = Any
 Operator = Callable[[Pytree], Pytree]
@@ -73,8 +73,10 @@ def cg(A: Operator, b: Pytree, x0: Optional[Pytree] = None,
     r0 = tree_sub(b, A(x0))
     z0 = M(r0)
     p0 = z0
-    rz0 = tree_dot(r0, z0)
-    rn0 = jnp.sqrt(tree_dot(r0, r0))
+    # one fused reduction for the (r,z)/(r,r) pair — one psum of a
+    # (2,) vector under sharding instead of two scalar syncs
+    rz0, rn0sq = tree_dots([(r0, z0), (r0, r0)])
+    rn0 = jnp.sqrt(rn0sq)
 
     # Finite-precision divergence guard: when ``tol`` is below the
     # dtype's reachable floor (an f32 solve asked for 1e-9), the
@@ -99,10 +101,13 @@ def cg(A: Operator, b: Pytree, x0: Optional[Pytree] = None,
         x = tree_axpy(alpha, p, x)
         r = tree_axpy(-alpha, Ap, r)
         z = M(r)
-        rz_new = tree_dot(r, z)
+        # fused (r,z)/(r,r) reduction: one collective sync per
+        # iteration where there were two (values unchanged — each row
+        # reduces the same elements in the same order)
+        rz_new, rnsq = tree_dots([(r, z), (r, r)])
         beta = jnp.where(rz > 0, rz_new / jnp.where(rz == 0, 1.0, rz), 0.0)
         p = tree_axpy(beta, p, z)
-        rn = jnp.sqrt(tree_dot(r, r))    # carried: cond reuses it
+        rn = jnp.sqrt(rnsq)              # carried: cond reuses it
         better = rn < rb
         xb = jax.tree_util.tree_map(
             lambda a_, b_: jnp.where(better, a_, b_), x, xb)
@@ -162,8 +167,9 @@ def bicgstab(A: Operator, b: Pytree, x0: Optional[Pytree] = None,
         s = tree_axpy(-alpha, v, r)
         shat = M(s)
         t = A(shat)
-        tt = tree_dot(t, t)
-        omega = tree_dot(t, s) / jnp.where(tt == 0, 1.0, tt)
+        # fused (t,t)/(t,s) reduction: one collective sync, not two
+        tt, ts = tree_dots([(t, t), (t, s)])
+        omega = ts / jnp.where(tt == 0, 1.0, tt)
         x = tree_axpy(alpha, phat, tree_axpy(omega, shat, x))
         r = tree_axpy(-omega, t, s)
         rn = jnp.sqrt(tree_dot(r, r))    # carried: cond reuses it

@@ -239,7 +239,8 @@ class HierarchyDriver:
                  recorder=None,
                  shadow_audit=None,
                  lanes: Optional[int] = None,
-                 fleet_step_wrap: Optional[Callable] = None):
+                 fleet_step_wrap: Optional[Callable] = None,
+                 lane_mesh=None):
         self.integ = integ
         self.cfg = cfg
         self.viz_fn = viz_fn
@@ -286,6 +287,18 @@ class HierarchyDriver:
                 "lanes carry independent per-lane dt (driver.lane_dt)")
         self.lanes = lanes
         self.fleet_step_wrap = fleet_step_wrap
+        # lane_mesh: shard the LANE axis over devices (GSPMD over whole
+        # lanes — parallel.mesh.make_lane_mesh). Orthogonal to the
+        # per-lane machinery: quarantine/dt stay (B,) traced vectors.
+        if lane_mesh is not None and lanes is None:
+            raise ValueError("lane_mesh requires fleet mode (lanes=B)")
+        if lane_mesh is not None:
+            d = int(lane_mesh.devices.size)
+            if lanes % d != 0:
+                raise ValueError(
+                    f"lanes={lanes} not divisible by the {d}-device "
+                    f"lane mesh (each device must own whole lanes)")
+        self.lane_mesh = lane_mesh
         if lanes is not None:
             # host mirrors of the traced per-lane knobs; the supervisor
             # mutates these between chunks (rollback backoff,
@@ -362,6 +375,24 @@ class HierarchyDriver:
         probe = self.health_probe
         lanes = self.lanes
         wrap = self.fleet_step_wrap
+        # lane-mesh shardings built OUTSIDE the closure (no self capture)
+        if self.lane_mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            _lane_sh = NamedSharding(
+                self.lane_mesh, PartitionSpec(self.lane_mesh.axis_names[0]))
+
+            def _pin_lanes(t):
+                # constraint-pin the lane axis at the chunk boundary so
+                # GSPMD keeps whole lanes on their devices through the
+                # scan; the comm scope labels any resulting resharding
+                # for obs/deviceprof comm_s attribution
+                with jax.named_scope("comm"):
+                    return jax.tree_util.tree_map(
+                        lambda a: (jax.lax.with_sharding_constraint(
+                            a, _lane_sh)
+                            if getattr(a, "ndim", 0) >= 1 else a), t)
+        else:
+            _pin_lanes = None
 
         stacked_step = jax.vmap(base_step, in_axes=(0, 0))
         if wrap is not None:
@@ -385,6 +416,10 @@ class HierarchyDriver:
             sigs.setdefault(n, set()).add(sig)
             counts[n] = len(sigs[n])
 
+            if _pin_lanes is not None:
+                state = _pin_lanes(state)
+                (dt, alive) = _pin_lanes((dt, alive))
+
             def body(s, _):
                 new = stacked_step(s, dt)
                 # freeze dead lanes at their pre-step rows; healthy
@@ -397,6 +432,8 @@ class HierarchyDriver:
                 return frozen, None
 
             out, _ = jax.lax.scan(body, state, None, length=n)
+            if _pin_lanes is not None:
+                out = _pin_lanes(out)
             if probe is not None:
                 # (B, 7) per-lane vitals -> (7, B); still ONE host
                 # transfer per chunk

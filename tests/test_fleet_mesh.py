@@ -1,0 +1,234 @@
+"""Fleet lanes × mesh sharding (PR 16): the B×D pod fleet.
+
+The contract under test is that lane-mesh sharding is INVISIBLE to the
+physics and to the resilience machinery: sharding the lane axis of a
+B-lane fleet over D devices (``parallel.mesh.make_lane_mesh`` +
+``lane_mesh=`` on the driver) must reproduce the replicated fleet
+BITWISE in f64 — through per-lane quarantine and dt backoff — because
+lanes are independent and each device owns whole lanes (no cross-lane
+collective may ever be introduced). Elastic N→M restart rides the
+PR-6 sharded-checkpoint manifests: a run saved on the 8-device lane
+mesh restores bitwise onto a 4-device mesh (2 lanes/device).
+"""
+
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ibamr_tpu.grid import StaggeredGrid
+from ibamr_tpu.integrators.ins import INSStaggeredIntegrator
+from ibamr_tpu.parallel.mesh import (
+    make_lane_mesh, place_lanes, shard_lanes)
+from ibamr_tpu.utils.hierarchy_driver import HierarchyDriver, RunConfig
+from ibamr_tpu.utils.lanes import lane_slice, stack_lanes
+from ibamr_tpu.utils.supervisor import ResilientDriver
+from tools.fault_injection import lane_nan_injector
+
+
+def _ins(n=16, mu=0.01):
+    g = StaggeredGrid(n=(n, n), x_lo=(0.0, 0.0), x_up=(1.0, 1.0))
+    return INSStaggeredIntegrator(g, rho=1.0, mu=mu, dtype=jnp.float64)
+
+
+def _tg_state(integ, amp=1.0):
+    g = integ.grid
+    xf, yc = g.face_centers(0, jnp.float64)
+    xc, yf = g.face_centers(1, jnp.float64)
+    u = amp * jnp.sin(2 * math.pi * xf) * jnp.cos(2 * math.pi * yc) \
+        + 0 * yc
+    v = -amp * jnp.cos(2 * math.pi * xc) * jnp.sin(2 * math.pi * yf) \
+        + 0 * xc
+    return integ.initialize(u0_arrays=(u, v))
+
+
+def _lane_states(integ, B):
+    return [_tg_state(integ, amp=1.0 + 0.05 * i) for i in range(B)]
+
+
+def _bitwise_equal(a, b) -> bool:
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    return all(np.asarray(x).tobytes() == np.asarray(y).tobytes()
+               for x, y in zip(la, lb))
+
+
+# ---------------------------------------------------------------------------
+# sharded == replicated, bitwise
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n_dev", [8, 4])
+def test_sharded_fleet_matches_replicated_bitwise(n_dev):
+    """B=8 lanes over an 8- and a 4-device lane mesh (1 and 2 whole
+    lanes per device) vs the replicated fleet: identical bits."""
+    integ = _ins()
+    B, steps, dt = 8, 4, 1e-3
+    states = _lane_states(integ, B)
+    cfg = RunConfig(dt=dt, num_steps=steps, health_interval=2)
+
+    rep = HierarchyDriver(integ, cfg, lanes=B).run(stack_lanes(states))
+
+    mesh = make_lane_mesh(n_dev)
+    drv = HierarchyDriver(integ, cfg, lanes=B, lane_mesh=mesh)
+    stacked = place_lanes(stack_lanes(states), mesh)
+    sh = drv.run(stacked)
+
+    assert _bitwise_equal(rep, sh)
+    # and the output is STILL lane-sharded (no silent gather)
+    for leaf in jax.tree_util.tree_leaves(sh):
+        if hasattr(leaf, "sharding") and leaf.ndim >= 1:
+            assert len(leaf.sharding.device_set) == n_dev
+            break
+
+
+def test_lane_mesh_rejects_indivisible_fleet():
+    integ = _ins()
+    mesh = make_lane_mesh(8)
+    with pytest.raises(ValueError, match="divisible"):
+        HierarchyDriver(integ, RunConfig(dt=1e-3, num_steps=2),
+                        lanes=6, lane_mesh=mesh)
+    with pytest.raises(ValueError, match="fleet mode"):
+        HierarchyDriver(integ, RunConfig(dt=1e-3, num_steps=2),
+                        lane_mesh=mesh)
+    with pytest.raises(ValueError, match="divide"):
+        place_lanes(stack_lanes(_lane_states(integ, 6)), mesh)
+
+
+def test_shard_lanes_pins_lane_axis():
+    integ = _ins()
+    mesh = make_lane_mesh(8)
+    stacked = stack_lanes(_lane_states(integ, 8))
+
+    @jax.jit
+    def pin(t):
+        return shard_lanes(t, mesh)
+
+    out = pin(stacked)
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    assert len(leaf.sharding.device_set) == 8
+
+
+# ---------------------------------------------------------------------------
+# resilience machinery under sharding: quarantine + dt backoff
+# ---------------------------------------------------------------------------
+
+def _supervised(integ, cfg, B, states, tmp_path, tag, inj,
+                max_retries, lane_mesh=None):
+    drv = HierarchyDriver(
+        integ, cfg, lanes=B, lane_mesh=lane_mesh,
+        fleet_step_wrap=lambda s: lane_nan_injector(s, **inj))
+    sup = ResilientDriver(drv, os.path.join(str(tmp_path), tag),
+                          max_retries=max_retries, dt_backoff=0.5,
+                          handle_signals=False,
+                          sharded=lane_mesh is not None, mesh=lane_mesh)
+    stacked = stack_lanes(states)
+    if lane_mesh is not None:
+        stacked = place_lanes(stacked, lane_mesh)
+    final = sup.run(stacked)
+    return drv, sup, final
+
+
+def test_sharded_fleet_quarantine_and_backoff_match_replicated(tmp_path):
+    """The full resilience episode — NaN fault, per-lane rollback with
+    dt backoff, quarantine after retry exhaustion — plays out
+    IDENTICALLY on the sharded and the replicated fleet. No
+    checkpoints (restart_interval=0), so both modes roll the failing
+    lane back to its initial slice and the final states must be
+    bitwise equal lane for lane."""
+    integ = _ins()
+    B, BAD, steps, dt = 8, 3, 8, 1e-3
+    states = _lane_states(integ, B)
+    cfg = RunConfig(dt=dt, num_steps=steps, health_interval=2,
+                    restart_interval=0)
+    inj = dict(at_step=4, lane=BAD, fleet_size=B, leaf_path="u[0]",
+               step_attr="k", dt_gate=dt)
+
+    drv_r, sup_r, fin_r = _supervised(integ, cfg, B, states, tmp_path,
+                                      "rep", inj, max_retries=1)
+    drv_s, sup_s, fin_s = _supervised(integ, cfg, B, states, tmp_path,
+                                      "sh", inj, max_retries=1,
+                                      lane_mesh=make_lane_mesh(8))
+
+    # same episode: one rollback (dt-gated fault cured by backoff),
+    # no quarantine, same dt vectors and alive masks
+    for sup in (sup_r, sup_s):
+        assert [r.get("event") for r in sup.incidents].count(
+            "lane_rollback") == 1
+        assert not any(r.get("event") == "lane_quarantine"
+                       for r in sup.incidents)
+    np.testing.assert_array_equal(drv_r.lane_dt, drv_s.lane_dt)
+    np.testing.assert_array_equal(drv_r.lane_alive, drv_s.lane_alive)
+    assert drv_s.lane_dt[BAD] == pytest.approx(0.5 * dt)
+    assert _bitwise_equal(fin_r, fin_s)
+
+
+def test_sharded_fleet_quarantines_exhausted_lane(tmp_path):
+    integ = _ins()
+    B, BAD, steps, dt = 8, 5, 8, 1e-3
+    states = _lane_states(integ, B)
+    cfg = RunConfig(dt=dt, num_steps=steps, health_interval=2,
+                    restart_interval=0)
+    inj = dict(at_step=4, lane=BAD, fleet_size=B, leaf_path="u[0]",
+               step_attr="k")
+
+    drv_r, sup_r, fin_r = _supervised(integ, cfg, B, states, tmp_path,
+                                      "rep", inj, max_retries=0)
+    drv_s, sup_s, fin_s = _supervised(integ, cfg, B, states, tmp_path,
+                                      "sh", inj, max_retries=0,
+                                      lane_mesh=make_lane_mesh(8))
+
+    for drv, sup in ((drv_r, sup_r), (drv_s, sup_s)):
+        assert not drv.lane_alive[BAD]
+        assert sum(drv.lane_alive) == B - 1
+        quar = [r for r in sup.incidents
+                if r.get("event") == "lane_quarantine"]
+        assert len(quar) == 1 and quar[0]["lane"] == BAD
+    assert _bitwise_equal(fin_r, fin_s)
+    # one compiled trace per chunk length on the sharded side too
+    assert all(v == 1 for v in drv_s.trace_counts.values()), \
+        drv_s.trace_counts
+
+
+# ---------------------------------------------------------------------------
+# elastic N -> M restart via the sharded-checkpoint manifest
+# ---------------------------------------------------------------------------
+
+def test_elastic_8_to_4_restart_bitwise(tmp_path):
+    """A fleet checkpoint saved on the 8-device lane mesh restores
+    BITWISE onto a 4-device mesh (2 lanes/device) via the manifest,
+    and resuming there matches the uninterrupted 8-device run."""
+    from ibamr_tpu.utils.checkpoint_sharded import (
+        restore_sharded, save_sharded_checkpoint)
+
+    integ = _ins()
+    B, dt = 8, 1e-3
+    states = _lane_states(integ, B)
+    mesh8 = make_lane_mesh(8)
+    cfg_half = RunConfig(dt=dt, num_steps=4, health_interval=2)
+
+    # run 4 steps on 8 devices, checkpoint
+    drv8 = HierarchyDriver(integ, cfg_half, lanes=B, lane_mesh=mesh8)
+    mid = drv8.run(place_lanes(stack_lanes(states), mesh8))
+    save_sharded_checkpoint(str(tmp_path), mid, 4, mesh=mesh8)
+
+    # the pod shrank: restore onto 4 devices via the manifest
+    mesh4 = make_lane_mesh(4)
+    template = place_lanes(stack_lanes(states), mesh4)
+    restored, step, manifest = restore_sharded(str(tmp_path), template)
+    assert step == 4
+    assert _bitwise_equal(restored, mid)
+    lead = jax.tree_util.tree_leaves(restored)[0]
+    assert len(lead.sharding.device_set) == 4
+
+    # resume 4 more steps on the smaller mesh == 8 uninterrupted steps
+    drv4 = HierarchyDriver(integ, cfg_half, lanes=B, lane_mesh=mesh4)
+    fin4 = drv4.run(restored)
+    cfg_full = RunConfig(dt=dt, num_steps=8, health_interval=2)
+    drv_full = HierarchyDriver(integ, cfg_full, lanes=B,
+                               lane_mesh=mesh8)
+    fin8 = drv_full.run(place_lanes(stack_lanes(states), mesh8))
+    assert _bitwise_equal(fin4, fin8)

@@ -128,6 +128,113 @@ def test_dot_census_counts_contraction():
 
 
 # ---------------------------------------------------------------------------
+# structural overlap census (PR 16)
+# ---------------------------------------------------------------------------
+
+_AXIS = [("i", 2)]
+
+
+def test_structural_census_hidden_vs_unhidden():
+    # independent compute between the psum's issue and its first
+    # consumer -> hidden; immediate consumption -> unhidden
+    def hidden(x, y):
+        s = jax.lax.psum(x, "i")
+        w = y * 2.0 + 1.0          # schedulable work in the window
+        return s + w
+
+    def unhidden(x, y):
+        s = jax.lax.psum(x, "i")
+        return s + y
+
+    x = jnp.ones(4, jnp.float32)
+    ch = gc.structural_overlap_census(
+        jax.make_jaxpr(hidden, axis_env=_AXIS)(x, x).jaxpr)
+    cu = gc.structural_overlap_census(
+        jax.make_jaxpr(unhidden, axis_env=_AXIS)(x, x).jaxpr)
+    assert ch["structural_collectives"] == 1
+    assert ch["hidden_collectives"] == 1
+    assert ch["hidden_fraction"] == 100
+    assert cu["unhidden_collectives"] == 1
+    assert cu["hidden_fraction"] == 0
+    assert cu["unhidden_sites"][0]["prim"] == "psum"
+
+
+def test_structural_census_layout_window_hides_nothing():
+    # a window containing only layout/bookkeeping primitives (reshape,
+    # convert) cannot hide link latency — still unhidden
+    def f(x, y):
+        s = jax.lax.psum(x, "i")
+        w = jnp.reshape(y, (2, 2)).astype(jnp.float32)
+        return s + w.reshape(4)
+
+    x = jnp.ones(4, jnp.float32)
+    c = gc.structural_overlap_census(
+        jax.make_jaxpr(f, axis_env=_AXIS)(x, x).jaxpr)
+    assert c["unhidden_collectives"] == 1
+    assert c["hidden_collectives"] == 0
+
+
+def test_structural_census_output_collective_and_fraction():
+    # a collective whose result is a body OUTPUT gets the remainder of
+    # the body as its window: trailing independent work hides it
+    def f(x, y):
+        s = jax.lax.psum(x, "i")   # consumed only by the output
+        w = y * 3.0
+        return s, w
+
+    x = jnp.ones(4, jnp.float32)
+    c = gc.structural_overlap_census(
+        jax.make_jaxpr(f, axis_env=_AXIS)(x, x).jaxpr)
+    assert c["hidden_collectives"] == 1
+    # and a collective-free program reads 100 (nothing to hide)
+    c0 = gc.structural_overlap_census(
+        jax.make_jaxpr(lambda a: a * 2.0)(x).jaxpr)
+    assert c0["structural_collectives"] == 0
+    assert c0["hidden_fraction"] == 100
+
+
+def test_structural_census_walks_scan_bodies():
+    # collectives inside a scan body are censused in the body's own
+    # trace order, not against the outer body
+    def f(x):
+        def body(c, _):
+            s = jax.lax.psum(c, "i")
+            w = c * 2.0
+            return s + w, None
+        out, _ = jax.lax.scan(body, x, None, length=3)
+        return out
+
+    x = jnp.ones(4, jnp.float32)
+    c = gc.structural_overlap_census(
+        jax.make_jaxpr(f, axis_env=_AXIS)(x).jaxpr)
+    assert c["structural_collectives"] == 1
+    assert c["hidden_collectives"] == 1
+
+
+# ---------------------------------------------------------------------------
+# --tighten directional merge (pure python — no jax)
+# ---------------------------------------------------------------------------
+
+def test_tighten_merges_directionally():
+    from tools.graph_audit import tighten_merge
+
+    old = {"scatter_ops": 3, "fft_ops": 2,
+           "donated_args": 2, "hidden_fraction": 50,
+           "legacy_only": 7}
+    measured = {"scatter_ops": 1,       # ceiling improved -> adopt
+                "fft_ops": 5,           # ceiling regressed -> KEEP old
+                "donated_args": 1,      # floor regressed -> KEEP old
+                "hidden_fraction": 80,  # floor improved -> adopt
+                "brand_new": 4}         # new metric -> adopt
+    out = tighten_merge(old, measured)
+    assert out == {"scatter_ops": 1, "fft_ops": 2,
+                   "donated_args": 2, "hidden_fraction": 80,
+                   "legacy_only": 7, "brand_new": 4}
+    # inputs are not mutated (the audit reuses the loaded budgets)
+    assert old["scatter_ops"] == 3 and "brand_new" not in old
+
+
+# ---------------------------------------------------------------------------
 # donation audit
 # ---------------------------------------------------------------------------
 

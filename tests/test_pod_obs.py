@@ -409,6 +409,118 @@ def test_fleet_summary_roundtrip_no_double_count(tmp_path, capsys):
     assert "proc 0:" in out and "proc 1:" in out
 
 
+def test_fleet_summary_renders_comm_graph_split(tmp_path, capsys):
+    """The per-proc hidden/unhidden collective split (PR 16): a
+    ``graph_census`` record on a shard (what ``tools/fleet.py`` emits
+    per supervised run) renders as the proc's ``comm graph:`` line
+    next to the measured comm share."""
+    from tools.obs import main as obs_main
+
+    d = _write_pod(tmp_path)
+    shard = os.path.join(d, "ledger-1.jsonl")
+    recs = obs.read_ledger(shard)
+    rec = {"seq": max(r["seq"] for r in recs) + 1,
+           "run_id": recs[0]["run_id"], "t": recs[-1]["t"] + 1.0,
+           "kind": "graph_census", "proc": "1", "scope": "fleet_chunk",
+           "chunk_length": 4, "lanes": 8, "mesh_devices": 8,
+           "structural_collectives": 12, "hidden_collectives": 10,
+           "unhidden_collectives": 2, "hidden_fraction": 83}
+    with open(shard, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    assert obs_main(["summary", d, "--fleet"]) == 0
+    out = capsys.readouterr().out
+    assert ("comm graph: 12 data-moving collectives, 10 hidden / "
+            "2 unhidden (83% structurally hidden) [lanes=8 x D=8]"
+            in out)
+    # proc 0 has no census record -> no comm-graph line in its block
+    block0 = out.split("proc 0:")[1].split("proc 1:")[0]
+    assert "comm graph" not in block0
+
+
+def test_run_fleet_emits_chunk_census(tmp_path, capsys):
+    """The producing side: a supervised lane-mesh fleet run lands one
+    ``graph_census`` record in its ledger, and the lane-mesh chunk is
+    fully lane-local (zero data-moving collectives)."""
+    from tools.fleet import build_fleet, run_fleet
+    from ibamr_tpu.parallel.mesh import make_lane_mesh
+    from ibamr_tpu.utils.hierarchy_driver import RunConfig
+
+    _mesh1d()  # skip unless 8 virtual devices
+    # x64 session (conftest): the shell must be built in f64 too
+    integ, _, stacked = build_fleet(16, 8, 16, 0.05, 8, 0.01,
+                                    "float64")
+    cfg = RunConfig(dt=1e-3, num_steps=4, health_interval=2)
+    summary, _ = run_fleet(integ, stacked, cfg, 8,
+                           directory=str(tmp_path),
+                           lane_mesh=make_lane_mesh(8))
+    recs = obs.read_ledger(os.path.join(str(tmp_path),
+                                        "ledger.jsonl"))
+    census = [r for r in recs if r.get("kind") == "graph_census"]
+    assert len(census) == 1
+    c = census[0]
+    assert c["scope"] == "fleet_chunk"
+    assert c["lanes"] == 8 and c["mesh_devices"] == 8
+    assert c["structural_collectives"] == 0
+    assert c["hidden_fraction"] == 100
+    assert summary["lanes_quarantined"] == 0
+
+
+# ---------------------------------------------------------------------------
+# prof diff: the dedicated comm gate (PR 16)
+# ---------------------------------------------------------------------------
+
+def _gate_summaries(comm_a, comm_b, device):
+    proc = "/device:TPU:0" if device else "python"
+    mk = lambda comm: {  # noqa: E731 - table of two
+        "total_device_s": 1.0,
+        "spans": {}, "unattributed_s": 0.0,
+        "op_classes": {"fft_s": 0.4, "dot_s": 0.3, "comm_s": comm,
+                       "other_s": 0.3 - comm},
+        "lanes": [{"process": proc, "thread": "XLA Ops",
+                   "events": 1, "busy_s": 1.0}]}
+    return mk(comm_a), mk(comm_b)
+
+
+def test_comm_gate_regresses_on_device_capture():
+    from tools.prof import diff_summaries
+
+    sa, sb = _gate_summaries(0.010, 0.013, device=True)
+    # +30% comm: inside the default 25%+floor general band would not
+    # fire for a 3 ms move on a 1 s capture... the op_class judge does
+    # fire at 25% — so use a general band ABOVE the move and show the
+    # dedicated gate still catches it
+    lines, verdict = diff_summaries(sa, sb, tol_pct=50.0,
+                                    floor_s=200e-6, comm_tol_pct=10.0)
+    assert verdict == "regressed"
+    assert any("comm gate" in ln and "REGRESSED" in ln
+               for ln in lines)
+
+
+def test_comm_gate_advisory_on_cpu_capture():
+    from tools.prof import diff_summaries
+
+    sa, sb = _gate_summaries(0.010, 0.013, device=False)
+    lines, verdict = diff_summaries(sa, sb, tol_pct=50.0,
+                                    floor_s=200e-6, comm_tol_pct=10.0)
+    assert verdict == "clean"
+    assert any("comm gate" in ln and "ADVISORY" in ln
+               for ln in lines)
+
+
+def test_comm_gate_within_band_and_unarmed():
+    from tools.prof import diff_summaries
+
+    sa, sb = _gate_summaries(0.010, 0.0101, device=True)
+    lines, verdict = diff_summaries(sa, sb, tol_pct=50.0,
+                                    floor_s=200e-6, comm_tol_pct=10.0)
+    assert verdict == "clean"
+    assert any("comm gate" in ln and "within band" in ln
+               for ln in lines)
+    # unarmed (default): no gate line at all, behavior unchanged
+    lines, _ = diff_summaries(sa, sb, tol_pct=50.0, floor_s=200e-6)
+    assert not any("comm gate" in ln for ln in lines)
+
+
 def test_fleet_compare_per_proc_deltas(tmp_path, capsys):
     from tools.obs import main as obs_main
 

@@ -20,6 +20,14 @@ Examples::
 
     python tools/fleet.py --lanes 8 --steps 16 --dir /tmp/fleet
     python tools/fleet.py --lanes 64 --n 32 --sequential
+    python tools/fleet.py --lanes 64 --mesh 8 --dir /tmp/pod  # B x D pod
+
+``--mesh D`` composes the two scaling axes (PR 16): the lane axis is
+sharded over a D-device lane mesh (``parallel.mesh.make_lane_mesh``),
+each device owns B/D whole lanes, checkpoints go through the sharded
+manifest path (elastic N→M restart re-places surviving lanes), and the
+per-lane quarantine/dt machinery is untouched — sharded == replicated
+bitwise in f64 (tests/test_fleet_mesh.py).
 """
 
 from __future__ import annotations
@@ -66,10 +74,46 @@ def build_fleet(n, n_lat, n_lon, mu, lanes, perturb, dtype):
     return integ, lane_states, stack_lanes(lane_states)
 
 
+def _emit_chunk_census(drv, stacked, cfg, lanes, lane_mesh):
+    """Emit the structural comm census of the fleet chunk (PR 16) into
+    the attached run ledger as one ``graph_census`` record, so the
+    per-proc rollup (``tools/obs.py summary --fleet``) can show each
+    process's hidden/unhidden collective split next to its measured
+    ``comm_s`` share. One extra trace of the chunk per run; the traced
+    signature is identical to the real run's, so the no-retrace
+    contract (``trace_counts``) is untouched."""
+    import jax
+    import jax.numpy as jnp
+
+    from ibamr_tpu import obs
+    from ibamr_tpu.analysis.graph_census import structural_overlap_census
+
+    n = min(cfg.health_interval, cfg.num_steps)
+    fn = drv._chunk(n)
+    fn = getattr(fn, "__wrapped__", fn)
+    jx = jax.make_jaxpr(fn)(stacked, jnp.asarray(drv.lane_dt),
+                            jnp.asarray(drv.lane_alive))
+    c = structural_overlap_census(jx.jaxpr)
+    obs.emit("graph_census", scope="fleet_chunk", chunk_length=n,
+             lanes=lanes,
+             mesh_devices=(int(lane_mesh.devices.size)
+                           if lane_mesh is not None else 0),
+             structural_collectives=c["structural_collectives"],
+             hidden_collectives=c["hidden_collectives"],
+             unhidden_collectives=c["unhidden_collectives"],
+             hidden_fraction=c["hidden_fraction"])
+
+
 def run_fleet(integ, stacked, cfg, lanes, directory=None,
               max_retries=2, dt_backoff=0.5, quarantine_threshold=0.5,
-              heartbeat=None):
-    """One supervised fleet run; returns (summary dict, final state)."""
+              heartbeat=None, lane_mesh=None):
+    """One supervised fleet run; returns (summary dict, final state).
+
+    With ``lane_mesh`` the lane axis is sharded over the mesh's devices
+    (B×D pod fleet): the stacked state is device_put under the lane
+    sharding, the chunk pins it there, and checkpoints/restores go
+    through the sharded manifest path so an elastic N→M restart
+    re-places surviving lanes."""
     import contextlib
 
     from ibamr_tpu import obs
@@ -77,8 +121,12 @@ def run_fleet(integ, stacked, cfg, lanes, directory=None,
     from ibamr_tpu.utils.hierarchy_driver import HierarchyDriver
     from ibamr_tpu.utils.supervisor import ResilientDriver
 
+    if lane_mesh is not None:
+        from ibamr_tpu.parallel.mesh import place_lanes
+        stacked = place_lanes(stacked, lane_mesh)
     probe = HealthProbe.for_integrator(integ)
-    drv = HierarchyDriver(integ, cfg, lanes=lanes, health_probe=probe)
+    drv = HierarchyDriver(integ, cfg, lanes=lanes, health_probe=probe,
+                          lane_mesh=lane_mesh)
     wd = None
     if heartbeat:
         from ibamr_tpu.utils.watchdog import RunWatchdog
@@ -104,9 +152,16 @@ def run_fleet(integ, stacked, cfg, lanes, directory=None,
                               dt_backoff=dt_backoff,
                               quarantine_threshold=quarantine_threshold,
                               handle_signals=False, watchdog=wd,
+                              sharded=lane_mesh is not None,
+                              mesh=lane_mesh,
                               incident_log=os.path.join(
                                   directory, "incidents.jsonl"))
         with ledger_cm as led:
+            try:
+                _emit_chunk_census(drv, stacked, cfg, lanes, lane_mesh)
+            except Exception as e:  # noqa: BLE001 - census is advisory
+                log(f"[fleet] chunk census skipped: "
+                    f"{type(e).__name__}: {e}")
             final = sup.run(stacked)
         ledger_seq = led.last_seq if led is not None else None
         incidents = list(sup.incidents)
@@ -149,6 +204,9 @@ def run_fleet(integ, stacked, cfg, lanes, directory=None,
         "incidents": [r.get("event") for r in incidents],
         "per_lane": per_lane,
     }
+    if lane_mesh is not None:
+        summary["mesh_devices"] = int(lane_mesh.devices.size)
+        summary["lanes_per_device"] = lanes // int(lane_mesh.devices.size)
     if ledger_path is not None:
         summary["ledger_path"] = ledger_path
         summary["ledger_records"] = (ledger_seq + 1
@@ -204,6 +262,12 @@ def main():
     ap.add_argument("--heartbeat", type=str, default="",
                     help="heartbeat.json path (carries lanes_ok/"
                          "lanes_quarantined/lanes_retrying)")
+    ap.add_argument("--mesh", type=int, nargs="?", const=0, default=None,
+                    metavar="D",
+                    help="shard the lane axis over a D-device lane "
+                         "mesh (omit D to use every visible device); "
+                         "lanes must divide D evenly — the B×D pod "
+                         "fleet with per-lane quarantine/dt intact")
     ap.add_argument("--sequential", action="store_true",
                     help="also run every lane alone (B=1) and report "
                          "the speedup")
@@ -231,12 +295,20 @@ def main():
         integ, lane_states, stacked = build_fleet(
             args.n, args.n_lat, args.n_lon, args.mu, args.lanes,
             args.perturb, "float64" if args.x64 else None)
+        lane_mesh = None
+        if args.mesh is not None:
+            from ibamr_tpu.parallel.mesh import make_lane_mesh
+            lane_mesh = make_lane_mesh(
+                n_devices=args.mesh if args.mesh > 0 else None)
+            result["mesh_devices"] = int(lane_mesh.devices.size)
+            log(f"[fleet] lane mesh: {result['mesh_devices']} devices "
+                f"x {args.lanes // result['mesh_devices']} lanes each")
         summary, _ = run_fleet(
             integ, stacked, cfg, args.lanes,
             directory=args.dir or None, max_retries=args.max_retries,
             dt_backoff=args.dt_backoff,
             quarantine_threshold=args.quarantine_threshold,
-            heartbeat=args.heartbeat or None)
+            heartbeat=args.heartbeat or None, lane_mesh=lane_mesh)
         result.update(summary)
         log(f"[fleet] {args.lanes} lanes x {args.steps} steps: "
             f"{summary['aggregate_steps_per_s']} lane-steps/s "

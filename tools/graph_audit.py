@@ -21,9 +21,13 @@ the in-process path additionally wraps measurement in
 ``jax.experimental.disable_x64()``).
 
 Flags: ``--artifacts a,b`` subset, ``--heavy`` includes the
-flagship-scale artifacts (minutes of compile), ``--tighten`` rewrites
-``GRAPH_BUDGETS.json`` to the measured values (merge-don't-clobber:
-unmeasured artifacts keep their committed budgets), ``--json`` emits
+flagship-scale artifacts (minutes of compile), ``--tighten`` ratchets
+``GRAPH_BUDGETS.json`` toward the measured values (merge-don't-clobber
+twice over: unmeasured artifacts keep their committed budgets, and per
+metric the ratchet is DIRECTIONAL — ceilings only tighten down, floors
+like ``hidden_fraction``/``donated_args`` only tighten up; loosening a
+budget after an intentional structural change requires
+``--tighten --clobber``), ``--json`` emits
 the machine-readable report (consumed by ``tools/relay_watch.py``'s
 on-healthy capture), ``--in-process`` skips the child processes (used
 by the test suite, which already isolates per-module).
@@ -40,6 +44,33 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+
+
+def tighten_merge(old: dict, measured: dict) -> dict:
+    """Directional ratchet of ONE artifact's budget (PR 16).
+
+    ``--tighten`` may only make a budget TIGHTER: ceiling metrics
+    (BUDGET_MAX_METRICS — counts that regress UP) take
+    ``min(old, measured)``; floor metrics (BUDGET_MIN_METRICS —
+    ``donated_args``, ``hidden_fraction``, which regress DOWN) take
+    ``max(old, measured)``. A metric the census newly emits is adopted
+    at its measured value; a metric only the committed file knows is
+    KEPT (the census/budget disagreement then surfaces as MISSING in
+    the audit instead of being silently erased). A genuine regression
+    therefore never launders through --tighten — loosening a budget on
+    purpose requires ``--clobber``. Pinned by
+    tests/test_graph_census.py::test_tighten_merges_directionally."""
+    from ibamr_tpu.analysis.contracts import BUDGET_MIN_METRICS
+
+    out = dict(old)
+    for k, v in measured.items():
+        if k not in old:
+            out[k] = v
+        elif k in BUDGET_MIN_METRICS:
+            out[k] = max(int(old[k]), int(v))
+        else:
+            out[k] = min(int(old[k]), int(v))
+    return out
 
 
 def _measure_child(q, name):
@@ -99,7 +130,15 @@ def main(argv=None) -> int:
     ap.add_argument("--heavy", action="store_true",
                     help="include flagship-scale artifacts")
     ap.add_argument("--tighten", action="store_true",
-                    help="rewrite budgets to the measured values")
+                    help="ratchet budgets toward the measured values "
+                         "(directional: ceilings only move DOWN, "
+                         "floors only move UP — a regression never "
+                         "launders through)")
+    ap.add_argument("--clobber", action="store_true",
+                    help="with --tighten: overwrite measured "
+                         "artifacts' budgets wholesale (required to "
+                         "LOOSEN a budget after an intentional "
+                         "structural change)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable report on stdout")
     ap.add_argument("--in-process", action="store_true",
@@ -146,17 +185,23 @@ def main(argv=None) -> int:
         doc = {"_doc": (
             "Graph-contract budgets (tools/graph_audit.py; see "
             "docs/ANALYSIS.md). Measured on the host-CPU backend "
-            "under the production x64-off config; 'donated_args' is "
-            "a floor (regresses DOWN), every other metric a ceiling "
-            "(regresses UP)."), "artifacts": dict(budgets)}
+            "under the production x64-off config; 'donated_args' and "
+            "'hidden_fraction' are floors (regress DOWN), every other "
+            "metric a ceiling (regresses UP)."),
+            "artifacts": dict(budgets)}
         for name, r in results.items():
-            doc["artifacts"][name] = r["metrics"]
+            if args.clobber or name not in budgets:
+                doc["artifacts"][name] = r["metrics"]
+            else:
+                doc["artifacts"][name] = tighten_merge(budgets[name],
+                                                       r["metrics"])
         with open(args.budgets, "w") as f:
             json.dump(doc, f, indent=1, sort_keys=True)
             f.write("\n")
         if not args.as_json:
             print(f"[graph-audit] wrote {args.budgets} "
-                  f"({len(results)} artifact(s) tightened)")
+                  f"({len(results)} artifact(s) "
+                  f"{'clobbered' if args.clobber else 'tightened'})")
 
     regressed = [d for d in drifts if d.regressions or d.missing]
     improved = [d for d in drifts if d.improvements

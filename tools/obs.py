@@ -412,6 +412,35 @@ def _comm_line(records: list):
     return None
 
 
+def _census_line(records: list):
+    """The structural comm split of one proc's NEWEST ``graph_census``
+    record (``tools/fleet.py`` emits one per supervised run, PR 16) —
+    how many data-moving collectives the chunk issues and how many have
+    an independent-compute window to hide behind. Backend-independent,
+    so it complements the measured ``comm_s`` line even on captures
+    where the CPU scheduler serialized everything."""
+    for rec in reversed(records):
+        if rec.get("kind") != "graph_census":
+            continue
+        total = rec.get("structural_collectives")
+        if total is None:
+            continue
+        hid = int(rec.get("hidden_collectives") or 0)
+        unhid = int(rec.get("unhidden_collectives") or 0)
+        frac = rec.get("hidden_fraction")
+        extra = ""
+        if rec.get("mesh_devices"):
+            extra = (f" [lanes={rec.get('lanes')} x "
+                     f"D={rec['mesh_devices']}]")
+        if int(total) == 0:
+            return (f"  comm graph: 0 data-moving collectives in the "
+                    f"chunk (fully lane-local){extra}")
+        return (f"  comm graph: {total} data-moving collectives, "
+                f"{hid} hidden / {unhid} unhidden "
+                f"({frac}% structurally hidden){extra}")
+    return None
+
+
 def cmd_fleet_summary(args) -> int:
     from ibamr_tpu.obs.merge import fleet_counters, merge_ledgers
 
@@ -445,6 +474,9 @@ def cmd_fleet_summary(args) -> int:
         comm = _comm_line(recs)
         if comm:
             print(comm)
+        census = _census_line(recs)
+        if census:
+            print(census)
     snap = fleet_counters(merged)
     if snap["counters"] or snap["gauges"]:
         print("\nfleet counters (last snapshot per proc, "

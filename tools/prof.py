@@ -15,6 +15,9 @@ The operator's side of ``ibamr_tpu/obs/deviceprof.py``:
   files, or the summaries EMBEDDED in two bench JSONs — per span path
   with tolerance bands, exiting like ``tools/graph_audit.py``:
   0 within band, 1 improved beyond band, 2 regressed beyond band.
+  ``--comm-tol-pct`` arms a dedicated, tighter gate on the ``comm_s``
+  op-class alone (PR 16) — the fleet-mesh legs' health line — which
+  is advisory (printed, never enforced) on CPU captures.
 - ``archive``: the relay_watch step — attribute if needed, validate,
   and only then prune the raw multi-MB profiler outputs, keeping the
   compact summary; a malformed summary exits 2 and prunes nothing.
@@ -240,13 +243,32 @@ def _per_exec(summary: dict, seconds: float) -> float:
     return seconds / execs if execs and execs > 0 else seconds
 
 
+def _cpu_capture(summary: dict) -> bool:
+    """True when the capture has no ``/device:*`` timeline process —
+    a CPU (TFRT) trace, where XLA lowers every collective synchronously
+    and ``comm_s`` measures the serialized copy, not overlap headroom.
+    Unknown (no lanes recorded) counts as CPU: advisory beats a false
+    page."""
+    lanes = summary.get("lanes") or []
+    return not any("/device:" in str(ln.get("process") or "")
+                   for ln in lanes)
+
+
 def diff_summaries(sa: dict, sb: dict, tol_pct: float,
-                   floor_s: float) -> tuple:
+                   floor_s: float, comm_tol_pct=None) -> tuple:
     """(report lines, verdict) for one pair — verdict in
     {"clean", "improved", "regressed"}. Times are normalized
     per-execution when both sides recorded execution counts, so a diff
     between a 40-step and an 80-step capture compares steps, not
-    captures."""
+    captures.
+
+    ``comm_tol_pct`` arms the dedicated comm gate (PR 16): a tighter
+    band on ``op_class/comm_s`` alone, because on the pod fleet comm
+    time is the one class the overlap work is supposed to keep flat —
+    a comm_s growth that stays inside the general band is exactly how
+    a halo that quietly stopped overlapping would slip through. On CPU
+    captures (no device timeline) the gate is ADVISORY: it prints, but
+    never flips the verdict."""
     lines = []
     verdict = "clean"
 
@@ -289,6 +311,29 @@ def diff_summaries(sa: dict, sb: dict, tol_pct: float,
     for cls in sorted((set(oca) | set(ocb)) - {"other_s"}):
         judge(f"op_class/{cls}", _per_exec(sa, oca.get(cls) or 0.0),
               _per_exec(sb, ocb.get(cls) or 0.0))
+    if comm_tol_pct is not None:
+        ca = _per_exec(sa, float(oca.get("comm_s") or 0.0))
+        cb = _per_exec(sb, float(ocb.get("comm_s") or 0.0))
+        delta = cb - ca
+        pct = 100.0 * delta / ca if ca > 0 else (100.0 if cb > 0
+                                                 else 0.0)
+        if delta > floor_s and pct > comm_tol_pct:
+            cpu = _cpu_capture(sa) or _cpu_capture(sb)
+            if cpu:
+                lines.append(
+                    f"  comm gate (>{comm_tol_pct:.0f}%): comm_s "
+                    f"{_fmt_s(ca)} -> {_fmt_s(cb)} {pct:+.1f}% — "
+                    f"ADVISORY (cpu capture: collectives lower "
+                    f"synchronously, comm_s is not overlap headroom)")
+            else:
+                lines.append(
+                    f"  comm gate (>{comm_tol_pct:.0f}%): comm_s "
+                    f"{_fmt_s(ca)} -> {_fmt_s(cb)} {pct:+.1f}%"
+                    f"  REGRESSED")
+                verdict = "regressed"
+        else:
+            lines.append(f"  comm gate (>{comm_tol_pct:.0f}%): comm_s "
+                         f"{_fmt_s(ca)} -> {_fmt_s(cb)} within band")
     return lines, verdict
 
 
@@ -314,8 +359,9 @@ def cmd_diff(args) -> int:
         return 2
     for label in shared:
         print(f"\nstage {label} (per-execution device time, A -> B):")
-        lines, verdict = diff_summaries(a_map[label], b_map[label],
-                                        args.tol_pct, args.abs_floor)
+        lines, verdict = diff_summaries(
+            a_map[label], b_map[label], args.tol_pct, args.abs_floor,
+            comm_tol_pct=args.comm_tol_pct)
         for ln in lines:
             print(ln)
         if verdict == "regressed" or (verdict == "improved"
@@ -405,6 +451,14 @@ def main(argv=None) -> int:
     d.add_argument("--tol-pct", type=float, default=DEFAULT_TOL_PCT)
     d.add_argument("--abs-floor", type=float, default=DEFAULT_ABS_FLOOR_S,
                    help="seconds; drift needs BOTH bands exceeded")
+    d.add_argument("--comm-tol-pct", type=float, default=None,
+                   metavar="PCT",
+                   help="arm the dedicated comm gate (PR 16): regress "
+                        "when op_class/comm_s alone grows more than "
+                        "PCT%% (and the abs floor) — tighter than the "
+                        "general band, because overlapped pipelines "
+                        "are supposed to keep comm flat; advisory "
+                        "(printed, not enforced) on CPU captures")
     d.set_defaults(fn=cmd_diff)
 
     r = sub.add_parser("archive", help="attribute + validate, then "

@@ -183,7 +183,7 @@ def main() -> int:
         bench_cmd = [sys.executable, os.path.join(REPO, "bench.py"),
                      "--stages", "64,128,256", "--heartbeat", hb_path,
                      "--record", record_dir, "--fleet", "8",
-                     "--tune-grid"]
+                     "--fleet-mesh", "--tune-grid"]
         if args.profile_stages:
             # device profiles of the named stages ride the same healthy
             # window; they are the only trace-level artifact a dead
