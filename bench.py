@@ -373,6 +373,65 @@ def tune_reference(timeout_s: float = 300.0, n: int = 16,
         f"tune leg hung > {timeout_s:.0f}s", "tune")
 
 
+def _soak_child(q, rates, durations, seed, burst):
+    """Child body: the open-loop soak grid — one pre-warmed router
+    and executable cache SHARED across the rate x duration cells (the
+    grid measures traffic handling, not recompilation), seeded
+    Poisson + burst arrivals over the heavy-tailed mix on a single
+    virtual CPU device."""
+    try:
+        import sys as _sys
+        _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from ibamr_tpu.utils.backend_guard import force_cpu
+
+        jax = force_cpu(1)
+        enable_compile_cache(jax)
+        from ibamr_tpu.serve import aot_cache
+        from ibamr_tpu.serve.loadgen import SOAK_POLICIES, soak_drill
+        from ibamr_tpu.serve.router import BucketSpec, WarmPoolRouter
+
+        spec = BucketSpec(n_cells=8, n_lat=6, n_lon=8, lanes=2,
+                          chunk_steps=2)
+        router = WarmPoolRouter([spec],
+                                cache=aot_cache.ExecutableCache(),
+                                allow_dynamic=True,
+                                policies=dict(SOAK_POLICIES))
+        router.warm(spec)
+        cells = []
+        for rate in rates:
+            for dur in durations:
+                out = soak_drill(seed=seed, duration_s=dur,
+                                 rate_rps=rate, burst_factor=burst,
+                                 time_scale=0.5, router=router)
+                cells.append({
+                    "rate_rps": rate, "duration_s": dur,
+                    "arrivals": out["arrivals"],
+                    "requests_per_s": out["requests_per_s"],
+                    "shed_rate": out["shed_rate"],
+                    "warm_first_step_p99_s":
+                        out["warm_first_step_p99_s"],
+                    "queue_wait_p99_s": out["queue_wait_p99_s"],
+                    "hung_threads": out["hung_threads"]})
+        q.put({"seed": seed, "burst_factor": burst, "grid": cells})
+    except Exception as e:  # noqa: BLE001 - report, parent decides
+        q.put({"error": f"{type(e).__name__}: {e}"})
+
+
+def soak_reference(timeout_s: float = 300.0,
+                   rates=(4.0, 8.0), durations=(4.0,),
+                   seed: int = 0, burst: float = 4.0):
+    """Sustained-traffic signal (PR 17): the open-loop Poisson+burst
+    soak over an arrival-rate x duration grid in a TERMINABLE child —
+    requests/s, shed rate, and warm/queue-wait p99 per cell land in
+    the round artifact so traffic capacity is trended across rounds
+    next to the single-request serve leg. The chaos-injected variant
+    lives in ``tools.fault_injection.run_soak_smoke`` (dryrun path
+    21); this leg is the clean-path capacity number."""
+    return _run_guarded_child(
+        _soak_child, (tuple(rates), tuple(durations), seed, burst),
+        timeout_s, f"soak leg hung > {timeout_s:.0f}s", "soak")
+
+
 def cpu_sharded_reference_with_trend(n_devices: int = 8):
     """The n=32 smoke leg PLUS a larger n=48 leg, with the
     speedup-vs-size trend (round 5, VERDICT round 4 weak #3: the
@@ -809,6 +868,10 @@ def main():
                     help="also run the autotuner's small measured "
                          "engine grid (scatter vs packed x f32/bf16) "
                          "in a CPU child and trend the ranking")
+    ap.add_argument("--soak", action="store_true",
+                    help="also run the open-loop Poisson+burst soak "
+                         "grid (arrival rate x duration) in a CPU "
+                         "child and trend requests/s + shed rate")
     ap.add_argument("--record", type=str, default="",
                     help="arm a flight recorder on every ramp stage; a "
                          "diverged stage dumps a replay capsule under "
@@ -1237,6 +1300,22 @@ def main():
                 log(f"[bench] tune: {result['tune']}")
             except Exception as e:
                 result["tune"] = {"error": f"{type(e).__name__}: {e}"}
+
+        # sustained-traffic leg (PR 17): the open-loop soak grid in a
+        # CPU child, trending requests/s + shed rate per round
+        if args.soak:
+            try:
+                remaining = (args.deadline
+                             - (time.perf_counter() - t_start))
+                if remaining < 30.0:
+                    result["soak"] = {
+                        "error": "skipped (deadline exhausted)"}
+                else:
+                    result["soak"] = soak_reference(
+                        timeout_s=min(300.0, remaining))
+                log(f"[bench] soak: {result['soak']}")
+            except Exception as e:
+                result["soak"] = {"error": f"{type(e).__name__}: {e}"}
 
         if errors:
             msg = "; ".join(errors)

@@ -1,0 +1,331 @@
+"""Deterministic open-loop load generator for the warm-pool router
+(PR 17, docs/SERVING.md "Traffic & overload").
+
+Every serving number before this module came from a single-family
+cold/warm drill; the north star is sustained traffic the server did
+not pick. This module supplies that traffic REPRODUCIBLY:
+
+- **arrivals** — :func:`poisson_burst_schedule` draws a seeded Poisson
+  process (exponential inter-arrival gaps from
+  ``np.random.default_rng(seed)``) with named burst windows where the
+  instantaneous rate multiplies by ``burst_factor``. The schedule is a
+  pure function of its arguments — virtual timestamps, no wall clock —
+  so the same seed replays the same soak bit-for-bit at the schedule
+  level.
+- **scenario mix** — :data:`SCENARIO_MIX` is heavy-tailed in service
+  demand (steps per request), modeled on the repo's example drivers:
+  most arrivals are short interactive probes (the ``examples/IB`` /
+  ``examples/navier_stokes`` driver scale), a minority are long batch
+  campaign chunks (the ``examples/adv_diff`` / ``examples/IBFE``
+  sweep scale). Every mix entry shares ONE scenario family (shape,
+  physics), so a bounded CPU soak pays exactly one bucket compile and
+  then rides the zero-compile warm path — heterogeneous ``steps``/
+  ``dt`` are traced arguments and never retrace.
+- **open loop** — :func:`run_open_loop` submits each arrival at its
+  scheduled (scaled) time from its own thread regardless of earlier
+  completions, which is what makes overload REAL: a closed loop would
+  politely self-throttle and never exercise admission control. Thread
+  count is bounded; saturation is counted, never silently dropped.
+- **the soak** — :func:`soak_drill` composes the above against a fresh
+  router with committed tenant-class policies and returns the traffic
+  summary ``tools/slo.py check --soak`` and ``bench.py --soak``
+  evaluate. Chaos (compile storms, killed builds, stragglers) rides on
+  top in ``tools.fault_injection.run_soak_smoke``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, replace
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ibamr_tpu import obs as _obs
+from ibamr_tpu.serve.router import (BucketSpec, ScenarioRequest,
+                                    TenantClassPolicy, WarmPoolRouter)
+
+# ---------------------------------------------------------------------------
+# scenario mix: heavy-tailed service demand over ONE warm family
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Scenario:
+    """One entry of the load mix: a named request template with a
+    sampling weight. ``name`` references the example-driver scale the
+    entry is modeled on; ``steps`` carries the heavy tail."""
+    name: str
+    weight: float
+    tenant_class: str
+    steps: int
+    dt: float = 5e-5
+    deadline_s: Optional[float] = None
+
+
+# Heavy-tailed mix (weights sum to 1): ~80% short interactive probes,
+# ~20% long batch chunks with 3-8x the service demand — the shape of
+# the example-driver population (many small demo probes, few long
+# campaign sweeps), restated as one bucket family.
+SCENARIO_MIX: Sequence[Scenario] = (
+    Scenario("ib/shell_probe", 0.55, "interactive", steps=1),
+    Scenario("navier_stokes/cavity_ack", 0.25, "interactive", steps=2),
+    Scenario("adv_diff/batch_sweep", 0.15, "batch", steps=4),
+    Scenario("ibfe/campaign_chunk", 0.05, "batch", steps=8),
+)
+
+
+@dataclass(frozen=True)
+class Arrival:
+    """One scheduled submission: virtual time + the request to send."""
+    t: float
+    scenario: str
+    request: ScenarioRequest
+
+
+def poisson_burst_schedule(seed: int, duration_s: float,
+                           rate_rps: float,
+                           burst_factor: float = 4.0,
+                           burst_start_frac: float = 0.4,
+                           burst_len_frac: float = 0.3,
+                           mix: Sequence[Scenario] = SCENARIO_MIX,
+                           n_cells: int = 8, n_lat: int = 6,
+                           n_lon: int = 8,
+                           tenants_per_class: int = 2,
+                           tenant_prefix: str = "") -> list:
+    """Seeded Poisson arrivals over ``[0, duration_s)`` virtual
+    seconds at ``rate_rps``, multiplied by ``burst_factor`` inside the
+    burst window (``[start_frac, start_frac + len_frac) * duration``).
+    Deterministic: a pure function of the arguments."""
+    rng = np.random.default_rng(int(seed))
+    weights = np.asarray([s.weight for s in mix], dtype=float)
+    weights = weights / weights.sum()
+    b0 = burst_start_frac * duration_s
+    b1 = b0 + burst_len_frac * duration_s
+    arrivals: list = []
+    t = 0.0
+    k = 0
+    while True:
+        rate = rate_rps * (burst_factor if b0 <= t < b1 else 1.0)
+        t += float(rng.exponential(1.0 / max(rate, 1e-9)))
+        if t >= duration_s:
+            break
+        sc = mix[int(rng.choice(len(mix), p=weights))]
+        tenant = (f"{tenant_prefix}{sc.tenant_class}"
+                  f"-{k % max(tenants_per_class, 1)}")
+        arrivals.append(Arrival(
+            t=t, scenario=sc.name,
+            request=ScenarioRequest(
+                tenant=tenant, n_cells=n_cells, n_lat=n_lat,
+                n_lon=n_lon, steps=sc.steps, dt=sc.dt,
+                tenant_class=sc.tenant_class,
+                deadline_s=sc.deadline_s)))
+        k += 1
+    return arrivals
+
+
+# ---------------------------------------------------------------------------
+# open-loop driver
+# ---------------------------------------------------------------------------
+
+
+def run_open_loop(router: WarmPoolRouter, arrivals: Sequence[Arrival],
+                  time_scale: float = 1.0, max_threads: int = 32,
+                  join_timeout_s: float = 120.0) -> dict:
+    """Fire ``arrivals`` at the router open-loop: each submission at
+    ``t * time_scale`` wall seconds after start, from its own bounded
+    worker thread, independent of earlier completions. Returns
+    ``{"results": [RequestResult...], "wall_s", "overruns",
+    "hung_threads"}`` — ``hung_threads > 0`` means a worker failed to
+    finish inside ``join_timeout_s`` (the soak drill's deadlock
+    tripwire); ``overruns`` counts submissions that could not start on
+    schedule because all workers were busy (they still run, late)."""
+    results: list = []
+    errors: list = []
+    lock = threading.Lock()
+    gate = threading.Semaphore(int(max_threads))
+    overruns = [0]
+    t0 = time.perf_counter()
+
+    def fire(arr: Arrival):
+        try:
+            out = router.serve([arr.request])
+            with lock:
+                results.extend(out)
+        except Exception as e:  # noqa: BLE001 - counted, not fatal
+            with lock:
+                errors.append(f"{type(e).__name__}: {e}")
+        finally:
+            gate.release()
+
+    threads = []
+    for arr in arrivals:
+        delay = arr.t * time_scale - (time.perf_counter() - t0)
+        if delay > 0:
+            time.sleep(delay)
+        if not gate.acquire(blocking=False):
+            overruns[0] += 1
+            gate.acquire()          # open loop saturated: run late
+        th = threading.Thread(target=fire, args=(arr,), daemon=True)
+        th.start()
+        threads.append(th)
+    deadline = time.monotonic() + join_timeout_s
+    hung = 0
+    for th in threads:
+        th.join(max(deadline - time.monotonic(), 0.0))
+        if th.is_alive():
+            hung += 1
+    return {"results": results, "errors": errors,
+            "wall_s": time.perf_counter() - t0,
+            "overruns": overruns[0], "hung_threads": hung}
+
+
+def _quantile(values, q):
+    if not values:
+        return None
+    vs = sorted(values)
+    import math
+    return vs[min(len(vs) - 1, max(0, math.ceil(q * len(vs)) - 1))]
+
+
+def traffic_summary(results, wall_s: float) -> dict:
+    """Per-class traffic rollup of a result list: completed/shed/
+    quarantined counts, shed rate, warm first-step and queue-wait
+    percentiles — the shape the soak artifact and the bench ``--soak``
+    grid carry."""
+    total = len(results)
+    shed = [r for r in results if r.shed]
+    served = [r for r in results if not r.shed]
+    by_reason: dict = {}
+    for r in shed:
+        by_reason[r.shed_reason] = by_reason.get(r.shed_reason, 0) + 1
+    classes: dict = {}
+    for r in results:
+        # RequestResult has no class field; recover it from shed
+        # records vs served tenants (tenant names are class-prefixed
+        # by the schedule generator)
+        cls = r.tenant.rsplit("-", 1)[0]
+        c = classes.setdefault(cls, {"submitted": 0, "completed": 0,
+                                     "shed": 0, "quarantined": 0,
+                                     "retried": 0})
+        c["submitted"] += 1
+        if r.shed:
+            c["shed"] += 1
+        else:
+            c["completed"] += 1
+        if r.quarantined:
+            c["quarantined"] += 1
+        if r.retries:
+            c["retried"] += 1
+    warm_first = [r.first_step_s for r in served
+                  if not r.cold and r.first_step_s is not None]
+    qwaits = [r.queue_wait_s for r in results
+              if r.queue_wait_s is not None]
+    return {
+        "submitted": total,
+        "completed": len(served),
+        "ok": sum(1 for r in served if r.ok),
+        "shed": len(shed),
+        "shed_rate": round(len(shed) / total, 4) if total else None,
+        "shed_by_reason": by_reason,
+        "quarantined": sum(1 for r in results if r.quarantined),
+        "retried": sum(1 for r in results if r.retries),
+        "requests_per_s": (round(len(served) / wall_s, 3)
+                           if wall_s > 0 else None),
+        "warm_first_step_p50_s": _round(_quantile(warm_first, 0.5)),
+        "warm_first_step_p99_s": _round(_quantile(warm_first, 0.99)),
+        "queue_wait_p99_s": _round(_quantile(qwaits, 0.99)),
+        "classes": classes,
+    }
+
+
+def _round(v, nd: int = 6):
+    return None if v is None else round(float(v), nd)
+
+
+# ---------------------------------------------------------------------------
+# the bounded soak drill (tools/slo.py check --soak, bench.py --soak)
+# ---------------------------------------------------------------------------
+
+# Committed soak policies: interactive traffic is slot-bounded with a
+# strict-ish deadline and one retry; batch traffic queues deeper and
+# waits longer. The drill ships these so the gate measures the SAME
+# admission behavior every round.
+SOAK_POLICIES = {
+    "interactive": TenantClassPolicy(
+        max_inflight=4, queue_depth=16, queue_timeout_s=30.0,
+        deadline_s=30.0, retry_budget=1),
+    "batch": TenantClassPolicy(
+        max_inflight=2, queue_depth=8, queue_timeout_s=60.0,
+        deadline_s=60.0, retry_budget=1),
+    "chaos": TenantClassPolicy(
+        max_inflight=2, queue_depth=2, queue_timeout_s=5.0,
+        deadline_s=5.0, retry_budget=1),
+}
+
+
+def soak_drill(seed: int = 0, duration_s: float = 6.0,
+               rate_rps: float = 6.0, burst_factor: float = 4.0,
+               n_cells: int = 8, n_lat: int = 6, n_lon: int = 8,
+               lanes: int = 2, cache_dir: Optional[str] = None,
+               time_scale: float = 1.0,
+               policies: Optional[dict] = None,
+               mix: Sequence[Scenario] = SCENARIO_MIX,
+               router: Optional[WarmPoolRouter] = None,
+               warm: bool = True) -> dict:
+    """One bounded deterministic CPU soak: a fresh router (unless one
+    is injected) with the committed :data:`SOAK_POLICIES`, pre-warmed,
+    driven open-loop by a seeded Poisson + ``burst_factor``x burst
+    schedule over the heavy-tailed mix. Returns the traffic summary
+    plus config echo; with a ledger attached
+    (``obs.ledger(path)``), the soak SLIs are computable from the
+    ledger alone (``tools/slo.py soak_slis_from_ledger``)."""
+    from ibamr_tpu.serve import aot_cache
+
+    if router is None:
+        spec = BucketSpec(n_cells=n_cells, n_lat=n_lat, n_lon=n_lon,
+                          lanes=lanes, chunk_steps=2)
+        router = WarmPoolRouter(
+            [spec], cache=aot_cache.ExecutableCache(directory=cache_dir),
+            allow_dynamic=True,
+            policies=dict(policies if policies is not None
+                          else SOAK_POLICIES))
+        if warm:
+            with _obs.span("soak/warm"):
+                router.warm(spec)
+    arrivals = poisson_burst_schedule(
+        seed=seed, duration_s=duration_s, rate_rps=rate_rps,
+        burst_factor=burst_factor, mix=mix, n_cells=n_cells,
+        n_lat=n_lat, n_lon=n_lon)
+    with _obs.span("soak/open_loop", arrivals=len(arrivals)):
+        run = run_open_loop(router, arrivals, time_scale=time_scale)
+    # shed requests can leave bucket builds in flight; drain them so
+    # a soak child process exits cleanly (a daemon thread mid-compile
+    # at interpreter teardown aborts the process)
+    router.drain_builds(timeout_s=60.0)
+    out = traffic_summary(run["results"], run["wall_s"])
+    out.update({
+        "seed": int(seed), "duration_s": duration_s,
+        "rate_rps": rate_rps, "burst_factor": burst_factor,
+        "arrivals": len(arrivals), "wall_s": round(run["wall_s"], 3),
+        "overruns": run["overruns"], "hung_threads": run["hung_threads"],
+        "loadgen_errors": run["errors"][:5],
+    })
+    return out
+
+
+def chaos_mix(base: Sequence[Scenario] = SCENARIO_MIX,
+              novel_families: int = 3) -> list:
+    """The chaos tenant's mix: the base mix re-classed to ``chaos``
+    plus requests that will land on NOVEL dynamic families (distinct
+    ``n_lon``), each a fresh bucket compile — the compile-storm fuel.
+    Returned scenarios carry ``steps`` tags the schedule generator
+    maps onto distinct families via :func:`chaos_requests`."""
+    out = [replace(s, tenant_class="chaos", weight=s.weight * 0.5)
+           for s in base]
+    for i in range(novel_families):
+        out.append(Scenario(f"chaos/novel_family_{i}",
+                            weight=0.5 / max(novel_families, 1),
+                            tenant_class="chaos", steps=1))
+    return out

@@ -29,6 +29,27 @@ The router runs only chunk lengths it pre-compiled (1 for the
 first-step ack, ``chunk_steps`` for cruise), so a warm second request
 of the same family performs ZERO compiles — pinned structurally by
 ``tools/serve.py check`` against SERVE_CONTRACT.json.
+
+Traffic robustness (PR 17, docs/SERVING.md "Traffic & overload"):
+step 1 above is now a real admission gate. Every request belongs to a
+**tenant class** (``ScenarioRequest.tenant_class``) with a
+:class:`TenantClassPolicy`: a bounded inflight-slot pool plus a
+bounded wait queue (overflow or timeout SHEDS the request —
+``serve_shed_total{reason=...}`` / a terminal ``request_shed`` ledger
+record, queue time on ``serve_queue_wait_seconds``), an
+admission-to-first-step **deadline budget** (enforced at the two
+host-side wait points: the admission queue and the bucket-compile
+wait; an ack chunk already in flight is never cancelled), and a
+**retry budget** with deterministic jittered backoff for transient
+failures (a failed or killed async pool build, a quarantined-lane
+landing) so a compile storm cannot amplify itself. A quarantined or
+shed request RELEASES its admission slot immediately and wakes one
+queued waiter (``serve_slots_reclaimed_total``) — dead lanes return
+capacity to waiting requests instead of draining the class dry. Every
+admitted ``trace_id`` reaches exactly one terminal record kind
+(``request`` or ``request_shed``) even when ``serve`` raises: the
+no-lost-request invariant the soak drill
+(``tools.fault_injection.run_soak_smoke``) pins.
 """
 
 from __future__ import annotations
@@ -57,6 +78,8 @@ _H_FIRST = {p: _obs.histogram("serve_first_step_seconds", path=p)
             for p in ("cold", "warm")}
 _H_WAIT = _obs.histogram("serve_bucket_wait_seconds")
 _H_PADFRAC = _obs.histogram("serve_padding_fraction")
+_H_QWAIT = _obs.histogram("serve_queue_wait_seconds")
+_RECLAIMS = _obs.counter("serve_slots_reclaimed_total")
 _obs.describe("serve_requests_total", "Requests completed by the router.")
 _obs.describe("serve_cold_requests_total",
               "Requests that paid a bucket compile (cold path).")
@@ -77,6 +100,135 @@ _obs.describe("serve_requests_inflight",
               "Requests admitted and not yet completed.")
 _obs.describe("serve_requests_completed",
               "Requests completed since process start.")
+_obs.describe("serve_shed_total",
+              "Requests shed by admission control, by reason="
+              "queue_full|queue_timeout|deadline_exceeded|"
+              "build_failed|no_bucket|router_error.")
+_obs.describe("serve_queue_wait_seconds",
+              "Admission-queue wait per request (0 for immediate "
+              "admission).")
+_obs.describe("serve_retries_total",
+              "Retry hops taken for transient failures, by "
+              "reason=build_failed|lane_quarantined.")
+_obs.describe("serve_slots_reclaimed_total",
+              "Admission slots reclaimed from quarantined/shed "
+              "requests and handed to queued waiters.")
+_obs.describe("serve_requests_queued",
+              "Requests currently waiting in an admission queue.")
+_obs.describe("serve_requests_shed",
+              "Requests shed since process start (cumulative gauge "
+              "for the watchdog heartbeat).")
+
+
+class PoolWaitTimeout(Exception):
+    """A request's deadline budget expired while its bucket's warm
+    pool was still compiling (the admission-to-first-step timeout)."""
+
+
+@dataclass(frozen=True)
+class TenantClassPolicy:
+    """Admission policy for one tenant class (PR 17).
+
+    ``max_inflight`` caps concurrently-admitted requests of the class;
+    beyond it, up to ``queue_depth`` requests WAIT (bounded by
+    ``queue_timeout_s`` and the per-request deadline) and the rest are
+    shed immediately (``queue_full``). ``deadline_s`` is the default
+    admission-to-first-step budget (a request's own ``deadline_s``
+    wins). ``retry_budget`` bounds jittered-backoff retries of
+    transient failures — 0 (the default) preserves the pre-PR-17
+    fail-fast behavior exactly."""
+    max_inflight: int = 1 << 20
+    queue_depth: int = 1 << 20
+    queue_timeout_s: float = 120.0
+    deadline_s: Optional[float] = None
+    retry_budget: int = 0
+    backoff_base_s: float = 0.05
+    backoff_cap_s: float = 0.5
+
+
+DEFAULT_POLICY = TenantClassPolicy()
+
+
+class _ClassState:
+    __slots__ = ("inflight", "queued", "cond")
+
+    def __init__(self, lock):
+        self.inflight = 0
+        self.queued = 0
+        self.cond = threading.Condition(lock)
+
+
+class AdmissionController:
+    """Per-tenant-class bounded admission: inflight slots + a bounded
+    wait queue, one condition variable per class (shared lock). All
+    waits are time-bounded, so admission can never deadlock — the
+    worst case is a shed."""
+
+    def __init__(self, policies=None, default: TenantClassPolicy = DEFAULT_POLICY):
+        self._policies = dict(policies or {})
+        self._default = default
+        self._lock = threading.Lock()
+        self._classes: dict = {}
+
+    def policy(self, cls: str) -> TenantClassPolicy:
+        return self._policies.get(cls, self._default)
+
+    def _state_locked(self, cls: str) -> _ClassState:
+        st = self._classes.get(cls)
+        if st is None:
+            st = self._classes[cls] = _ClassState(self._lock)
+        return st
+
+    def admit(self, cls: str, deadline_left: Optional[float] = None):
+        """Try to take an inflight slot for ``cls``; queue (bounded)
+        when the class is saturated. Returns ``(admitted, wait_s,
+        shed_reason)`` — ``shed_reason`` is ``None`` on admission,
+        else ``queue_full`` / ``queue_timeout`` /
+        ``deadline_exceeded``."""
+        pol = self.policy(cls)
+        if deadline_left is not None and deadline_left <= 0:
+            return False, 0.0, "deadline_exceeded"
+        t0 = time.perf_counter()
+        with self._lock:
+            st = self._state_locked(cls)
+            if st.inflight < pol.max_inflight:
+                st.inflight += 1
+                _H_QWAIT.observe(0.0)
+                return True, 0.0, None
+            if st.queued >= pol.queue_depth:
+                return False, 0.0, "queue_full"
+            budget, reason = pol.queue_timeout_s, "queue_timeout"
+            if deadline_left is not None and deadline_left < budget:
+                budget, reason = deadline_left, "deadline_exceeded"
+            st.queued += 1
+            gq = _obs.gauge("serve_requests_queued")
+            gq.set(gq.value + 1)
+            try:
+                while st.inflight >= pol.max_inflight:
+                    remaining = budget - (time.perf_counter() - t0)
+                    if remaining <= 0:
+                        wait_s = time.perf_counter() - t0
+                        _H_QWAIT.observe(wait_s)
+                        return False, wait_s, reason
+                    st.cond.wait(min(remaining, 0.25))
+                st.inflight += 1
+                wait_s = time.perf_counter() - t0
+                _H_QWAIT.observe(wait_s)
+                return True, wait_s, None
+            finally:
+                st.queued -= 1
+                gq.set(max(gq.value - 1, 0))
+
+    def release(self, cls: str, reclaimed: bool = False) -> None:
+        """Return a slot; ``reclaimed=True`` marks a slot freed by a
+        quarantined/shed request (the dead lane's capacity handed to a
+        waiter — ``serve_slots_reclaimed_total``)."""
+        with self._lock:
+            st = self._state_locked(cls)
+            st.inflight = max(st.inflight - 1, 0)
+            if reclaimed:
+                _RECLAIMS.inc()
+            st.cond.notify()
 
 
 @dataclass(frozen=True)
@@ -117,6 +269,11 @@ class ScenarioRequest:
     # per-lane initial velocity offset amplitude; a non-finite value
     # poisons the lane's state (the quarantine drill in tests)
     perturb: float = 0.0
+    # admission class (selects the TenantClassPolicy) and an optional
+    # per-request admission-to-first-step deadline overriding the
+    # class default (PR 17)
+    tenant_class: str = "standard"
+    deadline_s: Optional[float] = None
 
     def family(self):
         return (self.n_cells, self.n_lat, self.n_lon, self.engine,
@@ -138,6 +295,13 @@ class RequestResult:
     family_key: str
     error: Optional[str] = None
     trace_id: Optional[str] = None
+    # traffic accounting (PR 17): shed requests never ran a step;
+    # queue_wait_s is the admission-queue time, retries the number of
+    # backoff hops taken before this (terminal) outcome
+    shed: bool = False
+    shed_reason: Optional[str] = None
+    retries: int = 0
+    queue_wait_s: float = 0.0
 
 
 class WarmPool:
@@ -244,7 +408,9 @@ class WarmPoolRouter:
     docstring has the request lifecycle)."""
 
     def __init__(self, buckets: Sequence[BucketSpec] = (), cache=None,
-                 allow_dynamic: bool = True, default_lanes: int = 2):
+                 allow_dynamic: bool = True, default_lanes: int = 2,
+                 policies: Optional[dict] = None,
+                 default_policy: TenantClassPolicy = DEFAULT_POLICY):
         self.cache = cache if cache is not None else aot_cache.get_cache()
         self._specs = list(buckets)
         self._pools: dict = {}
@@ -252,12 +418,33 @@ class WarmPoolRouter:
         self._lock = threading.Lock()
         self.allow_dynamic = allow_dynamic
         self.default_lanes = int(default_lanes)
+        # per-tenant-class admission control (PR 17); the default
+        # policy is permissive (huge slots, no deadline, no retries)
+        # so a router built without policies behaves exactly as before
+        self.admission = AdmissionController(policies, default_policy)
 
     # -- pool lifecycle -----------------------------------------------------
 
     def is_warm(self, spec: BucketSpec) -> bool:
         with self._lock:
             return spec in self._pools
+
+    def drain_builds(self, timeout_s: float = 60.0) -> int:
+        """Join any in-flight pool-build threads (bounded); returns
+        how many are still alive after the timeout. A shed request
+        leaves its bucket build running (the next arrival gets the
+        warm pool), so call this before process exit — a daemon
+        thread inside an XLA compile at interpreter teardown aborts
+        the whole process."""
+        with self._lock:
+            threads = [f.thread for f in self._inflight.values()
+                       if f.thread is not None]
+        deadline = time.monotonic() + max(float(timeout_s), 0.0)
+        alive = 0
+        for t in threads:
+            t.join(max(deadline - time.monotonic(), 0.0))
+            alive += int(t.is_alive())
+        return alive
 
     def warm(self, spec: Optional[BucketSpec] = None,
              block: bool = True):
@@ -282,8 +469,20 @@ class WarmPoolRouter:
         with self._lock:
             pool = self._pools.get(spec)
             if pool is not None:
-                return lambda: pool
+                return lambda timeout=None: pool
             flight = self._inflight.get(spec)
+            if (flight is not None and flight.thread is not None
+                    and not flight.thread.is_alive()
+                    and not flight.event.is_set()):
+                # the build thread died without publishing (killed
+                # mid-build): fail the flight over so its waiters see
+                # a retryable build error instead of hanging forever,
+                # and let a fresh build start
+                self._inflight.pop(spec, None)
+                flight.error = RuntimeError(
+                    "pool build thread died before publishing")
+                flight.event.set()
+                flight = None
             if flight is None:
                 flight = _PoolBuild(trace_ids=trace_ids)
                 self._inflight[spec] = flight
@@ -292,8 +491,32 @@ class WarmPoolRouter:
                 flight.thread = t
                 t.start()
 
-        def wait():
-            flight.event.wait()
+        def wait(timeout=None):
+            deadline = (None if timeout is None
+                        else time.monotonic() + max(float(timeout), 0.0))
+            # sliced wait: each slice re-checks the builder thread's
+            # liveness, so a killed build fails over instead of
+            # deadlocking every waiter (soak invariant: no deadlock)
+            while not flight.event.is_set():
+                if (deadline is not None
+                        and time.monotonic() >= deadline):
+                    raise PoolWaitTimeout(
+                        f"pool build for {spec.n_cells}^3 "
+                        f"x{spec.lanes} exceeded the deadline budget")
+                slice_s = (0.25 if deadline is None
+                           else min(0.25, max(
+                               deadline - time.monotonic(), 0.001)))
+                if flight.event.wait(slice_s):
+                    break
+                th = flight.thread
+                if (th is not None and not th.is_alive()
+                        and not flight.event.is_set()):
+                    with self._lock:
+                        if self._inflight.get(spec) is flight:
+                            self._inflight.pop(spec, None)
+                    flight.error = RuntimeError(
+                        "pool build thread died before publishing")
+                    flight.event.set()
             if flight.error is not None:
                 raise flight.error
             return flight.pool
@@ -353,16 +576,22 @@ class WarmPoolRouter:
         ``request_admit`` ledger record; every record and span the
         request touches downstream carries the id, so
         ``tools/obs.py trace <id>`` rebuilds the full
-        admission→completion timeline from the ledger alone."""
+        admission→completion timeline from the ledger alone. Every
+        admitted id reaches exactly one TERMINAL record (``request``
+        or ``request_shed``) — even when ``serve`` raises, the
+        unserved remainder is shed first (the no-lost-request
+        invariant)."""
         g_in = _obs.gauge("serve_requests_inflight")
         g_done = _obs.gauge("serve_requests_completed")
         tids = [_obs.new_trace_id() for _ in requests]
+        t_admit = time.perf_counter()
         g_in.set(g_in.value + len(requests))
         for r, tid in zip(requests, tids):
             _obs.emit("request_admit", trace_id=tid, tenant=r.tenant,
+                      tenant_class=r.tenant_class,
                       family=str(r.family()), steps=int(r.steps))
+        results: list = [None] * len(requests)
         try:
-            results: list = [None] * len(requests)
             groups: dict = {}
             for i, r in enumerate(requests):
                 groups.setdefault(r.family(), []).append((i, r))
@@ -372,46 +601,236 @@ class WarmPoolRouter:
                     spec = self._bucket_for(family, len(members) - pos)
                     batch = members[pos:pos + spec.lanes]
                     pos += len(batch)
-                    out = self._serve_batch(spec, [r for _, r in batch],
-                                            [tids[i] for i, _ in batch])
+                    out = self._admit_and_serve(spec, batch, tids,
+                                                t_admit)
                     for (i, _), res in zip(batch, out):
                         results[i] = res
+        except BaseException as e:
+            reason = ("no_bucket" if isinstance(e, KeyError)
+                      else "router_error")
+            for i, r in enumerate(requests):
+                if results[i] is None:
+                    results[i] = self._shed(
+                        r, tids[i], reason, 0.0,
+                        error=f"{type(e).__name__}: {e}")
+            raise
         finally:
             g_in.set(max(g_in.value - len(requests), 0))
         g_done.set(g_done.value + len(requests))
         return results
 
+    # -- admission / shed / retry (PR 17) -----------------------------------
+
+    def _shed(self, req: ScenarioRequest, tid: Optional[str],
+              reason: str, queue_wait_s: float, retries: int = 0,
+              error: Optional[str] = None) -> RequestResult:
+        """Terminal shed: counter + cumulative gauge + the
+        ``request_shed`` ledger record (the shed counterpart of the
+        ``request`` accounting record)."""
+        _obs.counter("serve_shed_total", reason=reason).inc()
+        gs = _obs.gauge("serve_requests_shed")
+        gs.set(gs.value + 1)
+        payload = dict(trace_id=tid or None, tenant=req.tenant,
+                       tenant_class=req.tenant_class,
+                       family=str(req.family()), reason=reason,
+                       queue_wait_s=round(queue_wait_s, 4),
+                       retries=int(retries))
+        if error:
+            payload["error"] = error
+        _obs.emit("request_shed", **payload)
+        return RequestResult(
+            tenant=req.tenant, ok=False, quarantined=False,
+            cold=False, bucket_lanes=0, lane=-1, steps_done=0,
+            first_step_s=0.0, total_s=0.0,
+            family_key=str(req.family()),
+            error=error or f"shed ({reason})", trace_id=tid,
+            shed=True, shed_reason=reason, retries=int(retries),
+            queue_wait_s=queue_wait_s)
+
+    def _deadline_left(self, req: ScenarioRequest,
+                       t_admit: float) -> Optional[float]:
+        deadline = (req.deadline_s if req.deadline_s is not None
+                    else self.admission.policy(req.tenant_class
+                                               ).deadline_s)
+        if deadline is None:
+            return None
+        return deadline - (time.perf_counter() - t_admit)
+
+    @staticmethod
+    def _backoff_s(pol: TenantClassPolicy, attempt: int,
+                   tid: Optional[str]) -> float:
+        """Exponential backoff with DETERMINISTIC jitter derived from
+        the trace id (no RNG state, replays identically)."""
+        base = min(pol.backoff_cap_s,
+                   pol.backoff_base_s * (2 ** max(attempt - 1, 0)))
+        jitter = (int((tid or "0")[:8], 16) % 1000) / 1000.0
+        return base * (0.5 + 0.5 * jitter)
+
+    def _admit_and_serve(self, spec: BucketSpec, batch, tids,
+                         t_admit: float):
+        """Admission-gate one packed batch, serve the admitted
+        members (with retries), and release every admitted slot —
+        reclaimed slots (quarantined/shed requests) wake a queued
+        waiter so dead lanes return capacity."""
+        out: list = [None] * len(batch)
+        admitted: list = []
+        qwaits: dict = {}
+        for j, (i, r) in enumerate(batch):
+            ok, wait_s, reason = self.admission.admit(
+                r.tenant_class, self._deadline_left(r, t_admit))
+            if ok:
+                admitted.append(j)
+                qwaits[j] = wait_s
+            else:
+                out[j] = self._shed(r, tids[i], reason, wait_s)
+        if not admitted:
+            return out
+        try:
+            self._serve_admitted(spec, batch, tids, t_admit, admitted,
+                                 qwaits, out)
+        finally:
+            for j in admitted:
+                res = out[j]
+                reclaimed = (isinstance(res, RequestResult)
+                             and (res.quarantined or res.shed))
+                self.admission.release(batch[j][1].tenant_class,
+                                       reclaimed=reclaimed)
+        return out
+
+    def _serve_admitted(self, spec: BucketSpec, batch, tids,
+                        t_admit: float, admitted, qwaits, out):
+        """The retry loop: serve the admitted members, classify
+        transient failures (failed/killed pool build, quarantined
+        lane), back off and retry within the class budget; everything
+        else is terminal. ``out[j]`` is a RequestResult for every
+        admitted ``j`` on exit."""
+        pending = list(admitted)
+        attempt = 0
+        while pending:
+            reqs = [batch[j][1] for j in pending]
+            btids = [tids[batch[j][0]] for j in pending]
+            lefts = [self._deadline_left(r, t_admit) for r in reqs]
+            bq = [qwaits[j] for j in pending]
+            err: Optional[BaseException] = None
+            try:
+                res = self._serve_batch(spec, reqs, btids, qwaits=bq,
+                                        attempt=attempt,
+                                        deadline_lefts=lefts)
+            except Exception as e:  # noqa: BLE001 - pool build failed
+                res, err = None, e
+            retry: list = []
+            reasons: dict = {}
+            for k, j in enumerate(pending):
+                i, r = batch[j]
+                pol = self.admission.policy(r.tenant_class)
+                left = self._deadline_left(r, t_admit)
+                can_retry = (attempt < pol.retry_budget
+                             and (left is None
+                                  or left > self._backoff_s(
+                                      pol, attempt + 1, tids[i])))
+                if err is not None:
+                    if can_retry:
+                        retry.append(j)
+                        reasons[j] = "build_failed"
+                    else:
+                        out[j] = self._shed(
+                            r, tids[i], "build_failed", qwaits[j],
+                            retries=attempt,
+                            error=f"{type(err).__name__}: {err}")
+                    continue
+                rres = res[k]
+                if rres.quarantined and can_retry:
+                    retry.append(j)
+                    reasons[j] = "lane_quarantined"
+                else:
+                    out[j] = rres
+            if retry:
+                attempt += 1
+                backoff = 0.0
+                for j in retry:
+                    i, r = batch[j]
+                    pol = self.admission.policy(r.tenant_class)
+                    b = self._backoff_s(pol, attempt, tids[i])
+                    backoff = max(backoff, b)
+                    _obs.counter("serve_retries_total",
+                                 reason=reasons[j]).inc()
+                    _obs.emit("request_retry", trace_id=tids[i],
+                              tenant=r.tenant,
+                              tenant_class=r.tenant_class,
+                              attempt=attempt, reason=reasons[j],
+                              backoff_s=round(b, 4))
+                time.sleep(backoff)
+            pending = retry
+
     def _serve_batch(self, spec: BucketSpec,
                      reqs: Sequence[ScenarioRequest],
-                     tids: Sequence[Optional[str]] = ()):
+                     tids: Sequence[Optional[str]] = (),
+                     qwaits: Sequence[float] = (),
+                     attempt: int = 0,
+                     deadline_lefts: Sequence[Optional[float]] = ()):
         import jax.numpy as jnp
 
         tids = list(tids) or [None] * len(reqs)
+        qwaits = list(qwaits) or [0.0] * len(reqs)
+        lefts = list(deadline_lefts) or [None] * len(reqs)
         t_submit = time.perf_counter()
         with _obs.trace_scope(*tids), \
                 _obs.span("serve/request", lanes=spec.lanes,
                           requests=len(reqs)):
             cold = not self.is_warm(spec)
             wait = self._ensure_pool(spec, trace_ids=tids)
+            # the deadline budget binds the pool wait only when every
+            # member carries one — the most patient member keeps the
+            # build alive for the others
+            finite = [x for x in lefts if x is not None]
+            budget = (max(finite)
+                      if finite and len(finite) == len(reqs) else None)
             with _obs.span("bucket_wait", cold=cold):
                 t_wait = time.perf_counter()
-                pool = wait()              # cold: compile lands here
-                _H_WAIT.observe(time.perf_counter() - t_wait)
+                try:
+                    pool = wait(budget)    # cold: compile lands here
+                except PoolWaitTimeout:
+                    # every member's admission-to-first-step budget
+                    # expired while the bucket compiled: terminal shed
+                    # (a deadline, unlike a failed build, never
+                    # retries — the budget is already gone)
+                    return [self._shed(r, tids[k],
+                                       "deadline_exceeded", qwaits[k],
+                                       retries=attempt)
+                            for k, r in enumerate(reqs)]
+                finally:
+                    _H_WAIT.observe(time.perf_counter() - t_wait)
+            results: list = [None] * len(reqs)
+            elapsed = time.perf_counter() - t_submit
+            live_idx = []
+            for k, r in enumerate(reqs):
+                if lefts[k] is not None and lefts[k] - elapsed <= 0:
+                    # admission-to-first-step budget burned in the
+                    # bucket wait: shed before spending device time
+                    results[k] = self._shed(r, tids[k],
+                                            "deadline_exceeded",
+                                            qwaits[k], retries=attempt)
+                else:
+                    live_idx.append(k)
+            if not live_idx:
+                return results
+            sreqs = [reqs[k] for k in live_idx]
+            stids = [tids[k] for k in live_idx]
             B = spec.lanes
-            pads = B - len(reqs)
+            pads = B - len(sreqs)
             if pads:
                 _PADS.inc(pads)
             _H_PADFRAC.observe(pads / B)
             stacked, _ = _lanes.pad_lanes(
-                [pool.request_state(r) for r in reqs], B)
+                [pool.request_state(r) for r in sreqs], B)
             dt_vec = jnp.asarray(
-                [r.dt for r in reqs] + [reqs[-1].dt] * pads,
+                [r.dt for r in sreqs] + [sreqs[-1].dt] * pads,
                 dtype=pool._dt_vec.dtype)
 
             steps_done = np.zeros(B, dtype=int)
-            target = np.array([r.steps for r in reqs] + [0] * pads)
+            target = np.array([r.steps for r in sreqs] + [0] * pads)
             quarantined = np.zeros(B, dtype=bool)
-            alive_host = np.arange(B) < len(reqs)
+            alive_host = np.arange(B) < len(sreqs)
             first_step_s = None
             state = stacked
             while True:
@@ -437,12 +856,12 @@ class WarmPoolRouter:
                 steps_done[run_mask] += length
                 newly_bad = run_mask & (h < 0.5)
                 for lane in np.nonzero(newly_bad)[0]:
-                    if lane >= len(reqs):
+                    if lane >= len(sreqs):
                         continue
                     _obs.emit("lane_quarantine",
-                              trace_id=tids[lane] or None,
-                              tenant=reqs[lane].tenant, family=pool.key,
-                              lane=int(lane),
+                              trace_id=stids[lane] or None,
+                              tenant=sreqs[lane].tenant,
+                              family=pool.key, lane=int(lane),
                               step=int(steps_done[lane]))
                 quarantined |= newly_bad
                 alive_host &= ~newly_bad
@@ -451,8 +870,7 @@ class WarmPoolRouter:
             if first_step_s is None:          # zero-step requests
                 first_step_s = total_s
             path = "cold" if cold else "warm"
-            results = []
-            for lane, r in enumerate(reqs):
+            for lane, r in enumerate(sreqs):
                 q = bool(quarantined[lane])
                 ok = bool(steps_done[lane] >= r.steps) and not q
                 _REQS.inc()
@@ -462,21 +880,27 @@ class WarmPoolRouter:
                     _QUAR.inc()
                 _H_REQ[path].observe(total_s)
                 _H_FIRST[path].observe(first_step_s)
-                results.append(RequestResult(
+                qw = qwaits[live_idx[lane]]
+                results[live_idx[lane]] = RequestResult(
                     tenant=r.tenant, ok=ok, quarantined=q, cold=cold,
                     bucket_lanes=B, lane=lane,
                     steps_done=int(steps_done[lane]),
                     first_step_s=first_step_s, total_s=total_s,
-                    family_key=pool.key, trace_id=tids[lane],
+                    family_key=pool.key, trace_id=stids[lane],
                     error=("lane quarantined (non-finite state)" if q
-                           else None)))
-                _obs.emit("request", trace_id=tids[lane] or None,
-                          tenant=r.tenant, family=pool.key,
-                          engine=pool.engine, bucket_lanes=B, lane=lane,
-                          cold=cold, ok=ok, quarantined=q,
+                           else None),
+                    retries=int(attempt), queue_wait_s=qw)
+                _obs.emit("request", trace_id=stids[lane] or None,
+                          tenant=r.tenant,
+                          tenant_class=r.tenant_class,
+                          family=pool.key,
+                          engine=pool.engine, bucket_lanes=B,
+                          lane=lane, cold=cold, ok=ok, quarantined=q,
                           steps=int(steps_done[lane]),
                           first_step_s=round(first_step_s, 4),
-                          total_s=round(total_s, 4))
+                          total_s=round(total_s, 4),
+                          queue_wait_s=round(qw, 4),
+                          retries=int(attempt))
         return results
 
 

@@ -243,13 +243,24 @@ class RunWatchdog:
             from ibamr_tpu.obs import bus as _bus
             inflight = _bus.peek_gauge("serve_requests_inflight")
             completed = _bus.peek_gauge("serve_requests_completed")
+            queued = _bus.peek_gauge("serve_requests_queued")
+            shed = _bus.peek_gauge("serve_requests_shed")
         except Exception:
-            inflight = completed = None
+            inflight = completed = queued = shed = None
         if inflight is not None or completed is not None:
             payload["requests_inflight"] = (
                 None if inflight is None else int(inflight))
             payload["requests_completed"] = (
                 None if completed is None else int(completed))
+        # admission-control gauges (PR 17): queued waiters and the
+        # cumulative shed count — a wedged admission queue shows up in
+        # the heartbeat an external observer already polls; same
+        # peek-only rule, so solo runs never grow these keys
+        if queued is not None or shed is not None:
+            payload["requests_queued"] = (
+                None if queued is None else int(queued))
+            payload["requests_shed"] = (
+                None if shed is None else int(shed))
         return payload
 
     # -- detector -----------------------------------------------------------

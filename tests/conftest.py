@@ -258,6 +258,11 @@ SLOW_TESTS = {
     "test_hybrid_in_flagship_model",
     "test_failed_engine_degrades_and_matches_fallback",
     "test_hybrid_bf16_registry_name",
+    # PR 17 (traffic): multi-minute sustained soaks (real-time open
+    # loop; the bounded variants run in tier-1 via `slo.py check
+    # --soak` and dryrun path 21)
+    "test_soak_long_sustained_open_loop",
+    "test_soak_long_chaos_smoke",
 }
 
 

@@ -1,0 +1,675 @@
+"""Traffic robustness: admission control, deadlines, retries, the
+open-loop load generator, the soak SLO gate, and the serving chaos
+injectors (PR 17).
+
+Admission-controller and SLI tests are pure host code (no jax, no
+compiles). Router-level overload tests use policies that shed BEFORE
+any pool exists (queue_depth=0 / deadline already spent / all builds
+injected to fail), so nothing in the fast tier waits on a compile
+except the one module-scoped warm pool shared with the concurrency
+test. The multi-minute sustained soaks are slow-tier
+(``test_soak_long_*``); CI covers the bounded variant via
+``tools/slo.py check --soak`` and dryrun path 21.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from ibamr_tpu import obs
+from ibamr_tpu.serve.aot_cache import ExecutableCache
+from ibamr_tpu.serve.router import (AdmissionController, BucketSpec,
+                                    ScenarioRequest, TenantClassPolicy,
+                                    WarmPoolRouter)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_N, _N_LAT, _N_LON = 8, 6, 8
+
+
+def _req(tag, **kw):
+    kw.setdefault("steps", 2)
+    return ScenarioRequest(tenant=tag, n_cells=_N, n_lat=_N_LAT,
+                           n_lon=_N_LON, **kw)
+
+
+# ---------------------------------------------------------------------------
+# admission controller (pure threading — no jax)
+# ---------------------------------------------------------------------------
+
+def test_default_policy_admits_everything_immediately():
+    ac = AdmissionController()
+    for _ in range(100):
+        ok, wait_s, reason = ac.admit("anything")
+        assert ok and reason is None
+        assert wait_s == 0.0
+    for _ in range(100):
+        ac.release("anything")
+
+
+def test_admission_sheds_queue_full():
+    ac = AdmissionController(
+        {"tight": TenantClassPolicy(max_inflight=1, queue_depth=0)})
+    ok, _, _ = ac.admit("tight")
+    assert ok
+    ok, _, reason = ac.admit("tight")      # slot held, queue closed
+    assert not ok and reason == "queue_full"
+    ac.release("tight")
+    ok, _, _ = ac.admit("tight")           # released slot admits again
+    assert ok
+    ac.release("tight")
+
+
+def test_admission_queue_timeout_is_bounded():
+    ac = AdmissionController(
+        {"q": TenantClassPolicy(max_inflight=1, queue_depth=4,
+                                queue_timeout_s=0.2)})
+    assert ac.admit("q")[0]
+    t0 = time.perf_counter()
+    ok, wait_s, reason = ac.admit("q")     # nobody releases: must time out
+    waited = time.perf_counter() - t0
+    assert not ok and reason == "queue_timeout"
+    assert 0.15 <= waited < 5.0            # bounded, never a hang
+    assert wait_s > 0.0
+    ac.release("q")
+
+
+def test_admission_deadline_beats_queue_timeout():
+    ac = AdmissionController(
+        {"d": TenantClassPolicy(max_inflight=1, queue_depth=4,
+                                queue_timeout_s=30.0)})
+    assert ac.admit("d")[0]
+    ok, _, reason = ac.admit("d", deadline_left=0.1)
+    assert not ok and reason == "deadline_exceeded"
+    ok, _, reason = ac.admit("d", deadline_left=-1.0)
+    assert not ok and reason == "deadline_exceeded"
+    ac.release("d")
+
+
+def test_queued_waiter_wakes_on_release():
+    ac = AdmissionController(
+        {"w": TenantClassPolicy(max_inflight=1, queue_depth=4,
+                                queue_timeout_s=10.0)})
+    assert ac.admit("w")[0]
+    got = {}
+
+    def waiter():
+        got["res"] = ac.admit("w")
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    time.sleep(0.1)
+    ac.release("w", reclaimed=True)        # a reclaimed slot must wake it
+    th.join(10.0)
+    assert not th.is_alive()
+    ok, wait_s, _ = got["res"]
+    assert ok and wait_s < 5.0
+    ac.release("w")
+
+
+def test_reclaimed_release_counts():
+    obs.reset_metrics()
+    ac = AdmissionController(
+        {"r": TenantClassPolicy(max_inflight=2)})
+    ac.admit("r")
+    ac.admit("r")
+    ac.release("r", reclaimed=True)
+    ac.release("r")
+    snap = obs.metrics_snapshot()
+    assert snap["counters"].get("serve_slots_reclaimed_total") == 1
+
+
+# ---------------------------------------------------------------------------
+# router-level shed paths (no pool ever built — fast)
+# ---------------------------------------------------------------------------
+
+def test_router_sheds_queue_full_with_terminal_record(tmp_path):
+    router = WarmPoolRouter(
+        [BucketSpec(n_cells=_N, n_lat=_N_LAT, n_lon=_N_LON, lanes=2)],
+        cache=ExecutableCache(), allow_dynamic=False,
+        policies={"none": TenantClassPolicy(max_inflight=0,
+                                            queue_depth=0)})
+    lp = tmp_path / "ledger.jsonl"
+    with obs.ledger(str(lp)):
+        res = router.serve([_req("t0", tenant_class="none")])
+    assert len(res) == 1 and res[0].shed
+    assert res[0].shed_reason == "queue_full"
+    assert not res[0].ok and res[0].lane == -1
+    recs = list(obs.read_ledger(str(lp)))
+    admits = [r for r in recs if r.get("kind") == "request_admit"]
+    sheds = [r for r in recs if r.get("kind") == "request_shed"]
+    assert len(admits) == 1 and len(sheds) == 1
+    assert sheds[0]["trace_id"] == admits[0]["trace_id"]
+    assert sheds[0]["reason"] == "queue_full"
+    assert sheds[0]["tenant_class"] == "none"
+
+
+def test_router_sheds_spent_deadline_before_any_wait(tmp_path):
+    router = WarmPoolRouter(
+        [BucketSpec(n_cells=_N, n_lat=_N_LAT, n_lon=_N_LON, lanes=2)],
+        cache=ExecutableCache(), allow_dynamic=False)
+    lp = tmp_path / "ledger.jsonl"
+    with obs.ledger(str(lp)):
+        res = router.serve([_req("t0", deadline_s=0.0)])
+    assert res[0].shed and res[0].shed_reason == "deadline_exceeded"
+    sheds = [r for r in obs.read_ledger(str(lp))
+             if r.get("kind") == "request_shed"]
+    assert sheds and sheds[0]["reason"] == "deadline_exceeded"
+
+
+def test_failing_builds_exhaust_retry_budget_and_shed(tmp_path):
+    from tools.fault_injection import failing_build_injector
+
+    router = WarmPoolRouter(
+        [BucketSpec(n_cells=_N, n_lat=_N_LAT, n_lon=_N_LON, lanes=2)],
+        cache=ExecutableCache(), allow_dynamic=False,
+        policies={"retry": TenantClassPolicy(retry_budget=2,
+                                             backoff_base_s=0.01,
+                                             backoff_cap_s=0.02)})
+    lp = tmp_path / "ledger.jsonl"
+    with obs.ledger(str(lp)), failing_build_injector(n_failures=99):
+        res = router.serve([_req("t0", tenant_class="retry")])
+    assert res[0].shed and res[0].shed_reason == "build_failed"
+    assert res[0].retries == 2                 # the whole budget spent
+    assert "injected build failure" in res[0].error
+    recs = list(obs.read_ledger(str(lp)))
+    retries = [r for r in recs if r.get("kind") == "request_retry"]
+    assert [r["attempt"] for r in retries] == [1, 2]
+    assert all(r["reason"] == "build_failed" for r in retries)
+    assert all(r["backoff_s"] > 0 for r in retries)
+    sheds = [r for r in recs if r.get("kind") == "request_shed"]
+    assert len(sheds) == 1 and sheds[0]["retries"] == 2
+
+
+def test_shed_slot_is_reclaimed_for_the_next_waiter():
+    from tools.fault_injection import failing_build_injector
+
+    obs.reset_metrics()
+    router = WarmPoolRouter(
+        [BucketSpec(n_cells=_N, n_lat=_N_LAT, n_lon=_N_LON, lanes=2)],
+        cache=ExecutableCache(), allow_dynamic=False,
+        policies={"one": TenantClassPolicy(max_inflight=1,
+                                           queue_depth=4,
+                                           queue_timeout_s=20.0)})
+    results = []
+    lock = threading.Lock()
+
+    def submit():
+        out = router.serve([_req("t", tenant_class="one")])
+        with lock:
+            results.extend(out)
+
+    with failing_build_injector(n_failures=99):
+        threads = [threading.Thread(target=submit) for _ in range(3)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(60.0)
+    assert not any(th.is_alive() for th in threads)
+    # all three were ADMITTED (never queue_full/queue_timeout): each
+    # build_failed shed handed its slot to the next waiter
+    assert len(results) == 3
+    assert all(r.shed and r.shed_reason == "build_failed"
+               for r in results)
+    snap = obs.metrics_snapshot()
+    assert snap["counters"].get("serve_slots_reclaimed_total", 0) >= 2
+
+
+def test_backoff_is_deterministic_and_capped():
+    pol = TenantClassPolicy(backoff_base_s=0.1, backoff_cap_s=0.4)
+    tid = "deadbeef00000000"
+    b1 = WarmPoolRouter._backoff_s(pol, 1, tid)
+    assert b1 == WarmPoolRouter._backoff_s(pol, 1, tid)  # no RNG
+    assert 0.05 <= b1 <= 0.1
+    b9 = WarmPoolRouter._backoff_s(pol, 9, tid)
+    assert b9 <= 0.4                                      # capped
+    assert WarmPoolRouter._backoff_s(pol, 1, "00000000") \
+        != WarmPoolRouter._backoff_s(pol, 1, "ffffffff0")
+
+
+# ---------------------------------------------------------------------------
+# load generator (schedule math only — no jax)
+# ---------------------------------------------------------------------------
+
+def test_poisson_burst_schedule_is_deterministic():
+    from ibamr_tpu.serve.loadgen import poisson_burst_schedule
+
+    a = poisson_burst_schedule(seed=3, duration_s=10.0, rate_rps=5.0)
+    b = poisson_burst_schedule(seed=3, duration_s=10.0, rate_rps=5.0)
+    assert [(x.t, x.scenario, x.request.tenant) for x in a] \
+        == [(x.t, x.scenario, x.request.tenant) for x in b]
+    c = poisson_burst_schedule(seed=4, duration_s=10.0, rate_rps=5.0)
+    assert [x.t for x in a] != [x.t for x in c]
+    assert all(0.0 <= x.t < 10.0 for x in a)
+    assert [x.t for x in a] == sorted(x.t for x in a)
+
+
+def test_burst_window_multiplies_the_rate():
+    from ibamr_tpu.serve.loadgen import poisson_burst_schedule
+
+    arr = poisson_burst_schedule(seed=0, duration_s=100.0,
+                                 rate_rps=2.0, burst_factor=4.0,
+                                 burst_start_frac=0.4,
+                                 burst_len_frac=0.3)
+    in_burst = [x for x in arr if 40.0 <= x.t < 70.0]
+    outside = [x for x in arr if not (40.0 <= x.t < 70.0)]
+    rate_in = len(in_burst) / 30.0
+    rate_out = len(outside) / 70.0
+    assert rate_in > 2.0 * rate_out        # 4x nominal, 2x with noise
+
+
+def test_scenario_mix_is_heavy_tailed_with_both_classes():
+    from ibamr_tpu.serve.loadgen import (SCENARIO_MIX,
+                                         poisson_burst_schedule)
+
+    assert abs(sum(s.weight for s in SCENARIO_MIX) - 1.0) < 1e-9
+    classes = {s.tenant_class for s in SCENARIO_MIX}
+    assert classes == {"interactive", "batch"}
+    # heavy tail: the largest service demand dominates the smallest
+    steps = sorted(s.steps for s in SCENARIO_MIX)
+    assert steps[-1] >= 4 * steps[0]
+    arr = poisson_burst_schedule(seed=1, duration_s=60.0, rate_rps=5.0)
+    # one family only — a bounded soak pays exactly one bucket compile
+    assert len({x.request.family() for x in arr}) == 1
+    by_class = {}
+    for x in arr:
+        by_class[x.request.tenant_class] = \
+            by_class.get(x.request.tenant_class, 0) + 1
+    assert by_class["interactive"] > by_class["batch"] > 0
+
+
+def test_open_loop_counts_results_and_errors():
+    from ibamr_tpu.serve.loadgen import (Arrival, run_open_loop,
+                                         traffic_summary)
+
+    class FakeResult:
+        def __init__(self, tenant, shed=False):
+            self.tenant = tenant
+            self.shed = shed
+            self.shed_reason = "queue_full" if shed else None
+            self.ok = not shed
+            self.quarantined = False
+            self.retries = 0
+            self.queue_wait_s = 0.01
+            self.cold = False
+            self.first_step_s = 0.02
+
+    class FakeRouter:
+        def __init__(self):
+            self.n = 0
+            self.lock = threading.Lock()
+
+        def serve(self, reqs):
+            with self.lock:
+                self.n += 1
+                k = self.n
+            if k == 3:
+                raise RuntimeError("boom")
+            return [FakeResult(r.tenant, shed=(k % 4 == 0))
+                    for r in reqs]
+
+    arrivals = [Arrival(t=i * 0.01, scenario="s",
+                        request=_req(f"interactive-{i}",
+                                     tenant_class="interactive"))
+                for i in range(8)]
+    run = run_open_loop(FakeRouter(), arrivals, time_scale=0.1,
+                        join_timeout_s=30.0)
+    assert run["hung_threads"] == 0
+    assert len(run["errors"]) == 1 and "boom" in run["errors"][0]
+    assert len(run["results"]) == 7
+    summary = traffic_summary(run["results"], run["wall_s"])
+    assert summary["submitted"] == 7
+    assert summary["shed"] == summary["shed_by_reason"].get(
+        "queue_full", 0)
+    assert "interactive" in summary["classes"]
+
+
+# ---------------------------------------------------------------------------
+# soak SLIs + the --soak gate (synthetic ledgers — no jax)
+# ---------------------------------------------------------------------------
+
+def _soak_ledger(tmp_path, lost=0, shed=2, served=8):
+    recs = []
+    seq = 0
+    for i in range(served + shed + lost):
+        seq += 1
+        recs.append({"seq": seq, "kind": "request_admit",
+                     "trace_id": f"{i:016x}", "tenant": "t",
+                     "tenant_class": "interactive", "t": 0.0})
+    for i in range(served):
+        seq += 1
+        recs.append({"seq": seq, "kind": "request",
+                     "trace_id": f"{i:016x}", "tenant": "t",
+                     "tenant_class": "interactive", "cold": False,
+                     "ok": True, "quarantined": False,
+                     "first_step_s": 0.01 * (i + 1),
+                     "queue_wait_s": 0.005 * i, "t": 1.0})
+    for i in range(served, served + shed):
+        seq += 1
+        recs.append({"seq": seq, "kind": "request_shed",
+                     "trace_id": f"{i:016x}", "tenant": "t",
+                     "tenant_class": "interactive",
+                     "reason": "queue_full", "queue_wait_s": 0.5,
+                     "retries": 0, "t": 1.0})
+    lp = tmp_path / "soak_ledger.jsonl"
+    with open(lp, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    return str(lp)
+
+
+def test_soak_slis_from_ledger(tmp_path):
+    from tools.slo import soak_slis_from_ledger
+
+    path = _soak_ledger(tmp_path, lost=1, shed=2, served=8)
+    slis = soak_slis_from_ledger(list(obs.read_ledger(path)))
+    assert slis["soak_lost_requests"] == 1
+    assert slis["soak_shed_rate"] == pytest.approx(2 / 10)
+    assert slis["soak_warm_p99_s"] == pytest.approx(0.08)
+    assert slis["soak_queue_wait_p99_s"] == pytest.approx(0.5)
+
+
+def test_slo_check_soak_exit_codes(tmp_path, capsys):
+    from tools.slo import main as slo_main
+
+    clean = _soak_ledger(tmp_path, lost=0, shed=0, served=10)
+    (tmp_path / "b").mkdir()
+    lossy = _soak_ledger(tmp_path / "b", lost=2, shed=0, served=10)
+    contract = tmp_path / "SLO.json"
+    contract.write_text(json.dumps({
+        "slo_schema": 1, "slos": {},
+        "soak_slos": {
+            "soak_lost_requests": {"ceiling": 0},
+            "soak_shed_rate": {"ceiling": 0.2},
+            "soak_warm_p99_s": {"ceiling": 2.0},
+            "soak_queue_wait_p99_s": {"ceiling": 2.0}}}))
+    rc = slo_main(["check", "--soak", "--ledger", clean,
+                   "--contract", str(contract), "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and not out["violated"]
+    # lost requests violate the zero-ceiling -> exit 2
+    rc = slo_main(["check", "--soak", "--ledger", lossy,
+                   "--contract", str(contract), "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 2
+    assert any("soak_lost_requests" in v for v in out["violated"])
+    # a contract without soak_slos is unevaluable -> exit 1
+    bare = tmp_path / "bare.json"
+    bare.write_text(json.dumps({"slo_schema": 1, "slos": {}}))
+    rc = slo_main(["check", "--soak", "--ledger", clean,
+                   "--contract", str(bare), "--json"])
+    assert rc == 1
+    capsys.readouterr()
+
+
+def test_slo_soak_tighten_preserves_existing_slos(tmp_path, capsys):
+    from tools.slo import main as slo_main
+
+    clean = _soak_ledger(tmp_path, lost=0, shed=1, served=9)
+    contract = tmp_path / "SLO.json"
+    contract.write_text(json.dumps({
+        "slo_schema": 1,
+        "slos": {"warm_first_step_p99_s": {"ceiling": 2.0}}}))
+    rc = slo_main(["check", "--soak", "--ledger", clean,
+                   "--contract", str(contract), "--tighten"])
+    capsys.readouterr()
+    assert rc == 0
+    doc = json.loads(contract.read_text())
+    # the cold/warm section survives the soak merge untouched
+    assert doc["slos"] == {"warm_first_step_p99_s": {"ceiling": 2.0}}
+    assert doc["soak_slos"]["soak_lost_requests"] == {"ceiling": 0}
+    assert doc["soak_slos"]["soak_shed_rate"]["ceiling"] \
+        == pytest.approx(0.3)
+
+
+def test_committed_contract_has_soak_slos():
+    with open(os.path.join(REPO, "SLO.json")) as f:
+        doc = json.load(f)
+    assert doc["soak_slos"]["soak_lost_requests"] == {"ceiling": 0}
+    assert set(doc["soak_slos"]) >= {"soak_warm_p99_s",
+                                     "soak_queue_wait_p99_s",
+                                     "soak_shed_rate",
+                                     "soak_lost_requests"}
+    assert doc["soak"]["burst_factor"] == 4.0
+
+
+# ---------------------------------------------------------------------------
+# rendering: the traffic block, tail lines, trace hops
+# ---------------------------------------------------------------------------
+
+def test_render_traffic_block_and_absence():
+    from tools.obs import render_traffic
+
+    # no admission activity -> no block (plain runs keep their shape)
+    assert render_traffic({}, []) == []
+    recs = [
+        {"seq": 1, "kind": "request_admit", "trace_id": "a" * 16,
+         "tenant": "t", "tenant_class": "interactive"},
+        {"seq": 2, "kind": "request_retry", "trace_id": "a" * 16,
+         "tenant": "t", "tenant_class": "interactive", "attempt": 1,
+         "reason": "build_failed", "backoff_s": 0.03},
+        {"seq": 3, "kind": "request_shed", "trace_id": "a" * 16,
+         "tenant": "t", "tenant_class": "interactive",
+         "reason": "deadline_exceeded", "queue_wait_s": 0.4,
+         "retries": 1},
+    ]
+    lines = render_traffic({}, recs)
+    text = "\n".join(lines)
+    assert "deadline_exceeded=1" in text
+    assert "retries: 1 (build_failed=1)" in text
+    assert "class interactive" in text and "shed=1" in text
+    # labeled counters win over record recounts when snapshotted
+    snap = {"counters": {
+        'serve_shed_total{reason="queue_full"}': 7,
+        "serve_slots_reclaimed_total": 3}}
+    text = "\n".join(render_traffic(snap, recs))
+    assert "queue_full=7" in text
+    assert "reclaimed: 3" in text
+
+
+def test_tail_and_trace_render_shed_and_retry():
+    from tools.obs import _one_line, render_trace
+
+    shed = {"seq": 5, "kind": "request_shed", "trace_id": "b" * 16,
+            "tenant": "t", "tenant_class": "chaos",
+            "reason": "queue_full", "queue_wait_s": 0.2, "retries": 0,
+            "t": 1.0}
+    retry = {"seq": 4, "kind": "request_retry", "trace_id": "b" * 16,
+             "tenant": "t", "tenant_class": "chaos", "attempt": 1,
+             "reason": "lane_quarantined", "backoff_s": 0.05, "t": 0.5}
+    assert "reason=queue_full" in _one_line(shed)
+    assert "attempt=1" in _one_line(retry)
+    admit = {"seq": 1, "kind": "request_admit", "trace_id": "b" * 16,
+             "tenant": "t", "tenant_class": "chaos", "steps": 2,
+             "t": 0.0, "run_id": "r"}
+    lines = render_trace([admit, retry, shed], "b" * 16)
+    text = "\n".join(lines)
+    assert "retry #1" in text and "lane_quarantined" in text
+    assert "SHED" in text and "queue_full" in text
+    assert "verdict: shed (queue_full)" in text
+
+
+def test_trace_completed_line_carries_queue_wait_and_retries():
+    from tools.obs import render_trace
+
+    done = {"seq": 2, "kind": "request", "trace_id": "c" * 16,
+            "tenant": "t", "cold": False, "ok": True,
+            "quarantined": False, "lane": 0, "first_step_s": 0.01,
+            "total_s": 0.05, "queue_wait_s": 0.3, "retries": 2,
+            "t": 1.0, "run_id": "r"}
+    text = "\n".join(render_trace([done], "c" * 16))
+    assert "queue_wait=" in text and "retries=2" in text
+    assert "verdict: ok" in text
+
+
+def test_watchdog_heartbeat_carries_queue_and_shed_gauges(tmp_path):
+    from ibamr_tpu.utils.watchdog import RunWatchdog, read_heartbeat
+
+    obs.reset_metrics()
+    hb = str(tmp_path / "heartbeat.json")
+    wd = RunWatchdog(heartbeat_path=hb)
+    if obs.peek_gauge("serve_requests_queued") is None:
+        wd.beat(step=1)
+        payload = read_heartbeat(hb)
+        # solo schema untouched: no traffic keys without the gauges
+        assert "requests_queued" not in payload
+        assert "requests_shed" not in payload
+    obs.gauge("serve_requests_queued").set(3)
+    obs.gauge("serve_requests_shed").set(5)
+    wd.beat(step=2)
+    payload = read_heartbeat(hb)
+    assert payload["requests_queued"] == 3
+    assert payload["requests_shed"] == 5
+    obs.reset_metrics()
+
+
+# ---------------------------------------------------------------------------
+# genuine thread concurrency + chaos (one shared warm pool)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def traffic_router():
+    spec = BucketSpec(n_cells=_N, n_lat=_N_LAT, n_lon=_N_LON, lanes=2)
+    router = WarmPoolRouter(
+        [spec], cache=ExecutableCache(), allow_dynamic=True,
+        policies={
+            "interactive": TenantClassPolicy(
+                max_inflight=4, queue_depth=16, queue_timeout_s=30.0,
+                deadline_s=60.0, retry_budget=1,
+                backoff_base_s=0.01, backoff_cap_s=0.05),
+            "batch": TenantClassPolicy(
+                max_inflight=2, queue_depth=8, queue_timeout_s=30.0,
+                deadline_s=60.0, retry_budget=1,
+                backoff_base_s=0.01, backoff_cap_s=0.05),
+            "chaos": TenantClassPolicy(
+                max_inflight=2, queue_depth=2, queue_timeout_s=1.0,
+                deadline_s=2.0, retry_budget=1,
+                backoff_base_s=0.01, backoff_cap_s=0.05)})
+    router.warm(spec)
+    return router, spec
+
+
+def test_no_lost_request_under_concurrent_chaos(traffic_router,
+                                                tmp_path):
+    """N producer threads, mixed classes, chaos injectors firing: the
+    merged ledger must show EXACTLY one terminal record per admitted
+    trace_id, and no producer may hang."""
+    from tools.fault_injection import (failing_build_injector,
+                                       kill_router_thread_injector)
+
+    router, _ = traffic_router
+    lp = tmp_path / "ledger.jsonl"
+    results = []
+    lock = threading.Lock()
+
+    def producer(i):
+        # chaos producers land on a NOVEL family (n_lon=10): its
+        # builds get killed/failed by the injectors; healthy
+        # producers ride the warm pool
+        if i % 4 == 3:
+            req = ScenarioRequest(tenant=f"chaos-{i}", n_cells=_N,
+                                  n_lat=_N_LAT, n_lon=10, steps=1,
+                                  tenant_class="chaos")
+        else:
+            cls = "batch" if i % 4 == 2 else "interactive"
+            req = _req(f"{cls}-{i}", steps=1, tenant_class=cls)
+        out = router.serve([req])
+        with lock:
+            results.extend(out)
+
+    with obs.ledger(str(lp)):
+        # every novel-family build dies or raises: kill first, then
+        # injected failures — no real compile in this test
+        with kill_router_thread_injector(n_kills=1), \
+                failing_build_injector(n_failures=99):
+            threads = [threading.Thread(target=producer, args=(i,))
+                       for i in range(12)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(120.0)
+        hung = sum(1 for th in threads if th.is_alive())
+        assert hung == 0, f"{hung} producers hung under chaos"
+
+    assert len(results) == 12
+    recs = list(obs.read_ledger(str(lp)))
+    admits = [r["trace_id"] for r in recs
+              if r.get("kind") == "request_admit"]
+    assert len(admits) == 12
+    terminals = {}
+    for r in recs:
+        if r.get("kind") in ("request", "request_shed"):
+            terminals[r["trace_id"]] = \
+                terminals.get(r["trace_id"], 0) + 1
+    assert all(terminals.get(t, 0) == 1 for t in admits), \
+        f"lost/doubled: { {t: terminals.get(t, 0) for t in admits} }"
+    # healthy classes completed; chaos requests shed (their builds
+    # were all killed or raised) — capacity isolation held
+    chaos = [r for r in results if r.tenant.startswith("chaos")]
+    healthy = [r for r in results if not r.tenant.startswith("chaos")]
+    assert all(r.shed and r.shed_reason == "build_failed"
+               for r in chaos)
+    assert all(not r.shed and r.ok for r in healthy)
+
+
+def test_killed_build_thread_fails_over_not_hangs(traffic_router):
+    """A build thread that dies without publishing must fail over to
+    a retryable error inside the sliced wait — never a hang."""
+    from tools.fault_injection import (failing_build_injector,
+                                       kill_router_thread_injector)
+
+    router, _ = traffic_router
+    req = ScenarioRequest(tenant="chaos-k", n_cells=_N, n_lat=_N_LAT,
+                          n_lon=12, steps=1, tenant_class="chaos")
+    t0 = time.perf_counter()
+    with kill_router_thread_injector(n_kills=1), \
+            failing_build_injector(n_failures=99):
+        res = router.serve([req])
+    assert time.perf_counter() - t0 < 60.0
+    assert res[0].shed and res[0].shed_reason == "build_failed"
+    assert res[0].retries >= 1                  # the failover retried
+
+
+def test_warm_traffic_unchanged_by_admission_layer(traffic_router):
+    """Default-policy classes on the warm family keep the original
+    zero-compile contract: admission is free when capacity exists."""
+    router, _ = traffic_router
+    before = router.cache.stats()
+    res = router.serve([_req(f"t{i}", steps=1,
+                             tenant_class="interactive")
+                        for i in range(4)])
+    after = router.cache.stats()
+    assert all(not r.shed and r.ok and not r.cold for r in res)
+    assert all(r.queue_wait_s < 30.0 for r in res)
+    assert after["misses"] == before["misses"]  # zero compiles warm
+
+
+# ---------------------------------------------------------------------------
+# sustained soaks (slow tier — conftest SLOW_TESTS)
+# ---------------------------------------------------------------------------
+
+def test_soak_long_sustained_open_loop():
+    """Multi-minute clean soak: sustained arrivals, zero loss, zero
+    hung threads, shed rate inside the committed ceiling."""
+    from ibamr_tpu.serve.loadgen import soak_drill
+
+    out = soak_drill(seed=1, duration_s=120.0, rate_rps=6.0,
+                     time_scale=1.0)
+    assert out["hung_threads"] == 0
+    assert out["submitted"] == out["completed"] + out["shed"]
+    assert (out["shed_rate"] or 0.0) <= 0.2
+    assert out["warm_first_step_p99_s"] is not None
+
+
+def test_soak_long_chaos_smoke():
+    """The full chaos drill at a longer horizon (the tier-1 variant
+    runs bounded inside `slo.py check --soak` and dryrun path 21)."""
+    from tools.fault_injection import run_soak_smoke
+
+    out = run_soak_smoke(duration_s=60.0, rate_rps=8.0,
+                         time_scale=1.0)
+    assert out["soak_smoke"] == "ok"
+    assert out["lost"] == 0 and out["hung_threads"] == 0

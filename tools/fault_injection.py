@@ -56,6 +56,20 @@ dryrun drill are built from:
   poisoned lane, per-lane rollback + dt backoff, quarantine, healthy
   lanes bitwise untouched, sliced-capsule replay) wired as dryrun
   path 20 and ``python -m tools.fault_injection --fleet-smoke``.
+- :func:`compile_storm_injector` / :func:`slow_lane_injector` /
+  :func:`failing_build_injector` / :func:`kill_router_thread_injector`
+  (PR 17) — SERVING-path faults against the warm-pool router: slow
+  bucket compiles, straggler lanes, builds that raise, and build
+  threads that die without publishing. These are latency/liveness
+  faults, never state-value faults, so they are NOT ``recorded()`` —
+  there is nothing for the flight recorder to replay bitwise.
+  :func:`run_soak_smoke` composes them over the PR-17 open-loop load
+  generator into the traffic-robustness drill (dryrun path 21,
+  ``python -m tools.fault_injection --soak-smoke``): a chaos tenant
+  burns through novel families and injected faults at a 4x burst
+  while healthy tenants keep their warm p99, with the no-deadlock /
+  no-lost-request / bounded-shed invariants pinned from the merged
+  ledger.
 
 Everything here is deliberately boring and deterministic: no random
 fuzzing, every fault lands at a named step/byte so a failure
@@ -69,6 +83,7 @@ import contextlib
 import json
 import os
 import tempfile
+import threading
 import time
 
 import numpy as np
@@ -1540,6 +1555,305 @@ def run_fleet_smoke(directory: str | None = None,
             tmp.cleanup()
 
 
+# ---------------------------------------------------------------------------
+# serving-path chaos (PR 17): faults against the warm-pool router
+# ---------------------------------------------------------------------------
+#
+# All four injectors monkey-patch the router's seams for the duration
+# of a ``with`` block and restore them on exit. They are deliberately
+# NOT ``recorded()``: they perturb latency and liveness, never state
+# values, so there is no bitwise replay story — the soak drill's
+# invariants are the reproduction.
+
+
+@contextlib.contextmanager
+def compile_storm_injector(extra_s: float = 0.5):
+    """Every bucket build (the whole cost of a serving miss) takes
+    ``extra_s`` longer — the host-side model of a compile storm, where
+    novel families pile onto the build executor and cold requests wait.
+    Warm pools are untouched (the patch sits on
+    ``WarmPool.ensure_compiled``, which only runs at build time)."""
+    from ibamr_tpu.serve.router import WarmPool
+
+    orig = WarmPool.ensure_compiled
+
+    def stormy(self):
+        time.sleep(float(extra_s))
+        return orig(self)
+
+    WarmPool.ensure_compiled = stormy
+    try:
+        yield
+    finally:
+        WarmPool.ensure_compiled = orig
+
+
+@contextlib.contextmanager
+def slow_lane_injector(extra_s: float = 0.25, match=None):
+    """Straggler: every compiled-chunk invocation on pools whose spec
+    satisfies ``match`` (default: all pools) eats a host-side
+    ``extra_s`` sleep first. Scoping ``match`` to the chaos family is
+    how the soak proves a straggling tenant cannot drag a healthy
+    tenant's p99 — slots, not speed, are the shared resource."""
+    from ibamr_tpu.serve.router import WarmPool
+
+    orig = WarmPool.chunk
+
+    def straggler(self, length):
+        ex = orig(self, length)
+        if match is not None and not match(self.spec):
+            return ex
+
+        def slow_exec(*a, **k):
+            time.sleep(float(extra_s))
+            return ex(*a, **k)
+
+        return slow_exec
+
+    WarmPool.chunk = straggler
+    try:
+        yield
+    finally:
+        WarmPool.chunk = orig
+
+
+@contextlib.contextmanager
+def failing_build_injector(n_failures: int = 1,
+                           message: str = "injected build failure"):
+    """The first ``n_failures`` bucket builds raise — the transient
+    compile failure the router's jittered-backoff retry budget exists
+    for. Yields the live countdown list (``[remaining]``) so a drill
+    can assert the faults were actually consumed."""
+    from ibamr_tpu.serve.router import WarmPool
+
+    orig = WarmPool.ensure_compiled
+    remaining = [int(n_failures)]
+    lock = threading.Lock()
+
+    def flaky(self):
+        with lock:
+            fail = remaining[0] > 0
+            if fail:
+                remaining[0] -= 1
+        if fail:
+            raise RuntimeError(message)
+        return orig(self)
+
+    WarmPool.ensure_compiled = flaky
+    try:
+        yield remaining
+    finally:
+        WarmPool.ensure_compiled = orig
+
+
+@contextlib.contextmanager
+def kill_router_thread_injector(n_kills: int = 1):
+    """The first ``n_kills`` pool-build threads DIE without publishing
+    (``_build_pool`` returns before setting the flight event) — the
+    harshest router liveness fault: every waiter on that flight would
+    hang forever if the sliced-wait dead-thread failover did not
+    exist. Yields the live countdown list (``[remaining]``)."""
+    from ibamr_tpu.serve import router as _router
+
+    orig = _router.WarmPoolRouter._build_pool
+    remaining = [int(n_kills)]
+    lock = threading.Lock()
+
+    def killed(self, spec, flight):
+        with lock:
+            kill = remaining[0] > 0
+            if kill:
+                remaining[0] -= 1
+        if kill:
+            return  # thread exits: no pool, no error, no event
+        return orig(self, spec, flight)
+
+    _router.WarmPoolRouter._build_pool = killed
+    try:
+        yield remaining
+    finally:
+        _router.WarmPoolRouter._build_pool = orig
+
+
+def run_soak_smoke(directory: str | None = None,
+                   duration_s: float = 5.0, rate_rps: float = 8.0,
+                   time_scale: float = 0.5,
+                   chaos_rate_rps: float = 3.0) -> dict:
+    """Deterministic traffic-robustness drill (PR 17, dryrun path 21):
+    the open-loop load generator drives a warm-pool router under ALL
+    FOUR serving chaos injectors at once, and the liveness invariants
+    are pinned from the merged ledger.
+
+    1. **healthy traffic, chaos tenant burning** — seeded Poisson
+       arrivals with a 4x burst window over the heavy-tailed
+       interactive/batch mix share the router with a ``chaos``-class
+       tenant whose requests land on NOVEL families (fresh bucket
+       compiles) while a compile storm slows every build, the first
+       build raises (retry fuel), one build thread is killed
+       mid-flight, and the chaos families' lanes straggle;
+    2. **no deadlock** — every producer thread joins inside the
+       drill's bounded window (``hung_threads == 0``);
+    3. **no lost request** — every ``request_admit`` trace_id in the
+       ledger reaches EXACTLY one terminal record (``request`` or
+       ``request_shed``), storm or no storm;
+    4. **bounded shed** — healthy classes shed at most
+       ``max_healthy_shed_rate``; the chaos class may shed freely
+       (that is admission control doing its job, not a failure);
+    5. **healthy p99 within band** — healthy tenants' warm first-step
+       p99 stays inside the committed ``soak_warm_p99_s`` band while
+       the chaos tenant burns.
+
+    Raises on any failed expectation; returns a one-line JSON summary.
+    """
+    from ibamr_tpu import obs as _obs
+    from ibamr_tpu.serve import aot_cache
+    from ibamr_tpu.serve.loadgen import (SOAK_POLICIES, Scenario,
+                                         poisson_burst_schedule,
+                                         run_open_loop, traffic_summary)
+    from ibamr_tpu.serve.router import BucketSpec, WarmPoolRouter
+
+    max_healthy_shed_rate = 0.10
+    healthy_warm_p99_band_s = 2.0
+
+    tmp = None
+    if directory is None:
+        tmp = tempfile.TemporaryDirectory(prefix="ibamr_soak_smoke_")
+        directory = tmp.name
+    try:
+        ledger_path = os.path.join(directory, "soak_ledger.jsonl")
+        spec = BucketSpec(n_cells=8, n_lat=6, n_lon=8, lanes=2,
+                          chunk_steps=2)
+        router = WarmPoolRouter(
+            [spec],
+            cache=aot_cache.ExecutableCache(
+                directory=os.path.join(directory, "cache")),
+            allow_dynamic=True, policies=dict(SOAK_POLICIES))
+
+        with _obs.ledger(ledger_path):
+            with _obs.span("soak_smoke/warm"):
+                router.warm(spec)
+
+            # healthy mix on the pre-warmed family; chaos tenant on
+            # two NOVEL families (distinct n_lon -> fresh builds)
+            arrivals = poisson_burst_schedule(
+                seed=0, duration_s=duration_s, rate_rps=rate_rps,
+                burst_factor=4.0)
+            chaos_mix = (Scenario("chaos/storm_probe", 1.0, "chaos",
+                                  steps=1),)
+            for j, n_lon in enumerate((10, 12)):
+                arrivals += poisson_burst_schedule(
+                    seed=100 + j, duration_s=duration_s,
+                    rate_rps=chaos_rate_rps / 2.0, burst_factor=4.0,
+                    mix=chaos_mix, n_lon=n_lon, tenants_per_class=1)
+            arrivals.sort(key=lambda a: a.t)
+
+            chaos_family = (lambda s: s.n_lon != 8)
+            with _obs.span("soak_smoke/chaos_open_loop",
+                           arrivals=len(arrivals)), \
+                    compile_storm_injector(extra_s=0.2), \
+                    failing_build_injector(n_failures=1) as build_faults, \
+                    kill_router_thread_injector(n_kills=1) as kills, \
+                    slow_lane_injector(extra_s=0.2, match=chaos_family):
+                run = run_open_loop(router, arrivals,
+                                    time_scale=time_scale,
+                                    join_timeout_s=120.0)
+            _obs.chunk_boundary()
+
+        # -- 2. no deadlock ------------------------------------------
+        # deadline-shed chaos requests leave their bucket builds
+        # running; those threads must also terminate (and must do so
+        # before interpreter exit, or teardown aborts the process)
+        still = router.drain_builds(timeout_s=120.0)
+        if still:
+            raise AssertionError(
+                f"{still} pool builds never finished — a build "
+                f"thread is wedged")
+        if run["hung_threads"]:
+            raise AssertionError(
+                f"{run['hung_threads']} producer threads never "
+                f"finished — the router deadlocked under chaos")
+        if run["errors"]:
+            raise AssertionError(
+                f"serve() raised under chaos (every fault must "
+                f"terminate as a shed, not an exception): "
+                f"{run['errors'][:3]}")
+        if build_faults[0] != 0 or kills[0] != 0:
+            raise AssertionError(
+                f"injected faults not consumed: {build_faults[0]} "
+                f"build failures, {kills[0]} kills left — the drill "
+                f"did not exercise what it claims")
+
+        # -- 3. no lost request, from the ledger alone ---------------
+        records = list(_obs.read_ledger(ledger_path))
+        admits = [r["trace_id"] for r in records
+                  if r.get("kind") == "request_admit"]
+        terminals: dict = {}
+        for r in records:
+            if r.get("kind") in ("request", "request_shed"):
+                tid = r.get("trace_id")
+                terminals[tid] = terminals.get(tid, 0) + 1
+        lost = [t for t in admits if terminals.get(t, 0) == 0]
+        doubled = [t for t in admits if terminals.get(t, 0) > 1]
+        if lost:
+            raise AssertionError(
+                f"{len(lost)} admitted requests have NO terminal "
+                f"record (first: {lost[:3]}) — requests were lost")
+        if doubled:
+            raise AssertionError(
+                f"{len(doubled)} admitted requests have multiple "
+                f"terminal records (first: {doubled[:3]})")
+
+        # -- 4. bounded shed for healthy classes ---------------------
+        summary = traffic_summary(run["results"], run["wall_s"])
+        healthy_sub = healthy_shed = 0
+        for cls, c in summary["classes"].items():
+            if cls != "chaos":
+                healthy_sub += c["submitted"]
+                healthy_shed += c["shed"]
+        healthy_rate = (healthy_shed / healthy_sub) if healthy_sub else 0.0
+        if healthy_rate > max_healthy_shed_rate:
+            raise AssertionError(
+                f"healthy classes shed {healthy_rate:.2%} "
+                f"(> {max_healthy_shed_rate:.0%}) — the chaos tenant "
+                f"stole healthy capacity")
+
+        # -- 5. healthy warm p99 within band -------------------------
+        healthy_warm = sorted(
+            r["first_step_s"] for r in records
+            if r.get("kind") == "request"
+            and r.get("tenant_class") in ("interactive", "batch")
+            and not r.get("cold"))
+        if not healthy_warm:
+            raise AssertionError("no healthy warm completions — the "
+                                 "soak never reached the warm path")
+        import math
+        p99 = healthy_warm[min(len(healthy_warm) - 1,
+                               max(0, math.ceil(0.99 * len(healthy_warm))
+                                   - 1))]
+        if p99 > healthy_warm_p99_band_s:
+            raise AssertionError(
+                f"healthy warm p99 {p99:.3f}s blew the "
+                f"{healthy_warm_p99_band_s}s band while the chaos "
+                f"tenant burned")
+
+        chaos = summary["classes"].get("chaos", {})
+        return {"soak_smoke": "ok",
+                "arrivals": len(arrivals),
+                "admitted": len(admits),
+                "lost": 0,
+                "healthy_shed_rate": round(healthy_rate, 4),
+                "chaos_submitted": chaos.get("submitted", 0),
+                "chaos_shed": chaos.get("shed", 0),
+                "chaos_completed": chaos.get("completed", 0),
+                "retried": summary["retried"],
+                "healthy_warm_p99_s": round(float(p99), 4),
+                "hung_threads": 0,
+                "wall_s": round(run["wall_s"], 3)}
+    finally:
+        if tmp is not None:
+            tmp.cleanup()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="deterministic fault-injection drills")
@@ -1560,6 +1874,9 @@ def main(argv=None) -> int:
                     help="run the sharded-checkpoint drill (no-gather "
                          "save, elastic restore, damage inventory, "
                          "collision, supervised rollback, fsck gate)")
+    ap.add_argument("--soak-smoke", action="store_true",
+                    help="run the traffic-robustness soak drill "
+                         "(open-loop load + serving chaos injectors)")
     ap.add_argument("--fleet-smoke", action="store_true",
                     help="run the lane-quarantine fleet drill (vmapped "
                          "ensemble, one poisoned lane, per-lane "
@@ -1604,6 +1921,12 @@ def main(argv=None) -> int:
         jax = force_cpu(1)
         jax.config.update("jax_enable_x64", True)
         print(json.dumps(run_fleet_smoke(args.dir)), flush=True)
+        return 0
+    if args.soak_smoke:
+        # bounded CPU soak — pin the backend before any jax compute
+        from ibamr_tpu.utils.backend_guard import force_cpu
+        force_cpu(1)
+        print(json.dumps(run_soak_smoke(args.dir)), flush=True)
         return 0
     if args.record_capsule:
         record_capsule_drill(args.record_capsule)

@@ -46,6 +46,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
 import time
 
@@ -311,6 +312,104 @@ def render_tuning(snap, records: list) -> list:
     return lines
 
 
+_REASON_RE = re.compile(r'reason="([^"]*)"')
+
+
+def render_traffic(snap, records: list) -> list:
+    """Admission & overload block (PR 17): shed totals by reason,
+    retry totals, reclaimed quarantined slots, queue-wait percentiles
+    from the ``serve_queue_wait_seconds`` histogram, and a per-tenant-
+    class request table joined from the
+    ``request_admit``/``request``/``request_shed``/``request_retry``
+    records. Empty when the run saw no admission-control activity
+    (no sheds, retries, reclaims, or nonzero queue waits) — a plain
+    serving run keeps its summary unchanged."""
+    table = (snap or {}).get("counters") or {}
+    hists = (snap or {}).get("histograms") or {}
+    sheds = [r for r in records if r.get("kind") == "request_shed"]
+    retries = [r for r in records if r.get("kind") == "request_retry"]
+    shed_counters = {k: v for k, v in table.items()
+                     if k.startswith("serve_shed_total")}
+    retry_counters = {k: v for k, v in table.items()
+                      if k.startswith("serve_retries_total")}
+    reclaimed = table.get("serve_slots_reclaimed_total", 0)
+    qsnap = hists.get("serve_queue_wait_seconds")
+    waited = bool(qsnap and qsnap.get("count") and qsnap.get("sum"))
+    if not (sheds or retries or shed_counters or retry_counters
+            or reclaimed or waited):
+        return []
+    lines = []
+    by_reason: dict = {}
+    if shed_counters:
+        for k, v in shed_counters.items():
+            m = _REASON_RE.search(k)
+            by_reason[m.group(1) if m else "?"] = int(v)
+    else:
+        for r in sheds:
+            key = r.get("reason") or "?"
+            by_reason[key] = by_reason.get(key, 0) + 1
+    total_shed = sum(by_reason.values())
+    if total_shed:
+        detail = ", ".join(f"{k}={v}"
+                           for k, v in sorted(by_reason.items()))
+        lines.append(f"  shed: {total_shed} ({detail})")
+    by_retry: dict = {}
+    if retry_counters:
+        for k, v in retry_counters.items():
+            m = _REASON_RE.search(k)
+            by_retry[m.group(1) if m else "?"] = int(v)
+    else:
+        for r in retries:
+            key = r.get("reason") or "?"
+            by_retry[key] = by_retry.get(key, 0) + 1
+    if by_retry:
+        detail = ", ".join(f"{k}={v}"
+                           for k, v in sorted(by_retry.items()))
+        lines.append(f"  retries: {sum(by_retry.values())} ({detail})")
+    if reclaimed:
+        lines.append(f"  quarantined slots reclaimed: "
+                     f"{_fmt_num(reclaimed)}")
+    if qsnap and qsnap.get("count"):
+        p50, p99 = quantiles_from_counts(qsnap["counts"], [0.5, 0.99])
+        lines.append(f"  queue wait: p50 {_fmt_s(p50)}  "
+                     f"p99 {_fmt_s(p99)} "
+                     f"({_fmt_num(qsnap['count'])} admissions)")
+    else:
+        qwaits = sorted(r["queue_wait_s"] for r in records
+                        if r.get("kind") in ("request", "request_shed")
+                        and r.get("queue_wait_s") is not None)
+        if qwaits:
+            import math
+            idx = lambda q: qwaits[min(len(qwaits) - 1,  # noqa: E731
+                                       max(0, math.ceil(q * len(qwaits))
+                                           - 1))]
+            lines.append(f"  queue wait: p50 {_fmt_s(idx(0.5))}  "
+                         f"p99 {_fmt_s(idx(0.99))} "
+                         f"({_fmt_num(len(qwaits))} requests)")
+    classes: dict = {}
+
+    def _cls(r):
+        return classes.setdefault(
+            r.get("tenant_class") or "?",
+            {"admitted": 0, "completed": 0, "shed": 0, "retried": 0})
+
+    for r in records:
+        kind = r.get("kind")
+        if kind == "request_admit":
+            _cls(r)["admitted"] += 1
+        elif kind == "request":
+            _cls(r)["completed"] += 1
+        elif kind == "request_shed":
+            _cls(r)["shed"] += 1
+        elif kind == "request_retry":
+            _cls(r)["retried"] += 1
+    for cls, c in sorted(classes.items()):
+        lines.append(f"  class {cls:<12} admitted={c['admitted']:<5} "
+                     f"completed={c['completed']:<5} "
+                     f"shed={c['shed']:<5} retried={c['retried']}")
+    return lines
+
+
 def render_incidents(records: list, t0=None) -> list:
     lines = []
     for rec in records:
@@ -549,6 +648,11 @@ def cmd_summary(args) -> int:
         print("\ntuning (autotuner + resolver DB):")
         for ln in tuning:
             print(ln)
+    traffic = render_traffic(last_counters(records), records)
+    if traffic:
+        print("\ntraffic (admission & overload):")
+        for ln in traffic:
+            print(ln)
     print("\nincidents:")
     t0 = min(times) if times else None
     for ln in render_incidents(records, t0):
@@ -580,6 +684,18 @@ def _one_line(rec: dict) -> str:
                 f"lane={rec.get('lane')} "
                 f"first_step={_fmt_s(rec.get('first_step_s'))} "
                 f"ok={rec.get('ok')}")
+    if kind == "request_shed":
+        return (f"seq={rec['seq']:<6} shed      "
+                f"tenant={rec.get('tenant')} "
+                f"reason={rec.get('reason')} "
+                f"queue_wait={_fmt_s(rec.get('queue_wait_s'))} "
+                f"retries={rec.get('retries')}")
+    if kind == "request_retry":
+        return (f"seq={rec['seq']:<6} retry     "
+                f"tenant={rec.get('tenant')} "
+                f"attempt={rec.get('attempt')} "
+                f"reason={rec.get('reason')} "
+                f"backoff={_fmt_s(rec.get('backoff_s'))}")
     if kind == "tune_trial":
         return (f"seq={rec['seq']:<6} tune      "
                 f"{rec.get('engine')}/{rec.get('spectral_dtype')}"
@@ -675,15 +791,32 @@ def render_trace(records: list, tid: str) -> list:
                     f"{_fmt_s(rec.get('dur_s'))}")
         elif kind == "request_admit":
             desc = (f"admitted         tenant={rec.get('tenant')} "
-                    f"steps={rec.get('steps')}")
+                    f"steps={rec.get('steps')}"
+                    + (f" class={rec.get('tenant_class')}"
+                       if rec.get("tenant_class") else ""))
         elif kind == "request":
+            qw = rec.get("queue_wait_s")
             desc = (f"completed        "
                     f"{'cold' if rec.get('cold') else 'warm'} "
                     f"ok={rec.get('ok')} lane={rec.get('lane')} "
                     f"first_step={_fmt_s(rec.get('first_step_s'))} "
                     f"total={_fmt_s(rec.get('total_s'))}"
+                    + (f" queue_wait={_fmt_s(qw)}" if qw else "")
+                    + (f" retries={rec.get('retries')}"
+                       if rec.get("retries") else "")
                     + (" QUARANTINED" if rec.get("quarantined")
                        else ""))
+        elif kind == "request_shed":
+            desc = (f"SHED             "
+                    f"reason={rec.get('reason')} "
+                    f"queue_wait={_fmt_s(rec.get('queue_wait_s'))} "
+                    f"retries={rec.get('retries')}"
+                    + (f" error={rec.get('error')}"
+                       if rec.get("error") else ""))
+        elif kind == "request_retry":
+            desc = (f"retry #{rec.get('attempt')}         "
+                    f"reason={rec.get('reason')} "
+                    f"backoff={_fmt_s(rec.get('backoff_s'))}")
         elif kind == "aot_cache":
             desc = (f"aot_cache {rec.get('event'):<7}"
                     f"label={rec.get('label')}"
@@ -703,6 +836,11 @@ def render_trace(records: list, tid: str) -> list:
                    else "quarantined" if done.get("quarantined")
                    else "failed")
         lines.append(f"  verdict: {verdict}")
+    else:
+        shed = next((r for r in matched
+                     if r.get("kind") == "request_shed"), None)
+        if shed is not None:
+            lines.append(f"  verdict: shed ({shed.get('reason')})")
     return lines
 
 

@@ -389,6 +389,36 @@ def main() -> int:
                         g.write(r9b.stdout or "")
                 except subprocess.TimeoutExpired:
                     log(f, "fleet rollup timed out")
+            # tenth step (PR 17): the sustained-traffic soak grid —
+            # open-loop Poisson+burst arrivals over the heavy-tailed
+            # mix, requests/s + shed rate per (rate, duration) cell.
+            # A CPU-child signal like the serve leg (the device run's
+            # health is what gated us here; the soak grid itself is
+            # hermetic), landed next to the bench artifact so traffic
+            # capacity is trended per healthy window.
+            try:
+                r10 = subprocess.run(
+                    [sys.executable, "-c",
+                     "import json; from bench import soak_reference; "
+                     "print(json.dumps(soak_reference()))"],
+                    capture_output=True, text=True, cwd=REPO, env=env,
+                    timeout=args.bench_timeout)
+                tail = ""
+                try:
+                    grid = (json.loads(r10.stdout or "{}")
+                            .get("grid") or [])
+                    if grid:
+                        tail = (f"  cells={len(grid)} "
+                                f"rps={grid[-1].get('requests_per_s')} "
+                                f"shed={grid[-1].get('shed_rate')}")
+                except ValueError:
+                    pass
+                log(f, f"soak grid rc={r10.returncode}{tail}")
+                with open(args.out.replace(".json", "_soak.json"),
+                          "w") as g:
+                    g.write(r10.stdout or "")
+            except subprocess.TimeoutExpired:
+                log(f, "soak grid timed out")
             # fifth step (PR 10): archive each profile capture — the
             # attribution summary is the regression-comparable
             # artifact; the raw multi-MB traces are pruned ONLY after

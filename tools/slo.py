@@ -24,6 +24,25 @@ SLIs (:func:`slis_from_ledger`):
 - ``quarantine_rate`` — quarantined / completed requests;
 - ``cache_hit_ratio`` — executable-cache hits / (hits + misses).
 
+Soak mode (PR 17): ``slo.py check --soak`` runs the bounded
+deterministic CPU soak (``serve.loadgen.soak_drill`` — seeded Poisson
++ burst arrivals over the heavy-tailed mix, open loop, committed
+tenant-class policies) instead of the cold/warm drill, and evaluates
+the SOAK SLIs against the contract's separate ``soak_slos`` section
+(:func:`soak_slis_from_ledger`):
+
+- ``soak_warm_p99_s`` — warm first-step p99 UNDER SUSTAINED TRAFFIC
+  (the single-request drill number, restated with queueing);
+- ``soak_queue_wait_p99_s`` — admission queue-wait p99 from the
+  ``serve_queue_wait_seconds`` histogram;
+- ``soak_shed_rate`` — shed / admitted;
+- ``soak_lost_requests`` — admitted trace_ids with no terminal
+  ``request``/``request_shed`` record (the no-lost-request liveness
+  invariant; budgeted at exactly 0).
+
+``--soak --tighten`` merges a fresh ``soak_slos`` section into the
+existing contract without touching the cold/warm ``slos``.
+
 Exit convention (the ``graph_audit`` family, with one deliberate
 difference): **headroom under a ceiling is attainment, not drift** —
 a warm p99 far below budget is the system working, so it exits 0, not
@@ -56,6 +75,12 @@ SLI_NAMES = CEILINGS + FLOORS
 
 _WARM_FIRST_KEY = 'serve_first_step_seconds{path="warm"}'
 _PADFRAC_KEY = "serve_padding_fraction"
+
+# soak SLIs (PR 17): all ceilings, evaluated against the contract's
+# separate "soak_slos" section so the cold/warm check stays untouched
+SOAK_SLI_NAMES = ("soak_warm_p99_s", "soak_queue_wait_p99_s",
+                  "soak_shed_rate", "soak_lost_requests")
+_QWAIT_KEY = "serve_queue_wait_seconds"
 
 
 def _last_histograms(records) -> dict:
@@ -160,6 +185,55 @@ def slis_from_drill(drill: dict) -> dict:
     return slis
 
 
+def soak_slis_from_ledger(records) -> dict:
+    """Soak SLIs from a traffic ledger (``soak_drill`` with a ledger
+    attached, or any production ledger). Absent SLIs are ``None``."""
+    from ibamr_tpu.obs.bus import quantiles_from_counts
+
+    records = list(records)
+    requests = [r for r in records if r.get("kind") == "request"]
+    sheds = [r for r in records if r.get("kind") == "request_shed"]
+    admits = [r for r in records if r.get("kind") == "request_admit"]
+    warm = [r for r in requests if not r.get("cold")]
+    hists = _last_histograms(records)
+
+    slis: dict = {name: None for name in SOAK_SLI_NAMES}
+
+    snap = hists.get(_WARM_FIRST_KEY)
+    if snap and snap.get("count"):
+        slis["soak_warm_p99_s"] = quantiles_from_counts(
+            snap["counts"], [0.99])[0]
+    elif warm:
+        slis["soak_warm_p99_s"] = _empirical_quantile(
+            [r["first_step_s"] for r in warm
+             if r.get("first_step_s") is not None], 0.99)
+
+    snap = hists.get(_QWAIT_KEY)
+    if snap and snap.get("count"):
+        slis["soak_queue_wait_p99_s"] = quantiles_from_counts(
+            snap["counts"], [0.99])[0]
+    else:
+        qwaits = [r["queue_wait_s"] for r in requests + sheds
+                  if r.get("queue_wait_s") is not None]
+        if qwaits:
+            slis["soak_queue_wait_p99_s"] = _empirical_quantile(
+                qwaits, 0.99)
+
+    terminal = len(requests) + len(sheds)
+    if terminal:
+        slis["soak_shed_rate"] = len(sheds) / terminal
+
+    # the liveness invariant, from the ledger alone: every admitted
+    # trace_id must reach a terminal record
+    if admits:
+        done = {r.get("trace_id") for r in requests + sheds
+                if r.get("trace_id")}
+        slis["soak_lost_requests"] = sum(
+            1 for a in admits
+            if a.get("trace_id") and a["trace_id"] not in done)
+    return slis
+
+
 def load_contract(path: str = CONTRACT_PATH) -> dict:
     with open(path) as f:
         doc = json.load(f)
@@ -258,7 +332,66 @@ def run_drill_ledger(args, ledger_path: str) -> dict:
     return out
 
 
+def run_soak_ledger(args, ledger_path: str) -> dict:
+    """Run the bounded open-loop soak with a fresh attached ledger
+    and flush the metric registry into it; returns the traffic
+    summary."""
+    if args.backend == "device":
+        from ibamr_tpu.utils.backend_guard import init_backend_with_retry
+        _jax, _platform, err = init_backend_with_retry(retries=1,
+                                                       delay=2.0)
+        if err:
+            print(f"[slo] backend init degraded: {err}",
+                  file=sys.stderr)
+    else:
+        from ibamr_tpu.utils.backend_guard import force_cpu
+        force_cpu()
+    from ibamr_tpu import obs as _obs
+    from ibamr_tpu.serve.loadgen import soak_drill
+
+    with _obs.ledger(ledger_path):
+        out = soak_drill(seed=args.soak_seed,
+                         duration_s=args.soak_duration,
+                         rate_rps=args.soak_rate,
+                         burst_factor=args.soak_burst,
+                         n_cells=args.n, n_lat=args.n_lat,
+                         n_lon=args.n_lon, lanes=args.lanes,
+                         time_scale=args.soak_time_scale)
+        _obs.chunk_boundary()
+    return out
+
+
+def tighten_soak(slis: dict, soak_cfg: dict, contract_path: str):
+    """Merge a fresh ``soak_slos`` section (plus the soak drill cfg)
+    into the existing contract, leaving the cold/warm ``slos``
+    untouched. Latency ceilings get 2x slack (floored at 0.5 s), the
+    shed-rate ceiling +0.2; lost requests pin EXACTLY (zero is the
+    invariant, not a budget)."""
+    soak_slos = {}
+    if slis.get("soak_warm_p99_s") is not None:
+        soak_slos["soak_warm_p99_s"] = {"ceiling": round(
+            max(2.0 * slis["soak_warm_p99_s"], 0.5), 4)}
+    if slis.get("soak_queue_wait_p99_s") is not None:
+        soak_slos["soak_queue_wait_p99_s"] = {"ceiling": round(
+            max(2.0 * slis["soak_queue_wait_p99_s"], 0.5), 4)}
+    if slis.get("soak_shed_rate") is not None:
+        soak_slos["soak_shed_rate"] = {"ceiling": round(
+            min(slis["soak_shed_rate"] + 0.2, 1.0), 4)}
+    if slis.get("soak_lost_requests") is not None:
+        soak_slos["soak_lost_requests"] = {
+            "ceiling": int(slis["soak_lost_requests"])}
+    try:
+        doc = load_contract(contract_path)
+    except FileNotFoundError:
+        doc = {"slo_schema": SLO_SCHEMA, "slos": {}}
+    doc["soak"] = soak_cfg
+    doc["soak_slos"] = soak_slos
+    return doc
+
+
 def cmd_check(args) -> int:
+    if getattr(args, "soak", False):
+        return _check_soak(args)
     if args.ledger:
         from ibamr_tpu.obs.bus import read_ledger
         slis = slis_from_ledger(read_ledger(args.ledger))
@@ -324,6 +457,71 @@ def cmd_check(args) -> int:
     return rc
 
 
+def _check_soak(args) -> int:
+    """The ``check --soak`` path: soak SLIs vs the contract's
+    ``soak_slos`` section, same exit convention as the cold/warm
+    check."""
+    from ibamr_tpu.obs.bus import read_ledger
+
+    if args.ledger:
+        records = read_ledger(args.ledger)
+        soak_cfg = {"source": args.ledger}
+    else:
+        with tempfile.TemporaryDirectory(prefix="slo-soak-") as td:
+            lp = os.path.join(td, "ledger.jsonl")
+            run_soak_ledger(args, lp)
+            records = read_ledger(lp)
+        soak_cfg = {"seed": args.soak_seed,
+                    "duration_s": args.soak_duration,
+                    "rate_rps": args.soak_rate,
+                    "burst_factor": args.soak_burst,
+                    "time_scale": args.soak_time_scale,
+                    "n": args.n, "n_lat": args.n_lat,
+                    "n_lon": args.n_lon, "lanes": args.lanes}
+    slis = soak_slis_from_ledger(records)
+
+    if args.tighten:
+        doc = tighten_soak(slis, soak_cfg, args.contract)
+        with open(args.contract, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"[slo] wrote {args.contract} (soak_slos)")
+        return 0
+
+    try:
+        contract = load_contract(args.contract)
+    except FileNotFoundError:
+        contract = None
+    budget = (contract or {}).get("soak_slos")
+    if not budget:
+        violations, unmeasurable, met = [], [], []
+    else:
+        violations, unmeasurable, met = evaluate(slis, {"slos": budget})
+    unbudgeted = not budget
+    rc = (2 if violations
+          else 1 if unmeasurable or unbudgeted
+          else 0)
+    if args.as_json:
+        print(json.dumps({
+            "exit": rc, "slis": slis,
+            "violated": violations, "unmeasurable": unmeasurable,
+            "met": met, "unbudgeted": unbudgeted},
+            indent=1, sort_keys=True))
+        return rc
+    for line in violations + unmeasurable + met:
+        print(f"[slo] {line}")
+    if unbudgeted:
+        print(f"[slo] no soak_slos in {args.contract} — run "
+              f"--soak --tighten to pin")
+    verdict = {0: "clean — every soak SLO attained",
+               1: "unevaluable — missing soak_slos or SLI (run "
+                  "--soak --tighten to pin)",
+               2: "VIOLATED — the serving path is out of SLO under "
+                  "sustained traffic"}[rc]
+    print(f"[slo] {verdict}")
+    return rc
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="serving-path SLO gate: evaluate a ledger (or a "
@@ -353,6 +551,20 @@ def main(argv=None) -> int:
     c.add_argument("--engine", type=str, default="",
                    help="engine name ('' = auto via the resolver)")
     c.add_argument("--warm-requests", type=int, default=8)
+    c.add_argument("--soak", action="store_true",
+                   help="run the bounded open-loop soak instead of "
+                        "the cold/warm drill and evaluate the "
+                        "soak_slos section")
+    c.add_argument("--soak-duration", type=float, default=6.0,
+                   help="virtual seconds of arrivals in the soak")
+    c.add_argument("--soak-rate", type=float, default=6.0,
+                   help="base arrival rate (requests per virtual s)")
+    c.add_argument("--soak-seed", type=int, default=0)
+    c.add_argument("--soak-burst", type=float, default=4.0,
+                   help="rate multiplier inside the burst window")
+    c.add_argument("--soak-time-scale", type=float, default=0.5,
+                   help="wall seconds per virtual second (0.5 = "
+                        "replay the schedule at 2x speed)")
     c.add_argument("--tighten", action="store_true",
                    help="rewrite the contract from the measured SLIs "
                         "(with slack on latency/ratio budgets)")
