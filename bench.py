@@ -432,6 +432,51 @@ def soak_reference(timeout_s: float = 300.0,
         timeout_s, f"soak leg hung > {timeout_s:.0f}s", "soak")
 
 
+def _elastic_child(q, duration_s, rate_rps, shift_frac):
+    """Child body: the elastic warm-pool drill (mix shift + memory
+    pressure + crash-safe restart) on a single virtual CPU device;
+    the drill's own pinned invariants raise inside the child and
+    surface as the leg's error string."""
+    try:
+        import sys as _sys
+        _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from ibamr_tpu.utils.backend_guard import force_cpu
+
+        force_cpu(1)
+        from tools.fault_injection import run_elastic_smoke
+
+        out = run_elastic_smoke(duration_s=duration_s,
+                                rate_rps=rate_rps,
+                                shift_frac=shift_frac)
+        q.put({"duration_s": duration_s, "rate_rps": rate_rps,
+               "shift_frac": shift_frac,
+               "scale_up_s": out["scale_up_s"],
+               "restart_warm_s": out["restart_warm_s"],
+               "restart_fresh_compiles":
+                   out["restart_fresh_compiles"],
+               "mode_transitions": out["mode_transitions"],
+               "grows": out["grows"], "shrinks": out["shrinks"],
+               "shed": out["shed"], "lost": out["lost"],
+               "predicted_rps": out["predicted_rps"],
+               "measured_rps": out["measured_rps"]})
+    except Exception as e:  # noqa: BLE001 - report, parent decides
+        q.put({"error": f"{type(e).__name__}: {e}"})
+
+
+def elastic_reference(timeout_s: float = 300.0,
+                      duration_s: float = 5.0, rate_rps: float = 8.0,
+                      shift_frac: float = 0.4):
+    """Elasticity signal (PR 18): scale-up latency, restart-to-warm
+    time, fresh restart compiles (must stay 0), and the capacity
+    model's predicted-vs-measured rps from the elastic warm-pool
+    drill in a TERMINABLE child — trended across rounds next to the
+    soak leg so a scaling or restart regression shows up as a number,
+    not an incident."""
+    return _run_guarded_child(
+        _elastic_child, (duration_s, rate_rps, shift_frac),
+        timeout_s, f"elastic leg hung > {timeout_s:.0f}s", "elastic")
+
+
 def cpu_sharded_reference_with_trend(n_devices: int = 8):
     """The n=32 smoke leg PLUS a larger n=48 leg, with the
     speedup-vs-size trend (round 5, VERDICT round 4 weak #3: the
@@ -872,6 +917,11 @@ def main():
                     help="also run the open-loop Poisson+burst soak "
                          "grid (arrival rate x duration) in a CPU "
                          "child and trend requests/s + shed rate")
+    ap.add_argument("--elastic", action="store_true",
+                    help="also run the elastic warm-pool drill (mix "
+                         "shift + memory pressure + restart) in a "
+                         "CPU child and trend scale-up/restart "
+                         "latency")
     ap.add_argument("--record", type=str, default="",
                     help="arm a flight recorder on every ramp stage; a "
                          "diverged stage dumps a replay capsule under "
@@ -1316,6 +1366,23 @@ def main():
                 log(f"[bench] soak: {result['soak']}")
             except Exception as e:
                 result["soak"] = {"error": f"{type(e).__name__}: {e}"}
+
+        # elasticity leg (PR 18): the mix-shift + restart drill in a
+        # CPU child, trending scale-up/restart latency per round
+        if args.elastic:
+            try:
+                remaining = (args.deadline
+                             - (time.perf_counter() - t_start))
+                if remaining < 30.0:
+                    result["elastic"] = {
+                        "error": "skipped (deadline exhausted)"}
+                else:
+                    result["elastic"] = elastic_reference(
+                        timeout_s=min(300.0, remaining))
+                log(f"[bench] elastic: {result['elastic']}")
+            except Exception as e:
+                result["elastic"] = {
+                    "error": f"{type(e).__name__}: {e}"}
 
         if errors:
             msg = "; ".join(errors)

@@ -3,5 +3,9 @@
 ``aot_cache`` generalizes the SpectralPlan hash-cons
 (solvers/spectral_plan.py:get_plan) from FFT symbol tables to whole
 compiled executables; ``router`` packs scenario requests into
-pre-compiled fleet-lane buckets on top of it. See docs/SERVING.md.
+pre-compiled fleet-lane buckets on top of it; ``loadgen`` drives the
+router with deterministic open-loop traffic; ``autoscale`` closes the
+loop from observed traffic to warm capacity (elastic pools, brownout
+degradation, crash-safe restart) and ``capacity`` predicts what that
+loop can sustain. See docs/SERVING.md.
 """

@@ -68,6 +68,12 @@ _obs.describe("aot_cache_misses_total",
 _obs.describe("aot_cache_build_seconds",
               "Executable build wall time on a miss, by "
               "source=compile|persistent.")
+_obs.describe("aot_cache_bytes",
+              "Estimated bytes of compiled code held by the "
+              "executable cache (the brownout watermark input).")
+_obs.describe("aot_cache_released_total",
+              "Entries explicitly released (elastic pool shrink), "
+              "distinct from LRU/bytes-ceiling evictions.")
 
 # fingerprint fields that determine the compiled executable — the
 # "scenario family". Everything else in the fingerprint (rng keys,
@@ -137,6 +143,25 @@ def enable_persistent_cache(jax=None, directory: Optional[str] = None,
         return None
 
 
+def estimate_executable_bytes(executable) -> int:
+    """Best-effort compiled-size estimate for the bytes watermark:
+    XLA's ``memory_analysis`` generated-code size when the backend
+    exposes it, else the serialized HLO text length (a stable proxy —
+    bigger graphs compile to more code). 0 only when the executable
+    exposes neither; the watermark degrades to count-only LRU then."""
+    try:
+        ma = executable.memory_analysis()
+        size = int(getattr(ma, "generated_code_size_in_bytes", 0) or 0)
+        if size > 0:
+            return size
+    except Exception:  # noqa: BLE001 - estimate, never fatal
+        pass
+    try:
+        return len(executable.as_text())
+    except Exception:  # noqa: BLE001
+        return 0
+
+
 @dataclass
 class CacheEntry:
     """One cached executable + its accounting record."""
@@ -150,6 +175,8 @@ class CacheEntry:
     # "compile" = true cold build; "persistent" = a valid manifest
     # pre-existed, so XLA's disk cache served the backend compile
     cold_source: str = "compile"
+    # estimated compiled-code bytes (the aot_cache_bytes watermark)
+    size_bytes: int = 0
 
 
 class _InFlight:
@@ -171,19 +198,25 @@ class ExecutableCache:
     in-flight latch and shares the published entry."""
 
     def __init__(self, capacity: int = _DEFAULT_CAPACITY,
-                 directory: Optional[str] = None):
+                 directory: Optional[str] = None,
+                 max_bytes: Optional[int] = None):
         if capacity < 1:
             raise ValueError(
                 f"ExecutableCache.capacity must be >= 1, got {capacity!r}")
         self.capacity = int(capacity)
         self.directory = directory
+        # optional bytes ceiling on ESTIMATED compiled size: evicts
+        # LRU-first until under, on top of the count LRU. None (the
+        # default) preserves count-only behavior exactly.
+        self.max_bytes = None if max_bytes is None else int(max_bytes)
         if directory:
             os.makedirs(directory, exist_ok=True)
         self._entries: "OrderedDict[str, CacheEntry]" = OrderedDict()
         self._inflight: dict = {}
         self._lock = threading.Lock()
         self._stats = {"hits": 0, "misses": 0, "evictions": 0,
-                       "corrupt": 0, "inflight_waits": 0}
+                       "corrupt": 0, "inflight_waits": 0,
+                       "released": 0, "bytes": 0}
 
     # -- introspection ------------------------------------------------------
 
@@ -204,6 +237,60 @@ class ExecutableCache:
         with self._lock:
             return self._entries.get(key)
 
+    def bytes(self) -> int:
+        """Estimated bytes of compiled code currently held."""
+        with self._lock:
+            return int(self._stats["bytes"])
+
+    def release(self, keys) -> int:
+        """Explicitly drop entries (elastic pool shrink): counted as
+        ``released``, not evictions, so the LRU-pressure signal stays
+        honest. Returns how many entries were actually held."""
+        dropped = 0
+        with self._lock:
+            for key in ([keys] if isinstance(keys, str) else keys):
+                ent = self._entries.pop(key, None)
+                if ent is None:
+                    continue
+                dropped += 1
+                self._stats["released"] += 1
+                self._stats["bytes"] = max(
+                    0, self._stats["bytes"] - ent.size_bytes)
+                _obs.counter("aot_cache_released_total").inc()
+                _obs.emit("aot_cache", event="release", key=key,
+                          label=ent.label)
+            self._set_bytes_gauge_locked()
+        return dropped
+
+    def set_max_bytes(self, max_bytes: Optional[int]) -> int:
+        """Adjust the bytes ceiling at runtime (the memory-pressure
+        injector's seam) and evict LRU-first until under it. Returns
+        how many entries were evicted by the squeeze."""
+        with self._lock:
+            self.max_bytes = (None if max_bytes is None
+                              else int(max_bytes))
+            return self._evict_over_limits_locked()
+
+    def _evict_over_limits_locked(self) -> int:
+        evicted = 0
+        while self._entries and (
+                len(self._entries) > self.capacity
+                or (self.max_bytes is not None
+                    and self._stats["bytes"] > self.max_bytes)):
+            old_key, old = self._entries.popitem(last=False)
+            self._stats["evictions"] += 1
+            self._stats["bytes"] = max(
+                0, self._stats["bytes"] - old.size_bytes)
+            evicted += 1
+            _EVICTS.inc()
+            _obs.emit("aot_cache", event="evict", key=old_key,
+                      label=old.label)
+        self._set_bytes_gauge_locked()
+        return evicted
+
+    def _set_bytes_gauge_locked(self) -> None:
+        _obs.gauge("aot_cache_bytes").set(float(self._stats["bytes"]))
+
     def clear(self) -> None:
         """Drop every entry and zero the stats (tests; manifests on
         disk are left alone — they describe the persistent layer)."""
@@ -212,6 +299,7 @@ class ExecutableCache:
             self._inflight.clear()
             for k in self._stats:
                 self._stats[k] = 0
+            self._set_bytes_gauge_locked()
 
     # -- the hash-cons ------------------------------------------------------
 
@@ -267,24 +355,22 @@ class ExecutableCache:
             fingerprint=(canonicalize(fingerprint)
                          if isinstance(fingerprint, dict) else {}),
             compile_s=compile_s, label=label, built_at=time.time(),
-            cold_source="persistent" if manifest else "compile")
+            cold_source="persistent" if manifest else "compile",
+            size_bytes=estimate_executable_bytes(executable))
         with self._lock:
             self._entries[key] = entry
             self._entries.move_to_end(key)
             self._stats["misses"] += 1
-            while len(self._entries) > self.capacity:
-                old_key, old = self._entries.popitem(last=False)
-                self._stats["evictions"] += 1
-                _EVICTS.inc()
-                _obs.emit("aot_cache", event="evict", key=old_key,
-                          label=old.label)
+            self._stats["bytes"] += entry.size_bytes
+            self._evict_over_limits_locked()
             flight.entry = entry
             self._inflight.pop(key, None)
         _MISSES.inc()
         _H_BUILD[entry.cold_source].observe(compile_s)
         _obs.emit("aot_cache", event="miss", key=key, label=label,
                   compile_s=round(compile_s, 3),
-                  cold_source=entry.cold_source)
+                  cold_source=entry.cold_source,
+                  size_bytes=entry.size_bytes)
         self._write_manifest(entry)
         flight.event.set()
         return entry

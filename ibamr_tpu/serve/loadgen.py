@@ -55,13 +55,21 @@ from ibamr_tpu.serve.router import (BucketSpec, ScenarioRequest,
 class Scenario:
     """One entry of the load mix: a named request template with a
     sampling weight. ``name`` references the example-driver scale the
-    entry is modeled on; ``steps`` carries the heavy tail."""
+    entry is modeled on; ``steps`` carries the heavy tail.
+
+    ``family`` (PR 18) optionally overrides the bucket-family fields
+    of generated requests (any of ``n_cells``/``n_lat``/``n_lon``/
+    ``engine``/``spectral_dtype``/``mu`` as a mapping) — the
+    mix-shift soak routes part of the mix onto families the router
+    has never compiled. ``None`` (the default) keeps the schedule's
+    single shared family exactly as before."""
     name: str
     weight: float
     tenant_class: str
     steps: int
     dt: float = 5e-5
     deadline_s: Optional[float] = None
+    family: Optional[tuple] = None      # (("n_lon", 12), ...) mapping
 
 
 # Heavy-tailed mix (weights sum to 1): ~80% short interactive probes,
@@ -93,14 +101,33 @@ def poisson_burst_schedule(seed: int, duration_s: float,
                            n_cells: int = 8, n_lat: int = 6,
                            n_lon: int = 8,
                            tenants_per_class: int = 2,
-                           tenant_prefix: str = "") -> list:
+                           tenant_prefix: str = "",
+                           mix_schedule: Optional[Sequence] = None) -> list:
     """Seeded Poisson arrivals over ``[0, duration_s)`` virtual
     seconds at ``rate_rps``, multiplied by ``burst_factor`` inside the
     burst window (``[start_frac, start_frac + len_frac) * duration``).
-    Deterministic: a pure function of the arguments."""
+    Deterministic: a pure function of the arguments.
+
+    ``mix_schedule`` (PR 18) makes the mix PIECEWISE in virtual time:
+    a sequence of ``(start_frac, mix)`` pairs, each active from
+    ``start_frac * duration_s`` until the next — the mix-shift soak
+    rotates arrivals onto unseen families mid-run this way. ``None``
+    (the default) uses ``mix`` throughout, and the rng draw sequence
+    is unchanged: single-mix schedules replay bit-for-bit against
+    pre-PR-18 seeds."""
     rng = np.random.default_rng(int(seed))
-    weights = np.asarray([s.weight for s in mix], dtype=float)
-    weights = weights / weights.sum()
+    if mix_schedule is None:
+        segments = [(0.0, tuple(mix))]
+    else:
+        segments = sorted(((float(f), tuple(m))
+                           for f, m in mix_schedule),
+                          key=lambda seg: seg[0])
+        if not segments or segments[0][0] > 0.0:
+            segments.insert(0, (0.0, tuple(mix)))
+    seg_weights = []
+    for _, m in segments:
+        w = np.asarray([s.weight for s in m], dtype=float)
+        seg_weights.append(w / w.sum())
     b0 = burst_start_frac * duration_s
     b1 = b0 + burst_len_frac * duration_s
     arrivals: list = []
@@ -111,14 +138,26 @@ def poisson_burst_schedule(seed: int, duration_s: float,
         t += float(rng.exponential(1.0 / max(rate, 1e-9)))
         if t >= duration_s:
             break
-        sc = mix[int(rng.choice(len(mix), p=weights))]
+        active = 0
+        for si, (frac, _) in enumerate(segments):
+            if t >= frac * duration_s:
+                active = si
+        seg_mix, weights = segments[active][1], seg_weights[active]
+        sc = seg_mix[int(rng.choice(len(seg_mix), p=weights))]
+        fam = dict(sc.family) if sc.family else {}
         tenant = (f"{tenant_prefix}{sc.tenant_class}"
                   f"-{k % max(tenants_per_class, 1)}")
         arrivals.append(Arrival(
             t=t, scenario=sc.name,
             request=ScenarioRequest(
-                tenant=tenant, n_cells=n_cells, n_lat=n_lat,
-                n_lon=n_lon, steps=sc.steps, dt=sc.dt,
+                tenant=tenant,
+                n_cells=fam.get("n_cells", n_cells),
+                n_lat=fam.get("n_lat", n_lat),
+                n_lon=fam.get("n_lon", n_lon),
+                steps=sc.steps, dt=sc.dt,
+                engine=fam.get("engine"),
+                spectral_dtype=fam.get("spectral_dtype"),
+                mu=fam.get("mu", 0.05),
                 tenant_class=sc.tenant_class,
                 deadline_s=sc.deadline_s)))
         k += 1

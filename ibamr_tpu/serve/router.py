@@ -50,6 +50,16 @@ admitted ``trace_id`` reaches exactly one terminal record kind
 (``request`` or ``request_shed``) even when ``serve`` raises: the
 no-lost-request invariant the soak drill
 (``tools.fault_injection.run_soak_smoke``) pins.
+
+Elastic pools (PR 18, docs/SERVING.md "Elastic pools & brownout"):
+an optional :class:`~ibamr_tpu.serve.autoscale.ElasticPoolManager`
+attaches as ``router.manager`` and closes the loop from the admit
+stream to warm capacity — grow pre-compiles hot families async (the
+family is routable only once warm), shrink releases cold pools via
+:meth:`WarmPoolRouter.release_pool` (never a family with a batch in
+flight — ``family_inflight``), and the brownout mode ladder caps
+batch cruise chunks to the compiled length-1 ack and sheds batch
+tenants pre-admission with ``shed_reason="brownout"``.
 """
 
 from __future__ import annotations
@@ -103,7 +113,7 @@ _obs.describe("serve_requests_completed",
 _obs.describe("serve_shed_total",
               "Requests shed by admission control, by reason="
               "queue_full|queue_timeout|deadline_exceeded|"
-              "build_failed|no_bucket|router_error.")
+              "build_failed|no_bucket|router_error|brownout.")
 _obs.describe("serve_queue_wait_seconds",
               "Admission-queue wait per request (0 for immediate "
               "admission).")
@@ -375,6 +385,19 @@ class WarmPool:
                    f"x{self.spec.lanes}:len{length}"))
         return entry.executable
 
+    def entry_keys(self) -> list:
+        """The cache keys of this pool's ack/cruise chunks, computed
+        WITHOUT compiling — the elastic shrink path releases exactly
+        these from the shared cache (``router.release_pool``)."""
+        sig = aot_cache.arg_signature(
+            self._template_args(live=self.spec.lanes))
+        return [aot_cache.cache_key(
+                    self.fingerprint,
+                    extra={"kind": "fleet_chunk",
+                           "lanes": self.spec.lanes,
+                           "length": length, "args": sig})
+                for length in sorted({1, self.spec.chunk_steps})]
+
     def request_state(self, req: ScenarioRequest):
         """Template state with the request's perturbation applied: a
         per-component constant velocity offset (divergence-free) —
@@ -415,6 +438,7 @@ class WarmPoolRouter:
         self._specs = list(buckets)
         self._pools: dict = {}
         self._inflight: dict = {}
+        self._serving: dict = {}       # family -> batches in flight
         self._lock = threading.Lock()
         self.allow_dynamic = allow_dynamic
         self.default_lanes = int(default_lanes)
@@ -422,12 +446,57 @@ class WarmPoolRouter:
         # policy is permissive (huge slots, no deadline, no retries)
         # so a router built without policies behaves exactly as before
         self.admission = AdmissionController(policies, default_policy)
+        # optional elastic pool manager (PR 18): observes admissions,
+        # sheds batch tenants in shed_batch mode, caps batch cruise
+        # chunks in brownout. None = pre-PR-18 behavior exactly.
+        self.manager = None
 
     # -- pool lifecycle -----------------------------------------------------
 
     def is_warm(self, spec: BucketSpec) -> bool:
         with self._lock:
             return spec in self._pools
+
+    def live_specs(self) -> list:
+        """Specs with a published warm pool (routable families)."""
+        with self._lock:
+            return list(self._pools)
+
+    def live_families(self) -> dict:
+        """family tuple -> BucketSpec for every warm pool."""
+        with self._lock:
+            return {s.family(): s for s in self._pools}
+
+    def build_backlog(self) -> int:
+        """Async pool builds currently in flight (the precompile
+        backlog leg of the elastic manager's pressure signal)."""
+        with self._lock:
+            return len(self._inflight)
+
+    def family_inflight(self, family) -> int:
+        """Batches of ``family`` currently being served — the elastic
+        manager's never-evict-active guard reads this."""
+        with self._lock:
+            return self._serving.get(family, 0)
+
+    def release_pool(self, spec: BucketSpec) -> int:
+        """Evict a warm pool (elastic shrink): the family stops being
+        routable, its spec leaves the declared set, and its compiled
+        ack/cruise executables are released from the shared cache.
+        Returns how many cache entries were released. A family mid-
+        serve must not be released — the manager checks
+        :meth:`family_inflight` first (a released pool under a live
+        batch would not crash, but the batch's next chunk would pay a
+        fresh compile)."""
+        with self._lock:
+            pool = self._pools.pop(spec, None)
+            try:
+                self._specs.remove(spec)
+            except ValueError:
+                pass
+        if pool is None:
+            return 0
+        return self.cache.release(pool.entry_keys())
 
     def drain_builds(self, timeout_s: float = 60.0) -> int:
         """Join any in-flight pool-build threads (bounded); returns
@@ -590,6 +659,16 @@ class WarmPoolRouter:
             _obs.emit("request_admit", trace_id=tid, tenant=r.tenant,
                       tenant_class=r.tenant_class,
                       family=str(r.family()), steps=int(r.steps))
+        mgr = self.manager
+        if mgr is not None:
+            # elastic observation (PR 18): fold arrivals into the mix
+            # estimate + run a scaling/mode tick. A manager bug must
+            # degrade to static routing, never down the router.
+            for r, tid in zip(requests, tids):
+                try:
+                    mgr.observe_admit(r, trace_id=tid)
+                except Exception:  # noqa: BLE001 - degrade, don't die
+                    _obs.counter("serve_manager_errors_total").inc()
         results: list = [None] * len(requests)
         try:
             groups: dict = {}
@@ -675,7 +754,14 @@ class WarmPoolRouter:
         out: list = [None] * len(batch)
         admitted: list = []
         qwaits: dict = {}
+        mgr = self.manager
         for j, (i, r) in enumerate(batch):
+            if mgr is not None and mgr.should_shed(r.tenant_class):
+                # mode-driven shed (PR 18): shed_batch drops batch
+                # tenants BEFORE they take a slot, so interactive p99
+                # rides the capacity brownout protects
+                out[j] = self._shed(r, tids[i], "brownout", 0.0)
+                continue
             ok, wait_s, reason = self.admission.admit(
                 r.tenant_class, self._deadline_left(r, t_admit))
             if ok:
@@ -768,6 +854,30 @@ class WarmPoolRouter:
                      qwaits: Sequence[float] = (),
                      attempt: int = 0,
                      deadline_lefts: Sequence[Optional[float]] = ()):
+        """Serving-count bookkeeping around :meth:`_serve_batch_run`:
+        while a family has a batch in flight the elastic manager's
+        shrink path must not release its pool
+        (``family_inflight`` — the never-evict-active guard)."""
+        family = spec.family()
+        with self._lock:
+            self._serving[family] = self._serving.get(family, 0) + 1
+        try:
+            return self._serve_batch_run(spec, reqs, tids, qwaits,
+                                         attempt, deadline_lefts)
+        finally:
+            with self._lock:
+                n = self._serving.get(family, 1) - 1
+                if n <= 0:
+                    self._serving.pop(family, None)
+                else:
+                    self._serving[family] = n
+
+    def _serve_batch_run(self, spec: BucketSpec,
+                         reqs: Sequence[ScenarioRequest],
+                         tids: Sequence[Optional[str]] = (),
+                         qwaits: Sequence[float] = (),
+                         attempt: int = 0,
+                         deadline_lefts: Sequence[Optional[float]] = ()):
         import jax.numpy as jnp
 
         tids = list(tids) or [None] * len(reqs)
@@ -845,6 +955,15 @@ class WarmPoolRouter:
                           and int(remaining[live].max())
                           >= spec.chunk_steps
                           else 1)
+                # brownout cruise cap (PR 18): an all-batch batch is
+                # degraded to the already-compiled length-1 ack chunk
+                # — reduced throughput, still zero fresh compiles
+                mgr = self.manager
+                if mgr is not None and length > 1:
+                    cap = mgr.cruise_cap(
+                        [r.tenant_class for r in sreqs])
+                    if cap is not None:
+                        length = min(length, cap)
                 run_mask = live & (remaining >= length)
                 with _obs.span("ack" if first_step_s is None
                                else "cruise", steps=length):

@@ -261,6 +261,30 @@ class RunWatchdog:
                 None if queued is None else int(queued))
             payload["requests_shed"] = (
                 None if shed is None else int(shed))
+        # elastic-pool gauges (PR 18): live families, precompile
+        # backlog, and the brownout mode ladder position — a router
+        # stuck in shed_batch or leaking pools is visible to the same
+        # external poll; peek-only, so solo runs never grow these keys
+        try:
+            fams = _bus.peek_gauge("serve_families_live")
+            building = _bus.peek_gauge("serve_precompiles_inflight")
+            mode = _bus.peek_gauge("serve_mode")
+        except Exception:
+            fams = building = mode = None
+        if fams is not None or building is not None \
+                or mode is not None:
+            payload["families_live"] = (
+                None if fams is None else int(fams))
+            payload["precompiles_inflight"] = (
+                None if building is None else int(building))
+            try:
+                from ibamr_tpu.serve.autoscale import MODES
+            except Exception:
+                MODES = ()
+            payload["serve_mode"] = (
+                None if mode is None
+                else MODES[int(mode)] if 0 <= int(mode) < len(MODES)
+                else int(mode))
         return payload
 
     # -- detector -----------------------------------------------------------

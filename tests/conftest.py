@@ -263,6 +263,13 @@ SLOW_TESTS = {
     # --soak` and dryrun path 21)
     "test_soak_long_sustained_open_loop",
     "test_soak_long_chaos_smoke",
+    # PR 18 (robustness): elastic-pool drills against a LIVE router
+    # (real compiles, real-time open loop; the stub-router fast tier
+    # covers the same policy logic in milliseconds, and CI exercises
+    # the full drill via `slo.py check --elastic` and dryrun path 22)
+    "test_grow_never_blocks_serving",
+    "test_restart_drill_zero_fresh_compiles",
+    "test_run_elastic_smoke_end_to_end",
 }
 
 

@@ -1,0 +1,683 @@
+"""Elastic warm pools: the traffic-driven autoscaler, brownout mode
+ladder, bytes-aware executable cache, and crash-safe restart (PR 18).
+
+Estimator, mode-ladder, and scaling-policy tests run against a stub
+router over VIRTUAL time — no jax, no compiles, no sleeps — so the
+hysteresis/dwell arithmetic is pinned deterministically. Router-level
+tests (grow never blocks serving, cruise cap, shed_batch) share one
+module-scoped warm pool, the same budget discipline as
+tests/test_traffic.py. The multi-second restart drill and the full
+elastic smoke are slow-tier; CI covers them via ``tools/slo.py check
+--elastic`` and dryrun path 22.
+"""
+
+import os
+import time
+
+import pytest
+
+from ibamr_tpu import obs
+from ibamr_tpu.serve.autoscale import (MODES, ElasticPoolManager,
+                                       MixEstimator, ScalePolicy,
+                                       read_serving_manifest,
+                                       restore_serving_manifest)
+from ibamr_tpu.serve.router import BucketSpec, ScenarioRequest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_N, _N_LAT, _N_LON = 8, 6, 8
+
+
+def _req(tag, **kw):
+    kw.setdefault("steps", 2)
+    return ScenarioRequest(tenant=tag, n_cells=_N, n_lat=_N_LAT,
+                           n_lon=_N_LON, **kw)
+
+
+# ---------------------------------------------------------------------------
+# mix estimator (pure virtual time — no jax)
+# ---------------------------------------------------------------------------
+
+def test_mix_estimator_is_deterministic():
+    """The mix is a pure function of the (family, t) stream: replaying
+    the stream replays the estimate bit-for-bit."""
+    stream = [("a", 0.1), ("a", 0.3), ("b", 0.6), ("a", 0.7),
+              ("b", 1.2), ("b", 1.3), ("b", 1.9), ("a", 2.4)]
+
+    def run():
+        est = MixEstimator(window_s=0.5, alpha=0.5)
+        for fam, t in stream:
+            est.observe(fam, t)
+        return est.mix()
+
+    m1, m2 = run(), run()
+    assert m1 == m2
+    assert set(m1) == {"a", "b"}
+    assert abs(sum(m1.values()) - 1.0) < 1e-9
+
+
+def test_mix_estimator_tracks_a_shift():
+    est = MixEstimator(window_s=0.5, alpha=0.5)
+    for i in range(10):
+        est.observe("old", i * 0.25)
+    assert est.mix()["old"] == pytest.approx(1.0)
+    for i in range(10):
+        est.observe("new", 2.5 + i * 0.25)
+    mix = est.mix()
+    assert mix["new"] > 0.8
+    assert mix.get("old", 0.0) < 0.2
+
+
+def test_mix_estimator_idle_advance_decays_partial_window():
+    """advance() without arrivals rolls empty windows into the EWMA so
+    an idle stream ages the estimate the same way observe() would."""
+    est = MixEstimator(window_s=0.5, alpha=0.5)
+    est.observe("a", 0.1)
+    est.observe("b", 0.2)
+    before = dict(est.mix())
+    est.advance(10.0)          # 19 empty windows
+    after = est.mix()
+    # proportions survive (normalized), but raw mass decayed: a new
+    # arrival now dominates immediately
+    assert set(after) <= set(before)
+    est.observe("c", 10.1)
+    est.advance(11.0)
+    assert est.mix()["c"] > 0.9
+
+
+def test_mix_estimator_arrival_totals():
+    est = MixEstimator()
+    for i in range(7):
+        est.observe("x", i * 0.1)
+    est.observe("y", 1.0)
+    assert est.arrivals("x") == 7
+    assert est.arrivals("y") == 1
+    assert est.arrivals("z") == 0
+
+
+# ---------------------------------------------------------------------------
+# stub router: scaling policy over virtual time (no jax)
+# ---------------------------------------------------------------------------
+
+class _StubCache:
+    max_bytes = None
+
+    def __init__(self):
+        self.released = []
+        self.directory = None
+
+    def bytes(self):
+        return 0
+
+    def release(self, keys):
+        self.released.extend(keys)
+        return len(list(keys))
+
+
+class _StubRouter:
+    """The manager-facing slice of WarmPoolRouter: pools are entries
+    in a dict, builds publish through a gateable wait() callable."""
+
+    def __init__(self, families=()):
+        self.cache = _StubCache()
+        self.default_lanes = 2
+        self.manager = None
+        self.inflight = {}
+        self.backlog = 0
+        self.build_gate = None      # Event: builds block until set
+        self.admission = type("_A", (), {"_policies": {}})()
+        self._pools = {}
+        for fam in families:
+            self._pools[fam] = self._spec(fam)
+
+    def _spec(self, family):
+        return BucketSpec(n_cells=family[0], n_lat=family[1],
+                          n_lon=family[2], engine=family[3],
+                          spectral_dtype=family[4], mu=family[5],
+                          lanes=self.default_lanes)
+
+    def live_families(self):
+        return dict(self._pools)
+
+    def live_specs(self):
+        return list(self._pools.values())
+
+    def family_inflight(self, family):
+        return self.inflight.get(family, 0)
+
+    def build_backlog(self):
+        return self.backlog
+
+    def _bucket_for(self, family, count):
+        return self._spec(family)
+
+    def _ensure_pool(self, spec, trace_ids=()):
+        gate = self.build_gate
+
+        def wait(timeout=None):
+            if gate is not None:
+                gate.wait(10.0)
+            self._pools[spec.family()] = spec
+            return spec
+
+        return wait
+
+    def release_pool(self, spec):
+        self._pools.pop(spec.family(), None)
+        return self.cache.release([str(spec.family())])
+
+    def drain_builds(self, timeout_s=60.0):
+        return 0
+
+
+_FAM_A = (8, 6, 8, None, None, 0.05)
+_FAM_B = (8, 6, 12, None, None, 0.05)
+
+
+def _stub_manager(families=(_FAM_A,), **policy_kw):
+    policy_kw.setdefault("window_s", 0.5)
+    policy_kw.setdefault("grow_share", 0.10)
+    policy_kw.setdefault("grow_min_arrivals", 2)
+    policy_kw.setdefault("min_dwell_s", 2.0)
+    policy_kw.setdefault("mode_min_dwell_s", 1.0)
+    router = _StubRouter(families)
+    mgr = ElasticPoolManager(router, policy=ScalePolicy(**policy_kw))
+    return router, mgr
+
+
+def _admit(mgr, family, t):
+    req = ScenarioRequest(tenant="t", n_cells=family[0],
+                          n_lat=family[1], n_lon=family[2],
+                          engine=family[3], spectral_dtype=family[4],
+                          mu=family[5])
+    mgr.observe_admit(req, t=t)
+
+
+def test_grow_triggers_on_hot_unseen_family():
+    router, mgr = _stub_manager()
+    for i in range(6):
+        _admit(mgr, _FAM_B, 0.1 * i + 0.05)
+    mgr.tick(t=1.5)
+    mgr.drain(timeout_s=5.0)
+    assert _FAM_B in router.live_families()
+    actions = [e["action"] for e in mgr.scale_events]
+    assert "grow" in actions and "warmed" in actions
+
+
+def test_grow_needs_min_arrivals():
+    router, mgr = _stub_manager(grow_min_arrivals=5)
+    for i in range(3):
+        _admit(mgr, _FAM_B, 0.2 * i)
+    mgr.tick(t=2.0)
+    assert _FAM_B not in router.live_families()
+    assert not any(e["action"] == "grow" for e in mgr.scale_events)
+
+
+def test_grow_respects_max_live_families():
+    router, mgr = _stub_manager(max_live_families=1)
+    for i in range(6):
+        _admit(mgr, _FAM_B, 0.1 * i)
+    mgr.tick(t=2.0)
+    assert _FAM_B not in router.live_families()
+
+
+def test_shrink_waits_out_min_dwell_then_fires():
+    """Hysteresis: a family that just served is NOT shrunk inside
+    min_dwell_s even at zero share; it is shrunk after."""
+    router, mgr = _stub_manager(families=(_FAM_A, _FAM_B),
+                                min_dwell_s=2.0, shrink_share=0.3)
+    _admit(mgr, _FAM_A, 0.1)      # last activity on A at t=0.1
+    for i in range(15):           # B owns the mix, t in [0.5, 1.9]
+        _admit(mgr, _FAM_B, 0.5 + 0.1 * i)
+    mgr.tick(t=2.0)               # A idle 1.9s < dwell: survives
+    assert _FAM_A in router.live_families()
+    mgr.tick(t=2.2)               # A idle 2.1s >= dwell: shrunk
+    assert _FAM_A not in router.live_families()
+    shrink = [e for e in mgr.scale_events if e["action"] == "shrink"]
+    assert [e["family"] for e in shrink] == [str(_FAM_A)]
+    assert router.cache.released  # executables were released
+
+
+def test_shrink_never_evicts_family_currently_serving():
+    router, mgr = _stub_manager(families=(_FAM_A, _FAM_B),
+                                min_dwell_s=0.5)
+    router.inflight[_FAM_A] = 1   # a batch is on A's pool right now
+    for i in range(20):
+        _admit(mgr, _FAM_B, 0.1 * i)
+    mgr.tick(t=30.0)
+    assert _FAM_A in router.live_families()
+    router.inflight[_FAM_A] = 0
+    mgr.tick(t=30.5)
+    assert _FAM_A not in router.live_families()
+
+
+def test_shrink_never_scales_to_zero():
+    router, mgr = _stub_manager(families=(_FAM_A,), min_dwell_s=0.1,
+                                idle_evict_s=1.0)
+    _admit(mgr, _FAM_A, 0.0)
+    mgr.tick(t=100.0)             # idle far past every horizon
+    assert len(router.live_families()) == 1
+
+
+def test_idle_evicted_family_is_not_regrown_on_stale_share():
+    """The shrink->grow oscillation guard: after an idle eviction the
+    family's normalized share is still high (nothing else arrived),
+    but the grow loop must not re-grow it on that stale share."""
+    router, mgr = _stub_manager(families=(_FAM_A, _FAM_B),
+                                min_dwell_s=0.5, idle_evict_s=2.0)
+    _admit(mgr, _FAM_A, 0.0)
+    _admit(mgr, _FAM_A, 0.1)
+    for i in range(4):
+        _admit(mgr, _FAM_B, 0.2 + 0.1 * i)
+    mgr.tick(t=5.0)               # both idle >= 2s: A evicted
+    assert _FAM_A not in router.live_families()
+    for t in (5.5, 6.0, 6.5):
+        mgr.tick(t=t)
+    assert _FAM_A not in router.live_families()
+    grows = [e for e in mgr.scale_events
+             if e["action"] == "grow" and e["family"] == str(_FAM_A)]
+    assert not grows
+
+
+def test_grow_decision_is_ledgered_with_mix_snapshot(tmp_path):
+    lp = str(tmp_path / "ledger.jsonl")
+    with obs.ledger(lp):
+        router, mgr = _stub_manager()
+        for i in range(6):
+            _admit(mgr, _FAM_B, 0.1 * i)
+        mgr.tick(t=1.5)
+        mgr.drain(timeout_s=5.0)
+    recs = [r for r in obs.read_ledger(lp)
+            if r.get("kind") == "pool_scale"]
+    grow = next(r for r in recs if r["action"] == "grow")
+    assert grow["family"] == str(_FAM_B)
+    assert grow["reason"]
+    assert isinstance(grow["mix"], dict) and grow["mix"]
+    warmed = next(r for r in recs if r["action"] == "warmed")
+    assert warmed["warm_s"] >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# brownout mode ladder (pressure_fn override — virtual time, no jax)
+# ---------------------------------------------------------------------------
+
+def _pressured_manager(**policy_kw):
+    policy_kw.setdefault("mode_min_dwell_s", 1.0)
+    router = _StubRouter((_FAM_A,))
+    pressure = {"queue_p99_s": 0.0, "backlog": 0, "cache_frac": 0.0}
+    mgr = ElasticPoolManager(router, policy=ScalePolicy(**policy_kw),
+                             pressure_fn=lambda: dict(pressure))
+    return router, mgr, pressure
+
+
+def test_mode_ladder_escalates_immediately_and_exits_after_dwell():
+    _, mgr, p = _pressured_manager()
+    assert mgr.mode == "healthy"
+    p["queue_p99_s"] = 2.0                 # over brownout threshold
+    mgr.tick(t=0.1)
+    assert mgr.mode == "brownout"          # escalation: immediate
+    p["queue_p99_s"] = 0.0
+    mgr.tick(t=0.5)                        # dwell 0.4s < 1.0s
+    assert mgr.mode == "brownout"
+    mgr.tick(t=1.2)                        # dwell satisfied
+    assert mgr.mode == "healthy"
+
+
+def test_mode_ladder_escalates_to_shed_batch_and_steps_down():
+    _, mgr, p = _pressured_manager()
+    p["queue_p99_s"] = 10.0                # over the shed threshold
+    mgr.tick(t=0.1)
+    mgr.tick(t=0.2)                        # one rung per tick, no dwell
+    assert mgr.mode == "shed_batch"
+    p["queue_p99_s"] = 0.5                 # below brownout entry...
+    mgr.tick(t=2.0)
+    assert mgr.mode == "brownout"          # ...one rung at a time
+    p["queue_p99_s"] = 0.0
+    mgr.tick(t=4.0)
+    assert mgr.mode == "healthy"
+    assert [(a, b) for _, a, b in mgr.transitions] == [
+        ("healthy", "brownout"), ("brownout", "shed_batch"),
+        ("shed_batch", "brownout"), ("brownout", "healthy")]
+
+
+def test_mode_dead_band_holds_between_exit_and_entry():
+    """Pressure between the exit and entry thresholds changes
+    nothing in either direction — the anti-flap dead band."""
+    _, mgr, p = _pressured_manager()
+    p["queue_p99_s"] = 2.0
+    mgr.tick(t=0.1)
+    assert mgr.mode == "brownout"
+    p["queue_p99_s"] = 0.5      # exit needs < 0.25, entry needs >= 1.0
+    for t in (1.5, 3.0, 9.0):
+        mgr.tick(t=t)
+        assert mgr.mode == "brownout"
+    assert len(mgr.transitions) == 1
+
+
+def test_mode_oscillation_bounded_by_dwell():
+    """Square-wave pressure faster than the dwell cannot produce more
+    than one transition per dwell window."""
+    _, mgr, p = _pressured_manager(mode_min_dwell_s=2.0)
+    for i in range(40):
+        t = 0.1 * (i + 1)
+        p["queue_p99_s"] = 2.0 if i % 2 == 0 else 0.0
+        mgr.tick(t=t)
+    # 4s of virtual time, 2s de-escalation dwell: at most 1 entry +
+    # 2 exits could ever fit; flapping would produce ~20
+    assert len(mgr.transitions) <= 3
+
+
+def test_backlog_and_cache_watermark_trip_brownout():
+    _, mgr, p = _pressured_manager(brownout_backlog=2)
+    p["backlog"] = 2
+    mgr.tick(t=0.1)
+    assert mgr.mode == "brownout"
+    p["backlog"] = 0
+    mgr.tick(t=2.0)
+    assert mgr.mode == "healthy"
+    p["cache_frac"] = 0.95
+    mgr.tick(t=2.1)
+    assert mgr.mode == "brownout"
+
+
+def test_should_shed_and_cruise_cap_by_mode():
+    _, mgr, p = _pressured_manager()
+    assert not mgr.should_shed("batch")
+    assert mgr.cruise_cap(["batch"]) is None
+    p["queue_p99_s"] = 2.0
+    mgr.tick(t=0.1)                        # brownout
+    assert not mgr.should_shed("batch")    # brownout caps, not sheds
+    assert mgr.cruise_cap(["batch", "batch"]) == 1
+    assert mgr.cruise_cap(["batch", "interactive"]) is None
+    p["queue_p99_s"] = 10.0
+    mgr.tick(t=0.2)                        # shed_batch
+    assert mgr.should_shed("batch")
+    assert not mgr.should_shed("interactive")
+
+
+def test_brownout_defers_non_urgent_grow_and_resumes_on_healthy():
+    router, mgr, p = _pressured_manager(grow_share=0.05,
+                                        urgent_share=0.9,
+                                        grow_min_arrivals=1)
+    p["queue_p99_s"] = 2.0
+    mgr.tick(t=0.05)
+    assert mgr.mode == "brownout"
+    for i in range(3):                     # B hot but not urgent-hot:
+        _admit(mgr, _FAM_A, 0.1 + 0.1 * i)   # A first keeps B's blended
+        _admit(mgr, _FAM_B, 0.15 + 0.1 * i)  # share at 0.5 < urgent 0.9
+    assert _FAM_B not in router.live_families()
+    assert any(e["action"] == "deferred" for e in mgr.scale_events)
+    p["queue_p99_s"] = 0.0
+    mgr.tick(t=2.0)                        # healthy: deferred resumes
+    assert mgr.mode == "healthy"
+    mgr.drain(timeout_s=5.0)
+    assert _FAM_B in router.live_families()
+    resumed = [e for e in mgr.scale_events
+               if e["action"] == "grow"
+               and e["reason"] == "deferred_resume"]
+    assert resumed
+
+
+def test_serve_mode_gauge_tracks_ladder_index():
+    _, mgr, p = _pressured_manager()
+    p["queue_p99_s"] = 2.0
+    mgr.tick(t=0.1)
+    snap = obs.metrics_snapshot()["gauges"]
+    assert snap["serve_mode"] == MODES.index("brownout")
+    obs.reset_metrics()
+
+
+# ---------------------------------------------------------------------------
+# serving manifest (stub router — no jax)
+# ---------------------------------------------------------------------------
+
+def test_manifest_round_trip_and_digest_guard(tmp_path):
+    router, mgr = _stub_manager(families=(_FAM_A, _FAM_B))
+    mp = str(tmp_path / "serving_manifest.json")
+    mgr.manifest_path = mp
+    mgr.save_manifest()
+    body = read_serving_manifest(mp)
+    fams = {tuple(BucketSpec(**f).family()) for f in body["families"]}
+    assert fams == {_FAM_A, _FAM_B}
+    assert body["mode"] == "healthy"
+    assert body["scale_digest"]
+    # a flipped byte is refused, never restored wrong
+    raw = open(mp).read().replace('"mode": "healthy"',
+                                  '"mode": "healthy "')
+    with open(mp, "w") as f:
+        f.write(raw)
+    with pytest.raises(ValueError):
+        read_serving_manifest(mp)
+
+
+def test_manifest_scale_digest_tracks_history(tmp_path):
+    router, mgr = _stub_manager()
+    d0 = mgr.manifest()["scale_digest"]
+    for i in range(6):
+        _admit(mgr, _FAM_B, 0.1 * i)
+    mgr.tick(t=1.5)
+    mgr.drain(timeout_s=5.0)
+    assert mgr.manifest()["scale_digest"] != d0
+
+
+# ---------------------------------------------------------------------------
+# bytes-aware executable cache (PR 18 satellite — no compiles needed)
+# ---------------------------------------------------------------------------
+
+def test_cache_bytes_accounting_and_release(tmp_path):
+    from ibamr_tpu.serve.aot_cache import CacheEntry, ExecutableCache
+    cache = ExecutableCache(directory=str(tmp_path))
+    # inject entries directly: bytes accounting is pure bookkeeping
+    with cache._lock:
+        for i, size in enumerate((100, 250)):
+            cache._entries[f"k{i}"] = CacheEntry(
+                key=f"k{i}", executable=object(),
+                built_at=time.time(), size_bytes=size)
+            cache._stats["bytes"] += size
+            cache._set_bytes_gauge_locked()
+    assert cache.bytes() == 350
+    assert obs.metrics_snapshot()["gauges"]["aot_cache_bytes"] == 350
+    dropped = cache.release(["k0", "missing"])
+    assert dropped == 1
+    assert cache.bytes() == 250
+    assert cache.stats()["released"] == 1
+    obs.reset_metrics()
+
+
+def test_cache_max_bytes_evicts_lru_first(tmp_path):
+    from ibamr_tpu.serve.aot_cache import CacheEntry, ExecutableCache
+    cache = ExecutableCache(directory=str(tmp_path))
+    with cache._lock:
+        for i in range(4):
+            cache._entries[f"k{i}"] = CacheEntry(
+                key=f"k{i}", executable=object(),
+                built_at=time.time(), size_bytes=100)
+            cache._stats["bytes"] += 100
+    evicted = cache.set_max_bytes(150)      # k3 is newest (insertion)
+    assert evicted == 3
+    assert list(cache.keys()) == ["k3"]
+    assert cache.bytes() == 100
+    # restoring a roomier ceiling evicts nothing further
+    assert cache.set_max_bytes(None) == 0
+    obs.reset_metrics()
+
+
+def test_estimate_executable_bytes_falls_back_gracefully():
+    from ibamr_tpu.serve.aot_cache import estimate_executable_bytes
+
+    class _WithMem:
+        def memory_analysis(self):
+            class _M:
+                generated_code_size_in_bytes = 1234
+            return _M()
+
+    class _WithText:
+        def as_text(self):
+            return "x" * 77
+
+    assert estimate_executable_bytes(_WithMem()) == 1234
+    assert estimate_executable_bytes(_WithText()) == 77
+    assert estimate_executable_bytes(object()) == 0
+
+
+# ---------------------------------------------------------------------------
+# loadgen: family overrides + piecewise mix schedule (PR 18 satellite)
+# ---------------------------------------------------------------------------
+
+def test_mix_schedule_default_replays_pre_pr18_schedule():
+    from ibamr_tpu.serve.loadgen import poisson_burst_schedule
+    a = poisson_burst_schedule(seed=3, duration_s=4.0, rate_rps=6.0)
+    b = poisson_burst_schedule(seed=3, duration_s=4.0, rate_rps=6.0,
+                               mix_schedule=None)
+    assert [(x.t, x.request) for x in a] == [(x.t, x.request)
+                                            for x in b]
+
+
+def test_mix_schedule_rotates_families_at_the_boundary():
+    import dataclasses as dc
+
+    from ibamr_tpu.serve.loadgen import (SCENARIO_MIX,
+                                         poisson_burst_schedule)
+    shifted = tuple(dc.replace(s, family=(("n_lon", 12),))
+                    for s in SCENARIO_MIX)
+    arrivals = poisson_burst_schedule(
+        seed=0, duration_s=4.0, rate_rps=8.0,
+        mix_schedule=[(0.0, SCENARIO_MIX), (0.5, shifted)])
+    pre = [a for a in arrivals if a.t < 2.0]
+    post = [a for a in arrivals if a.t >= 2.0]
+    assert pre and post
+    assert all(a.request.n_lon == 8 for a in pre)
+    assert all(a.request.n_lon == 12 for a in post)
+    # same seed, same split, bit-for-bit
+    again = poisson_burst_schedule(
+        seed=0, duration_s=4.0, rate_rps=8.0,
+        mix_schedule=[(0.0, SCENARIO_MIX), (0.5, shifted)])
+    assert [(x.t, x.request) for x in arrivals] == [
+        (x.t, x.request) for x in again]
+
+
+def test_mix_shift_injector_is_deterministic():
+    from tools.fault_injection import mix_shift_injector
+    a1, fam1 = mix_shift_injector(seed=1, duration_s=3.0,
+                                  rate_rps=6.0, shift_frac=0.5)
+    a2, fam2 = mix_shift_injector(seed=1, duration_s=3.0,
+                                  rate_rps=6.0, shift_frac=0.5)
+    assert fam1 == fam2
+    assert [(x.t, x.request) for x in a1] == [(x.t, x.request)
+                                             for x in a2]
+    assert any(str(x.request.family()) == fam1 for x in a1)
+
+
+def test_memory_pressure_injector_restores_ceiling(tmp_path):
+    from ibamr_tpu.serve.aot_cache import ExecutableCache
+    from tools.fault_injection import memory_pressure_injector
+    cache = ExecutableCache(directory=str(tmp_path), max_bytes=1000)
+    with memory_pressure_injector(cache, 10) as evicted:
+        assert cache.max_bytes == 10
+        assert evicted == [0]              # nothing cached yet
+    assert cache.max_bytes == 1000
+
+
+# ---------------------------------------------------------------------------
+# real router: grow never blocks serving + restart drill (compiles)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def live_router(tmp_path_factory):
+    from ibamr_tpu.serve.aot_cache import ExecutableCache
+    from ibamr_tpu.serve.loadgen import SOAK_POLICIES
+    from ibamr_tpu.serve.router import WarmPoolRouter
+    cache = ExecutableCache(directory=str(
+        tmp_path_factory.mktemp("autoscale_cache")))
+    spec = BucketSpec(n_cells=_N, n_lat=_N_LAT, n_lon=_N_LON,
+                      lanes=2, chunk_steps=2)
+    router = WarmPoolRouter([spec], cache=cache, allow_dynamic=True,
+                            policies=dict(SOAK_POLICIES))
+    router.warm(spec)
+    return router, spec
+
+
+def test_grow_never_blocks_serving(live_router, tmp_path):
+    """While a grow build for an unseen family is in flight (slowed by
+    the compile-storm injector), requests to the live family must keep
+    completing — proven from ledger seq ordering: warm serves land
+    BETWEEN the grow decision and its warm confirmation."""
+    from tools.fault_injection import compile_storm_injector
+    router, spec = live_router
+    mgr = ElasticPoolManager(
+        router, policy=ScalePolicy(grow_share=0.05,
+                                   grow_min_arrivals=1,
+                                   urgent_share=0.0,
+                                   min_dwell_s=1e9))
+    lp = str(tmp_path / "ledger.jsonl")
+    try:
+        with obs.ledger(lp), compile_storm_injector(extra_s=1.0):
+            for i in range(4):
+                _admit(mgr, _FAM_B, 0.05 + 0.1 * i)   # triggers grow
+            for i in range(3):
+                res = router.serve([_req("live", steps=2)])[0]
+                assert res.ok and not res.cold
+            mgr.drain(timeout_s=60.0)
+            obs.chunk_boundary()
+    finally:
+        router.manager = None
+        obs.reset_metrics()
+    recs = list(obs.read_ledger(lp))
+    grow_seq = next(r["seq"] for r in recs
+                    if r.get("kind") == "pool_scale"
+                    and r.get("action") == "grow")
+    warm_seq = next(r["seq"] for r in recs
+                    if r.get("kind") == "pool_scale"
+                    and r.get("action") == "warmed")
+    served = [r["seq"] for r in recs if r.get("kind") == "request"
+              and not r.get("cold") and r.get("ok")]
+    assert any(grow_seq < s < warm_seq for s in served), \
+        "no warm serve landed while the grow build was in flight"
+
+
+def test_restart_drill_zero_fresh_compiles(tmp_path):
+    """save_manifest -> fresh router restore: every re-warmed family
+    must load from the persistent compile layer (cold_source
+    attribution), and the first post-restart serve is warm."""
+    from ibamr_tpu.serve import aot_cache
+    from ibamr_tpu.serve.loadgen import SOAK_POLICIES
+    from ibamr_tpu.serve.router import WarmPoolRouter
+    aot_cache.enable_persistent_cache(min_compile_secs=0.0)
+    cache = aot_cache.ExecutableCache(
+        directory=str(tmp_path / "cache"))
+    spec = BucketSpec(n_cells=_N, n_lat=_N_LAT, n_lon=_N_LON,
+                      lanes=2, chunk_steps=2)
+    router = WarmPoolRouter([spec], cache=cache, allow_dynamic=True,
+                            policies=dict(SOAK_POLICIES))
+    router.warm(spec)
+    mp = str(tmp_path / "serving_manifest.json")
+    mgr = ElasticPoolManager(router, manifest_path=mp)
+    _admit(mgr, _FAM_A, 0.1)
+    mgr.save_manifest()
+    mgr.drain(timeout_s=60.0)
+    router.manager = None
+
+    router2, mgr2, stats = restore_serving_manifest(mp)
+    try:
+        assert stats["fresh_compiles"] == 0
+        assert stats["persistent_loads"] >= 2    # lengths {1, chunk}
+        assert stats["warmed"] == 1 and not stats["errors"]
+        res = router2.serve([_req("after", steps=2)])[0]
+        assert res.ok and not res.cold and not res.shed
+    finally:
+        router2.manager = None
+        obs.reset_metrics()
+
+
+def test_run_elastic_smoke_end_to_end(tmp_path):
+    """The full dryrun-path-22 drill: mix shift + memory pressure +
+    restart, every pinned invariant raised inside."""
+    from tools.fault_injection import run_elastic_smoke
+    out = run_elastic_smoke(str(tmp_path))
+    assert out["elastic_smoke"] == "ok"
+    assert out["lost"] == 0
+    assert out["restart_fresh_compiles"] == 0
+    assert out["grows"] >= 1 and out["shrinks"] >= 1
+    assert out["mode_transitions"] <= 6
+    assert out["predicted_rps"] is not None
+    obs.reset_metrics()

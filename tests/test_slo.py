@@ -220,3 +220,117 @@ def test_committed_contract_matches_schema():
         assert name in slo.SLI_NAMES, name
         key = "floor" if name in slo.FLOORS else "ceiling"
         assert set(budget) == {key}, (name, budget)
+    # PR 18: the committed elastic budget names only known elastic
+    # SLIs, all ceilings, with both count invariants pinned at zero
+    for name, budget in doc.get("elastic_slos", {}).items():
+        assert name in slo.ELASTIC_SLI_NAMES, name
+        assert set(budget) == {"ceiling"}, (name, budget)
+    assert doc["elastic_slos"]["elastic_lost_requests"] == {"ceiling": 0}
+    assert (doc["elastic_slos"]["elastic_restart_fresh_compiles"]
+            == {"ceiling": 0})
+
+
+# ---------------------------------------------------------------------------
+# elastic mode (PR 18): SLIs from a synthetic drill ledger + the CLI
+# exit matrix — no live router, no compiles
+# ---------------------------------------------------------------------------
+
+def _elastic_records():
+    """A minimal but complete elastic-drill story: one grow that
+    warms, two mode transitions, a restart that paid zero fresh
+    compiles, and a fully-joined interactive request stream."""
+    return [
+        {"kind": "pool_scale", "seq": 1, "action": "grow",
+         "family": "(8, 6, 12, None, None, 0.05)",
+         "reason": "mix_shift", "t": 1.0},
+        {"kind": "serve_mode", "seq": 2, "mode": "brownout",
+         "prev": "healthy", "t": 1.1, "queue_p99_s": 2.0,
+         "backlog": 1, "cache_frac": 0.0},
+        {"kind": "pool_scale", "seq": 3, "action": "warmed",
+         "family": "(8, 6, 12, None, None, 0.05)",
+         "reason": "mix_shift", "t": 2.5, "warm_s": 1.5},
+        {"kind": "serve_mode", "seq": 4, "mode": "healthy",
+         "prev": "brownout", "t": 3.0, "queue_p99_s": 0.1,
+         "backlog": 0, "cache_frac": 0.0},
+        {"kind": "request_admit", "seq": 5, "trace_id": "a" * 16},
+        {"kind": "request", "seq": 6, "trace_id": "a" * 16,
+         "cold": False, "tenant_class": "interactive",
+         "first_step_s": 0.05},
+        {"kind": "request_admit", "seq": 7, "trace_id": "b" * 16},
+        {"kind": "request_shed", "seq": 8, "trace_id": "b" * 16,
+         "shed_reason": "brownout"},
+        {"kind": "serving_restore", "seq": 9, "warm_s": 1.0,
+         "fresh_compiles": 0, "persistent_loads": 2},
+    ]
+
+
+def test_elastic_slis_from_synthetic_ledger():
+    slis = slo.elastic_slis_from_ledger(_elastic_records())
+    assert slis["elastic_scale_up_latency_s"] == 1.5
+    assert slis["elastic_restart_to_warm_s"] == 1.0
+    assert slis["elastic_restart_fresh_compiles"] == 0
+    assert slis["elastic_mode_transitions"] == 2
+    assert slis["elastic_interactive_p99_s"] == 0.05
+    assert slis["elastic_lost_requests"] == 0    # admit/terminal join
+    # a dropped terminal record is a LOST request, never silence
+    recs = [r for r in _elastic_records() if r["seq"] != 8]
+    assert slo.elastic_slis_from_ledger(recs)[
+        "elastic_lost_requests"] == 1
+    # a non-elastic ledger measures nothing (every SLI absent)
+    plain = [{"kind": "request_admit", "seq": 1, "trace_id": "c" * 16},
+             {"kind": "request", "seq": 2, "trace_id": "c" * 16,
+              "cold": True, "first_step_s": 1.0}]
+    slis = slo.elastic_slis_from_ledger(plain)
+    assert slis["elastic_mode_transitions"] is None
+    assert slis["elastic_scale_up_latency_s"] is None
+
+
+def test_check_elastic_ledger_exit_matrix(tmp_path, capsys):
+    """``check --elastic --ledger`` against the committed contract is
+    clean; a hostile budget exits 2; a contract with no elastic_slos
+    exits 1 (unbudgeted, never silently green)."""
+    lpath = str(tmp_path / "elastic_ledger.jsonl")
+    with open(lpath, "w") as f:
+        for rec in _elastic_records():
+            f.write(json.dumps(rec) + "\n")
+    rc = slo.main(["check", "--elastic", "--ledger", lpath, "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0, doc
+    assert doc["exit"] == 0 and not doc["violated"]
+    assert len(doc["met"]) == len(slo.ELASTIC_SLI_NAMES)
+    # violated budget -> 2
+    bad = {"slo_schema": 1,
+           "elastic_slos": {"elastic_lost_requests": {"ceiling": -1},
+                            "elastic_scale_up_latency_s":
+                                {"ceiling": 1e-9}},
+           "slos": {}}
+    cpath = str(tmp_path / "bad.json")
+    json.dump(bad, open(cpath, "w"))
+    rc = slo.main(["check", "--elastic", "--ledger", lpath,
+                   "--contract", cpath])
+    out = capsys.readouterr().out
+    assert rc == 2 and "VIOLATED" in out
+    # no elastic_slos section -> 1
+    json.dump({"slo_schema": 1, "slos": {}}, open(cpath, "w"))
+    rc = slo.main(["check", "--elastic", "--ledger", lpath,
+                   "--contract", cpath])
+    out = capsys.readouterr().out
+    assert rc == 1 and "no elastic_slos" in out
+
+
+def test_tighten_elastic_merges_without_clobbering(tmp_path):
+    """--elastic --tighten rewrites only elastic/elastic_slos; the
+    cold/warm and soak sections survive byte-identical."""
+    base = slo.load_contract()
+    cpath = str(tmp_path / "contract.json")
+    json.dump(base, open(cpath, "w"))
+    slis = slo.elastic_slis_from_ledger(_elastic_records())
+    doc = slo.tighten_elastic(slis, {"source": "synthetic"}, cpath)
+    assert doc["slos"] == base["slos"]
+    assert doc.get("soak_slos") == base.get("soak_slos")
+    s = doc["elastic_slos"]
+    assert s["elastic_lost_requests"] == {"ceiling": 0}        # exact
+    assert s["elastic_restart_fresh_compiles"] == {"ceiling": 0}
+    assert s["elastic_mode_transitions"] == {"ceiling": 4}     # +2
+    assert s["elastic_scale_up_latency_s"]["ceiling"] == 3.0   # 2x
+    assert s["elastic_interactive_p99_s"]["ceiling"] == 1.0    # floored

@@ -70,6 +70,16 @@ dryrun drill are built from:
   while healthy tenants keep their warm p99, with the no-deadlock /
   no-lost-request / bounded-shed invariants pinned from the merged
   ledger.
+- :func:`mix_shift_injector` / :func:`memory_pressure_injector`
+  (PR 18) — ELASTICITY faults: the arrival mix rotates to an unseen
+  bucket family mid-soak (pure schedule transform, bit-replayable),
+  and the executable cache's bytes ceiling is squeezed mid-run.
+  :func:`run_elastic_smoke` composes them into the elastic warm-pool
+  drill (dryrun path 22, ``python -m tools.fault_injection
+  --elastic-smoke``): the ElasticPoolManager must grow the shifted
+  family before any of its requests shed, ride the brownout ladder
+  without oscillating, shrink the cold family, and survive a
+  checkpoint/restore restart with ZERO fresh XLA compiles.
 
 Everything here is deliberately boring and deterministic: no random
 fuzzing, every fault lands at a named step/byte so a failure
@@ -80,6 +90,7 @@ from __future__ import annotations
 
 import argparse
 import contextlib
+import dataclasses
 import json
 import os
 import tempfile
@@ -1675,6 +1686,315 @@ def kill_router_thread_injector(n_kills: int = 1):
         _router.WarmPoolRouter._build_pool = orig
 
 
+def mix_shift_injector(seed: int, duration_s: float, rate_rps: float,
+                       shift_frac: float = 0.5,
+                       shifted_family=(("n_lon", 12),),
+                       burst_factor: float = 2.0):
+    """Mix-shift fault (PR 18): a deterministic arrival schedule whose
+    mix ROTATES to an unseen bucket family at ``shift_frac`` of the
+    run — the traffic pattern a fixed warm-pool set cannot survive
+    (every post-shift request would cold-compile or shed). Pure
+    schedule transform, no monkey-patching: the same seed replays the
+    same shift bit-for-bit. Returns ``(arrivals, shifted_family_str)``
+    where the string matches the ``family`` field of
+    ``request_admit``/``pool_scale`` ledger records."""
+    from ibamr_tpu.serve.loadgen import (SCENARIO_MIX, ScenarioRequest,
+                                         poisson_burst_schedule)
+
+    shifted_mix = tuple(
+        dataclasses.replace(s, family=tuple(shifted_family))
+        for s in SCENARIO_MIX)
+    arrivals = poisson_burst_schedule(
+        seed=seed, duration_s=duration_s, rate_rps=rate_rps,
+        burst_factor=burst_factor,
+        mix_schedule=[(0.0, SCENARIO_MIX),
+                      (float(shift_frac), shifted_mix)])
+    fam = dict(shifted_family)
+    probe = ScenarioRequest(
+        tenant="probe", n_cells=fam.get("n_cells", 8),
+        n_lat=fam.get("n_lat", 6), n_lon=fam.get("n_lon", 8),
+        engine=fam.get("engine"),
+        spectral_dtype=fam.get("spectral_dtype"),
+        mu=fam.get("mu", 0.05))
+    return arrivals, str(probe.family())
+
+
+@contextlib.contextmanager
+def memory_pressure_injector(cache, max_bytes: int):
+    """Memory-pressure fault (PR 18): squeeze the executable cache's
+    bytes ceiling mid-run (the ``aot_cache_bytes`` watermark the
+    brownout pressure signal reads), restoring the original ceiling on
+    exit. Yields the live eviction count ``[n]`` from the initial
+    squeeze so a drill can assert what the pressure actually cost."""
+    orig = cache.max_bytes
+    evicted = [cache.set_max_bytes(int(max_bytes))]
+    try:
+        yield evicted
+    finally:
+        cache.set_max_bytes(orig)
+
+
+def run_elastic_smoke(directory: str | None = None,
+                      duration_s: float = 5.0, rate_rps: float = 8.0,
+                      time_scale: float = 0.5,
+                      shift_frac: float = 0.4) -> dict:
+    """Deterministic elasticity drill (PR 18, dryrun path 22): a
+    mid-soak MIX SHIFT onto an unseen family plus MEMORY PRESSURE on
+    the executable cache drive the ``ElasticPoolManager`` through
+    grow, brownout, shrink, and a crash-safe restart, and the
+    invariants are pinned from the merged ledger:
+
+    1. **no lost request** — every admitted ``trace_id`` reaches
+       exactly one terminal record, shift or no shift;
+    2. **scale-up before shed** — the shifted family's ``pool_scale``
+       grow decision lands BEFORE any of its requests shed, and the
+       family is eventually served warm;
+    3. **brownout without oscillation** — the precompile backlog +
+       bytes watermark push the mode ladder into brownout, it
+       de-escalates through the dwell guard, and the total number of
+       mode transitions stays bounded (no flapping);
+    4. **elastic shrink** — the pre-shift family decays cold and is
+       released (executables + bytes), never while serving;
+    5. **restart drill** — ``serving_manifest.json`` is checkpointed,
+       a FRESH router+cache restores it with bounded-concurrency
+       re-warm and ZERO fresh XLA compiles (aot-cache ``cold_source``
+       manifest attribution), then serves warm on the first request.
+
+    Raises on any failed expectation; returns a one-line JSON summary
+    (``tools/slo.py check --elastic`` evaluates the same ledger
+    against SLO.json's ``elastic_slos``)."""
+    from ibamr_tpu import obs as _obs
+    from ibamr_tpu.serve import aot_cache
+    from ibamr_tpu.serve.autoscale import (ElasticPoolManager,
+                                           ScalePolicy,
+                                           restore_serving_manifest)
+    from ibamr_tpu.serve.capacity import capacity_report
+    from ibamr_tpu.serve.loadgen import (SOAK_POLICIES,
+                                         run_open_loop,
+                                         traffic_summary)
+    from ibamr_tpu.serve.router import (BucketSpec, ScenarioRequest,
+                                        WarmPoolRouter)
+
+    max_transitions = 6
+    tmp = None
+    if directory is None:
+        tmp = tempfile.TemporaryDirectory(prefix="ibamr_elastic_smoke_")
+        directory = tmp.name
+    try:
+        ledger_path = os.path.join(directory, "elastic_ledger.jsonl")
+        manifest_path = os.path.join(directory,
+                                     "serving_manifest.json")
+        # the cross-process compile layer: restart re-warms through
+        # XLA's disk cache (repo-default dir; never fatal if absent)
+        aot_cache.enable_persistent_cache(min_compile_secs=0.0)
+        cache = aot_cache.ExecutableCache(
+            directory=os.path.join(directory, "cache"))
+        spec = BucketSpec(n_cells=8, n_lat=6, n_lon=8, lanes=2,
+                          chunk_steps=2)
+        router = WarmPoolRouter([spec], cache=cache,
+                                allow_dynamic=True,
+                                policies=dict(SOAK_POLICIES))
+        # backlog>=1 trips brownout: one async grow IS the pressure
+        # this drill exercises; de-escalation dwell bounds flapping
+        manager = ElasticPoolManager(
+            router,
+            policy=ScalePolicy(grow_share=0.08, grow_min_arrivals=2,
+                               shrink_share=0.02, min_dwell_s=2.0,
+                               idle_evict_s=6.0,
+                               brownout_backlog=1,
+                               brownout_exit_backlog=0,
+                               urgent_share=0.15,
+                               mode_min_dwell_s=0.5),
+            manifest_path=manifest_path)
+
+        arrivals, shifted_family = mix_shift_injector(
+            seed=0, duration_s=duration_s, rate_rps=rate_rps,
+            shift_frac=shift_frac)
+        shift_t = shift_frac * duration_s
+        pre = [a for a in arrivals if a.t < shift_t]
+        post = [dataclasses.replace(a, t=a.t - shift_t)
+                for a in arrivals if a.t >= shift_t]
+
+        with _obs.ledger(ledger_path):
+            with _obs.span("elastic_smoke/warm"):
+                router.warm(spec)
+            base_family = str(spec.family())
+
+            with _obs.span("elastic_smoke/pre_shift",
+                           arrivals=len(pre)):
+                run1 = run_open_loop(router, pre,
+                                     time_scale=time_scale,
+                                     join_timeout_s=120.0)
+            # mid-soak: the mix rotates to the unseen family while the
+            # cache's bytes ceiling is squeezed (generous enough that
+            # the shifted family still fits — the watermark is
+            # pressure, not sabotage)
+            ceiling = max(int(cache.bytes() * 3), 1)
+            with _obs.span("elastic_smoke/shifted_open_loop",
+                           arrivals=len(post)), \
+                    memory_pressure_injector(cache, ceiling):
+                run2 = run_open_loop(router, post,
+                                     time_scale=time_scale,
+                                     join_timeout_s=180.0)
+
+            # settle: idle ticks decay the mix + drain the mode
+            # ladder back to healthy and let the cold family shrink
+            t_settle = time.monotonic()
+            while time.monotonic() - t_settle < 20.0:
+                manager.tick()
+                shrunk = any(e["action"] == "shrink"
+                             for e in manager.scale_events)
+                if manager.mode == "healthy" and shrunk:
+                    break
+                time.sleep(0.25)
+            manager.tick()
+
+            # -- 5. the restart drill --------------------------------
+            manager.save_manifest()
+            if manager.drain(timeout_s=120.0):
+                raise AssertionError("builds/watchers never finished "
+                                     "before the restart drill")
+            router2, manager2, restore_stats = \
+                restore_serving_manifest(manifest_path)
+            fam = dict((("n_lon", 12),))
+            probe = router2.serve([ScenarioRequest(
+                tenant="interactive-restart", n_cells=8, n_lat=6,
+                n_lon=fam["n_lon"], steps=2,
+                tenant_class="interactive")])[0]
+            router2.drain_builds(timeout_s=60.0)
+            _obs.chunk_boundary()
+
+        # -- invariant 1: no lost request ----------------------------
+        for run in (run1, run2):
+            if run["hung_threads"]:
+                raise AssertionError(
+                    f"{run['hung_threads']} producer threads never "
+                    f"finished — the elastic drill deadlocked")
+            if run["errors"]:
+                raise AssertionError(
+                    f"serve() raised under the mix shift: "
+                    f"{run['errors'][:3]}")
+        records = list(_obs.read_ledger(ledger_path))
+        admits = [r for r in records
+                  if r.get("kind") == "request_admit"]
+        terminals: dict = {}
+        for r in records:
+            if r.get("kind") in ("request", "request_shed"):
+                tid = r.get("trace_id")
+                terminals[tid] = terminals.get(tid, 0) + 1
+        lost = [r["trace_id"] for r in admits
+                if terminals.get(r["trace_id"], 0) == 0]
+        doubled = [r["trace_id"] for r in admits
+                   if terminals.get(r["trace_id"], 0) > 1]
+        if lost or doubled:
+            raise AssertionError(
+                f"terminal-record invariant broken: {len(lost)} lost, "
+                f"{len(doubled)} doubled (first: "
+                f"{(lost + doubled)[:3]})")
+
+        # -- invariant 2: scale-up before shed for the shifted mix ---
+        grows = [r for r in records if r.get("kind") == "pool_scale"
+                 and r.get("action") == "grow"
+                 and r.get("family") == shifted_family]
+        if not grows:
+            raise AssertionError(
+                f"the shifted family {shifted_family} never got a "
+                f"grow decision — the mix estimator is blind")
+        first_grow_seq = min(r["seq"] for r in grows)
+        shifted_tids = {r["trace_id"] for r in admits
+                        if r.get("family") == shifted_family}
+        shifted_sheds = [r for r in records
+                         if r.get("kind") == "request_shed"
+                         and r.get("trace_id") in shifted_tids]
+        early = [r for r in shifted_sheds
+                 if r.get("seq", 0) < first_grow_seq]
+        if early:
+            raise AssertionError(
+                f"{len(early)} shifted-family requests shed BEFORE "
+                f"the grow decision (seq {first_grow_seq})")
+        warmed = [r for r in records if r.get("kind") == "pool_scale"
+                  and r.get("action") == "warmed"
+                  and r.get("family") == shifted_family]
+        shifted_warm = [r for r in records if r.get("kind") == "request"
+                        and r.get("trace_id") in shifted_tids
+                        and not r.get("cold")]
+        if not warmed or not shifted_warm:
+            raise AssertionError(
+                f"shifted family never published warm "
+                f"(warmed={len(warmed)}, warm_served="
+                f"{len(shifted_warm)})")
+
+        # -- invariant 3: brownout entry/exit without oscillation ----
+        modes = [r for r in records if r.get("kind") == "serve_mode"]
+        if not any(r["mode"] == "brownout" for r in modes):
+            raise AssertionError(
+                "the grow backlog never tripped brownout — the "
+                "pressure signal is dead")
+        if len(modes) > max_transitions:
+            raise AssertionError(
+                f"{len(modes)} mode transitions (> {max_transitions})"
+                f" — the ladder is oscillating")
+        if manager.mode != "healthy":
+            raise AssertionError(
+                f"mode never de-escalated (stuck {manager.mode})")
+
+        # -- invariant 4: elastic shrink of the cold family ----------
+        shrinks = [r for r in records if r.get("kind") == "pool_scale"
+                   and r.get("action") == "shrink"]
+        if not any(r.get("family") == base_family for r in shrinks):
+            raise AssertionError(
+                f"the pre-shift family {base_family} was never "
+                f"shrunk after going cold")
+        if shifted_family not in {str(f)
+                                  for f in router.live_families()}:
+            raise AssertionError(
+                "the shifted (hot) family is not live after shrink")
+
+        # -- invariant 5: restart reached warm with zero fresh builds
+        if restore_stats["fresh_compiles"] != 0:
+            raise AssertionError(
+                f"restart drill paid {restore_stats['fresh_compiles']}"
+                f" fresh compiles (cold_source attribution) — the "
+                f"persistent layer did not survive the crash")
+        if restore_stats["warmed"] == 0 or restore_stats["errors"]:
+            raise AssertionError(
+                f"restart re-warm failed: {restore_stats}")
+        if probe.shed or probe.cold or not probe.ok:
+            raise AssertionError(
+                f"first post-restart request was not a warm serve: "
+                f"cold={probe.cold} shed={probe.shed} ok={probe.ok}")
+
+        results = run1["results"] + run2["results"]
+        wall = run1["wall_s"] + run2["wall_s"]
+        summary = traffic_summary(results, wall)
+        cap = capacity_report(records, p99_ceiling_s=2.0)
+        if cap["prediction"]["rps"] is None:
+            raise AssertionError(
+                "capacity model unevaluable — no warm samples in the "
+                "elastic ledger")
+        return {"elastic_smoke": "ok",
+                "arrivals": len(arrivals),
+                "admitted": len(admits),
+                "lost": 0,
+                "shed": summary["shed"],
+                "mode_transitions": len(modes),
+                "grows": len(grows),
+                "shrinks": len(shrinks),
+                "scale_up_s": max(r.get("warm_s", 0.0)
+                                  for r in warmed),
+                "restart_warm_s": restore_stats["warm_s"],
+                "restart_fresh_compiles":
+                    restore_stats["fresh_compiles"],
+                "cache_bytes": cache.bytes(),
+                "predicted_rps": cap["prediction"]["rps"],
+                "measured_rps": summary["requests_per_s"],
+                "wall_s": round(wall, 3),
+                "ledger": (None if tmp is not None else ledger_path)}
+    finally:
+        if tmp is not None:
+            tmp.cleanup()
+
+
 def run_soak_smoke(directory: str | None = None,
                    duration_s: float = 5.0, rate_rps: float = 8.0,
                    time_scale: float = 0.5,
@@ -1877,6 +2197,10 @@ def main(argv=None) -> int:
     ap.add_argument("--soak-smoke", action="store_true",
                     help="run the traffic-robustness soak drill "
                          "(open-loop load + serving chaos injectors)")
+    ap.add_argument("--elastic-smoke", action="store_true",
+                    help="run the elastic warm-pool drill (mix shift "
+                         "+ memory pressure -> grow/brownout/shrink + "
+                         "crash-safe restart)")
     ap.add_argument("--fleet-smoke", action="store_true",
                     help="run the lane-quarantine fleet drill (vmapped "
                          "ensemble, one poisoned lane, per-lane "
@@ -1927,6 +2251,12 @@ def main(argv=None) -> int:
         from ibamr_tpu.utils.backend_guard import force_cpu
         force_cpu(1)
         print(json.dumps(run_soak_smoke(args.dir)), flush=True)
+        return 0
+    if args.elastic_smoke:
+        # bounded CPU elasticity drill — same backend pin as the soak
+        from ibamr_tpu.utils.backend_guard import force_cpu
+        force_cpu(1)
+        print(json.dumps(run_elastic_smoke(args.dir)), flush=True)
         return 0
     if args.record_capsule:
         record_capsule_drill(args.record_capsule)

@@ -410,6 +410,58 @@ def render_traffic(snap, records: list) -> list:
     return lines
 
 
+def render_elastic(snap, records: list) -> list:
+    """Elastic-pool block (PR 18): scale events by action+reason from
+    the ``pool_scale`` records, the serve-mode ladder history from
+    ``serve_mode`` transitions, and the restart drill's
+    checkpoint/restore outcome from
+    ``serving_manifest``/``serving_restore``. Empty when the run had
+    no elastic manager — a static-router summary is unchanged."""
+    scales = [r for r in records if r.get("kind") == "pool_scale"]
+    modes = [r for r in records if r.get("kind") == "serve_mode"]
+    manifests = [r for r in records
+                 if r.get("kind") == "serving_manifest"]
+    restores = [r for r in records
+                if r.get("kind") == "serving_restore"]
+    if not (scales or modes or manifests or restores):
+        return []
+    lines = []
+    by_action: dict = {}
+    for r in scales:
+        key = (r.get("action") or "?", r.get("reason") or "?")
+        by_action[key] = by_action.get(key, 0) + 1
+    if by_action:
+        detail = ", ".join(f"{a}/{re}={n}" for (a, re), n
+                           in sorted(by_action.items()))
+        lines.append(f"  scale events: {len(scales)} ({detail})")
+    warmed = [r.get("warm_s") for r in scales
+              if r.get("action") == "warmed"
+              and r.get("warm_s") is not None]
+    if warmed:
+        lines.append(f"  scale-up latency: max {_fmt_s(max(warmed))} "
+                     f"over {len(warmed)} grow(s)")
+    fams = ((snap or {}).get("gauges")
+            or {}).get("serve_families_live")
+    if fams is not None:
+        lines.append(f"  families live (last): {int(fams)}")
+    if modes:
+        hist = " -> ".join([modes[0].get("prev") or "?"]
+                           + [m.get("mode") or "?" for m in modes])
+        lines.append(f"  mode ladder: {hist} "
+                     f"({len(modes)} transition(s))")
+    for r in manifests:
+        lines.append(f"  manifest saved: {r.get('path')} "
+                     f"({r.get('families')} families, "
+                     f"digest {str(r.get('scale_digest'))[:12]})")
+    for r in restores:
+        lines.append(f"  restart: {r.get('warmed')}/"
+                     f"{r.get('families')} re-warmed in "
+                     f"{_fmt_s(r.get('warm_s'))}, "
+                     f"fresh_compiles={r.get('fresh_compiles')} "
+                     f"persistent_loads={r.get('persistent_loads')}")
+    return lines
+
+
 def render_incidents(records: list, t0=None) -> list:
     lines = []
     for rec in records:
@@ -653,6 +705,11 @@ def cmd_summary(args) -> int:
         print("\ntraffic (admission & overload):")
         for ln in traffic:
             print(ln)
+    elastic = render_elastic(last_counters(records), records)
+    if elastic:
+        print("\nelastic pools (scaling, brownout, restart):")
+        for ln in elastic:
+            print(ln)
     print("\nincidents:")
     t0 = min(times) if times else None
     for ln in render_incidents(records, t0):
@@ -708,6 +765,28 @@ def _one_line(rec: dict) -> str:
         return (f"seq={rec['seq']:<6} aot_cache "
                 f"{rec.get('event')} key={rec.get('key')} "
                 f"label={rec.get('label')}")
+    if kind == "pool_scale":
+        return (f"seq={rec['seq']:<6} scale     "
+                f"{rec.get('action')} family={rec.get('family')} "
+                f"reason={rec.get('reason')} "
+                f"live={rec.get('families_live')}"
+                + (f" warm={_fmt_s(rec.get('warm_s'))}"
+                   if rec.get("warm_s") is not None else ""))
+    if kind == "serve_mode":
+        return (f"seq={rec['seq']:<6} mode      "
+                f"{rec.get('prev')} -> {rec.get('mode')} "
+                f"queue_p99={_fmt_s(rec.get('queue_p99_s'))} "
+                f"backlog={rec.get('backlog')}")
+    if kind == "serving_manifest":
+        return (f"seq={rec['seq']:<6} manifest  "
+                f"{rec.get('path')} families={rec.get('families')} "
+                f"digest={str(rec.get('scale_digest'))[:12]}")
+    if kind == "serving_restore":
+        return (f"seq={rec['seq']:<6} restore   "
+                f"warmed={rec.get('warmed')}/{rec.get('families')} "
+                f"{_fmt_s(rec.get('warm_s'))} "
+                f"fresh={rec.get('fresh_compiles')} "
+                f"persistent={rec.get('persistent_loads')}")
     if kind == "device_time":
         return (f"seq={rec['seq']:<6} device    "
                 f"{_fmt_s(rec.get('total_device_s'))} device, "
@@ -825,6 +904,17 @@ def render_trace(records: list, tid: str) -> list:
         elif kind == "lane_quarantine":
             desc = (f"lane_quarantine  lane={rec.get('lane')} "
                     f"step={rec.get('step')}")
+        elif kind == "pool_scale":
+            desc = (f"SCALE {rec.get('action'):<10} "
+                    f"family={rec.get('family')} "
+                    f"reason={rec.get('reason')}"
+                    + (f" warm={_fmt_s(rec.get('warm_s'))}"
+                       if rec.get("warm_s") is not None else ""))
+        elif kind == "serve_mode":
+            desc = (f"MODE             {rec.get('prev')} -> "
+                    f"{rec.get('mode')} "
+                    f"queue_p99={_fmt_s(rec.get('queue_p99_s'))} "
+                    f"backlog={rec.get('backlog')}")
         else:
             body = {k: v for k, v in rec.items()
                     if k not in ("seq", "run_id", "t", "kind",

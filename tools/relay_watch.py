@@ -419,6 +419,35 @@ def main() -> int:
                     g.write(r10.stdout or "")
             except subprocess.TimeoutExpired:
                 log(f, "soak grid timed out")
+            # eleventh step (PR 18): the elastic warm-pool drill —
+            # mix shift + memory pressure + crash-safe restart in a
+            # CPU child; scale-up latency, restart-to-warm time, and
+            # fresh restart compiles (must stay 0) are trended per
+            # healthy window next to the soak grid.
+            try:
+                r11 = subprocess.run(
+                    [sys.executable, "-c",
+                     "import json; "
+                     "from bench import elastic_reference; "
+                     "print(json.dumps(elastic_reference()))"],
+                    capture_output=True, text=True, cwd=REPO, env=env,
+                    timeout=args.bench_timeout)
+                tail = ""
+                try:
+                    el = json.loads(r11.stdout or "{}")
+                    if "scale_up_s" in el:
+                        tail = (f"  scale_up={el['scale_up_s']} "
+                                f"restart={el['restart_warm_s']} "
+                                f"fresh="
+                                f"{el['restart_fresh_compiles']}")
+                except ValueError:
+                    pass
+                log(f, f"elastic drill rc={r11.returncode}{tail}")
+                with open(args.out.replace(".json", "_elastic.json"),
+                          "w") as g:
+                    g.write(r11.stdout or "")
+            except subprocess.TimeoutExpired:
+                log(f, "elastic drill timed out")
             # fifth step (PR 10): archive each profile capture — the
             # attribution summary is the regression-comparable
             # artifact; the raw multi-MB traces are pruned ONLY after

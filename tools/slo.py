@@ -43,6 +43,30 @@ the SOAK SLIs against the contract's separate ``soak_slos`` section
 ``--soak --tighten`` merges a fresh ``soak_slos`` section into the
 existing contract without touching the cold/warm ``slos``.
 
+Elastic mode (PR 18): ``slo.py check --elastic`` runs the elastic
+warm-pool drill (``tools.fault_injection.run_elastic_smoke`` — a
+mid-soak mix shift onto an unseen family under memory pressure, then
+a crash-safe restart) and evaluates the ELASTIC SLIs against the
+contract's ``elastic_slos`` section
+(:func:`elastic_slis_from_ledger`):
+
+- ``elastic_scale_up_latency_s`` — worst grow-decision-to-warm
+  latency (``pool_scale`` warmed confirmations);
+- ``elastic_restart_to_warm_s`` — manifest-restore-to-all-warm wall
+  time (the ``serving_restore`` record);
+- ``elastic_restart_fresh_compiles`` — fresh XLA compiles paid by the
+  restart re-warm (aot-cache ``cold_source`` attribution; budgeted at
+  exactly 0 — the persistent layer IS the crash-safety claim);
+- ``elastic_lost_requests`` — the no-lost-request join, through scale
+  events, brownout, and shed (exactly 0);
+- ``elastic_mode_transitions`` — serve-mode ladder transitions (an
+  oscillating ladder fails the budget, not just the drill);
+- ``elastic_interactive_p99_s`` — warm INTERACTIVE first-step p99
+  while batch is capped/shed (brownout protects it, or this trips).
+
+``--elastic --tighten`` merges a fresh ``elastic_slos`` section, same
+discipline as soak.
+
 Exit convention (the ``graph_audit`` family, with one deliberate
 difference): **headroom under a ceiling is attainment, not drift** —
 a warm p99 far below budget is the system working, so it exits 0, not
@@ -81,6 +105,17 @@ _PADFRAC_KEY = "serve_padding_fraction"
 SOAK_SLI_NAMES = ("soak_warm_p99_s", "soak_queue_wait_p99_s",
                   "soak_shed_rate", "soak_lost_requests")
 _QWAIT_KEY = "serve_queue_wait_seconds"
+
+# elastic SLIs (PR 18): the autoscaling/brownout/restart invariants of
+# the elastic warm-pool drill, evaluated against the contract's
+# separate "elastic_slos" section. All ceilings; the two count SLIs
+# (lost requests, fresh restart compiles) are budgeted at EXACTLY 0.
+ELASTIC_SLI_NAMES = ("elastic_scale_up_latency_s",
+                     "elastic_restart_to_warm_s",
+                     "elastic_restart_fresh_compiles",
+                     "elastic_lost_requests",
+                     "elastic_mode_transitions",
+                     "elastic_interactive_p99_s")
 
 
 def _last_histograms(records) -> dict:
@@ -234,6 +269,58 @@ def soak_slis_from_ledger(records) -> dict:
     return slis
 
 
+def elastic_slis_from_ledger(records) -> dict:
+    """Elastic SLIs from an elastic-drill (or production) ledger:
+    scaling latency from ``pool_scale`` warm confirmations, restart
+    health from the ``serving_restore`` record, mode-ladder stability
+    from ``serve_mode`` transitions, and the interactive warm p99 +
+    no-lost-request join from the request stream. Absent SLIs are
+    ``None``."""
+    records = list(records)
+    requests = [r for r in records if r.get("kind") == "request"]
+    sheds = [r for r in records if r.get("kind") == "request_shed"]
+    admits = [r for r in records if r.get("kind") == "request_admit"]
+
+    slis: dict = {name: None for name in ELASTIC_SLI_NAMES}
+
+    warmed = [r.get("warm_s") for r in records
+              if r.get("kind") == "pool_scale"
+              and r.get("action") == "warmed"
+              and r.get("warm_s") is not None]
+    if warmed:
+        slis["elastic_scale_up_latency_s"] = max(warmed)
+
+    restores = [r for r in records
+                if r.get("kind") == "serving_restore"]
+    if restores:
+        last = restores[-1]          # the drill's (only) restart
+        slis["elastic_restart_to_warm_s"] = last.get("warm_s")
+        slis["elastic_restart_fresh_compiles"] = last.get(
+            "fresh_compiles")
+
+    modes = [r for r in records if r.get("kind") == "serve_mode"]
+    if modes or restores or warmed:
+        # zero transitions is a measurement (a quiet drill), but only
+        # when the ledger demonstrably came from an elastic run
+        slis["elastic_mode_transitions"] = len(modes)
+
+    interactive = [r["first_step_s"] for r in requests
+                   if not r.get("cold")
+                   and r.get("tenant_class") == "interactive"
+                   and r.get("first_step_s") is not None]
+    if interactive:
+        slis["elastic_interactive_p99_s"] = _empirical_quantile(
+            interactive, 0.99)
+
+    if admits:
+        done = {r.get("trace_id") for r in requests + sheds
+                if r.get("trace_id")}
+        slis["elastic_lost_requests"] = sum(
+            1 for a in admits
+            if a.get("trace_id") and a["trace_id"] not in done)
+    return slis
+
+
 def load_contract(path: str = CONTRACT_PATH) -> dict:
     with open(path) as f:
         doc = json.load(f)
@@ -361,6 +448,65 @@ def run_soak_ledger(args, ledger_path: str) -> dict:
     return out
 
 
+def run_elastic_drill(args, directory: str) -> dict:
+    """Run the bounded elastic warm-pool drill in ``directory``; the
+    drill owns its own attached ledger
+    (``<directory>/elastic_ledger.jsonl``) and raises on any broken
+    invariant before the SLO layer even evaluates."""
+    if args.backend == "device":
+        from ibamr_tpu.utils.backend_guard import init_backend_with_retry
+        _jax, _platform, err = init_backend_with_retry(retries=1,
+                                                       delay=2.0)
+        if err:
+            print(f"[slo] backend init degraded: {err}",
+                  file=sys.stderr)
+    else:
+        from ibamr_tpu.utils.backend_guard import force_cpu
+        force_cpu()
+    from tools.fault_injection import run_elastic_smoke
+
+    return run_elastic_smoke(directory,
+                             duration_s=args.elastic_duration,
+                             rate_rps=args.elastic_rate,
+                             time_scale=args.elastic_time_scale,
+                             shift_frac=args.elastic_shift_frac)
+
+
+def tighten_elastic(slis: dict, elastic_cfg: dict,
+                    contract_path: str):
+    """Merge a fresh ``elastic_slos`` section (plus the drill cfg)
+    into the existing contract, leaving ``slos``/``soak_slos``
+    untouched. Latency ceilings get 2x slack (floored at 1 s), the
+    transition ceiling +2; lost requests and fresh restart compiles
+    pin EXACTLY (zero is the invariant, not a budget)."""
+    elastic_slos = {}
+    if slis.get("elastic_scale_up_latency_s") is not None:
+        elastic_slos["elastic_scale_up_latency_s"] = {"ceiling": round(
+            max(2.0 * slis["elastic_scale_up_latency_s"], 1.0), 4)}
+    if slis.get("elastic_restart_to_warm_s") is not None:
+        elastic_slos["elastic_restart_to_warm_s"] = {"ceiling": round(
+            max(2.0 * slis["elastic_restart_to_warm_s"], 1.0), 4)}
+    if slis.get("elastic_restart_fresh_compiles") is not None:
+        elastic_slos["elastic_restart_fresh_compiles"] = {
+            "ceiling": int(slis["elastic_restart_fresh_compiles"])}
+    if slis.get("elastic_lost_requests") is not None:
+        elastic_slos["elastic_lost_requests"] = {
+            "ceiling": int(slis["elastic_lost_requests"])}
+    if slis.get("elastic_mode_transitions") is not None:
+        elastic_slos["elastic_mode_transitions"] = {
+            "ceiling": int(slis["elastic_mode_transitions"]) + 2}
+    if slis.get("elastic_interactive_p99_s") is not None:
+        elastic_slos["elastic_interactive_p99_s"] = {"ceiling": round(
+            max(2.0 * slis["elastic_interactive_p99_s"], 1.0), 4)}
+    try:
+        doc = load_contract(contract_path)
+    except FileNotFoundError:
+        doc = {"slo_schema": SLO_SCHEMA, "slos": {}}
+    doc["elastic"] = elastic_cfg
+    doc["elastic_slos"] = elastic_slos
+    return doc
+
+
 def tighten_soak(slis: dict, soak_cfg: dict, contract_path: str):
     """Merge a fresh ``soak_slos`` section (plus the soak drill cfg)
     into the existing contract, leaving the cold/warm ``slos``
@@ -390,6 +536,8 @@ def tighten_soak(slis: dict, soak_cfg: dict, contract_path: str):
 
 
 def cmd_check(args) -> int:
+    if getattr(args, "elastic", False):
+        return _check_elastic(args)
     if getattr(args, "soak", False):
         return _check_soak(args)
     if args.ledger:
@@ -453,6 +601,71 @@ def cmd_check(args) -> int:
                1: "unevaluable — missing contract or SLI "
                   "(run --tighten to pin)",
                2: "VIOLATED — the serving path is out of SLO"}[rc]
+    print(f"[slo] {verdict}")
+    return rc
+
+
+def _check_elastic(args) -> int:
+    """The ``check --elastic`` path: elastic SLIs vs the contract's
+    ``elastic_slos`` section, same exit convention as the cold/warm
+    check. Without ``--ledger`` the bounded elastic drill runs first
+    — its own pinned invariants raise before the budget is even
+    consulted, so exit 2 here means a BUDGET regression on a drill
+    that still satisfies the hard invariants."""
+    from ibamr_tpu.obs.bus import read_ledger
+
+    if args.ledger:
+        records = read_ledger(args.ledger)
+        elastic_cfg = {"source": args.ledger}
+    else:
+        with tempfile.TemporaryDirectory(prefix="slo-elastic-") as td:
+            run_elastic_drill(args, td)
+            records = read_ledger(
+                os.path.join(td, "elastic_ledger.jsonl"))
+        elastic_cfg = {"duration_s": args.elastic_duration,
+                       "rate_rps": args.elastic_rate,
+                       "shift_frac": args.elastic_shift_frac,
+                       "time_scale": args.elastic_time_scale}
+    slis = elastic_slis_from_ledger(records)
+
+    if args.tighten:
+        doc = tighten_elastic(slis, elastic_cfg, args.contract)
+        with open(args.contract, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"[slo] wrote {args.contract} (elastic_slos)")
+        return 0
+
+    try:
+        contract = load_contract(args.contract)
+    except FileNotFoundError:
+        contract = None
+    budget = (contract or {}).get("elastic_slos")
+    if not budget:
+        violations, unmeasurable, met = [], [], []
+    else:
+        violations, unmeasurable, met = evaluate(slis, {"slos": budget})
+    unbudgeted = not budget
+    rc = (2 if violations
+          else 1 if unmeasurable or unbudgeted
+          else 0)
+    if args.as_json:
+        print(json.dumps({
+            "exit": rc, "slis": slis,
+            "violated": violations, "unmeasurable": unmeasurable,
+            "met": met, "unbudgeted": unbudgeted},
+            indent=1, sort_keys=True))
+        return rc
+    for line in violations + unmeasurable + met:
+        print(f"[slo] {line}")
+    if unbudgeted:
+        print(f"[slo] no elastic_slos in {args.contract} — run "
+              f"--elastic --tighten to pin")
+    verdict = {0: "clean — every elastic SLO attained",
+               1: "unevaluable — missing elastic_slos or SLI (run "
+                  "--elastic --tighten to pin)",
+               2: "VIOLATED — the elastic serving path is out of "
+                  "SLO"}[rc]
     print(f"[slo] {verdict}")
     return rc
 
@@ -565,6 +778,20 @@ def main(argv=None) -> int:
     c.add_argument("--soak-time-scale", type=float, default=0.5,
                    help="wall seconds per virtual second (0.5 = "
                         "replay the schedule at 2x speed)")
+    c.add_argument("--elastic", action="store_true",
+                   help="run the elastic warm-pool drill (mix shift "
+                        "+ memory pressure + restart) and evaluate "
+                        "the elastic_slos section")
+    c.add_argument("--elastic-duration", type=float, default=5.0,
+                   help="virtual seconds of arrivals in the elastic "
+                        "drill")
+    c.add_argument("--elastic-rate", type=float, default=8.0,
+                   help="base arrival rate (requests per virtual s)")
+    c.add_argument("--elastic-shift-frac", type=float, default=0.4,
+                   help="fraction of the run after which the mix "
+                        "rotates to the unseen family")
+    c.add_argument("--elastic-time-scale", type=float, default=0.5,
+                   help="wall seconds per virtual second")
     c.add_argument("--tighten", action="store_true",
                    help="rewrite the contract from the measured SLIs "
                         "(with slack on latency/ratio budgets)")
