@@ -477,6 +477,56 @@ def elastic_reference(timeout_s: float = 300.0,
         timeout_s, f"elastic leg hung > {timeout_s:.0f}s", "elastic")
 
 
+def _grad_child(q, n, reps):
+    """Child body: the gradient microbench (PR 19) on a single
+    virtual CPU device — primal-vs-VJP wall time and the FFT /
+    scatter / f64-widening census for the fused substep, the packed
+    transfers, and the whole coupled step."""
+    try:
+        import sys as _sys
+        _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from ibamr_tpu.utils.backend_guard import force_cpu
+
+        force_cpu(1)
+        from tools.microbench_grad import run as grad_run
+
+        out = grad_run(n=n, reps=reps, quiet=True)
+        keep = {"n", "backend"}
+        for piece in ("substep", "spread", "interp", "step"):
+            keep.update({f"{piece}_primal_ms", f"{piece}_vjp_ms",
+                         f"{piece}_grad_ratio",
+                         f"{piece}_primal_fft_ops",
+                         f"{piece}_vjp_fft_ops",
+                         f"{piece}_vjp_scatter_prims"})
+        slim = {k: (round(v, 3) if isinstance(v, float) else v)
+                for k, v in out.items() if k in keep}
+        # the VJP graph replays the primal forward (overflow-fallback
+        # scatters included); the pinned claim is that the REVERSE
+        # sweep adds none on the spread path, so report the delta
+        slim["spread_vjp_scatter_added"] = (
+            out.get("spread_vjp_scatter_prims", 0)
+            - out.get("spread_primal_scatter_prims", 0))
+        slim["f64_widenings_total"] = sum(
+            v for k, v in out.items() if k.endswith("f64_widenings"))
+        q.put(slim)
+    except Exception as e:  # noqa: BLE001 - report, parent decides
+        q.put({"error": f"{type(e).__name__}: {e}"})
+
+
+def grad_reference(timeout_s: float = 300.0, n: int = 24,
+                   reps: int = 3):
+    """Adjoint-cost signal (PR 19): VJP-vs-primal wall ratio plus the
+    batched-FFT and scatter counts per differentiable piece from the
+    gradient microbench in a TERMINABLE child — trended across rounds
+    so a reverse-pass cost regression (an extra transpose FFT, a
+    scatter sneaking into the spread adjoint, an f64 widening) shows
+    up as a number next to the forward flagship legs. The full-size
+    on-chip capture rides tools/relay_watch.py at 256^3."""
+    return _run_guarded_child(
+        _grad_child, (n, reps), timeout_s,
+        f"grad leg hung > {timeout_s:.0f}s", "grad")
+
+
 def cpu_sharded_reference_with_trend(n_devices: int = 8):
     """The n=32 smoke leg PLUS a larger n=48 leg, with the
     speedup-vs-size trend (round 5, VERDICT round 4 weak #3: the
@@ -922,6 +972,10 @@ def main():
                          "shift + memory pressure + restart) in a "
                          "CPU child and trend scale-up/restart "
                          "latency")
+    ap.add_argument("--grad", action="store_true",
+                    help="also run the gradient microbench (primal vs "
+                         "VJP wall + FFT/scatter census per piece) in "
+                         "a CPU child and trend the adjoint ratios")
     ap.add_argument("--record", type=str, default="",
                     help="arm a flight recorder on every ramp stage; a "
                          "diverged stage dumps a replay capsule under "
@@ -1383,6 +1437,23 @@ def main():
             except Exception as e:
                 result["elastic"] = {
                     "error": f"{type(e).__name__}: {e}"}
+
+        # adjoint-cost leg (PR 19): primal-vs-VJP ratios + FFT/scatter
+        # census in a CPU child, trending the reverse-pass price per
+        # round (the "adjoint at primal cost" pins, measured)
+        if args.grad:
+            try:
+                remaining = (args.deadline
+                             - (time.perf_counter() - t_start))
+                if remaining < 30.0:
+                    result["grad"] = {
+                        "error": "skipped (deadline exhausted)"}
+                else:
+                    result["grad"] = grad_reference(
+                        timeout_s=min(300.0, remaining))
+                log(f"[bench] grad: {result['grad']}")
+            except Exception as e:
+                result["grad"] = {"error": f"{type(e).__name__}: {e}"}
 
         if errors:
             msg = "; ".join(errors)

@@ -111,14 +111,100 @@ def _build_transfer(engine, piece):
     return interp, (u, X, mask), ()
 
 
-def _driver(integ, lanes=None, donate=False, lane_mesh=None):
+def _driver(integ, lanes=None, donate=False, lane_mesh=None, remat=None):
     from ibamr_tpu.utils.health import HealthProbe
     from ibamr_tpu.utils.hierarchy_driver import HierarchyDriver, RunConfig
 
     cfg = RunConfig(dt=_DT, num_steps=4, health_interval=2,
-                    donate=donate)
+                    donate=donate, remat=remat)
     return HierarchyDriver(integ, cfg, lanes=lanes, lane_mesh=lane_mesh,
                            health_probe=HealthProbe.for_integrator(integ))
+
+
+# -- gradient artifacts (PR 19): the adjoint-at-primal-cost pins ------------
+
+def _build_grad_substep(spectral_dtype=None):
+    # full jax.vjp round trip of the fused spectral substep. The custom
+    # VJP rides the SAME plan (conjugate symbol application), so the
+    # whole forward+backward pass is pinned at <= 2x the primal's
+    # batched FFT calls (fft_ops 4 vs the primal's 2) — the headline
+    # "adjoint at primal cost" budget.
+    import jax
+    import jax.numpy as jnp
+
+    sub, (rhs,), _ = _build_fused_substep(spectral_dtype=spectral_dtype)
+    out_shape = jax.eval_shape(sub, rhs)
+    ct = jax.tree_util.tree_map(
+        lambda s: jnp.ones(s.shape, s.dtype), out_shape)
+
+    def grad_sub(r, c):
+        out, vjpf = jax.vjp(sub, r)
+        return out, vjpf(c)
+
+    return grad_sub, (rhs, ct), ()
+
+
+def _build_grad_transfer(piece):
+    # the packed-transfer BACKWARD pass in isolation (the bwd rule the
+    # custom VJP installs), with the buckets closure-captured exactly as
+    # reverse-mode residuals are: zero bucket preps in the graph, and
+    # for grad_spread zero scatter primitives — d(spread) is an interp
+    # through the SAME PackedBuckets (gather-only overflow merge
+    # included). grad_interp's d/df IS the primal spread (the adjoint
+    # of a gather is a scatter); its budget pins that no NEW scatter
+    # shapes appear beyond the primal set.
+    import jax
+    import jax.numpy as jnp
+
+    from ibamr_tpu.ops import interaction_packed as ip
+
+    integ, state = _shell(engine="packed")
+    eng = integ.ib.fast
+    X, mask = state.X, state.mask
+    b = eng.buckets(X, mask)
+    nd = (eng.geom, eng.grid, 0, eng.kernel,
+          jax.lax.Precision.HIGHEST, None)
+    if piece == "spread":
+        F = jnp.zeros(X.shape[0], X.dtype)
+        g = jnp.zeros(eng.grid.n, X.dtype)
+
+        def spread_bwd(Fa, Xa, ga):
+            return ip._spread_bwd(*nd, (b, Fa, Xa), ga)[1:]
+
+        return spread_bwd, (F, X, g), ()
+    f = jnp.zeros(eng.grid.n, X.dtype)
+    ct = jnp.zeros(X.shape[0], X.dtype)
+
+    def interp_bwd(fa, Xa, ca):
+        return ip._interp_bwd(*nd, (b, fa, Xa), ca)[1:]
+
+    return interp_bwd, (f, X, ct), ()
+
+
+def _build_grad_chunk():
+    # reverse mode through the driver's remat-checkpointed scan chunk
+    # (RunConfig(remat=), health probe fused in): the design loop's
+    # unit of differentiation. host_transfers_in_scan == 0 and
+    # f64_widenings == 0 are the pins — the cotangent scan must stay as
+    # device-resident and dtype-clean as the primal one.
+    import jax
+    import jax.numpy as jnp
+
+    integ, state = _shell()
+    drv = _driver(integ, remat="dots")
+    chunk = _unwrap(drv._chunk(4))
+
+    def grad_chunk(st, dt):
+        def loss(s):
+            leaves = jax.tree_util.tree_leaves(chunk(s, dt))
+            return sum(jnp.sum(l) for l in leaves
+                       if jnp.issubdtype(l.dtype, jnp.inexact))
+
+        # allow_int: the state pytree carries int32 counters (step
+        # index, refresh bookkeeping) that get symbolic-zero cotangents
+        return jax.grad(loss, allow_int=True)(st)
+
+    return grad_chunk, (state, _DT), ()
 
 
 def _build_solo_chunk():
@@ -441,6 +527,26 @@ ARTIFACTS: Dict[str, Artifact] = {
         Artifact("interp_mxu",
                  lambda: _build_transfer(True, "interp"),
                  notes="dense one-hot MXU interp"),
+        Artifact("grad_substep", _build_grad_substep,
+                 notes="full vjp round trip of the fused substep: the "
+                       "cotangent rides the SAME plan, <= 2x primal "
+                       "batched FFTs (fft_ops 4 vs 2) is the headline "
+                       "adjoint-at-primal-cost pin"),
+        Artifact("grad_spread",
+                 lambda: _build_grad_transfer("spread"),
+                 notes="packed spread backward pass: an interp through "
+                       "the SAME buckets — zero scatter prims, zero "
+                       "bucket preps"),
+        Artifact("grad_interp",
+                 lambda: _build_grad_transfer("interp"),
+                 notes="packed interp backward pass: d/df reuses the "
+                       "primal spread's scatter set (no new shapes), "
+                       "d/dX the oracle weight-derivative pullback"),
+        Artifact("grad_chunk", _build_grad_chunk,
+                 notes="reverse mode through the remat-checkpointed "
+                       "driver chunk; cotangent scan stays device-"
+                       "resident (zero in-scan transfers) and dtype-"
+                       "clean (zero f64 widenings)"),
         Artifact("solo_chunk", _build_solo_chunk,
                  notes="driver scan chunk + fused health probe; "
                        "host_transfers_in_scan == 0 is the pin"),

@@ -63,7 +63,10 @@ _JIT_DECOS = {"jit", "filter_jit"}
 _TRACE_ENTRY = {"jit", "vmap", "pmap", "grad", "value_and_grad",
                 "checkpoint", "remat", "custom_vjp", "custom_jvp",
                 "scan", "while_loop", "cond", "switch", "fori_loop",
-                "map", "associated_scan", "associative_scan"}
+                "map", "associated_scan", "associative_scan",
+                # gradient entry points (PR 19): functions handed to
+                # these are traced scopes exactly like jit/grad ones
+                "vjp", "linearize", "jacfwd", "jacrev"}
 # attribute / call results that are trace-time STATIC even on a tracer
 _STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding",
                  "itemsize", "weak_type"}

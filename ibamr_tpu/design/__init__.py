@@ -1,0 +1,23 @@
+"""Inverse design: optimize THROUGH the solver (PR 19, ROADMAP item 4).
+
+The C++ reference can only simulate; this package cashes in every perf
+lever the rebuild chose with the gradient path in mind — the fused
+``SpectralPlan`` substep (custom VJP: cotangents ride the SAME plan),
+the packed transfers (d(spread) is an interp through the SAME buckets,
+zero scatter primitives), ``RunConfig(remat=)`` checkpointed chunks,
+and the PR-11 ``ExecutableCache`` (gradient executables keyed as
+``kind: grad_chunk`` so a design iteration after the first pays zero
+compiles). A design loop is a warm-pool tenant.
+"""
+
+from ibamr_tpu.design.loop import (AdamState, DesignIter, DesignLoop,
+                                   DesignResult, adam_init, adam_update,
+                                   global_norm)
+from ibamr_tpu.design.eel_gait import build_eel, build_eel_gait_problem
+from ibamr_tpu.design.cantilever import build_cantilever_problem
+
+__all__ = [
+    "AdamState", "DesignIter", "DesignLoop", "DesignResult",
+    "adam_init", "adam_update", "global_norm",
+    "build_eel", "build_eel_gait_problem", "build_cantilever_problem",
+]

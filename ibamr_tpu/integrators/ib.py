@@ -25,6 +25,7 @@ with ``compute_force(X, U, t)`` can replace the standard force generator
 
 from __future__ import annotations
 
+import functools
 from typing import Callable, NamedTuple, Optional, Tuple
 
 import jax
@@ -166,13 +167,44 @@ class IBExplicitIntegrator:
         Donation contract: after ``new = f(state, dt)`` the caller's
         ``state`` buffers are DELETED — anyone retaining pre-step state
         (rollback templates, trajectory recorders keeping live arrays)
-        must pass ``donate=False``."""
+        must pass ``donate=False``. That includes reverse-mode autodiff:
+        a cotangent pass replays the step from saved primals, so a
+        donated input under an outer ``grad``/``vjp`` trace is a
+        use-after-free the donated executable would hide. The returned
+        callable therefore REFUSES (raises, does not silently ignore)
+        donation when any input leaf is a tracer — mirroring
+        ResilientDriver's forced-off donation, but loudly: the caller
+        asked for an optimization the gradient makes unsound, and must
+        choose (``donate=False``, or ``RunConfig(remat=...)`` chunks
+        which force donation off under grad)."""
         key = (bool(donate), bool(with_stats))
         fn = self._jitted_steps.get(key)
         if fn is None:
             base = self.step_with_stats if with_stats else self.step
-            fn = jax.jit(base, donate_argnums=(0,)) if donate \
-                else jax.jit(base)
+            if donate:
+                jitted = jax.jit(base, donate_argnums=(0,))
+
+                @functools.wraps(base)
+                def fn(state, dt):
+                    if any(isinstance(l, jax.core.Tracer)
+                           for l in jax.tree_util.tree_leaves(
+                               (state, dt))):
+                        raise ValueError(
+                            "jitted_step(donate=True) called under an "
+                            "active trace (grad/vjp/jit): buffer "
+                            "donation invalidates the primal values "
+                            "the cotangent pass replays from. Use "
+                            "jitted_step(donate=False) when "
+                            "differentiating (the design loop and "
+                            "RunConfig(remat=...) chunks do this "
+                            "automatically).")
+                    return jitted(state, dt)
+                # keep the RAW python step reachable for the graph-
+                # contract harness (contracts._unwrap lowers it with
+                # its own donate_argnums)
+                fn.__wrapped__ = base
+            else:
+                fn = jax.jit(base)
             self._jitted_steps[key] = fn
         return fn
 

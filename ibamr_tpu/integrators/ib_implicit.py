@@ -26,6 +26,7 @@ the same structure as the reference's per-Krylov-iteration cost.
 
 from __future__ import annotations
 
+import contextlib
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -33,9 +34,21 @@ import jax.numpy as jnp
 
 from ibamr_tpu.integrators.ib import IBMethod, IBState
 from ibamr_tpu.integrators.ins import INSStaggeredIntegrator
+from ibamr_tpu.ops.interaction_packed import plain_autodiff_transfers
 from ibamr_tpu.solvers.krylov import newton_krylov
+from ibamr_tpu.solvers.spectral_plan import plain_autodiff_substep
 
 Vel = Tuple[jnp.ndarray, ...]
+
+
+@contextlib.contextmanager
+def _forward_diffable_trace():
+    """newton_krylov takes exact JVPs (jax.linearize) through the whole
+    spread -> solve -> interp residual, and jax.custom_vjp functions
+    refuse forward mode — trace the Newton solve with the budgeted
+    reverse-mode wrappers swapped for their raw autodiff twins."""
+    with plain_autodiff_transfers(), plain_autodiff_substep():
+        yield
 
 
 class IBImplicitIntegrator:
@@ -108,11 +121,12 @@ class IBImplicitIntegrator:
         U_n = ib.interpolate_velocity(u_n, grid, X_n, mask)
         X_pred = X_n + dt * U_n
 
-        sol = newton_krylov(residual, X_pred, tol=self.newton_tol,
-                            maxiter=self.newton_maxiter,
-                            inner_m=self.inner_m,
-                            inner_restarts=self.inner_restarts,
-                            inner_tol=self.inner_tol)
+        with _forward_diffable_trace():
+            sol = newton_krylov(residual, X_pred, tol=self.newton_tol,
+                                maxiter=self.newton_maxiter,
+                                inner_m=self.inner_m,
+                                inner_restarts=self.inner_restarts,
+                                inner_tol=self.inner_tol)
         X_new = sol.x
         ins_new, U_mid = fluid_and_U(X_new)
         return IBState(ins=ins_new, X=X_new, U=U_mid, mask=mask)
@@ -216,11 +230,12 @@ class TwoLevelIBImplicit:
 
         U_n = expl._interp(fluid.uf, X_n, mask)
         X_pred = X_n + dt * U_n
-        sol = newton_krylov(residual, X_pred, tol=self.newton_tol,
-                            maxiter=self.newton_maxiter,
-                            inner_m=self.inner_m,
-                            inner_restarts=self.inner_restarts,
-                            inner_tol=self.inner_tol)
+        with _forward_diffable_trace():
+            sol = newton_krylov(residual, X_pred, tol=self.newton_tol,
+                                maxiter=self.newton_maxiter,
+                                inner_m=self.inner_m,
+                                inner_restarts=self.inner_restarts,
+                                inner_tol=self.inner_tol)
         X_new = sol.x
         fluid_new, U_mid = fluid_and_U(X_new)
         return TwoLevelIBState(fluid=fluid_new, X=X_new, U=U_mid,

@@ -22,6 +22,7 @@ import math
 from typing import Callable, Dict, List, Tuple, Union
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 KernelFn = Callable[[jnp.ndarray], jnp.ndarray]
@@ -38,20 +39,43 @@ def _phi_cosine(r: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(a < 2.0, 0.25 * (1.0 + jnp.cos(0.5 * math.pi * a)), 0.0)
 
 
+@jax.custom_jvp
+def _safe_sqrt(x: jnp.ndarray) -> jnp.ndarray:
+    """sqrt clamped at 0 with a FINITE derivative at the clamp (PR 19):
+    plain ``sqrt(maximum(x, 0))``'s autodiff chain is ``inf * 0 = nan``
+    wherever the clamp is active — which poisons every marker-position
+    gradient through the IB kernels. A custom JVP keeps the PRIMAL
+    graph byte-identical (the kernel appears in every transfer graph;
+    its convert/pbroadcast budgets must not pay for differentiability)
+    and guards only the derivative: 1/(2*sqrt) where positive, 0 at and
+    below the clamp."""
+    return jnp.sqrt(jnp.maximum(x, 0.0))
+
+
+@_safe_sqrt.defjvp
+def _safe_sqrt_jvp(primals, tangents):
+    (x,), (t,) = primals, tangents
+    y = _safe_sqrt(x)
+    pos = y > 0.0
+    denom = jnp.where(pos, 2.0 * y, jnp.ones((), y.dtype))
+    return y, jnp.where(pos, t / denom, jnp.zeros((), y.dtype))
+
+
 def _phi_ib3(r: jnp.ndarray) -> jnp.ndarray:
     a = jnp.abs(r)
-    # guard sqrt args so the unused branch never produces nan
-    inner = (1.0 + jnp.sqrt(jnp.maximum(1.0 - 3.0 * a * a, 0.0))) / 3.0
-    s = jnp.sqrt(jnp.maximum(1.0 - 3.0 * (1.0 - a) ** 2, 0.0))
+    # guard sqrt args so the unused branch never produces nan (and the
+    # gradient stays finite at the clamp — _safe_sqrt)
+    inner = (1.0 + _safe_sqrt(1.0 - 3.0 * a * a)) / 3.0
+    s = _safe_sqrt(1.0 - 3.0 * (1.0 - a) ** 2)
     outer = (5.0 - 3.0 * a - s) / 6.0
     return jnp.where(a < 0.5, inner, jnp.where(a < 1.5, outer, 0.0))
 
 
 def _phi_ib4(r: jnp.ndarray) -> jnp.ndarray:
     a = jnp.abs(r)
-    s_in = jnp.sqrt(jnp.maximum(1.0 + 4.0 * a - 4.0 * a * a, 0.0))
+    s_in = _safe_sqrt(1.0 + 4.0 * a - 4.0 * a * a)
     inner = 0.125 * (3.0 - 2.0 * a + s_in)
-    s_out = jnp.sqrt(jnp.maximum(-7.0 + 12.0 * a - 4.0 * a * a, 0.0))
+    s_out = _safe_sqrt(-7.0 + 12.0 * a - 4.0 * a * a)
     outer = 0.125 * (5.0 - 2.0 * a - s_out)
     return jnp.where(a < 1.0, inner, jnp.where(a < 2.0, outer, 0.0))
 

@@ -35,6 +35,8 @@ exact adjoints; tests pin equality against the scatter oracle.
 
 from __future__ import annotations
 
+import contextlib
+import functools
 import math
 from typing import NamedTuple, Optional, Tuple
 
@@ -43,6 +45,7 @@ import jax
 import jax.numpy as jnp
 
 from ibamr_tpu.grid import StaggeredGrid
+from ibamr_tpu.ops import interaction
 from ibamr_tpu.ops.delta import Kernel, get_kernel
 from ibamr_tpu.ops.interaction_fast import (
     BucketGeometry, _block_ids_np, _extract_tiles, _overlap_add,
@@ -50,6 +53,43 @@ from ibamr_tpu.ops.interaction_fast import (
     spread_overflow_fallbacks, unbucket_with_overflow)
 
 Vel = Tuple[jnp.ndarray, ...]
+
+# Reverse-mode policy for the packed transfers (PR 19). The default
+# custom VJP reuses spread/interp adjointness: d(spread) wrt F is an
+# interp of the grid cotangent through the SAME ``PackedBuckets`` (pure
+# gathers — the overflow merge is rewritten scatter-free), d(interp)
+# wrt f is a spread through the same buckets, and position cotangents
+# flow through the oracle stencil weights (gather-only graphs). The
+# bucket layout itself is treated as a non-differentiated constant:
+# pack-time integers are piecewise constant in X, and the position
+# gradient is returned in full through the explicit ``X`` argument
+# (callers always pass the same X the buckets were built from — the
+# engine API bakes that in). Set False to fall back to plain autodiff
+# through the packed implementation (saves nothing, emits transposed
+# scatters, and is NOT covered by the ``grad_spread``/``grad_interp``
+# graph budgets).
+GRAD_TRANSFERS = True
+
+
+@contextlib.contextmanager
+def plain_autodiff_transfers():
+    """Trace-scoped opt-out of the custom-VJP transfer wrappers.
+
+    ``jax.custom_vjp`` functions refuse forward-mode autodiff
+    (jvp/linearize), so any graph that takes exact JVPs through a
+    spread/interp — the implicit Newton-Krylov coupling linearizes its
+    whole spread -> solve -> interp residual — must trace inside this
+    context: transfers route through the raw packed implementations,
+    which JAX differentiates natively in both modes (reverse mode there
+    emits transposed scatters and is NOT covered by the
+    ``grad_spread``/``grad_interp`` budgets)."""
+    global GRAD_TRANSFERS
+    prev = GRAD_TRANSFERS
+    GRAD_TRANSFERS = False
+    try:
+        yield
+    finally:
+        GRAD_TRANSFERS = prev
 
 
 class PackedBuckets(NamedTuple):
@@ -255,15 +295,11 @@ def refresh_packed(geom: BucketGeometry, grid: StaggeredGrid,
                              overflow_cap=ocap)), hit
 
 
-def spread_packed(geom: BucketGeometry, grid: StaggeredGrid,
-                  b: PackedBuckets, F: jnp.ndarray, X: jnp.ndarray,
-                  centering, kernel: Kernel,
-                  precision=jax.lax.Precision.HIGHEST,
-                  compute_dtype=None) -> jnp.ndarray:
-    """Spread marker values F (N,) -> grid field; exact up to roundoff
-    vs interaction.spread (overflow flows through that path).
-    ``compute_dtype=jnp.bfloat16`` compresses the chunk operands (the
-    dominant HBM traffic; ~3 decimal digits of weight precision)."""
+def _spread_raw(geom: BucketGeometry, grid: StaggeredGrid,
+                b: PackedBuckets, F: jnp.ndarray, X: jnp.ndarray,
+                centering, kernel: Kernel,
+                precision=jax.lax.Precision.HIGHEST,
+                compute_dtype=None) -> jnp.ndarray:
     inv_vol = 1.0 / math.prod(grid.dx)
     Ff = bucketed_channel(b, F)
     A, Wlast = _tile_weights(geom, grid, b, centering, kernel)
@@ -279,12 +315,11 @@ def spread_packed(geom: BucketGeometry, grid: StaggeredGrid,
                                      kernel)
 
 
-def interpolate_packed(geom: BucketGeometry, grid: StaggeredGrid,
-                       b: PackedBuckets, f: jnp.ndarray, X: jnp.ndarray,
-                       centering, kernel: Kernel,
-                       precision=jax.lax.Precision.HIGHEST,
-                       compute_dtype=None) -> jnp.ndarray:
-    """Interpolate grid field at markers -> (N,) (adjoint of spread)."""
+def _interp_raw(geom: BucketGeometry, grid: StaggeredGrid,
+                b: PackedBuckets, f: jnp.ndarray, X: jnp.ndarray,
+                centering, kernel: Kernel,
+                precision=jax.lax.Precision.HIGHEST,
+                compute_dtype=None) -> jnp.ndarray:
     T = _extract_tiles(geom, grid, f)                 # (B, P, nz)
     Tq = jnp.take(T, b.tile_of_chunk, axis=0)         # (Q, P, nz)
     A, Wlast = _tile_weights(geom, grid, b, centering, kernel)
@@ -292,6 +327,208 @@ def interpolate_packed(geom: BucketGeometry, grid: StaggeredGrid,
                             precision=precision)
     Ub = jnp.sum(A * D, axis=-1) * b.wb               # (Q, c)
     return unbucket_with_overflow(Ub, b, f, X, grid, centering, kernel)
+
+
+# -- packed-transfer reverse mode (PR 19) ------------------------------------
+
+def _marker_weights(b: PackedBuckets) -> jnp.ndarray:
+    """Recover the per-ORIGINAL-marker weight vector from the packed
+    layout: the pack-time weight for packed markers (their slot is
+    unique) plus ``w_overflow`` for dropped ones — pure gathers."""
+    wb_flat = b.wb.reshape(-1)
+    packed = jnp.take(wb_flat, jnp.minimum(b.slot_of_marker,
+                                           wb_flat.size - 1))
+    packed = jnp.where(b.slot_of_marker < wb_flat.size, packed, 0.0)
+    return packed + b.w_overflow
+
+
+def _merge_overflow_gather(U: jnp.ndarray, o_idx: jnp.ndarray,
+                           vals: jnp.ndarray) -> jnp.ndarray:
+    """``U.at[o_idx].add(vals)`` rewritten scatter-free: sort the
+    compact overflow list by marker id, prefix-sum the sorted values,
+    and gather each marker's run sum via two searchsorted probes
+    (sort + cumsum + gathers only — pad entries alias real markers
+    with value 0, and duplicate ids sum exactly as the scatter-add
+    would)."""
+    perm = jnp.argsort(o_idx)
+    so = o_idx[perm]
+    cs = jnp.concatenate([jnp.zeros((1,), vals.dtype),
+                          jnp.cumsum(vals[perm])])
+    ar = jnp.arange(U.shape[0], dtype=so.dtype)
+    lo = jnp.searchsorted(so, ar, side="left")
+    hi = jnp.searchsorted(so, ar, side="right")
+    return U + (cs[hi] - cs[lo])
+
+
+def _interp_gather_only(geom: BucketGeometry, grid: StaggeredGrid,
+                        b: PackedBuckets, g: jnp.ndarray,
+                        X: jnp.ndarray, centering, kernel: Kernel,
+                        precision, compute_dtype) -> jnp.ndarray:
+    """Interp of grid field ``g`` through the SAME buckets, emitting
+    ZERO scatter primitives: the packed main path is already pure
+    gathers/einsum; the overflow merge goes through
+    :func:`_merge_overflow_gather` instead of ``.at[].add``. This is
+    the spread VJP's cotangent pass — ``grad_spread`` pins the zero."""
+    T = _extract_tiles(geom, grid, g)
+    Tq = jnp.take(T, b.tile_of_chunk, axis=0)
+    A, Wlast = _tile_weights(geom, grid, b, centering, kernel)
+    D = contract_compressed("qpz,qmz->qmp", Tq, Wlast, compute_dtype,
+                            precision=precision)
+    Ub = jnp.sum(A * D, axis=-1) * b.wb
+    U = jnp.take(Ub.reshape(-1), jnp.minimum(
+        b.slot_of_marker, Ub.size - 1), axis=0)
+    U = jnp.where(b.slot_of_marker < Ub.size, U, 0.0)
+
+    def compact(U):
+        Uo = interaction.interpolate(g, grid, X[b.o_idx],
+                                     centering=centering, kernel=kernel,
+                                     weights=b.o_w)
+        return _merge_overflow_gather(U, b.o_idx, Uo)
+
+    def full(U):
+        return U + interaction.interpolate(
+            g, grid, X, centering=centering, kernel=kernel,
+            weights=b.w_overflow)
+
+    return jax.lax.cond(
+        b.exceeded, full,
+        lambda u: jax.lax.cond(b.any_overflow, compact,
+                               lambda uu: uu, u), U)
+
+
+def _position_cotangent(grid: StaggeredGrid, field: jnp.ndarray,
+                        X: jnp.ndarray, centering, kernel: Kernel,
+                        scale: jnp.ndarray) -> jnp.ndarray:
+    """Marker-position cotangent of a transfer: pull ``scale`` (the
+    per-marker chain factor) back through the oracle stencil evaluation
+    ``X -> sum_cells field * delta_h(cells - X)``. The stencil indices
+    are floor-derived (zero derivative); only the kernel weights
+    differentiate, so the pulled-back graph is gathers + elementwise —
+    no scatters."""
+    y, pull = jax.vjp(
+        lambda Xp: interaction.interpolate(field, grid, Xp,
+                                           centering=centering,
+                                           kernel=kernel), X)
+    (X_ct,) = pull(scale.astype(y.dtype))
+    return X_ct
+
+
+def _zeros_ct(x):
+    if jnp.issubdtype(jnp.result_type(x), jnp.inexact):
+        return jnp.zeros_like(x)
+    return np.zeros(jnp.shape(x), dtype=jax.dtypes.float0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4, 5))
+def _spread_vjp(geom, grid, centering, kernel, precision, compute_dtype,
+                b: PackedBuckets, F: jnp.ndarray,
+                X: jnp.ndarray) -> jnp.ndarray:
+    return _spread_raw(geom, grid, b, F, X, centering, kernel,
+                       precision=precision, compute_dtype=compute_dtype)
+
+
+def _spread_fwd(geom, grid, centering, kernel, precision, compute_dtype,
+                b, F, X):
+    out = _spread_raw(geom, grid, b, F, X, centering, kernel,
+                      precision=precision, compute_dtype=compute_dtype)
+    return out, (b, F, X)
+
+
+def _spread_bwd(geom, grid, centering, kernel, precision, compute_dtype,
+                res, ct):
+    b, F, X = res
+    inv_vol = 1.0 / math.prod(grid.dx)
+    # d/dF: interp of the grid cotangent through the SAME buckets
+    # (weights included), scaled by the spread's 1/h^dim — zero
+    # scatters, zero bucket preps
+    F_ct = inv_vol * _interp_gather_only(geom, grid, b, ct, X,
+                                         centering, kernel, precision,
+                                         compute_dtype)
+    # d/dX: the kernel-weight derivative, pulled back through the
+    # oracle stencil evaluation of the SAME cotangent field
+    w_full = _marker_weights(b)
+    X_ct = _position_cotangent(grid, ct, X, centering, kernel,
+                               F * w_full * inv_vol)
+    return (jax.tree_util.tree_map(_zeros_ct, b), F_ct, X_ct)
+
+
+_spread_vjp.defvjp(_spread_fwd, _spread_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4, 5))
+def _interp_vjp(geom, grid, centering, kernel, precision, compute_dtype,
+                b: PackedBuckets, f: jnp.ndarray,
+                X: jnp.ndarray) -> jnp.ndarray:
+    return _interp_raw(geom, grid, b, f, X, centering, kernel,
+                       precision=precision, compute_dtype=compute_dtype)
+
+
+def _interp_fwd(geom, grid, centering, kernel, precision, compute_dtype,
+                b, f, X):
+    out = _interp_raw(geom, grid, b, f, X, centering, kernel,
+                      precision=precision, compute_dtype=compute_dtype)
+    return out, (b, f, X)
+
+
+def _interp_bwd(geom, grid, centering, kernel, precision, compute_dtype,
+                res, ct):
+    b, f, X = res
+    vol = math.prod(grid.dx)
+    # d/df: spread of the marker cotangents through the SAME buckets;
+    # interp carries no 1/h^dim, so undo the spread's factor. The
+    # grid-side adjoint of a gather IS a scatter — this path reuses
+    # the primal spread's scatter set verbatim (grad_interp budgets
+    # it; no NEW scatter shapes are introduced)
+    f_ct = vol * _spread_raw(geom, grid, b, ct, X, centering, kernel,
+                             precision=precision,
+                             compute_dtype=compute_dtype)
+    w_full = _marker_weights(b)
+    X_ct = _position_cotangent(grid, f, X, centering, kernel,
+                               ct * w_full)
+    return (jax.tree_util.tree_map(_zeros_ct, b), f_ct, X_ct)
+
+
+_interp_vjp.defvjp(_interp_fwd, _interp_bwd)
+
+
+def spread_packed(geom: BucketGeometry, grid: StaggeredGrid,
+                  b: PackedBuckets, F: jnp.ndarray, X: jnp.ndarray,
+                  centering, kernel: Kernel,
+                  precision=jax.lax.Precision.HIGHEST,
+                  compute_dtype=None) -> jnp.ndarray:
+    """Spread marker values F (N,) -> grid field; exact up to roundoff
+    vs interaction.spread (overflow flows through that path).
+    ``compute_dtype=jnp.bfloat16`` compresses the chunk operands (the
+    dominant HBM traffic; ~3 decimal digits of weight precision).
+
+    Reverse mode: a custom VJP (see ``GRAD_TRANSFERS``) whose cotangent
+    pass is an interp through the SAME buckets — zero scatter
+    primitives, zero extra bucket preps (the ``grad_spread`` graph
+    budget pins both)."""
+    if not GRAD_TRANSFERS:
+        return _spread_raw(geom, grid, b, F, X, centering, kernel,
+                           precision=precision,
+                           compute_dtype=compute_dtype)
+    return _spread_vjp(geom, grid, centering, kernel, precision,
+                       compute_dtype, b, F, X)
+
+
+def interpolate_packed(geom: BucketGeometry, grid: StaggeredGrid,
+                       b: PackedBuckets, f: jnp.ndarray, X: jnp.ndarray,
+                       centering, kernel: Kernel,
+                       precision=jax.lax.Precision.HIGHEST,
+                       compute_dtype=None) -> jnp.ndarray:
+    """Interpolate grid field at markers -> (N,) (adjoint of spread).
+
+    Reverse mode: custom VJP — d/df is a spread through the SAME
+    buckets (scaled by h^dim), d/dX the oracle weight-derivative
+    pullback (``grad_interp`` budgets the pass)."""
+    if not GRAD_TRANSFERS:
+        return _interp_raw(geom, grid, b, f, X, centering, kernel,
+                           precision=precision,
+                           compute_dtype=compute_dtype)
+    return _interp_vjp(geom, grid, centering, kernel, precision,
+                       compute_dtype, b, f, X)
 
 
 class PackedInteraction:

@@ -37,6 +37,8 @@ calls), so trajectories and restart files are unchanged.
 
 from __future__ import annotations
 
+import contextlib
+import functools
 import threading
 from collections import OrderedDict
 from typing import Optional, Sequence, Tuple
@@ -45,6 +47,38 @@ import jax
 import jax.numpy as jnp
 
 Vel = Tuple[jnp.ndarray, ...]
+
+# Reverse-mode policy for the fused substep (PR 19). The default custom
+# VJP treats the Helmholtz/pressure coefficients (alpha, beta,
+# pinc_coeffs) and filter_sym as non-differentiated constants — design
+# variables flow through the RHS fields, and the cotangent pass is the
+# SAME plan with conjugated symbols (one batched rfftn + one batched
+# irfftn, zero saved spectra). Set this True to fall back to plain
+# autodiff when a caller genuinely needs d/d(alpha) or d/d(beta)
+# (e.g. differentiating through an adaptive dt); that path re-derives
+# the chain rule through the k-space algebra and is NOT covered by the
+# ``grad_substep`` graph budget.
+DIFFERENTIATE_COEFFS = False
+
+
+@contextlib.contextmanager
+def plain_autodiff_substep():
+    """Trace-scoped opt-out of the fused substep's custom VJP.
+
+    ``jax.custom_vjp`` refuses forward-mode autodiff (jvp/linearize);
+    graphs that linearize through the fluid solve — the implicit
+    Newton-Krylov residual folds an INS step into every evaluation —
+    must trace inside this context, which routes ``substep`` through
+    the raw k-space algebra (both autodiff modes supported natively;
+    coefficient gradients become available; the ``grad_substep``
+    budget does not apply to graphs traced this way)."""
+    global DIFFERENTIATE_COEFFS
+    prev = DIFFERENTIATE_COEFFS
+    DIFFERENTIATE_COEFFS = True
+    try:
+        yield
+    finally:
+        DIFFERENTIATE_COEFFS = prev
 
 # -- spectral_dtype normalization -------------------------------------------
 
@@ -205,20 +239,24 @@ class SpectralPlan:
                 filter_sym=filter_sym)
             return (tuple(c.astype(rdtype) for c in u64),
                     p64.astype(rdtype))
-        x = jnp.stack(rhs)
-        if sdtype is not None:
-            # bf16 transform operands, f32 twiddle/accumulation
-            x = _round_real(x.astype(jnp.float32), sdtype)
-        uh = jnp.fft.rfftn(x, axes=self.axes)
-        outh = self.kspace_algebra(uh, alpha, beta, pinc_coeffs,
-                                   f32=sdtype is not None,
-                                   filter_sym=filter_sym)
-        if sdtype is not None:
-            # split-real compression of the inverse-transform operand
-            outh = _round_complex(outh, sdtype)
-        out = jnp.fft.irfftn(outh, s=self.shape, axes=self.axes)
-        out = out.astype(rdtype)
-        return tuple(out[d] for d in range(self.dim)), out[self.dim]
+        a, b = pinc_coeffs
+        sdtype_name = "bf16" if sdtype is jnp.bfloat16 else "none"
+        # strongly type concrete coefficients HERE, at trace time: a
+        # weak python float crossing the custom_vjp boundary becomes a
+        # convert_element_type op per scalar in the compiled graph (the
+        # convert budgets pin the substep at its pre-VJP count). Traced
+        # coefficients (dt under grad) pass through untouched.
+        wdtype = jnp.float32 if sdtype is not None else self.rdtype
+        alpha, beta, a, b = (
+            v if isinstance(v, jax.core.Tracer) else jnp.asarray(v, wdtype)
+            for v in (alpha, beta, a, b))
+        if DIFFERENTIATE_COEFFS:
+            # opt-out: plain autodiff through the raw math (coefficient
+            # cotangents available, gradient cost unbudgeted)
+            return _substep_raw(self, sdtype_name, tuple(rhs),
+                                alpha, beta, a, b, filter_sym)
+        return _substep_core(self, sdtype_name, tuple(rhs),
+                             alpha, beta, a, b, filter_sym)
 
     def kspace_algebra(self, uh: jnp.ndarray, alpha, beta,
                        pinc_coeffs: Tuple[float, float],
@@ -248,6 +286,46 @@ class SpectralPlan:
         return jnp.stack(
             [uh[d] + jnp.conj(D[d]) * phih for d in range(dim)]
             + [((a + b * sym) * phih).astype(cdtype)])
+
+    def kspace_algebra_adjoint(self, ch: jnp.ndarray, alpha, beta,
+                               pinc_coeffs: Tuple[float, float],
+                               f32: bool = False,
+                               filter_sym: Optional[jnp.ndarray] = None
+                               ) -> jnp.ndarray:
+        """Conjugate-transpose of :meth:`kspace_algebra`'s block symbol,
+        applied to the stacked ``dim + 1`` cotangent spectra ``ch``.
+
+        The substep's spatial map is ``irfftn . diag(M) . rfftn`` for
+        the per-mode block symbol ``M(k)``; its real transpose is the
+        SAME transform pair around ``M(k)^H``. With ``H = 1/(alpha +
+        beta*lam)``, ``P = filter_sym`` and ``D_e`` the staggered
+        divergence symbols, the closed form is
+
+            (M^H c)_e = H * P * [ c_e + conj(D_e)/lam *
+                                  ( sum_d D_d c_d + (a + b*lam) c_p ) ]
+
+        with the ``1/lam`` term zeroed at k=0 (matching the primal's
+        zero-mean pressure convention). Same cached tables, same
+        diagonal structure, zero extra transforms — the cotangent pass
+        IS the plan."""
+        dim = self.dim
+        sym, D = self._tables(f32=f32)
+        wdtype = jnp.float32 if f32 else self.rdtype
+        cdtype = ch.dtype
+        a, b = pinc_coeffs
+        g = None
+        for d in range(dim):
+            t = D[d] * ch[d]
+            g = t if g is None else g + t
+        g = g + ((a + b * sym) * ch[dim]).astype(cdtype)
+        sym_safe = jnp.where(sym == 0, 1.0, sym)
+        psih = jnp.where(sym == 0, 0.0, g / sym_safe)
+        denom = (alpha + beta * sym).astype(wdtype)
+        out = jnp.stack([ch[d] + jnp.conj(D[d]) * psih
+                         for d in range(dim)]) / denom[None]
+        if filter_sym is not None:
+            out = out * filter_sym.astype(wdtype)[None]
+        return out
 
     # -- the classic solves, sharing the cached tables -----------------------
     def solve_poisson(self, rhs: jnp.ndarray) -> jnp.ndarray:
@@ -303,6 +381,85 @@ class SpectralPlan:
         out = jnp.fft.irfftn(uh, s=self.shape, axes=self.axes)
         out = out.astype(rdtype)
         return tuple(out[d] for d in range(dim)), out[dim]
+
+
+# -- fused-substep reverse mode (PR 19) --------------------------------------
+#
+# ``_substep_raw`` is the literal substep math (bitwise identical to the
+# pre-VJP implementation: same ops, same order). ``_substep_core`` wraps
+# it in a ``jax.custom_vjp`` whose backward pass applies the SAME plan
+# with conjugated symbols: one batched rfftn over the stacked dim+1
+# output cotangents, the diagonal ``kspace_algebra_adjoint``, one
+# batched irfftn for the dim RHS cotangents. No spectra are saved from
+# the forward pass (residuals are the five scalars + the filter table),
+# so a full vjp round trip costs exactly 2x the primal's batched FFT
+# calls — the ``grad_substep`` graph budget pins that statically.
+
+def _substep_raw(plan: "SpectralPlan", sdtype_name: str, rhs: Vel,
+                 alpha, beta, a, b,
+                 filter_sym: Optional[jnp.ndarray]
+                 ) -> Tuple[Vel, jnp.ndarray]:
+    sdtype = jnp.bfloat16 if sdtype_name == "bf16" else None
+    x = jnp.stack(rhs)
+    if sdtype is not None:
+        # bf16 transform operands, f32 twiddle/accumulation
+        x = _round_real(x.astype(jnp.float32), sdtype)
+    uh = jnp.fft.rfftn(x, axes=plan.axes)
+    outh = plan.kspace_algebra(uh, alpha, beta, (a, b),
+                               f32=sdtype is not None,
+                               filter_sym=filter_sym)
+    if sdtype is not None:
+        # split-real compression of the inverse-transform operand
+        outh = _round_complex(outh, sdtype)
+    out = jnp.fft.irfftn(outh, s=plan.shape, axes=plan.axes)
+    out = out.astype(plan.rdtype)
+    return tuple(out[d] for d in range(plan.dim)), out[plan.dim]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _substep_core(plan: "SpectralPlan", sdtype_name: str, rhs: Vel,
+                  alpha, beta, a, b,
+                  filter_sym: Optional[jnp.ndarray]
+                  ) -> Tuple[Vel, jnp.ndarray]:
+    return _substep_raw(plan, sdtype_name, rhs, alpha, beta, a, b,
+                        filter_sym)
+
+
+def _substep_fwd(plan, sdtype_name, rhs, alpha, beta, a, b, filter_sym):
+    out = _substep_raw(plan, sdtype_name, rhs, alpha, beta, a, b,
+                       filter_sym)
+    # residuals: coefficients only — the adjoint needs no forward
+    # activations (the whole point of "adjoint at primal cost")
+    return out, (alpha, beta, a, b, filter_sym)
+
+
+def _substep_bwd(plan, sdtype_name, res, ct):
+    alpha, beta, a, b, filter_sym = res
+    ct_u, ct_p = ct
+    sdtype = jnp.bfloat16 if sdtype_name == "bf16" else None
+    c = jnp.stack(tuple(ct_u) + (ct_p,)).astype(
+        jnp.float32 if sdtype is not None else plan.rdtype)
+    if sdtype is not None:
+        # mirror the primal's operand compression on the cotangents so
+        # the transposed transforms see the same storage precision
+        c = _round_real(c, sdtype)
+    ch = jnp.fft.rfftn(c, axes=plan.axes)
+    gh = plan.kspace_algebra_adjoint(ch, alpha, beta, (a, b),
+                                     f32=sdtype is not None,
+                                     filter_sym=filter_sym)
+    if sdtype is not None:
+        gh = _round_complex(gh, sdtype)
+    g = jnp.fft.irfftn(gh, s=plan.shape, axes=plan.axes)
+    g = g.astype(plan.rdtype)
+    rhs_ct = tuple(g[d] for d in range(plan.dim))
+    # alpha/beta/pinc are treated as constants (see
+    # DIFFERENTIATE_COEFFS); filter_sym is a precomputed table
+    zero = lambda v: None if v is None else jnp.zeros_like(v)  # noqa: E731
+    return (rhs_ct, zero(alpha), zero(beta), zero(a), zero(b),
+            zero(filter_sym))
+
+
+_substep_core.defvjp(_substep_fwd, _substep_bwd)
 
 
 # -- the hash-cons LRU cache -------------------------------------------------

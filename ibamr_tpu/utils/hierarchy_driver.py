@@ -145,6 +145,30 @@ def _bad_leaf_names(state) -> list:
     return bad
 
 
+# Rematerialization policies for differentiable chunks (PR 19): what
+# reverse-mode may SAVE inside each step of a scan chunk. "full" saves
+# nothing (recompute everything from the per-step carry — minimal
+# memory, one extra primal pass); "dots" saves matmul/contraction
+# results (the MXU transfer einsums — recompute only the cheap
+# elementwise chains). Names, not callables, so RunConfig stays a
+# plain-data input file.
+REMAT_POLICIES = {
+    "full": None,
+    "dots": "checkpoint_dots",
+    "dots_no_batch": "checkpoint_dots_with_no_batch_dims",
+}
+
+
+def checkpointed_step(step, remat: str):
+    """Wrap ``step(state, dt)`` in ``jax.checkpoint`` under the named
+    policy — the building block for gradient-ready scan chunks."""
+    policy_name = REMAT_POLICIES[remat]
+    if policy_name is None:
+        return jax.checkpoint(step)
+    return jax.checkpoint(
+        step, policy=getattr(jax.checkpoint_policies, policy_name))
+
+
 @dataclasses.dataclass
 class RunConfig:
     """Cadences mirror the reference input-file vocabulary."""
@@ -162,8 +186,20 @@ class RunConfig:
     #   pre-chunk state references — anything retaining the state it
     #   passed to run() (rollback templates, resume copies) must leave
     #   this off; ResilientDriver forces it off for exactly that reason.
+    remat: Optional[str] = None       # checkpoint policy for the scan
+    #   chunk (PR 19): None = primal-only chunks (unchanged); a policy
+    #   name from REMAT_POLICIES wraps the per-step body in
+    #   ``jax.checkpoint`` so reverse-mode through a chunk stores ONE
+    #   state per step instead of every intermediate field. Setting it
+    #   also forces chunk-input donation OFF (a donated input is a
+    #   use-after-free for the cotangent replay) — the design loop
+    #   differentiates these chunks via ibamr_tpu.design.
 
     def __post_init__(self):
+        if self.remat is not None and self.remat not in REMAT_POLICIES:
+            raise ValueError(
+                f"RunConfig.remat must be one of "
+                f"{sorted(REMAT_POLICIES)} or None, got {self.remat!r}")
         # Fail-fast input validation: a bad input file must die HERE
         # with the offending field named, not produce a zero-length
         # scan or a silent no-op run hours later.
@@ -312,6 +348,12 @@ class HierarchyDriver:
     def _chunk(self, n: int):
         if n not in self._chunks:
             base_step = self._base_step
+            if self.cfg.remat is not None:
+                # gradient-ready chunk: per-step checkpoint policy; the
+                # scan below then exposes the standard scan-of-remat
+                # structure reverse-mode differentiates at one saved
+                # carry per step
+                base_step = checkpointed_step(base_step, self.cfg.remat)
             # local aliases: the closure must not capture self, or the
             # global pjit cache would pin the whole driver (integrator,
             # history, callbacks) for the cache entry's lifetime
@@ -353,7 +395,11 @@ class HierarchyDriver:
             # instead of allocating fresh full-field buffers per chunk).
             # Safe inside run(): callbacks only ever see the POST-chunk
             # state, and the loop immediately rebinds ``state``.
-            if self.cfg.donate:
+            # FORCED OFF under remat: a gradient-bound chunk's input is
+            # replayed by the cotangent pass — donating it is a
+            # use-after-free (same hazard jitted_step(donate=True)
+            # refuses under an active trace).
+            if self.cfg.donate and self.cfg.remat is None:
                 self._chunks[n] = jax.jit(chunk, donate_argnums=(0,))
             else:
                 self._chunks[n] = jax.jit(chunk)

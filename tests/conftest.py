@@ -126,6 +126,13 @@ SLOW_TESTS = {
     # PR 10: real jax.profiler capture + attribute round trip (~30 s:
     # one jit compile, a 40-step captured run, and trace parsing)
     "test_real_capture_attributes_driver_chunk",
+    # PR 19 gradient drills: end-to-end FD checks roll the coupled
+    # solver out twice per direction at f64 (~5-7 s each). The fast
+    # tier keeps the cheap spectral/interp FD checks and the census,
+    # donation-guard, remat and design-loop pins; these two heavies
+    # are covered in CI by dryrun path 23 (--design-smoke).
+    "test_eel_objective_grad_matches_fd",
+    "test_packed_spread_vjp_matches_fd",
     "test_gib_twisted_rod_relaxes",
     "test_project_vc_divergence_free",
     "test_pallas_total_force_conserved",

@@ -462,6 +462,47 @@ def render_elastic(snap, records: list) -> list:
     return lines
 
 
+def render_design(snap, records: list) -> list:
+    """Design-loop block (PR 19): per-label iteration counts, the
+    objective trajectory, compile accounting (cold misses vs warm
+    hits — the adjoint-at-primal-cost contract says warm iterations
+    pay ZERO compiles), and cold-vs-warm iteration wall from the
+    ``design_iter`` records :class:`ibamr_tpu.design.DesignLoop`
+    emits. Empty when the run had no design loop."""
+    iters = [r for r in records if r.get("kind") == "design_iter"]
+    if not iters:
+        return []
+    lines = []
+    by_label: dict = {}
+    for r in iters:
+        by_label.setdefault(r.get("label") or "?", []).append(r)
+    for label, rs in sorted(by_label.items()):
+        rs = sorted(rs, key=lambda r: (r.get("iteration") or 0))
+        objs = [r.get("objective") for r in rs]
+        misses = sum(int(r.get("cache_misses") or 0) for r in rs)
+        warm_miss = sum(int(r.get("cache_misses") or 0)
+                        for r in rs[1:])
+        warm_wall = [r.get("wall_s") for r in rs[1:]
+                     if r.get("wall_s") is not None]
+        lines.append(f"  {label}: {len(rs)} iteration(s), "
+                     f"objective {objs[0]:.4e} -> {objs[-1]:.4e}"
+                     + (" (decreasing)" if len(objs) > 1
+                        and all(b < a for a, b in zip(objs, objs[1:]))
+                        else ""))
+        lines.append(f"    compiles: {misses} total, {warm_miss} warm"
+                     + ("  [warm iterations recompiled!]"
+                        if warm_miss else ""))
+        if rs and rs[0].get("wall_s") is not None and warm_wall:
+            lines.append(
+                f"    wall: cold {_fmt_s(rs[0].get('wall_s'))}, "
+                f"warm mean {_fmt_s(sum(warm_wall) / len(warm_wall))}")
+        gn = [r.get("grad_norm") for r in rs
+              if r.get("grad_norm") is not None]
+        if gn:
+            lines.append(f"    grad norm: {gn[0]:.3e} -> {gn[-1]:.3e}")
+    return lines
+
+
 def render_incidents(records: list, t0=None) -> list:
     lines = []
     for rec in records:
@@ -709,6 +750,12 @@ def cmd_summary(args) -> int:
     if elastic:
         print("\nelastic pools (scaling, brownout, restart):")
         for ln in elastic:
+            print(ln)
+    design = render_design(last_counters(records), records)
+    if design:
+        print("\ndesign loop (adjoint iterations, compile "
+              "accounting):")
+        for ln in design:
             print(ln)
     print("\nincidents:")
     t0 = min(times) if times else None

@@ -9,7 +9,10 @@ Loop: probe the accelerator backend in a killable subprocess every
      ``--out``), and
   2. ``tools/microbench_transfer.py`` at 256^3 (per-engine legs), and
   3. ``tools/microbench_fluid.py`` at 256^3 (transform-vs-algebra
-     split of the fluid substep + the bf16 transform twin),
+     split of the fluid substep + the bf16 transform twin), and
+  4. ``tools/microbench_grad.py`` at 256^3 (primal-vs-VJP wall and
+     fft/scatter census per differentiable piece — the adjoint-at-
+     primal-cost ratios on the real chip),
 
 then keep polling: if the relay was healthy but the bench failed to
 produce a TPU-platform JSON line (the relay can die mid-run), the
@@ -262,6 +265,27 @@ def main() -> int:
                     g.write(r3.stderr or "")
             except subprocess.TimeoutExpired:
                 log(f, "microbench_fluid timed out")
+            # the adjoint's price while the window is warm (PR 19):
+            # primal-vs-VJP wall per piece + the fft/scatter/widening
+            # census — the measured side of the grad_* graph budgets
+            try:
+                r3g = subprocess.run(
+                    [sys.executable,
+                     os.path.join(REPO, "tools", "microbench_grad.py"),
+                     "--n", "256", "--json"],
+                    capture_output=True, text=True, cwd=REPO, env=env,
+                    timeout=args.bench_timeout)
+                log(f, f"microbench_grad rc={r3g.returncode}\n"
+                       + "\n".join((r3g.stdout or "").strip().splitlines()[-10:])
+                       + "\n--- stderr tail ---\n"
+                       + "\n".join((r3g.stderr or "").strip().splitlines()[-10:]))
+                with open(args.out.replace(".json", "_microbench_grad.txt"),
+                          "w") as g:
+                    g.write(r3g.stdout or "")
+                    g.write("\n--- stderr ---\n")
+                    g.write(r3g.stderr or "")
+            except subprocess.TimeoutExpired:
+                log(f, "microbench_grad timed out")
             # stamp the graph-contract state of the captured code rev
             # (PR 8): the audit's children force the CPU backend
             # themselves, so this costs no relay time — it just rides
