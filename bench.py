@@ -477,6 +477,106 @@ def elastic_reference(timeout_s: float = 300.0,
         timeout_s, f"elastic leg hung > {timeout_s:.0f}s", "elastic")
 
 
+def _assim_child(q, fleet_sizes, cycles):
+    """Child body: the CLEAN assimilation cadence (no injectors) on a
+    single virtual CPU device — one twin-experiment miniature, then
+    for each ensemble size B a full supervised observe->analyze->
+    advance run with an attached ledger, reporting the analysis wall
+    (first cycle pays the AOT compile; steady state is the recurring
+    bill) against the chunk cadence and cycles/s. The chaos-injected
+    variant lives in ``tools.fault_injection.run_assim_smoke``; this
+    leg is the clean-path cost number."""
+    try:
+        import sys as _sys
+        _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from ibamr_tpu.utils.backend_guard import force_cpu
+
+        force_cpu(1)
+        import tempfile as _tempfile
+
+        import jax
+        if not jax.config.jax_enable_x64:
+            jax.config.update("jax_enable_x64", True)
+        import jax.numpy as jnp
+
+        from ibamr_tpu import obs as _obs
+        from ibamr_tpu.assim import (AssimConfig, AssimilationCycle,
+                                     ObservationOperator,
+                                     synthesize_batches)
+        from ibamr_tpu.instruments import InstrumentPanel, make_meters
+        from ibamr_tpu.models.shell3d import build_shell_example
+        from ibamr_tpu.serve.aot_cache import ExecutableCache
+        from ibamr_tpu.utils.health import HealthProbe
+        from ibamr_tpu.utils.lanes import stack_lanes
+
+        spc, dt0, n_lon = 2, 1e-3, 16
+        integ, st0 = build_shell_example(n_cells=16, n_lat=8,
+                                         n_lon=n_lon, mu=0.05,
+                                         dtype="float64")
+        loops = [[2 * n_lon + j for j in range(n_lon)],
+                 [5 * n_lon + j for j in range(n_lon)]]
+        panel = InstrumentPanel(integ.ins.grid,
+                                make_meters(loops, closed=True,
+                                            dtype=jnp.float64))
+        op = ObservationOperator(panel)
+        st, truth = st0, []
+        for _ in range(cycles):
+            for _ in range(spc):
+                st = integ.step(st, dt0)
+            truth.append(st)
+        batches = synthesize_batches(op, truth, sigma=1e-5, seed=3)
+
+        legs = []
+        for B in fleet_sizes:
+            fleet0 = stack_lanes([st0._replace(ins=st0.ins._replace(
+                u=tuple(c + 2e-3 * (i + 1) for c in st0.ins.u)))
+                for i in range(B)])
+            cyc = AssimilationCycle(
+                integ, op, B,
+                AssimConfig(steps_per_cycle=spc, dt=dt0),
+                probe=HealthProbe.for_integrator(integ),
+                cache=ExecutableCache())
+            with _tempfile.TemporaryDirectory(
+                    prefix="bench-assim-") as td:
+                lp = os.path.join(td, "ledger.jsonl")
+                t0 = time.perf_counter()
+                with _obs.ledger(lp):
+                    cyc.run(fleet0, batches, directory=td,
+                            max_retries=1)
+                wall = time.perf_counter() - t0
+                recs = list(_obs.read_ledger(lp))
+            walls = [r["analysis_wall_s"] for r in recs
+                     if r.get("kind") == "assim_cycle"
+                     and not r.get("skipped")
+                     and r.get("analysis_wall_s") is not None]
+            steady = walls[1:] or walls
+            legs.append({
+                "lanes": B, "cycles": len(walls),
+                "analysis_wall_first_s": round(walls[0], 4),
+                "analysis_wall_steady_s": round(
+                    sum(steady) / len(steady), 4),
+                "analysis_fraction": round(sum(walls) / wall, 4),
+                "cycles_per_s": round(len(walls) / wall, 4),
+                "wall_s": round(wall, 3)})
+        q.put({"steps_per_cycle": spc, "legs": legs})
+    except Exception as e:  # noqa: BLE001 - report, parent decides
+        q.put({"error": f"{type(e).__name__}: {e}"})
+
+
+def assim_reference(timeout_s: float = 420.0,
+                    fleet_sizes=(8, 64), cycles: int = 3):
+    """Forecasting-cadence signal (PR 20): per-cycle analysis wall
+    against the advance cadence and cycles/s for a small and a large
+    ensemble from the clean assimilation run in a TERMINABLE child —
+    trended across rounds next to the soak/elastic/grad legs so a
+    regression in the between-chunk analysis cost (an accidental
+    retrace, a host sync creeping into the gain computation) shows up
+    as a number, not an incident."""
+    return _run_guarded_child(
+        _assim_child, (tuple(fleet_sizes), cycles), timeout_s,
+        f"assim leg hung > {timeout_s:.0f}s", "assim")
+
+
 def _grad_child(q, n, reps):
     """Child body: the gradient microbench (PR 19) on a single
     virtual CPU device — primal-vs-VJP wall time and the FFT /
@@ -976,6 +1076,12 @@ def main():
                     help="also run the gradient microbench (primal vs "
                          "VJP wall + FFT/scatter census per piece) in "
                          "a CPU child and trend the adjoint ratios")
+    ap.add_argument("--assim", action="store_true",
+                    help="also run the clean assimilation cadence "
+                         "(analysis wall vs chunk cadence, cycles/s "
+                         "for a small and a large ensemble) in a CPU "
+                         "child and trend the per-cycle analysis "
+                         "cost")
     ap.add_argument("--record", type=str, default="",
                     help="arm a flight recorder on every ramp stage; a "
                          "diverged stage dumps a replay capsule under "
@@ -1454,6 +1560,23 @@ def main():
                 log(f"[bench] grad: {result['grad']}")
             except Exception as e:
                 result["grad"] = {"error": f"{type(e).__name__}: {e}"}
+
+        # forecasting-cadence leg (PR 20): the clean assimilation run
+        # in a CPU child, trending analysis wall + cycles/s per round
+        if args.assim:
+            try:
+                remaining = (args.deadline
+                             - (time.perf_counter() - t_start))
+                if remaining < 30.0:
+                    result["assim"] = {
+                        "error": "skipped (deadline exhausted)"}
+                else:
+                    result["assim"] = assim_reference(
+                        timeout_s=min(420.0, remaining))
+                log(f"[bench] assim: {result['assim']}")
+            except Exception as e:
+                result["assim"] = {
+                    "error": f"{type(e).__name__}: {e}"}
 
         if errors:
             msg = "; ".join(errors)

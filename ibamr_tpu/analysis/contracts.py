@@ -492,6 +492,48 @@ def _build_solo_step_256():
     return (lambda s: integ.step(s, _DT)), (state,), ()
 
 
+def _build_assim_analysis():
+    # the masked B-lane ESRF analysis step (PR 20): instrument-panel
+    # observation operator vmapped over lanes + ensemble-space
+    # square-root update + pack/unpack of the assimilated state
+    # subset. The args carry ONE QUARANTINED LANE and one rejected
+    # channel on purpose — quarantine and QC act through mask VALUES,
+    # so this is the trace signature the whole failure surface rides.
+    # Pins: zero in-scan host transfers, zero scatters (gather-only
+    # interp + dense (B,B) algebra), zero f64 widenings.
+    import jax
+    import jax.numpy as jnp
+
+    from ibamr_tpu.assim import (ObservationOperator, esrf_analysis,
+                                 state_packer)
+    from ibamr_tpu.instruments import InstrumentPanel, make_meters
+    from ibamr_tpu.utils import lanes as _lanes
+
+    integ, state = _shell()
+    loops = [[2 * _N_LON + j for j in range(_N_LON)],
+             [5 * _N_LON + j for j in range(_N_LON)]]
+    panel = InstrumentPanel(integ.ins.grid,
+                            make_meters(loops, closed=True))
+    op = ObservationOperator(panel)
+    B = 4
+    stacked = _lanes.broadcast_lane(state, B)
+    pack, unpack, _n = state_packer(state)
+
+    def analyze(fleet, y, r, om, alive, lam):
+        ens = jax.vmap(pack)(fleet)
+        obs_ens = jax.vmap(op)(fleet)
+        ana, diag = esrf_analysis(ens, obs_ens, y, r, alive, om, lam)
+        return jax.vmap(unpack)(fleet, ana), diag
+
+    m = op.n_obs
+    y = jnp.zeros((m,), jnp.float32)
+    r = jnp.full((m,), 1e-4, jnp.float32)
+    om = jnp.array([True] * (m - 1) + [False])      # one QC reject
+    alive = jnp.array([True] * (B - 1) + [False])   # one quarantined
+    lam = jnp.asarray(1.0, jnp.float32)
+    return analyze, (stacked, y, r, om, alive, lam), ()
+
+
 @dataclass(frozen=True)
 class Artifact:
     """One named compiled artifact under contract."""
@@ -595,6 +637,13 @@ ARTIFACTS: Dict[str, Artifact] = {
                  notes="8-lane fleet chunk sharded over the 8-device "
                        "lane mesh (B x D pod fleet); lanes are "
                        "independent so collective traffic stays zero"),
+        Artifact("assim_analysis", _build_assim_analysis,
+                 notes="masked B-lane ESRF analysis between scan "
+                       "chunks (PR 20): instrument-panel obs operator "
+                       "+ ensemble-space square-root update, one "
+                       "quarantined lane and one rejected channel in "
+                       "the trace — gather-only, dtype-clean, zero "
+                       "host transfers"),
         Artifact("krylov_reduce", _build_krylov_reduce,
                  notes="sharded CG global reductions; fused tree_dots "
                        "pins one all-reduce sync per iteration pair"),

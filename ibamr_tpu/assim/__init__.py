@@ -1,0 +1,29 @@
+"""Fault-tolerant ensemble data assimilation (ROADMAP item 5).
+
+The PR-7 lane fleet as a statistical object: a masked EnKF/ESRF
+analysis (:mod:`~ibamr_tpu.assim.enkf`) updates all B lanes between
+scan chunks from instrument-panel observations
+(:mod:`~ibamr_tpu.assim.observe`), behind a per-channel QC gate
+(:mod:`~ibamr_tpu.assim.qc`), orchestrated by the supervised
+:class:`~ibamr_tpu.assim.cycle.AssimilationCycle`. See
+docs/RESILIENCE.md ("Filter robustness") for the failure-mode map.
+"""
+
+from ibamr_tpu.assim.cycle import (INFLATION_FALLBACKS, AssimConfig,
+                                   AssimilationCycle, FilterDegraded)
+from ibamr_tpu.assim.enkf import (AnalysisDiag, esrf_analysis,
+                                  masked_moments, masked_spread,
+                                  state_packer)
+from ibamr_tpu.assim.observe import (ObservationBatch,
+                                     ObservationOperator,
+                                     stream_from_list,
+                                     synthesize_batches)
+from ibamr_tpu.assim.qc import QCConfig, screen
+
+__all__ = [
+    "AnalysisDiag", "AssimConfig", "AssimilationCycle",
+    "FilterDegraded", "INFLATION_FALLBACKS", "ObservationBatch",
+    "ObservationOperator", "QCConfig", "esrf_analysis",
+    "masked_moments", "masked_spread", "screen", "state_packer",
+    "stream_from_list", "synthesize_batches",
+]

@@ -1,0 +1,157 @@
+"""Masked ensemble square-root filter (ESRF/ETKF) analysis.
+
+The analysis is small dense batched linear algebra in ensemble space
+(Evensen 1994; ETKF square-root form after Hunt et al. 2007): with B
+lanes and m observed channels, everything beyond the two (B, n)
+ensemble matmuls is (B, B) or (B, m) — an eigh, a few GEMMs — so the
+update between scan chunks costs microseconds next to the chunk.
+
+Robustness contracts, all in-graph (zero retraces):
+
+- **masked statistics** — the (B,) ``alive`` mask weights every
+  ensemble moment, so a quarantined lane contributes NOTHING to the
+  mean, the anomalies, or the gain, and its own rows pass through the
+  analysis bitwise frozen (``jnp.where`` on the lane axis — the PR-7
+  lane-freeze idiom). Masked analysis on B lanes with k alive is
+  exactly the dense analysis on the k-member ensemble (pinned by
+  tests/test_assim.py).
+- **masked observations** — the (m,) ``obs_mask`` from the QC gate
+  zeroes rejected channels out of the innovation and the gain instead
+  of slicing them out, so a cycle with three rejected sensors runs the
+  SAME executable as a clean one.
+- **multiplicative inflation** — a traced scalar multiplying the
+  posterior anomalies (Anderson & Anderson 1999 family). Escalating
+  the inflation rung never recompiles, and posterior spread responds
+  exactly linearly, which is what makes the collapse -> escalate ->
+  cured ladder deterministic.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+try:                       # optional: only the packer needs it
+    from jax.flatten_util import ravel_pytree
+except Exception:          # pragma: no cover
+    ravel_pytree = None
+
+_EPS = 1e-30
+
+
+class AnalysisDiag(NamedTuple):
+    """Scalar diagnostics of one analysis — ONE host transfer reads
+    them all post-update (the filter-health sentinels' inputs)."""
+    spread_f: jnp.ndarray      # forecast ensemble spread (masked rms)
+    spread_a: jnp.ndarray      # analysis ensemble spread
+    innov_rms: jnp.ndarray     # rms innovation over accepted channels
+    consistency: jnp.ndarray   # innovation chi2 / E[chi2] (~1 healthy)
+    n_alive: jnp.ndarray       # effective ensemble size
+    n_obs: jnp.ndarray         # accepted channel count
+
+
+def masked_moments(ens: jnp.ndarray, alive: jnp.ndarray):
+    """Mean and anomalies over alive lanes only.
+
+    ens: (B, n); alive: (B,) bool. Returns (mean (n,), anom (B, n) with
+    dead rows zeroed, neff scalar)."""
+    w = alive.astype(ens.dtype)
+    neff = jnp.maximum(jnp.sum(w), 1.0)
+    mean = jnp.sum(w[:, None] * ens, axis=0) / neff
+    anom = (ens - mean[None, :]) * w[:, None]
+    return mean, anom, neff
+
+
+def masked_spread(ens: jnp.ndarray, alive: jnp.ndarray) -> jnp.ndarray:
+    """Scalar ensemble spread: rms of masked anomalies per alive-lane
+    degree of freedom."""
+    _, anom, neff = masked_moments(ens, alive)
+    n = ens.shape[1]
+    denom = jnp.maximum(neff - 1.0, 1.0) * n
+    return jnp.sqrt(jnp.sum(anom * anom) / denom)
+
+
+def esrf_analysis(ens: jnp.ndarray, obs_ens: jnp.ndarray,
+                  y: jnp.ndarray, r: jnp.ndarray,
+                  alive: jnp.ndarray, obs_mask: jnp.ndarray,
+                  inflation: jnp.ndarray
+                  ) -> Tuple[jnp.ndarray, AnalysisDiag]:
+    """One masked ETKF square-root update.
+
+    ens: (B, n) packed state ensemble; obs_ens: (B, m) = H(ens);
+    y: (m,) observed values; r: (m,) obs-error variances;
+    alive: (B,) lane mask; obs_mask: (m,) QC-accepted mask;
+    inflation: scalar posterior multiplicative inflation.
+
+    Returns (analysis ensemble (B, n) with dead lanes frozen, diag).
+    """
+    B = ens.shape[0]
+    dt = ens.dtype
+    xbar, Zx, neff = masked_moments(ens, alive)
+    ybar, Zy, _ = masked_moments(obs_ens, alive)
+
+    om = obs_mask.astype(dt)
+    rinv = om / jnp.asarray(r, dt)                  # rejected -> 0
+    d = (jnp.asarray(y, dt) - ybar) * om            # (m,)
+
+    # ensemble-space gain: G = (neff-1) I + Zy R^-1 Zy^T, (B, B)
+    C = (Zy * rinv[None, :]) @ Zy.T
+    G = (neff - 1.0) * jnp.eye(B, dtype=dt) + C
+    lam, Q = jnp.linalg.eigh(G)
+    lam = jnp.maximum(lam, jnp.asarray(_EPS, dt))
+    wbar = (Q / lam[None, :]) @ (Q.T @ (Zy @ (rinv * d)))   # (B,)
+    # symmetric square root: Wa = sqrt(neff-1) G^{-1/2}
+    Wa = (Q * jnp.sqrt((neff - 1.0) / lam)[None, :]) @ Q.T  # (B, B)
+
+    mean_shift = wbar @ Zx                          # (n,)
+    anom_a = Wa @ Zx                                # (B, n)
+    infl = jnp.asarray(inflation, dt)
+    ana = xbar[None, :] + mean_shift[None, :] + infl * anom_a
+    # dead lanes ride through bitwise frozen (lane-freeze idiom)
+    ana = jnp.where(alive[:, None], ana, ens)
+
+    # diagnostics — innovation consistency: E[d_j^2] = HPH_jj + r_j
+    m_eff = jnp.maximum(jnp.sum(om), 1.0)
+    hph = jnp.sum(Zy * Zy, axis=0) / jnp.maximum(neff - 1.0, 1.0)
+    chi2 = jnp.sum(d * d * om / (hph + jnp.asarray(r, dt) + _EPS))
+    diag = AnalysisDiag(
+        spread_f=masked_spread(ens, alive),
+        spread_a=masked_spread(ana, alive),
+        innov_rms=jnp.sqrt(jnp.sum(d * d) / m_eff),
+        consistency=chi2 / m_eff,
+        n_alive=neff,
+        n_obs=jnp.sum(om))
+    return ana, diag
+
+
+# ---------------------------------------------------------------------------
+# state packing: the assimilated subset of an IBState as a flat vector
+# ---------------------------------------------------------------------------
+
+def state_packer(template_state):
+    """(pack, unpack, n) for the assimilated subset of an UNBATCHED
+    IBState: the MAC velocity components and the pressure.
+
+    ``pack(state) -> (n,)`` and ``unpack(state, vec) -> state`` are
+    pure and jittable; ``jax.vmap`` them for the lane-stacked fleet.
+    Markers ride along un-assimilated (they are slaved to the velocity
+    field through the IB coupling), and ``n_prev``/``t``/``k`` keep
+    the lane's own history — the analysis moves the flow, not the
+    clock.
+    """
+    if ravel_pytree is None:   # pragma: no cover
+        raise ImportError("jax.flatten_util is required for packing")
+    subset = (template_state.ins.u, template_state.ins.p)
+    flat0, unravel = ravel_pytree(subset)
+
+    def pack(state):
+        v, _ = ravel_pytree((state.ins.u, state.ins.p))
+        return v
+
+    def unpack(state, vec):
+        u, p = unravel(vec.astype(flat0.dtype))
+        return state._replace(ins=state.ins._replace(u=u, p=p))
+
+    return pack, unpack, int(flat0.shape[0])

@@ -483,3 +483,19 @@ class HealthProbe:
         """Forget streaks AND every baseline (a new run)."""
         self._solo_ctx = _new_triage_ctx()
         self._lane_ctx = None
+
+    def rebaseline(self):
+        """Drop the drift anchors (functional / volume / budget
+        baselines) while KEEPING warn streaks — for a legitimate
+        discontinuous state move, e.g. an assimilation analysis that
+        updates every lane between chunks. The next vitals sample
+        re-anchors each baseline; without this the first post-analysis
+        chunk reads the innovation jump as func/vol/budget drift and
+        false-positives a WARN. Streaks survive on purpose: a lane
+        that was already trending bad must not get its strikes wiped
+        by every analysis."""
+        ctxs = [self._solo_ctx] + list(self._lane_ctx or [])
+        for ctx in ctxs:
+            ctx["baseline_func"] = None
+            ctx["baseline_vol"] = None
+            ctx["baseline_budget"] = None

@@ -530,6 +530,16 @@ class HierarchyDriver:
         cfg = self.cfg
         step = start_step
         dt = cfg.dt
+        if (start_step and cfg.regrid_interval
+                and self.regrid_fn is not None
+                and start_step % cfg.regrid_interval == 0):
+            # resume landing ON a regrid boundary: the checkpoint the
+            # caller restored was written BEFORE that step's regrid ran
+            # (cadence order below is checkpoint, then regrid), so the
+            # pending regrid — or an assimilation analysis riding the
+            # regrid hook — must fire exactly once here, else a
+            # supervisor rollback silently drops it
+            state = self.regrid_fn(state, start_step)
         cadences = [i for i in (cfg.viz_dump_interval,
                                 cfg.restart_interval,
                                 cfg.regrid_interval) if i]

@@ -253,6 +253,20 @@ class ResilientDriver:
         self.driver._chunks = {}
         return cur, nxt
 
+    def _escalate_inflation(self, e) -> Optional[tuple]:
+        """Climb the assimilation cycle's multiplicative-inflation
+        ladder one INFLATION_FALLBACKS rung (the precision-escalation
+        shape for ``kind == "filter_degraded"``). The exception carries
+        the cycle's bound ``escalate`` callable; inflation is a traced
+        argument of the analysis executable, so no chunk or cache
+        invalidation is needed. Returns ``(before, after)`` rungs, or
+        None when the ladder is exhausted — the caller then falls
+        through to the plain dt-backoff recovery."""
+        esc = getattr(e, "escalate", None)
+        if not callable(esc):
+            return None
+        return esc()
+
     # -- rollback -----------------------------------------------------------
 
     def _latest(self):
@@ -491,25 +505,38 @@ class ResilientDriver:
                         writer.wait()  # pending intervals land first
                     except Exception:
                         pass           # roll back to what's on disk
-                    esc = self._escalate_precision(e) \
-                        if kind == "precision_drift" else None
+                    if kind == "precision_drift":
+                        esc = self._escalate_precision(e)
+                    elif kind == "filter_degraded":
+                        esc = self._escalate_inflation(e)
+                    else:
+                        esc = None
                     cur_state, cur_step, ck = self._rollback(initial[0],
                                                              initial)
                     _ROLLBACKS.inc()
                     if esc is not None:
-                        # precision, not stability, is the problem: dt
-                        # stays put; the retry reruns the rolled-back
-                        # chunk at the escalated spectral_dtype
+                        # precision (or filter tuning), not stability,
+                        # is the problem: dt stays put; the retry
+                        # reruns the rolled-back chunk at the escalated
+                        # spectral_dtype / inflation rung
                         _ESCALATIONS.inc()
+                        event = ("inflation_escalation"
+                                 if kind == "filter_degraded"
+                                 else "precision_escalation")
+                        before_key, after_key = (
+                            ("inflation_before", "inflation_after")
+                            if kind == "filter_degraded"
+                            else ("spectral_dtype_before",
+                                  "spectral_dtype_after"))
                         self._record(dict(payload, **{
-                            "event": "precision_escalation",
+                            "event": event,
                             "kind": kind, "step": e.step,
                             "retry": retries,
                             "max_retries": self.max_retries,
                             "rollback_step": cur_step,
                             "from_checkpoint": ck is not None,
-                            "spectral_dtype_before": esc[0],
-                            "spectral_dtype_after": esc[1],
+                            before_key: esc[0],
+                            after_key: esc[1],
                             "dt": dt_before}))
                         continue
                     driver.cfg.dt = dt_before * self.dt_backoff

@@ -277,6 +277,12 @@ SLOW_TESTS = {
     "test_grow_never_blocks_serving",
     "test_restart_drill_zero_fresh_compiles",
     "test_run_elastic_smoke_end_to_end",
+    # PR 20 (assimilation): the collapse->rollback->escalation loop
+    # compiles two fleet chunks + analysis executables; the subprocess
+    # chaos drill spawns an interpreter (covered in CI by dryrun path
+    # 24 and `slo.py check --assim`)
+    "test_spread_collapse_rolls_back_and_escalates_inflation",
+    "test_assim_smoke_drill_end_to_end",
 }
 
 

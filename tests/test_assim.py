@@ -1,0 +1,343 @@
+"""Fault-tolerant ensemble data assimilation (PR 20).
+
+Correctness of the masked ESRF against an independent NumPy Kalman
+oracle, the masked==dense-on-alive identity that lets quarantine ride
+through mask VALUES (one trace), the QC rejection matrix, the
+collapse -> rollback -> inflation-escalation loop through the
+supervisor (which also pins the exactly-once resume-regrid fix: the
+retried cycle's analysis must re-fire after a rollback), the
+ensemble-size skill argument, the HealthProbe.rebaseline contract,
+and the end-to-end chaos drill as a subprocess.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ibamr_tpu.assim import (AssimConfig, AssimilationCycle,
+                             ObservationOperator, QCConfig,
+                             esrf_analysis, screen, state_packer,
+                             synthesize_batches)
+from ibamr_tpu.assim.observe import ObservationBatch
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _random_problem(rng, B=5, n=7, m=4):
+    """A dense random linear-obs ensemble problem (f64)."""
+    ens = rng.standard_normal((B, n))
+    H = rng.standard_normal((m, n))
+    obs_ens = ens @ H.T
+    y = rng.standard_normal(m)
+    r = 10.0 ** rng.uniform(-2.0, 0.0, m)
+    return ens, obs_ens, y, r
+
+
+def test_esrf_matches_numpy_kalman_oracle():
+    """Ensemble-space square-root update == covariance-space Kalman
+    formulas, computed independently in NumPy: the analysis mean is
+    xbar + K d and the analysis covariance is (I - KH) P (the defining
+    property of a square-root filter — no stochastic obs perturbation
+    noise)."""
+    rng = np.random.default_rng(0)
+    ens, obs_ens, y, r = _random_problem(rng)
+    B, _n = ens.shape
+    ana, diag = esrf_analysis(
+        jnp.asarray(ens), jnp.asarray(obs_ens), jnp.asarray(y),
+        jnp.asarray(r), jnp.ones((B,), bool),
+        jnp.ones((y.size,), bool), jnp.asarray(1.0))
+    ana = np.asarray(ana)
+
+    xbar, ybar = ens.mean(0), obs_ens.mean(0)
+    Zx, Zy = ens - xbar, obs_ens - ybar
+    PHt = Zx.T @ Zy / (B - 1)                      # (n, m)
+    HPHt = Zy.T @ Zy / (B - 1)                     # (m, m)
+    K = PHt @ np.linalg.inv(HPHt + np.diag(r))
+    np.testing.assert_allclose(ana.mean(0), xbar + K @ (y - ybar),
+                               atol=1e-10)
+
+    Za = ana - ana.mean(0)
+    Pa_ens = Za.T @ Za / (B - 1)
+    Pa = Zx.T @ Zx / (B - 1) - K @ (Zy.T @ Zx / (B - 1))
+    np.testing.assert_allclose(Pa_ens, Pa, atol=1e-10)
+
+    np.testing.assert_allclose(
+        float(diag.innov_rms),
+        float(np.sqrt(np.mean((y - ybar) ** 2))), atol=1e-10)
+
+
+def test_masked_equals_dense_on_alive_and_freezes_dead():
+    """Dead lanes contribute NOTHING: the masked update on the full
+    fleet equals the dense update on the alive subset exactly (block
+    structure of the masked gain), and dead rows ride through
+    bitwise-frozen."""
+    rng = np.random.default_rng(1)
+    ens, obs_ens, y, r = _random_problem(rng, B=6)
+    alive = np.array([True, True, False, True, False, True])
+    om = jnp.ones((y.size,), bool)
+    ana_m, diag_m = esrf_analysis(
+        jnp.asarray(ens), jnp.asarray(obs_ens), jnp.asarray(y),
+        jnp.asarray(r), jnp.asarray(alive), om, jnp.asarray(1.0))
+    sub = np.flatnonzero(alive)
+    ana_d, _ = esrf_analysis(
+        jnp.asarray(ens[sub]), jnp.asarray(obs_ens[sub]),
+        jnp.asarray(y), jnp.asarray(r),
+        jnp.ones((sub.size,), bool), om, jnp.asarray(1.0))
+    np.testing.assert_allclose(np.asarray(ana_m)[sub],
+                               np.asarray(ana_d), atol=1e-10)
+    assert np.array_equal(np.asarray(ana_m)[~alive], ens[~alive])
+    assert int(diag_m.n_alive) == sub.size
+
+
+def test_posterior_inflation_scales_spread_exactly():
+    """Posterior multiplicative inflation acts on the analysis
+    anomalies alone, so spread_a is EXACTLY linear in the factor —
+    the property that makes collapse -> escalate -> cure
+    deterministic."""
+    rng = np.random.default_rng(4)
+    ens, obs_ens, y, r = _random_problem(rng)
+    B = ens.shape[0]
+    args = (jnp.asarray(ens), jnp.asarray(obs_ens), jnp.asarray(y),
+            jnp.asarray(r), jnp.ones((B,), bool),
+            jnp.ones((y.size,), bool))
+    _, d1 = esrf_analysis(*args, jnp.asarray(1.0))
+    _, d2 = esrf_analysis(*args, jnp.asarray(1.4))
+    np.testing.assert_allclose(float(d2.spread_a),
+                               1.4 * float(d1.spread_a), rtol=1e-12)
+
+
+def test_qc_rejection_matrix():
+    """Each failure mode hits its own channel; the gate rejects
+    exactly those with the right reason, in the documented precedence
+    dropout > stale > outlier (a NaN can't be an outlier; a stale
+    value's innovation is not trusted enough to call it one)."""
+    values = np.array([np.nan, 5.0, 0.01, 0.0, 100.0])
+    age = np.array([0.0, 0.0, 1e4, 0.0, 1e4])
+    batch = ObservationBatch(values=values, r=np.full(5, 1e-2),
+                             age_s=age, cycle=0,
+                             names=("a", "b", "c", "d", "e"))
+    accept, report = screen(batch, ybar=np.zeros(5),
+                            hph=np.full(5, 1e-2),
+                            cfg=QCConfig(k_sigma=4.0, max_age_s=60.0),
+                            step=0, cycle=0)
+    assert accept.tolist() == [False, False, False, True, False]
+    assert report["accepted"] == 1 and report["rejected"] == 4
+    assert report["by_reason"] == {"dropout": 1, "stale": 2,
+                                   "outlier": 1}
+
+
+def test_analysis_skill_improves_with_ensemble_size():
+    """With identity observations of a zero truth and tiny R, the
+    analysis can only correct within the span of the ensemble
+    anomalies: B=4 in a 12-dim state leaves most of the error
+    untouched, B=32 spans the space and pulls the mean to the truth.
+    Both beat their own forecast."""
+    n = 12
+    y = np.zeros(n)
+    r = np.full(n, 1e-4)
+    rng = np.random.default_rng(3)
+    errs = {}
+    for B in (4, 32):
+        ens = rng.standard_normal((B, n))
+        ana, _ = esrf_analysis(
+            jnp.asarray(ens), jnp.asarray(ens), jnp.asarray(y),
+            jnp.asarray(r), jnp.ones((B,), bool),
+            jnp.ones((n,), bool), jnp.asarray(1.0))
+        errs[B] = float(np.sqrt(np.mean(
+            np.asarray(ana).mean(0) ** 2)))
+        forecast = float(np.sqrt(np.mean(ens.mean(0) ** 2)))
+        assert errs[B] < forecast
+    assert errs[32] < 0.2 * errs[4]
+
+
+def test_one_trace_through_qc_and_quarantine():
+    """QC rejections (obs_mask), quarantine (alive), and inflation
+    escalation all arrive as ARRAY VALUES, not shapes: the jitted
+    analysis retains one trace across every combination."""
+    rng = np.random.default_rng(2)
+    ens, obs_ens, y, r = _random_problem(rng, B=4)
+    m = y.size
+    traces = {"n": 0}
+
+    def f(ens, obs_ens, y, r, alive, om, infl):
+        traces["n"] += 1
+        return esrf_analysis(ens, obs_ens, y, r, alive, om, infl)
+
+    jf = jax.jit(f)
+    base = (jnp.asarray(ens), jnp.asarray(obs_ens), jnp.asarray(y),
+            jnp.asarray(r))
+    cases = [
+        (np.ones(4, bool), np.ones(m, bool), 1.0),
+        (np.array([True, True, True, False]), np.ones(m, bool), 1.0),
+        (np.ones(4, bool),
+         np.array([True, False, True, True]), 1.05),
+        (np.array([False, True, True, True]),
+         np.array([False, False, True, True]), 1.4),
+    ]
+    for alive, om, infl in cases:
+        jax.block_until_ready(jf(*base, jnp.asarray(alive),
+                                 jnp.asarray(om),
+                                 jnp.asarray(infl)))
+    assert traces["n"] == 1
+
+
+def test_state_packer_roundtrip_bitwise():
+    from ibamr_tpu.models.shell3d import build_shell_example
+
+    _integ, st = build_shell_example(n_cells=8, n_lat=6, n_lon=8,
+                                     dtype="float64")
+    pack, unpack, n = state_packer(st)
+    vec = pack(st)
+    assert vec.shape == (n,)
+    st2 = unpack(st, vec)
+    for a, b in zip(jax.tree_util.tree_leaves(st),
+                    jax.tree_util.tree_leaves(st2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_rebaseline_drops_anchors_keeps_streaks():
+    """The analysis legitimately moves every lane, so the cycle calls
+    ``probe.rebaseline()``: drift anchors re-seed from the NEXT chunk
+    (no false WARN against a pre-analysis baseline) but warn streaks
+    survive (a lane already trending bad keeps its strikes)."""
+    from ibamr_tpu.utils.health import OK, HealthProbe
+
+    def vitals(func):
+        # (finite, max_u, cfl, div, func, vol, budget)
+        return np.array([1.0, 0.1, 0.1, 0.0, func, 1.0, 1.0])
+
+    probe = HealthProbe(func_growth_warn=3.0, sustain=10)
+    level, _, _ = probe.classify(vitals(1.0), step=1, dt=1e-3)
+    assert level == OK and probe._baseline_func == 1.0
+
+    # without rebaseline the post-analysis functional reads as drift
+    ctrl = HealthProbe(func_growth_warn=3.0, sustain=10)
+    ctrl.classify(vitals(1.0), step=1, dt=1e-3)
+    level_ctrl, reasons_ctrl, _ = ctrl.classify(vitals(10.0), step=2,
+                                                dt=1e-3)
+    assert level_ctrl != OK and reasons_ctrl
+
+    probe._warn_streak = 2
+    probe.rebaseline()
+    assert probe._baseline_func is None
+    assert probe._warn_streak == 2
+    level, reasons, _ = probe.classify(vitals(10.0), step=2, dt=1e-3)
+    assert level == OK and not reasons
+    assert probe._baseline_func == 10.0
+    assert probe._warn_streak == 0  # OK chunk legitimately clears it
+
+
+def _shell_assim_setup(B, n_cyc, spc=2, dt0=1e-3, seed=11):
+    from ibamr_tpu.instruments import InstrumentPanel, make_meters
+    from ibamr_tpu.models.shell3d import build_shell_example
+    from ibamr_tpu.utils.lanes import stack_lanes
+
+    n_lon = 16
+    integ, st0 = build_shell_example(n_cells=16, n_lat=8, n_lon=n_lon,
+                                     mu=0.05, dtype="float64")
+    loops = [[2 * n_lon + j for j in range(n_lon)],
+             [5 * n_lon + j for j in range(n_lon)]]
+    panel = InstrumentPanel(integ.ins.grid,
+                            make_meters(loops, closed=True,
+                                        dtype=jnp.float64))
+    op = ObservationOperator(panel)
+    st, truth = st0, []
+    for _ in range(n_cyc):
+        for _ in range(spc):
+            st = integ.step(st, dt0)
+        truth.append(st)
+    batches = synthesize_batches(op, truth, sigma=1e-5, seed=seed)
+    fleet0 = stack_lanes([st0._replace(ins=st0.ins._replace(
+        u=tuple(c + 2e-3 * (i + 1) for c in st0.ins.u)))
+        for i in range(B)])
+    return integ, op, fleet0, batches
+
+
+def test_spread_collapse_rolls_back_and_escalates_inflation(tmp_path):
+    """The filter-health loop end-to-end: a spread floor set just
+    above the filter's natural analysis spread trips FilterDegraded,
+    the supervisor rolls back to the verified checkpoint and escalates
+    inflation one rung per retry (1.0 -> 1.05 -> 1.1 cures a 7%
+    deficit), and — the exactly-once resume-regrid pin — the retried
+    cycle's analysis RE-FIRES after the rollback, so no cycle is
+    lost and the escalated inflation actually applies."""
+    from ibamr_tpu import obs as _obs
+    from ibamr_tpu.serve.aot_cache import ExecutableCache
+    from ibamr_tpu.utils.health import HealthProbe
+
+    B, n_cyc, spc = 4, 3, 2
+    integ, op, fleet0, batches = _shell_assim_setup(B, n_cyc, spc=spc)
+
+    # clean pass to learn the natural first-cycle analysis spread
+    base_dir = tmp_path / "base"
+    base_dir.mkdir()
+    base_ledger = str(base_dir / "ledger.jsonl")
+    cyc0 = AssimilationCycle(
+        integ, op, B, AssimConfig(steps_per_cycle=spc, dt=1e-3),
+        probe=HealthProbe.for_integrator(integ),
+        cache=ExecutableCache())
+    with _obs.ledger(base_ledger):
+        cyc0.run(fleet0, batches, directory=str(base_dir),
+                 max_retries=1)
+    recs = list(_obs.read_ledger(base_ledger))
+    s_base = next(r["spread_a"] for r in recs
+                  if r.get("kind") == "assim_cycle"
+                  and not r.get("skipped"))
+    assert cyc0.escalations == [] and cyc0.inflation == 1.0
+
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    ledger = str(run_dir / "ledger.jsonl")
+    cyc = AssimilationCycle(
+        integ, op, B,
+        AssimConfig(steps_per_cycle=spc, dt=1e-3,
+                    spread_floor=1.07 * s_base),
+        probe=HealthProbe.for_integrator(integ),
+        cache=ExecutableCache())
+    # the floor is ABSOLUTE and the filter's natural spread keeps
+    # contracting cycle over cycle, so later cycles legitimately need
+    # more rungs — give the ladder room to climb
+    with _obs.ledger(ledger):
+        cyc.run(fleet0, batches, directory=str(run_dir),
+                max_retries=8)
+
+    # two rungs: 1.05 * s still under the 1.07 floor, 1.1 * s clears
+    assert cyc.escalations[:2] == [(1.0, 1.05), (1.05, 1.1)]
+    assert cyc.inflation >= 1.1
+
+    incidents = [json.loads(ln) for ln in
+                 open(os.path.join(str(run_dir), "incidents.jsonl"))]
+    esc = [r for r in incidents
+           if r.get("event") == "inflation_escalation"]
+    assert [(r["inflation_before"], r["inflation_after"])
+            for r in esc[:2]] == [(1.0, 1.05), (1.05, 1.1)]
+
+    # zero lost cycles THROUGH the rollbacks (the resume-regrid pin)
+    recs = list(_obs.read_ledger(ledger))
+    done = {r["cycle"] for r in recs
+            if r.get("kind") == "assim_cycle"}
+    assert done == set(range(n_cyc))
+
+
+def test_assim_smoke_drill_end_to_end(tmp_path):
+    """The committed chaos drill as CI runs it (dryrun path 24): all
+    four injectors armed at once, subprocess-isolated."""
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.fault_injection",
+         "--assim-smoke", "--dir", str(tmp_path)],
+        capture_output=True, text=True, cwd=REPO, timeout=900)
+    assert r.returncode == 0, (r.stdout or "") + (r.stderr or "")[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["assim_smoke"] == "ok"
+    assert out["lost_cycles"] == 0
+    assert out["analysis_compiles"] == 2
+    assert {tuple(t) for t in out["qc_rejections"]} == {
+        (1, "flux[0]", "dropout"), (2, "flux[1]", "outlier"),
+        (3, "mean_pressure[0]", "stale")}
+    assert out["forecast_error"] < out["open_loop_error"]

@@ -88,6 +88,20 @@ dryrun drill are built from:
   the objective, iteration 1 pays exactly one executable-cache MISS
   and iterations 2+ are pure HITS (zero warm compiles), and every
   iteration lands one ``design_iter`` ledger record.
+- :func:`obs_dropout_injector` / :func:`obs_outlier_injector` /
+  :func:`stale_obs_injector` / :func:`member_divergence_injector`
+  (PR 20) — ASSIMILATION faults: dead, spiking and stale sensor
+  channels as pure transforms of the assimilation cycle's
+  ``obs_source`` seam, plus one ensemble member diverging mid-run
+  (lane_nan mechanics, ``recorded()`` for capsule replay).
+  :func:`run_assim_smoke` arms all four at once over the B-lane
+  forecasting service (dryrun path 24, ``python -m
+  tools.fault_injection --assim-smoke``): the QC gate rejects exactly
+  the injected (channel, cycle, reason) triples, the divergent member
+  is quarantined and excluded from the masked analysis statistics,
+  every cycle lands a terminal ``assim_cycle`` record (zero lost),
+  the final forecast error beats the open-loop ensemble, and the
+  whole episode retraces nothing.
 
 Everything here is deliberately boring and deterministic: no random
 fuzzing, every fault lands at a named step/byte so a failure
@@ -481,6 +495,8 @@ def apply_recorded_injectors(injectors: dict):
       applies to the STACKED step (replay of a lane capsule builds a
       B=1 fleet chunk and transforms ``lane``/``fleet_size`` before
       calling this — see ``tools.replay._lane_injectors``)
+    - ``member_divergence``: the assimilation drill's lane fault
+      (lane_nan mechanics under its own name, same lane transform)
 
     Unknown names raise: silently dropping a recorded fault would turn
     every replay of it into a false ``not_reproduced``/"cured" verdict.
@@ -507,6 +523,9 @@ def apply_recorded_injectors(injectors: dict):
             elif name == "lane_drift":
                 wrappers.append(lambda fn, p=params:
                                 lane_drift_injector(fn, **p))
+            elif name == "member_divergence":
+                wrappers.append(lambda fn, p=params:
+                                member_divergence_injector(fn, **p))
             else:
                 raise KeyError(
                     f"replay manifest records unknown injector {name!r}")
@@ -2303,6 +2322,317 @@ def run_design_smoke(directory: str | None = None,
             tmp.cleanup()
 
 
+# ---------------------------------------------------------------------------
+# assimilation faults (PR 20): bad sensors and bad members
+# ---------------------------------------------------------------------------
+#
+# The first three injectors wrap the cycle's ``obs_source`` seam — a
+# pure schedule transform over the sensor stream (which channels go
+# bad, at which cycles), so an armed drill is bit-reproducible from
+# its parameters alone. ``member_divergence_injector`` is a lane-
+# confined STATE fault (the lane_nan shape) and is ``recorded()`` so
+# capsules of an assimilating run carry it.
+
+def obs_dropout_injector(source, channels, at_cycles):
+    """Wrap an ``obs_source`` so the named channels read NaN (a dead
+    sensor) at the named cycles — the QC gate must reject each with
+    reason ``dropout`` and the analysis must proceed on the rest."""
+    chans, cycs = list(channels), {int(c) for c in at_cycles}
+
+    def wrapped(cycle, step):
+        b = source(cycle, step)
+        if b is None or cycle not in cycs:
+            return b
+        b = dataclasses.replace(b, values=b.values.copy())
+        b.values[chans] = np.nan
+        return b
+
+    return wrapped
+
+
+def obs_outlier_injector(source, channels, at_cycles,
+                         magnitude: float = 50.0):
+    """Wrap an ``obs_source`` so the named channels spike by
+    ``magnitude`` observation-sigmas (an electrical transient) at the
+    named cycles — far beyond any plausible innovation, so the QC
+    gate's background check rejects each with reason ``outlier``."""
+    chans, cycs = list(channels), {int(c) for c in at_cycles}
+
+    def wrapped(cycle, step):
+        b = source(cycle, step)
+        if b is None or cycle not in cycs:
+            return b
+        b = dataclasses.replace(b, values=b.values.copy())
+        b.values[chans] += magnitude * np.sqrt(b.r[chans])
+        return b
+
+    return wrapped
+
+
+def stale_obs_injector(source, channels, at_cycles,
+                       age_s: float = 1e6):
+    """Wrap an ``obs_source`` so the named channels arrive ``age_s``
+    seconds old (a feed replaying its last value) at the named cycles
+    — the QC gate must reject each with reason ``stale``."""
+    chans, cycs = list(channels), {int(c) for c in at_cycles}
+
+    def wrapped(cycle, step):
+        b = source(cycle, step)
+        if b is None or cycle not in cycs:
+            return b
+        b = dataclasses.replace(b, age_s=b.age_s.copy())
+        b.age_s[chans] = age_s
+        return b
+
+    return wrapped
+
+
+def member_divergence_injector(stacked_step, at_step: int, lane: int,
+                               fleet_size: int,
+                               leaf_path: str = "u[0]",
+                               dt_gate: float | None = None,
+                               step_attr: str = "ins.k"):
+    """One ensemble MEMBER diverges mid-run: lane ``lane``'s rows go
+    NaN at its ``at_step`` (the :func:`lane_nan_injector` mechanics
+    under the assimilation drill's name). The fleet triage must
+    quarantine the member, and the masked analysis statistics must
+    exclude it instead of averaging a diverged state into every other
+    lane — the failure mode ensemble filters are famously soft on."""
+    return lane_nan_injector(stacked_step, at_step=at_step, lane=lane,
+                             fleet_size=fleet_size,
+                             leaf_path=leaf_path, dt_gate=dt_gate,
+                             step_attr=step_attr)
+
+
+def run_assim_smoke(directory: str | None = None, fleet_size: int = 6,
+                    cycles: int = 6, steps_per_cycle: int = 2,
+                    bad_lane: int | None = None) -> dict:
+    """Deterministic end-to-end ASSIMILATION drill (PR 20, dryrun path
+    24): the B-lane shell fleet runs as a forecasting service while
+    ALL FOUR assimilation injectors are armed at once —
+
+    1. **bad sensors rejected, not assimilated** — a dropped channel
+       (NaN), a 50-sigma outlier spike and a stale feed each hit a
+       distinct channel at a distinct cycle; the QC gate must reject
+       exactly those (channel, cycle, reason) triples as structured
+       ``assim_qc_reject`` ledger records while the analysis proceeds
+       on the surviving channels;
+    2. **bad member quarantined, not averaged in** — one lane's state
+       goes NaN mid-run; the lane-granular supervisor quarantines it
+       and the masked ensemble statistics exclude it from every
+       subsequent analysis (its rows ride through frozen);
+    3. **zero lost cycles** — every cycle lands exactly one terminal
+       ``assim_cycle`` ledger record (skipped or analyzed), through
+       quarantine and QC rejections alike;
+    4. **the filter earns its keep** — the final cycle's forecast
+       error (rms innovation over accepted channels) beats the
+       open-loop ensemble (same fleet, same injected member fault, no
+       analysis) against the same sensors;
+    5. **zero retraces** — the whole episode (quarantine, rejections,
+       per-lane dt backoff) runs one trace signature per chunk length
+       and exactly two analysis-executable compiles (observe +
+       analyze), everything after a pure cache hit.
+
+    Raises on any failed expectation; returns a one-line JSON summary
+    (``tools/slo.py check --assim`` evaluates the same ledger against
+    SLO.json's ``assim_slos``). Needs x64 — enabled here if not
+    already."""
+    import jax
+    import jax.numpy as jnp
+
+    from ibamr_tpu import obs as _obs
+    from ibamr_tpu.assim import (AssimConfig, AssimilationCycle,
+                                 ObservationOperator, masked_moments,
+                                 stream_from_list, synthesize_batches)
+    from ibamr_tpu.instruments import InstrumentPanel, make_meters
+    from ibamr_tpu.models.shell3d import build_shell_example
+    from ibamr_tpu.serve.aot_cache import ExecutableCache
+    from ibamr_tpu.utils.flight_recorder import (FlightRecorder,
+                                                 factory_spec)
+    from ibamr_tpu.utils.health import HealthProbe
+    from ibamr_tpu.utils.lanes import stack_lanes
+    from ibamr_tpu.assim import qc as _aqc
+
+    if not jax.config.jax_enable_x64:
+        jax.config.update("jax_enable_x64", True)
+
+    B = int(fleet_size)
+    BAD = B - 1 if bad_lane is None else int(bad_lane)
+    n_cyc, spc = int(cycles), int(steps_per_cycle)
+    dt0 = 1e-3
+    t_all = time.perf_counter()
+    tmp = None
+    if directory is None:
+        tmp = tempfile.TemporaryDirectory(prefix="ibamr_assim_smoke_")
+        directory = tmp.name
+    try:
+        kwargs = dict(n_cells=16, n_lat=8, n_lon=16, mu=0.05,
+                      dtype="float64")
+        integ, st0 = build_shell_example(**kwargs)
+        n_lon = kwargs["n_lon"]
+        # two flow meters: latitude rings of the shell (closed loops)
+        loops = [[2 * n_lon + j for j in range(n_lon)],
+                 [5 * n_lon + j for j in range(n_lon)]]
+        panel = InstrumentPanel(integ.ins.grid,
+                                make_meters(loops, closed=True,
+                                            dtype=jnp.float64))
+        op = ObservationOperator(panel)
+
+        # truth trajectory -> noisy synthetic sensors (twin experiment)
+        st, truth_states = st0, []
+        for _ in range(n_cyc):
+            for _ in range(spc):
+                st = integ.step(st, dt0)
+            truth_states.append(st)
+        sigma = 1e-5
+        batches = synthesize_batches(op, truth_states, sigma=sigma,
+                                     seed=7)
+        names = op.channel_names()
+
+        # heterogeneous ensemble: additive per-lane velocity offsets
+        # (the initial shell state is quiescent — multiplicative
+        # perturbations would leave the ensemble degenerate)
+        lane_states = [st0._replace(ins=st0.ins._replace(
+            u=tuple(c + 2e-3 * (i + 1) for c in st0.ins.u)))
+            for i in range(B)]
+        fleet0 = stack_lanes(lane_states)
+
+        # the four injectors, armed at once: three sensor faults on
+        # distinct (channel, cycle) slots + one diverging member
+        injected = {(1, names[0], "dropout"),
+                    (2, names[1], "outlier"),
+                    (3, names[2], "stale")}
+        member_inj = dict(at_step=spc + 1, lane=BAD, fleet_size=B,
+                          leaf_path="u[0]", step_attr="ins.k")
+        source = stream_from_list(batches)
+        source = obs_dropout_injector(source, [0], [1])
+        # the spike must clear the background check however wide the
+        # ensemble is: 2e4 obs-sigmas dwarfs any plausible HPH^T
+        source = obs_outlier_injector(source, [1], [2],
+                                      magnitude=2e4)
+        source = stale_obs_injector(source, [2], [3])
+
+        ledger_path = os.path.join(directory, "assim_ledger.jsonl")
+        cfg = AssimConfig(steps_per_cycle=spc, dt=dt0,
+                          qc=_aqc.QCConfig(k_sigma=6.0))
+        cache = ExecutableCache()
+        probe = HealthProbe.for_integrator(integ)
+        with _obs.ledger(ledger_path):
+            with recorded("member_divergence", **member_inj):
+                cyc = AssimilationCycle(
+                    integ, op, B, cfg, probe=probe, cache=cache,
+                    fleet_step_wrap=lambda s:
+                        member_divergence_injector(s, **member_inj),
+                    recorder=FlightRecorder(capacity=4,
+                                            spec=factory_spec(
+                        "ibamr_tpu.models.shell3d",
+                        "build_shell_example", **kwargs)))
+                out = cyc.run(fleet0, batches, directory=directory,
+                              obs_source=source, max_retries=1)
+
+        # -- 2. the diverged member is quarantined, stats exclude it --
+        if cyc.driver.lane_alive[BAD]:
+            raise AssertionError("diverged member never quarantined")
+        if not all(cyc.driver.lane_alive[i] for i in range(B)
+                   if i != BAD):
+            raise AssertionError("a healthy member was quarantined")
+
+        records = list(_obs.read_ledger(ledger_path))
+
+        # -- 1. exactly the injected bad observations were rejected ---
+        rej = {(r["cycle"], r["instrument"], r["reason"])
+               for r in records if r.get("kind") == "assim_qc_reject"}
+        if not injected <= rej:
+            raise AssertionError(
+                f"injected bad observations not all rejected: "
+                f"missing {injected - rej}")
+        extra = rej - injected
+        if extra:
+            raise AssertionError(
+                f"QC rejected healthy observations: {extra}")
+
+        # -- 3. zero lost cycles --------------------------------------
+        cyc_recs = [r for r in records
+                    if r.get("kind") == "assim_cycle"]
+        done = {r["cycle"] for r in cyc_recs}
+        if done != set(range(n_cyc)):
+            raise AssertionError(
+                f"lost cycles: {sorted(set(range(n_cyc)) - done)}")
+        analyzed = [r for r in cyc_recs if not r.get("skipped")]
+        if not analyzed:
+            raise AssertionError("no cycle ever analyzed")
+
+        # -- 5. zero retraces / zero steady-state compiles ------------
+        if any(c != 1 for c in cyc.driver.trace_counts.values()):
+            raise AssertionError(
+                f"fleet chunk retraced: {cyc.driver.trace_counts}")
+        stats = cache.stats()
+        if stats["misses"] != 2:
+            raise AssertionError(
+                f"expected exactly 2 analysis compiles (observe + "
+                f"analyze), got {stats['misses']}")
+
+        # -- 4. the filter beats the open-loop ensemble ---------------
+        # open loop: same fleet, same member fault, no analysis
+        ol_cfg = AssimConfig(steps_per_cycle=spc, dt=dt0)
+        ol = AssimilationCycle(
+            integ, op, B, ol_cfg, probe=HealthProbe.for_integrator(integ),
+            cache=ExecutableCache(),
+            fleet_step_wrap=lambda s:
+                member_divergence_injector(s, **member_inj))
+        ol_dir = os.path.join(directory, "open_loop")
+        os.makedirs(ol_dir, exist_ok=True)
+        ol_out = ol.run(fleet0, directory=ol_dir, n_cycles=n_cyc,
+                        obs_source=lambda c, s: None, max_retries=1)
+
+        def _forecast_err(fleet_state, alive, batch):
+            pred = np.asarray(jax.vmap(op)(fleet_state))
+            ybar, _, _ = masked_moments(jnp.asarray(pred),
+                                        jnp.asarray(alive))
+            d = np.asarray(batch.values) - np.asarray(ybar)
+            d = d[np.isfinite(d)]
+            return float(np.sqrt(np.mean(d * d)))
+
+        clean_final = batches[-1]
+        err_assim = _forecast_err(out, cyc.driver.lane_alive,
+                                  clean_final)
+        err_open = _forecast_err(ol_out, ol.driver.lane_alive,
+                                 clean_final)
+        if not err_assim < err_open:
+            raise AssertionError(
+                f"assimilation did not beat the open loop: "
+                f"{err_assim:.3e} vs {err_open:.3e}")
+
+        # land the drill verdict in the ledger itself (append-only:
+        # reopening continues the seq) — tools/slo.py check --assim
+        # computes its SLIs from the ledger ALONE, and the
+        # open-loop baseline exists nowhere else
+        with _obs.ledger(ledger_path):
+            _obs.emit("assim_summary", cycles=n_cyc, fleet_size=B,
+                      bad_lane=BAD, forecast_error=err_assim,
+                      open_loop_error=err_open,
+                      analysis_compiles=stats["misses"],
+                      analysis_cache_hits=stats["hits"],
+                      final_inflation=cyc.inflation,
+                      inflation_escalations=len(cyc.escalations))
+
+        return {"assim_smoke": "ok", "fleet_size": B,
+                "bad_lane": BAD, "cycles": n_cyc,
+                "qc_rejections": sorted(
+                    [list(t) for t in rej]),
+                "lost_cycles": 0,
+                "analysis_compiles": stats["misses"],
+                "analysis_cache_hits": stats["hits"],
+                "forecast_error": float(f"{err_assim:.6e}"),
+                "open_loop_error": float(f"{err_open:.6e}"),
+                "final_inflation": cyc.inflation,
+                "ledger": ledger_path,
+                "wall_s": round(time.perf_counter() - t_all, 3)}
+    finally:
+        if tmp is not None:
+            tmp.cleanup()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="deterministic fault-injection drills")
@@ -2330,6 +2660,11 @@ def main(argv=None) -> int:
                     help="run the elastic warm-pool drill (mix shift "
                          "+ memory pressure -> grow/brownout/shrink + "
                          "crash-safe restart)")
+    ap.add_argument("--assim-smoke", action="store_true",
+                    help="run the fault-tolerant ensemble data "
+                         "assimilation drill (QC-rejected bad "
+                         "sensors, quarantined divergent member, "
+                         "zero lost cycles, filter beats open loop)")
     ap.add_argument("--design-smoke", action="store_true",
                     help="run the inverse-design drill (eel2d gait "
                          "objective: FD-checked adjoint, strict Adam "
@@ -2397,6 +2732,13 @@ def main(argv=None) -> int:
         from ibamr_tpu.utils.backend_guard import force_cpu
         force_cpu(1)
         print(json.dumps(run_design_smoke(args.dir)), flush=True)
+        return 0
+    if args.assim_smoke:
+        # tiny f64 twin experiment — one CPU device; the drill
+        # enables x64 itself (deterministic filter pins need it)
+        from ibamr_tpu.utils.backend_guard import force_cpu
+        force_cpu(1)
+        print(json.dumps(run_assim_smoke(args.dir)), flush=True)
         return 0
     if args.record_capsule:
         record_capsule_drill(args.record_capsule)

@@ -503,6 +503,83 @@ def render_design(snap, records: list) -> list:
     return lines
 
 
+def render_assim(snap, records: list) -> list:
+    """Assimilation block (PR 20): forecast-error trajectory and
+    spread trend from the ``assim_cycle`` records, QC rejections by
+    reason from the labelled counter (record fallback), inflation
+    escalations from the supervisor's incident stream, and the drill
+    verdict (``assim_summary``) when one landed. Empty when the run
+    never assimilated."""
+    cycles = [r for r in records if r.get("kind") == "assim_cycle"]
+    rejects = [r for r in records
+               if r.get("kind") == "assim_qc_reject"]
+    summaries = [r for r in records
+                 if r.get("kind") == "assim_summary"]
+    if not (cycles or rejects or summaries):
+        return []
+    lines = []
+    analyzed = [r for r in cycles if not r.get("skipped")]
+    if cycles:
+        lines.append(f"  cycles: {len(cycles)} "
+                     f"({len(analyzed)} analyzed, "
+                     f"{len(cycles) - len(analyzed)} skipped)")
+    errs = [r["forecast_error"] for r in analyzed
+            if r.get("forecast_error") is not None]
+    if errs:
+        shown = (errs if len(errs) <= 6
+                 else errs[:3] + [None] + errs[-2:])
+        traj = " -> ".join("..." if e is None else f"{e:.3e}"
+                           for e in shown)
+        lines.append(f"  forecast error: {traj}")
+    spreads = [(r.get("spread_f"), r.get("spread_a"))
+               for r in analyzed if r.get("spread_f") is not None]
+    if spreads:
+        f0, a0 = spreads[0]
+        fl, al = spreads[-1]
+        lines.append(f"  spread (forecast/analysis): "
+                     f"{f0:.3e}/{a0:.3e} -> {fl:.3e}/{al:.3e}")
+    if analyzed and analyzed[-1].get("consistency") is not None:
+        lines.append(f"  innovation consistency (last): "
+                     f"{analyzed[-1]['consistency']:.3f} "
+                     f"(1 = spread matches error)")
+
+    # QC rejections by reason: the counter labels are authoritative;
+    # the structured reject records are the fallback
+    by_reason: dict = {}
+    for k, v in ((snap or {}).get("counters") or {}).items():
+        if k.startswith("assim_qc_rejections_total"):
+            m = _REASON_RE.search(k)
+            by_reason[m.group(1) if m else "?"] = int(v)
+    if not by_reason:
+        for r in rejects:
+            key = r.get("reason") or "?"
+            by_reason[key] = by_reason.get(key, 0) + 1
+    if by_reason:
+        detail = ", ".join(f"{k}={n}"
+                           for k, n in sorted(by_reason.items()))
+        lines.append(f"  qc rejections: {sum(by_reason.values())} "
+                     f"({detail})")
+    escal = [r for r in records
+             if r.get("kind") == "incident"
+             and r.get("event") == "inflation_escalation"]
+    if escal:
+        ladder = " -> ".join(
+            [f"{escal[0].get('inflation_before')}"]
+            + [f"{r.get('inflation_after')}" for r in escal])
+        lines.append(f"  inflation escalations: {len(escal)} "
+                     f"({ladder})")
+    elif analyzed:
+        lines.append(f"  inflation (last): "
+                     f"{analyzed[-1].get('inflation')}")
+    for r in summaries:
+        fe, ol = r.get("forecast_error"), r.get("open_loop_error")
+        if fe is not None and ol:
+            lines.append(f"  drill verdict: forecast {fe:.3e} vs "
+                         f"open-loop {ol:.3e} "
+                         f"({ol / fe:.1f}x better)")
+    return lines
+
+
 def render_incidents(records: list, t0=None) -> list:
     lines = []
     for rec in records:
@@ -757,6 +834,11 @@ def cmd_summary(args) -> int:
               "accounting):")
         for ln in design:
             print(ln)
+    assim = render_assim(last_counters(records), records)
+    if assim:
+        print("\nassimilation (filter health, QC, forecast skill):")
+        for ln in assim:
+            print(ln)
     print("\nincidents:")
     t0 = min(times) if times else None
     for ln in render_incidents(records, t0):
@@ -834,6 +916,24 @@ def _one_line(rec: dict) -> str:
                 f"{_fmt_s(rec.get('warm_s'))} "
                 f"fresh={rec.get('fresh_compiles')} "
                 f"persistent={rec.get('persistent_loads')}")
+    if kind == "assim_cycle":
+        if rec.get("skipped"):
+            return (f"seq={rec['seq']:<6} assim     "
+                    f"cycle={rec.get('cycle')} step={rec.get('step')} "
+                    f"SKIPPED accepted={rec.get('accepted')} "
+                    f"rejected={rec.get('rejected')}")
+        return (f"seq={rec['seq']:<6} assim     "
+                f"cycle={rec.get('cycle')} step={rec.get('step')} "
+                f"err={rec.get('forecast_error'):.3e} "
+                f"spread={rec.get('spread_a'):.3e} "
+                f"infl={rec.get('inflation')} "
+                f"alive={rec.get('n_alive')} "
+                f"wall={_fmt_s(rec.get('analysis_wall_s'))}")
+    if kind == "assim_qc_reject":
+        return (f"seq={rec['seq']:<6} qc_reject "
+                f"cycle={rec.get('cycle')} "
+                f"{rec.get('instrument')} reason={rec.get('reason')} "
+                f"innovation={rec.get('innovation')}")
     if kind == "device_time":
         return (f"seq={rec['seq']:<6} device    "
                 f"{_fmt_s(rec.get('total_device_s'))} device, "
@@ -962,6 +1062,22 @@ def render_trace(records: list, tid: str) -> list:
                     f"{rec.get('mode')} "
                     f"queue_p99={_fmt_s(rec.get('queue_p99_s'))} "
                     f"backlog={rec.get('backlog')}")
+        elif kind == "assim_cycle":
+            if rec.get("skipped"):
+                desc = (f"assim cycle #{rec.get('cycle')}  SKIPPED "
+                        f"(accepted={rec.get('accepted')} of "
+                        f"{(rec.get('accepted') or 0) + (rec.get('rejected') or 0)})")
+            else:
+                desc = (f"assim cycle #{rec.get('cycle')}  "
+                        f"err={rec.get('forecast_error'):.3e} "
+                        f"spread={rec.get('spread_a'):.3e} "
+                        f"infl={rec.get('inflation')} "
+                        f"alive={rec.get('n_alive')} "
+                        f"wall={_fmt_s(rec.get('analysis_wall_s'))}")
+        elif kind == "assim_qc_reject":
+            desc = (f"QC REJECT        {rec.get('instrument')} "
+                    f"reason={rec.get('reason')} "
+                    f"innovation={rec.get('innovation')}")
         else:
             body = {k: v for k, v in rec.items()
                     if k not in ("seq", "run_id", "t", "kind",

@@ -472,6 +472,37 @@ def main() -> int:
                     g.write(r11.stdout or "")
             except subprocess.TimeoutExpired:
                 log(f, "elastic drill timed out")
+            # thirteenth step (PR 20): the clean assimilation cadence
+            # — per-cycle analysis wall vs the chunk cadence and
+            # cycles/s for a small and a large ensemble in a CPU
+            # child; a between-chunk cost regression (retrace, host
+            # sync in the gain) is trended per healthy window next to
+            # the soak/elastic legs.
+            try:
+                r13 = subprocess.run(
+                    [sys.executable, "-c",
+                     "import json; "
+                     "from bench import assim_reference; "
+                     "print(json.dumps(assim_reference()))"],
+                    capture_output=True, text=True, cwd=REPO, env=env,
+                    timeout=args.bench_timeout)
+                tail = ""
+                try:
+                    asm = json.loads(r13.stdout or "{}")
+                    if asm.get("legs"):
+                        tail = "  " + " ".join(
+                            f"B={g['lanes']}:"
+                            f"{g['analysis_wall_steady_s']}s/"
+                            f"{g['cycles_per_s']}cyc/s"
+                            for g in asm["legs"])
+                except ValueError:
+                    pass
+                log(f, f"assim cadence rc={r13.returncode}{tail}")
+                with open(args.out.replace(".json", "_assim.json"),
+                          "w") as g:
+                    g.write(r13.stdout or "")
+            except subprocess.TimeoutExpired:
+                log(f, "assim cadence timed out")
             # fifth step (PR 10): archive each profile capture — the
             # attribution summary is the regression-comparable
             # artifact; the raw multi-MB traces are pruned ONLY after

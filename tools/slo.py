@@ -67,6 +67,27 @@ contract's ``elastic_slos`` section
 ``--elastic --tighten`` merges a fresh ``elastic_slos`` section, same
 discipline as soak.
 
+Assimilation mode (PR 20): ``slo.py check --assim`` runs the chaos
+assimilation drill (``tools.fault_injection.run_assim_smoke`` — all
+four observation/member injectors armed at once against the
+supervised ensemble filter) and evaluates the ASSIM SLIs against the
+contract's ``assim_slos`` section
+(:func:`assim_slis_from_ledger`):
+
+- ``assim_lost_cycles`` — observation cycles with no ``assim_cycle``
+  ledger record, derived by joining the ``assim_summary`` expected
+  count against the cycle stream (budgeted at EXACTLY 0 — a rollback
+  that silently drops an analysis is the failure mode this pins);
+- ``assim_forecast_error_ratio`` — final forecast error over the
+  open-loop (no-assimilation) baseline from the same drill; any
+  ceiling below 1.0 IS the "assimilation helps" claim;
+- ``assim_analysis_wall_p99_s`` — p99 analysis wall time per cycle
+  (histogram snapshot when one landed, else empirical from the
+  ``assim_cycle`` records).
+
+``--assim --tighten`` merges a fresh ``assim_slos`` section, same
+discipline as soak/elastic.
+
 Exit convention (the ``graph_audit`` family, with one deliberate
 difference): **headroom under a ceiling is attainment, not drift** —
 a warm p99 far below budget is the system working, so it exits 0, not
@@ -116,6 +137,16 @@ ELASTIC_SLI_NAMES = ("elastic_scale_up_latency_s",
                      "elastic_lost_requests",
                      "elastic_mode_transitions",
                      "elastic_interactive_p99_s")
+
+# assimilation SLIs (PR 20): the forecasting-service invariants of
+# the chaos assimilation drill, evaluated against the contract's
+# separate "assim_slos" section. All ceilings; lost cycles pin at
+# EXACTLY 0 and the error ratio's ceiling sits below 1.0 by
+# construction (beating the open loop is the product claim).
+ASSIM_SLI_NAMES = ("assim_lost_cycles",
+                   "assim_forecast_error_ratio",
+                   "assim_analysis_wall_p99_s")
+_AWALL_KEY = "assim_analysis_wall_seconds"
 
 
 def _last_histograms(records) -> dict:
@@ -321,6 +352,49 @@ def elastic_slis_from_ledger(records) -> dict:
     return slis
 
 
+def assim_slis_from_ledger(records) -> dict:
+    """Assimilation SLIs from an assimilation-drill (or production)
+    ledger. Lost cycles come from joining the ``assim_summary``
+    record's expected-cycle count against the observed
+    ``assim_cycle`` stream — self-reported verdicts are NOT trusted;
+    the forecast-error ratio has to come from the summary because the
+    open-loop baseline runs outside the ledger. Absent SLIs are
+    ``None``."""
+    from ibamr_tpu.obs.bus import quantiles_from_counts
+
+    records = list(records)
+    cycles = [r for r in records if r.get("kind") == "assim_cycle"]
+    summaries = [r for r in records
+                 if r.get("kind") == "assim_summary"]
+    hists = _last_histograms(records)
+
+    slis: dict = {name: None for name in ASSIM_SLI_NAMES}
+
+    if summaries:
+        last = summaries[-1]
+        expected = last.get("cycles")
+        if expected is not None:
+            done = {r.get("cycle") for r in cycles}
+            slis["assim_lost_cycles"] = sum(
+                1 for c in range(int(expected)) if c not in done)
+        fe, ol = last.get("forecast_error"), last.get("open_loop_error")
+        if fe is not None and ol:
+            slis["assim_forecast_error_ratio"] = float(fe) / float(ol)
+
+    snap = hists.get(_AWALL_KEY)
+    if snap and snap.get("count"):
+        slis["assim_analysis_wall_p99_s"] = quantiles_from_counts(
+            snap["counts"], [0.99])[0]
+    else:
+        walls = [r["analysis_wall_s"] for r in cycles
+                 if not r.get("skipped")
+                 and r.get("analysis_wall_s") is not None]
+        if walls:
+            slis["assim_analysis_wall_p99_s"] = _empirical_quantile(
+                walls, 0.99)
+    return slis
+
+
 def load_contract(path: str = CONTRACT_PATH) -> dict:
     with open(path) as f:
         doc = json.load(f)
@@ -472,6 +546,58 @@ def run_elastic_drill(args, directory: str) -> dict:
                              shift_frac=args.elastic_shift_frac)
 
 
+def run_assim_drill(args, directory: str) -> dict:
+    """Run the chaos assimilation drill in ``directory``; the drill
+    owns its own attached ledger (``<directory>/assim_ledger.jsonl``)
+    and raises on any broken invariant (unrejected bad obs,
+    unquarantined member, lost cycle, retrace) before the SLO layer
+    even evaluates."""
+    if args.backend == "device":
+        from ibamr_tpu.utils.backend_guard import init_backend_with_retry
+        _jax, _platform, err = init_backend_with_retry(retries=1,
+                                                       delay=2.0)
+        if err:
+            print(f"[slo] backend init degraded: {err}",
+                  file=sys.stderr)
+    else:
+        from ibamr_tpu.utils.backend_guard import force_cpu
+        force_cpu()
+    from tools.fault_injection import run_assim_smoke
+
+    return run_assim_smoke(directory,
+                           fleet_size=args.assim_fleet,
+                           cycles=args.assim_cycles)
+
+
+def tighten_assim(slis: dict, assim_cfg: dict, contract_path: str):
+    """Merge a fresh ``assim_slos`` section (plus the drill cfg) into
+    the existing contract, leaving every other section untouched.
+    Lost cycles pin EXACTLY (zero is the invariant); the error-ratio
+    ceiling gets 4x slack but is clamped BELOW 1.0 — a contract that
+    tolerated losing to the open loop would not be a forecasting SLO;
+    the wall ceiling gets 3x slack floored at 0.5 s (the p99 of a
+    short drill IS the first cycle, which pays the one-time AOT
+    compile — noisier than a steady-state latency)."""
+    assim_slos = {}
+    if slis.get("assim_lost_cycles") is not None:
+        assim_slos["assim_lost_cycles"] = {
+            "ceiling": int(slis["assim_lost_cycles"])}
+    if slis.get("assim_forecast_error_ratio") is not None:
+        assim_slos["assim_forecast_error_ratio"] = {"ceiling": round(
+            min(max(4.0 * slis["assim_forecast_error_ratio"], 0.25),
+                0.9), 4)}
+    if slis.get("assim_analysis_wall_p99_s") is not None:
+        assim_slos["assim_analysis_wall_p99_s"] = {"ceiling": round(
+            max(3.0 * slis["assim_analysis_wall_p99_s"], 0.5), 4)}
+    try:
+        doc = load_contract(contract_path)
+    except FileNotFoundError:
+        doc = {"slo_schema": SLO_SCHEMA, "slos": {}}
+    doc["assim"] = assim_cfg
+    doc["assim_slos"] = assim_slos
+    return doc
+
+
 def tighten_elastic(slis: dict, elastic_cfg: dict,
                     contract_path: str):
     """Merge a fresh ``elastic_slos`` section (plus the drill cfg)
@@ -536,6 +662,8 @@ def tighten_soak(slis: dict, soak_cfg: dict, contract_path: str):
 
 
 def cmd_check(args) -> int:
+    if getattr(args, "assim", False):
+        return _check_assim(args)
     if getattr(args, "elastic", False):
         return _check_elastic(args)
     if getattr(args, "soak", False):
@@ -601,6 +729,71 @@ def cmd_check(args) -> int:
                1: "unevaluable — missing contract or SLI "
                   "(run --tighten to pin)",
                2: "VIOLATED — the serving path is out of SLO"}[rc]
+    print(f"[slo] {verdict}")
+    return rc
+
+
+def _check_assim(args) -> int:
+    """The ``check --assim`` path: assimilation SLIs vs the
+    contract's ``assim_slos`` section, same exit convention as the
+    cold/warm check. Without ``--ledger`` the chaos assimilation
+    drill runs first — its own pinned invariants (every injected bad
+    obs rejected, the diverged member quarantined, zero lost cycles,
+    zero retraces, filter beats open loop) raise before the budget is
+    even consulted, so exit 2 here means a BUDGET regression on a
+    drill that still satisfies the hard invariants."""
+    from ibamr_tpu.obs.bus import read_ledger
+
+    if args.ledger:
+        records = read_ledger(args.ledger)
+        assim_cfg = {"source": args.ledger}
+    else:
+        with tempfile.TemporaryDirectory(prefix="slo-assim-") as td:
+            run_assim_drill(args, td)
+            records = read_ledger(
+                os.path.join(td, "assim_ledger.jsonl"))
+        assim_cfg = {"fleet_size": args.assim_fleet,
+                     "cycles": args.assim_cycles}
+    slis = assim_slis_from_ledger(records)
+
+    if args.tighten:
+        doc = tighten_assim(slis, assim_cfg, args.contract)
+        with open(args.contract, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"[slo] wrote {args.contract} (assim_slos)")
+        return 0
+
+    try:
+        contract = load_contract(args.contract)
+    except FileNotFoundError:
+        contract = None
+    budget = (contract or {}).get("assim_slos")
+    if not budget:
+        violations, unmeasurable, met = [], [], []
+    else:
+        violations, unmeasurable, met = evaluate(slis, {"slos": budget})
+    unbudgeted = not budget
+    rc = (2 if violations
+          else 1 if unmeasurable or unbudgeted
+          else 0)
+    if args.as_json:
+        print(json.dumps({
+            "exit": rc, "slis": slis,
+            "violated": violations, "unmeasurable": unmeasurable,
+            "met": met, "unbudgeted": unbudgeted},
+            indent=1, sort_keys=True))
+        return rc
+    for line in violations + unmeasurable + met:
+        print(f"[slo] {line}")
+    if unbudgeted:
+        print(f"[slo] no assim_slos in {args.contract} — run "
+              f"--assim --tighten to pin")
+    verdict = {0: "clean — every assimilation SLO attained",
+               1: "unevaluable — missing assim_slos or SLI (run "
+                  "--assim --tighten to pin)",
+               2: "VIOLATED — the forecasting service is out of "
+                  "SLO"}[rc]
     print(f"[slo] {verdict}")
     return rc
 
@@ -792,6 +985,15 @@ def main(argv=None) -> int:
                         "rotates to the unseen family")
     c.add_argument("--elastic-time-scale", type=float, default=0.5,
                    help="wall seconds per virtual second")
+    c.add_argument("--assim", action="store_true",
+                   help="run the chaos assimilation drill (all four "
+                        "obs/member injectors armed) and evaluate "
+                        "the assim_slos section")
+    c.add_argument("--assim-fleet", type=int, default=6,
+                   help="ensemble size B for the assimilation drill")
+    c.add_argument("--assim-cycles", type=int, default=6,
+                   help="observation cycles in the assimilation "
+                        "drill")
     c.add_argument("--tighten", action="store_true",
                    help="rewrite the contract from the measured SLIs "
                         "(with slack on latency/ratio budgets)")
